@@ -1,0 +1,10 @@
+"""karpenter_tpu — a TPU-native node-provisioning framework.
+
+Re-implements the capabilities of Karpenter (reference at /root/reference,
+see SURVEY.md) with the scheduling core — first-fit-decreasing bin-packing and
+the consolidation repack search — expressed as vectorized constraint
+satisfaction over a (pod-groups x node-candidates x topology-domains) tensor,
+compiled by JAX/XLA for TPU.
+"""
+
+__version__ = "0.1.0"

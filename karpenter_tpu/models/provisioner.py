@@ -1,0 +1,93 @@
+"""Provisioner — per-pool provisioning policy.
+
+Models the core Provisioner CRD
+(/root/reference/pkg/apis/crds/karpenter.sh_provisioners.yaml:37-315): layered
+requirements, taints/startup taints, labels stamped on nodes, resource limits,
+TTLs, consolidation flag, and weight (priority among provisioners,
+scheduling.md:435-525).  AWS-overlay defaulting (linux/amd64/on-demand,
+categories c,m,r gen>2 — pkg/apis/v1alpha5/provisioner.go:55-85) is applied by
+``with_defaults``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from . import labels as L
+from .pod import PodSpec, Taint, Toleration
+from .requirements import GT, IN, NOT_IN, Requirement, Requirements
+from .resources import ResourceList
+
+
+@dataclass
+class Provisioner:
+    name: str = "default"
+    requirements: List[Requirement] = field(default_factory=list)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    limits: ResourceList = field(default_factory=dict)  # sparse caps on total provisioned
+    weight: int = 0  # higher tried first (scheduling.md:435-525)
+    consolidation_enabled: bool = False
+    ttl_seconds_after_empty: Optional[float] = None
+    ttl_seconds_until_expired: Optional[float] = None
+    node_template: str = "default"  # providerRef analog
+
+    def with_defaults(self) -> "Provisioner":
+        """AWS-overlay defaulting (provisioner.go:55-85): OS/arch/capacity-type
+        defaults plus generic instance-category defaults when the user left the
+        instance dimension unconstrained."""
+        reqs = {r.key for r in self.requirements}
+        extra: List[Requirement] = []
+        if L.OS not in reqs:
+            extra.append(Requirement(L.OS, IN, [L.OS_LINUX]))
+        if L.ARCH not in reqs:
+            extra.append(Requirement(L.ARCH, IN, [L.ARCH_AMD64]))
+        if L.CAPACITY_TYPE not in reqs:
+            extra.append(Requirement(L.CAPACITY_TYPE, IN, [L.CAPACITY_TYPE_ON_DEMAND]))
+        if not reqs & {L.INSTANCE_TYPE, L.INSTANCE_FAMILY, L.INSTANCE_CATEGORY}:
+            extra.append(Requirement(L.INSTANCE_CATEGORY, IN, ["c", "m", "r"]))
+            extra.append(Requirement(L.INSTANCE_GENERATION, GT, ["2"]))
+        out = Provisioner(**self.__dict__)
+        out.requirements = list(self.requirements) + extra
+        out.taints = list(self.taints)
+        out.startup_taints = list(self.startup_taints)
+        out.labels = dict(self.labels)
+        out.limits = dict(self.limits)
+        return out
+
+    def scheduling_requirements(self) -> Requirements:
+        """Provisioner-level requirement layer (labels become In-requirements)."""
+        reqs = Requirements(self.requirements)
+        for k, v in self.labels.items():
+            reqs.add(Requirement(k, IN, [v]))
+        reqs.add(Requirement(L.PROVISIONER_NAME, IN, [self.name]))
+        return reqs
+
+    def tolerates(self, pod: PodSpec) -> bool:
+        """Pod must tolerate every hard provisioner taint (scheduling.md:256-301).
+        Startup taints are ignored for scheduling (they're removed post-boot)."""
+        return not any(t.blocks(pod.tolerations) for t in self.taints)
+
+    def validate(self) -> List[str]:
+        """Static validation mirroring the v1alpha5 webhook rules."""
+        errs: List[str] = []
+        for k in self.labels:
+            dom = k.split("/")[0] if "/" in k else ""
+            if any(dom == d or dom.endswith("." + d) for d in L.RESTRICTED_DOMAINS):
+                if k not in L.ALLOWED_IN_RESTRICTED:
+                    errs.append(f"label {k!r} in restricted domain")
+        for t in self.taints + self.startup_taints:
+            if not t.key:
+                errs.append("taint with empty key")
+            if t.effect not in (L.EFFECT_NO_SCHEDULE, L.EFFECT_PREFER_NO_SCHEDULE, L.EFFECT_NO_EXECUTE):
+                errs.append(f"taint {t.key!r}: bad effect {t.effect!r}")
+        for r in self.requirements:
+            dom = r.key.split("/")[0] if "/" in r.key else ""
+            if any(dom == d or dom.endswith("." + d) for d in L.RESTRICTED_DOMAINS):
+                if r.key not in L.ALLOWED_IN_RESTRICTED and not r.key.startswith("karpenter.k8s.tpu/"):
+                    errs.append(f"requirement key {r.key!r} in restricted domain")
+        if self.weight < 0 or self.weight > 100:
+            errs.append(f"weight {self.weight} outside [0,100]")
+        return errs

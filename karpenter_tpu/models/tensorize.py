@@ -1,0 +1,407 @@
+"""Lower pods x provisioners x instance types into solver tensors.
+
+This is the bridge between the k8s-object world (models/*) and the TPU solver
+(solver/tpu.py).  Axes:
+
+- **G** — deduplicated pod groups (pods with identical constraints+requests),
+  sorted in FFD order (decreasing magnitude).  50k pods from deployments
+  typically collapse to O(100) groups; heterogeneous pods degrade to G == P
+  and the solver still works, just with a longer scan.
+- **C** — node candidates = compatible (provisioner, instance-type) pairs.
+  Provisioner requirements are folded in host-side: incompatible pairs are
+  dropped, provisioner labels override type labels.
+- **D** — topology domains = zone x capacity-type combos.  Hostname domains
+  are *not* an axis (one per node, created during the solve — SURVEY §7 "hard
+  parts"); they are handled by per-row counters in the solver.
+- **R** — resource vocabulary.
+- **K/W** — label keys and packed mask words (models/vocab.py).
+- **S** — interned (selector, topology-key, kind) constraint slots for
+  topology-spread and pod (anti-)affinity.
+
+Everything emitted is a dense numpy array, ready to become a jnp array.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import labels as L
+from .instancetype import InstanceType, Offering
+from .pod import LabelSelector, PodAffinityTerm, PodSpec, TopologySpreadConstraint
+from .provisioner import Provisioner
+from .requirements import Requirement, Requirements
+from .vocab import ABSENT, Vocab
+
+# Baseline resources every solve carries, in a stable order.
+CORE_RESOURCES = (L.RESOURCE_CPU, L.RESOURCE_MEMORY, L.RESOURCE_EPHEMERAL_STORAGE, L.RESOURCE_PODS)
+
+NO_SELECTOR = -1
+
+
+@dataclass
+class PodGroup:
+    """One dedup'd slice of the pending-pod set."""
+
+    key: tuple
+    pods: List[PodSpec]
+    requirements: Requirements  # pod-level (first required term; OR-terms beyond 1 split groups)
+    requests: Dict[str, float]
+
+    @property
+    def count(self) -> int:
+        return len(self.pods)
+
+
+@dataclass
+class ConstraintSlots:
+    """Interned topology/affinity constraint table (the S axis)."""
+
+    selectors: List[Tuple[LabelSelector, str, str]] = field(default_factory=list)  # (sel, topo, kind)
+    index: Dict[tuple, int] = field(default_factory=dict)
+
+    def intern(self, sel: LabelSelector, topology_key: str, kind: str) -> int:
+        key = (sel, topology_key, kind)
+        sid = self.index.get(key)
+        if sid is None:
+            sid = len(self.selectors)
+            self.selectors.append((sel, topology_key, kind))
+            self.index[key] = sid
+        return sid
+
+    def __len__(self) -> int:
+        return len(self.selectors)
+
+
+@dataclass
+class SolveTensors:
+    """Everything the TPU solver consumes.  See module docstring for axes."""
+
+    vocab: Vocab
+    groups: List[PodGroup]
+
+    # group axis (FFD-sorted)
+    counts: np.ndarray       # [G] int32
+    requests: np.ndarray     # [G, R] f32 — per-pod requests (pods resource == 1)
+    pm: np.ndarray           # [G, K, W] uint32 requirement masks
+    magnitude: np.ndarray    # [G] f32 FFD sort key
+
+    # spread / affinity per group (slot id or NO_SELECTOR)
+    g_zone_spread: np.ndarray   # [G] int32 slot id
+    g_zone_skew: np.ndarray     # [G] int32 maxSkew
+    g_host_spread: np.ndarray   # [G] int32 (covers hostname spread AND hostname anti-affinity)
+    g_host_cap: np.ndarray      # [G] int32 max matching pods per node (maxSkew; 1 for anti-affinity)
+    g_zone_anti: np.ndarray     # [G] int32 zone-scoped anti-affinity slot
+    g_sel_match: np.ndarray     # [S, G] bool — group's pods match selector s
+
+    # candidate axis
+    cand_names: List[Tuple[str, str]]   # (provisioner, instance type)
+    cand_alloc: np.ndarray   # [C, R] f32 allocatable
+    cand_vw: np.ndarray      # [C, K] int32 (value-id // 32)
+    cand_vb: np.ndarray      # [C, K] int32 (value-id % 32)
+    cand_prov: np.ndarray    # [C] int32
+    cand_price: np.ndarray   # [C, D] f32 ($/hr; +inf where no offering)
+    cand_avail: np.ndarray   # [C, D] bool
+    key_check: np.ndarray    # [K] bool — keys checked on the C axis (zone/ct excluded)
+    gp_ok: np.ndarray        # [G, P] bool — group tolerates prov taints & reqs intersect
+
+    # provisioner axis
+    prov_names: List[str]
+    prov_weight: np.ndarray  # [P] f32
+    prov_limits: np.ndarray  # [P, R] f32 (+inf where unset)
+
+    # domain axis
+    dom_zone: np.ndarray     # [D] int32 zone ordinal
+    dom_vw: np.ndarray       # [D, 2] int32 packed word idx for (zone key, ct key)
+    dom_vb: np.ndarray       # [D, 2] int32 bit idx
+    zone_names: List[str]
+    n_zones: int
+
+    @property
+    def G(self) -> int:
+        return len(self.counts)
+
+    @property
+    def C(self) -> int:
+        # cand_* arrays are padded to >=1 row so jit shapes stay valid; the
+        # padding row is inert (avail all-False) and not a real candidate
+        return len(self.cand_names)
+
+    @property
+    def D(self) -> int:
+        return len(self.dom_zone)
+
+    @property
+    def R(self) -> int:
+        return self.requests.shape[1]
+
+    @property
+    def S(self) -> int:
+        return self.g_sel_match.shape[0]
+
+
+def _ffd_magnitude(requests: Mapping[str, float]) -> float:
+    """Deterministic FFD sort key: CPU cores + memory scaled at 4GiB/core +
+    GPU heavily weighted.  Both solvers (oracle + TPU) share this exact key,
+    per designs/bin-packing.md step 1 ("non-increasing order of resources")."""
+    cpu = requests.get(L.RESOURCE_CPU, 0.0)
+    mem = requests.get(L.RESOURCE_MEMORY, 0.0) / (4.0 * 1024.0**3)
+    gpu = requests.get(L.RESOURCE_GPU, 0.0) * 64.0
+    return cpu + mem + gpu
+
+
+def group_pods(pods: Sequence[PodSpec]) -> List[PodGroup]:
+    """Dedup pods into interchangeable groups, FFD-sorted (desc magnitude).
+
+    Pods with multiple OR'd required-affinity terms use only their first term
+    for grouping (v1 limitation: OR-terms beyond the first are not explored;
+    the reference relaxes through terms similarly).
+    """
+    by_key: Dict[tuple, PodGroup] = {}
+    for p in pods:
+        k = p.group_key()
+        grp = by_key.get(k)
+        if grp is None:
+            reqs = p.scheduling_requirements()[0]
+            grp = PodGroup(key=k, pods=[], requirements=reqs, requests=dict(p.requests))
+            by_key[k] = grp
+        grp.pods.append(p)
+    groups = list(by_key.values())
+    groups.sort(key=lambda g: (-_ffd_magnitude(g.requests), g.pods[0].name))
+    return groups
+
+
+def build_candidates(
+    provisioners: Sequence[Provisioner],
+    instance_types: Sequence[InstanceType],
+) -> List[Tuple[int, Provisioner, InstanceType, Requirements]]:
+    """Compatible (provisioner, type) pairs with merged requirements.
+
+    Mirrors the host-side filter at cloudprovider.go:305-324 (machine
+    requirements x instance type requirements x offering availability).
+    Provisioners are ordered by weight desc (scheduling.md:435-525) before
+    pairing so candidate order encodes provisioner priority.
+    """
+    out = []
+    ordered = sorted(enumerate(provisioners), key=lambda ip: (-ip[1].weight, ip[1].name))
+    for pi, prov in ordered:
+        preqs = prov.scheduling_requirements()
+        for it in instance_types:
+            if preqs.intersects(it.requirements) is not None:
+                continue
+            merged = it.requirements.copy().add(preqs)
+            out.append((pi, prov, it, merged))
+    return out
+
+
+def tensorize(
+    pods: Sequence[PodSpec],
+    provisioners: Sequence[Provisioner],
+    instance_types: Sequence[InstanceType],
+    *,
+    vocab: Optional[Vocab] = None,
+    unavailable: Optional[set] = None,  # {(instance_type, zone, capacity_type)} ICE-style mask
+) -> SolveTensors:
+    vocab = vocab or Vocab()
+    unavailable = unavailable or set()
+    groups = group_pods(pods)
+    pairs = build_candidates(provisioners, instance_types)
+
+    # ---- pass 1: intern everything ------------------------------------
+    for r in CORE_RESOURCES:
+        vocab.resource(r)
+    zone_set: Dict[str, int] = {}
+    ct_set: Dict[str, int] = {}
+    for _, prov, it, merged in pairs:
+        for req in merged.to_list():
+            vocab.key(req.key)  # valueless operators (Exists/DoesNotExist) too
+            for v in req.values:
+                vocab.value(req.key, v)
+        for o in it.offerings:
+            zone_set.setdefault(o.zone, len(zone_set))
+            ct_set.setdefault(o.capacity_type, len(ct_set))
+            vocab.value(L.ZONE, o.zone)
+            vocab.value(L.CAPACITY_TYPE, o.capacity_type)
+        for rname in it.capacity:
+            vocab.resource(rname)
+    for g in groups:
+        for req in g.requirements.to_list():
+            vocab.key(req.key)
+            for v in req.values:
+                vocab.value(req.key, v)
+        for rname in g.requests:
+            vocab.resource(rname)
+    zone_key = vocab.key(L.ZONE)
+    ct_key = vocab.key(L.CAPACITY_TYPE)
+
+    # ---- constraint slots ---------------------------------------------
+    slots = ConstraintSlots()
+    g_zone_spread = np.full(len(groups), NO_SELECTOR, dtype=np.int32)
+    g_zone_skew = np.ones(len(groups), dtype=np.int32)
+    g_host_spread = np.full(len(groups), NO_SELECTOR, dtype=np.int32)
+    g_host_cap = np.zeros(len(groups), dtype=np.int32)
+    g_zone_anti = np.full(len(groups), NO_SELECTOR, dtype=np.int32)
+    for gi, g in enumerate(groups):
+        rep = g.pods[0]
+        for tsc in rep.topology_spread:
+            if not tsc.hard:
+                continue  # ScheduleAnyway is advisory; v1 ignores soft spread
+            sid = slots.intern(tsc.label_selector, tsc.topology_key, "spread")
+            if tsc.topology_key == L.ZONE:
+                g_zone_spread[gi] = sid
+                g_zone_skew[gi] = tsc.max_skew
+            elif tsc.topology_key == L.HOSTNAME:
+                g_host_spread[gi] = sid
+                g_host_cap[gi] = tsc.max_skew
+        for term in rep.anti_affinity_terms():
+            sid = slots.intern(term.label_selector, term.topology_key, "anti")
+            if term.topology_key == L.HOSTNAME:
+                # one hostname slot per group: when both a hostname spread and
+                # a hostname anti-affinity exist, keep the stricter cap
+                # (anti-affinity caps at 1-if-self-match, encoded as 0 here)
+                if g_host_spread[gi] == NO_SELECTOR or g_host_cap[gi] > 1:
+                    g_host_spread[gi] = sid
+                    g_host_cap[gi] = 0
+            elif term.topology_key == L.ZONE:
+                g_zone_anti[gi] = sid
+
+    S = max(1, len(slots))
+    g_sel_match = np.zeros((S, len(groups)), dtype=bool)
+    for sid, (sel, _topo, _kind) in enumerate(slots.selectors):
+        for gi, g in enumerate(groups):
+            g_sel_match[sid, gi] = sel.matches(g.pods[0].labels)
+    # hostname anti-affinity: a self-matching group gets cap 1 (one per node),
+    # a non-matching group may not co-locate with matchers at all (cap enforced
+    # in-solver via row counters); spread groups keep their maxSkew cap.
+    for gi in range(len(groups)):
+        sid = g_host_spread[gi]
+        if sid != NO_SELECTOR and g_host_cap[gi] == 0:
+            g_host_cap[gi] = 1 if g_sel_match[sid, gi] else 0
+
+    vocab.frozen = True
+    K, W, R = vocab.n_keys, vocab.mask_words(), vocab.n_resources
+
+    # ---- group tensors -------------------------------------------------
+    G = len(groups)
+    counts = np.array([g.count for g in groups], dtype=np.int32)
+    requests = np.zeros((G, R), dtype=np.float32)
+    pm = np.zeros((G, K, W), dtype=np.uint32)
+    magnitude = np.zeros(G, dtype=np.float32)
+    for gi, g in enumerate(groups):
+        req_full = dict(g.requests)
+        req_full.setdefault(L.RESOURCE_PODS, 1.0)
+        requests[gi] = vocab.resources_to_row(req_full).astype(np.float32)
+        pm[gi] = vocab.requirements_to_mask(g.requirements)
+        magnitude[gi] = _ffd_magnitude(g.requests)
+
+    # ---- provisioner tensors -------------------------------------------
+    ordered_provs = sorted(provisioners, key=lambda p: (-p.weight, p.name))
+    prov_index = {p.name: i for i, p in enumerate(ordered_provs)}
+    P = max(1, len(ordered_provs))
+    prov_weight = np.zeros(P, dtype=np.float32)
+    prov_limits = np.full((P, R), np.inf, dtype=np.float32)
+    for i, p in enumerate(ordered_provs):
+        prov_weight[i] = p.weight
+        for rname, cap in p.limits.items():
+            rid = vocab.resource_id.get(rname)
+            if rid is not None:
+                prov_limits[i, rid] = cap
+
+    prov_reqs = {p.name: p.scheduling_requirements() for p in ordered_provs}
+    gp_ok = np.zeros((G, P), dtype=bool)
+    for gi, g in enumerate(groups):
+        rep = g.pods[0]
+        for p in ordered_provs:
+            i = prov_index[p.name]
+            gp_ok[gi, i] = (
+                p.tolerates(rep)
+                and g.requirements.intersects(prov_reqs[p.name]) is None
+            )
+
+    # ---- domain axis ----------------------------------------------------
+    zones = sorted(zone_set, key=zone_set.get)
+    cts = sorted(ct_set, key=ct_set.get)
+    doms = [(z, c) for z in zones for c in cts]
+    D = max(1, len(doms))
+    dom_zone = np.zeros(D, dtype=np.int32)
+    dom_vw = np.zeros((D, 2), dtype=np.int32)
+    dom_vb = np.zeros((D, 2), dtype=np.int32)
+    for di, (z, c) in enumerate(doms):
+        dom_zone[di] = zones.index(z)
+        zvid = vocab.value_id[zone_key][z]
+        cvid = vocab.value_id[ct_key][c]
+        dom_vw[di] = (zvid // 32, cvid // 32)
+        dom_vb[di] = (zvid % 32, cvid % 32)
+
+    # ---- candidate tensors ----------------------------------------------
+    C = len(pairs)
+    cand_names: List[Tuple[str, str]] = []
+    cand_alloc = np.zeros((max(1, C), R), dtype=np.float32)
+    candV = np.zeros((max(1, C), K), dtype=np.int32)
+    cand_prov = np.zeros(max(1, C), dtype=np.int32)
+    cand_price = np.full((max(1, C), D), np.inf, dtype=np.float32)
+    cand_avail = np.zeros((max(1, C), D), dtype=bool)
+    dom_index = {zc: i for i, zc in enumerate(doms)}
+    for ci, (pi, prov, it, merged) in enumerate(pairs):
+        cand_names.append((prov.name, it.name))
+        alloc = dict(it.allocatable)
+        cand_alloc[ci] = vocab.resources_to_row(alloc).astype(np.float32)
+        labels = {**it.labels(), **prov.labels, L.PROVISIONER_NAME: prov.name}
+        candV[ci] = vocab.labels_to_ids(labels)
+        cand_prov[ci] = prov_index[prov.name]
+        preqs = prov_reqs[prov.name]
+        zone_ok = preqs.get(L.ZONE)
+        ct_ok = preqs.get(L.CAPACITY_TYPE)
+        for o in it.offerings:
+            di = dom_index.get((o.zone, o.capacity_type))
+            if di is None:
+                continue
+            ok = (
+                o.available
+                and zone_ok.contains(o.zone)
+                and ct_ok.contains(o.capacity_type)
+                and (it.name, o.zone, o.capacity_type) not in unavailable
+            )
+            if ok:
+                cand_avail[ci, di] = True
+                cand_price[ci, di] = o.price
+            elif np.isinf(cand_price[ci, di]):
+                cand_price[ci, di] = o.price  # keep price for consolidation math
+
+    key_check = np.ones(K, dtype=bool)
+    key_check[zone_key] = False
+    key_check[ct_key] = False
+
+    return SolveTensors(
+        vocab=vocab,
+        groups=groups,
+        counts=counts,
+        requests=requests,
+        pm=pm,
+        magnitude=magnitude,
+        g_zone_spread=g_zone_spread,
+        g_zone_skew=g_zone_skew,
+        g_host_spread=g_host_spread,
+        g_host_cap=g_host_cap,
+        g_zone_anti=g_zone_anti,
+        g_sel_match=g_sel_match,
+        cand_names=cand_names,
+        cand_alloc=cand_alloc,
+        cand_vw=candV // 32,
+        cand_vb=candV % 32,
+        cand_prov=cand_prov,
+        cand_price=cand_price,
+        cand_avail=cand_avail,
+        key_check=key_check,
+        gp_ok=gp_ok,
+        prov_names=[p.name for p in ordered_provs],
+        prov_weight=prov_weight,
+        prov_limits=prov_limits,
+        dom_zone=dom_zone,
+        dom_vw=dom_vw,
+        dom_vb=dom_vb,
+        zone_names=zones,
+        n_zones=len(zones),
+    )

@@ -1,0 +1,29 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax imports.
+
+Multi-chip TPU hardware is not available in CI; sharding tests run over
+XLA's forced host-platform device count, which exercises the same
+GSPMD-partitioned programs the real mesh would run.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def small_catalog():
+    from karpenter_tpu.models.catalog import generate_catalog
+
+    return generate_catalog(full=False)
+
+
+@pytest.fixture(scope="session")
+def full_catalog():
+    from karpenter_tpu.models.catalog import generate_catalog
+
+    return generate_catalog(full=True)

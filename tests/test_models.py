@@ -1,0 +1,171 @@
+"""Catalog, instance types, overhead model, tensorization."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.catalog import generate_catalog
+from karpenter_tpu.models.instancetype import GIB, MIB, compute_overhead
+from karpenter_tpu.models.pod import PodSpec, Taint, Toleration
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.requirements import IN, Requirement
+from karpenter_tpu.models.tensorize import group_pods, tensorize
+
+
+class TestOverhead:
+    def test_kube_reserved_cpu_staircase(self):
+        # 4 vCPU: 6% of 1 core + 1% of 1 + 0.5% of 2 = 60+10+10 = 80 millis
+        oh = compute_overhead(4.0, 20.0)
+        assert oh.kube_reserved[L.RESOURCE_CPU] == pytest.approx(0.080)
+        # 2 vCPU: 60 + 10 = 70 millis
+        oh2 = compute_overhead(2.0, 20.0)
+        assert oh2.kube_reserved[L.RESOURCE_CPU] == pytest.approx(0.070)
+        # 96 vCPU: 60+10+10+ (92*1000*0.0025=230) = 310 millis
+        oh3 = compute_overhead(96.0, 100.0)
+        assert oh3.kube_reserved[L.RESOURCE_CPU] == pytest.approx(0.310)
+
+    def test_kube_reserved_memory(self):
+        oh = compute_overhead(4.0, 20.0)
+        assert oh.kube_reserved[L.RESOURCE_MEMORY] == (11 * 20 + 255) * MIB
+
+    def test_total_includes_system_and_eviction(self):
+        oh = compute_overhead(4.0, 20.0)
+        total = oh.total()
+        assert total[L.RESOURCE_CPU] == pytest.approx(0.180)  # 80m kube + 100m system
+        assert total[L.RESOURCE_MEMORY] == pytest.approx((11 * 20 + 255 + 100 + 100) * MIB)
+
+
+class TestCatalog:
+    def test_small_catalog_20_types(self, small_catalog):
+        assert len(small_catalog) == 20
+        names = {t.name for t in small_catalog}
+        assert "m5.xlarge" in names and "t3a.small" in names
+
+    def test_full_catalog_scale(self, full_catalog):
+        assert len(full_catalog) > 400
+
+    def test_allocatable_less_than_capacity(self, small_catalog):
+        m5x = next(t for t in small_catalog if t.name == "m5.xlarge")
+        assert m5x.capacity[L.RESOURCE_CPU] == 4.0
+        assert m5x.allocatable[L.RESOURCE_CPU] < 4.0
+        assert m5x.allocatable[L.RESOURCE_MEMORY] < m5x.capacity[L.RESOURCE_MEMORY]
+        # m5.xlarge ~16GiB raw => ~14.8 after 7.5% VM overhead, minus kubelet
+        assert m5x.capacity[L.RESOURCE_MEMORY] == pytest.approx(16 * GIB * 0.925)
+
+    def test_offerings_priced_and_spot_cheaper(self, small_catalog):
+        m5x = next(t for t in small_catalog if t.name == "m5.xlarge")
+        ods = [o for o in m5x.offerings if o.capacity_type == L.CAPACITY_TYPE_ON_DEMAND]
+        spots = [o for o in m5x.offerings if o.capacity_type == L.CAPACITY_TYPE_SPOT]
+        assert len(ods) == 3 and len(spots) == 3  # 3 zones
+        assert all(s.price < o.price for s, o in zip(spots, ods))
+
+    def test_requirement_labels(self, small_catalog):
+        m5x = next(t for t in small_catalog if t.name == "m5.xlarge")
+        labels = m5x.labels()
+        assert labels[L.INSTANCE_TYPE] == "m5.xlarge"
+        assert labels[L.ARCH] == L.ARCH_AMD64
+        assert labels[L.INSTANCE_CATEGORY] == "m"
+        assert labels[L.INSTANCE_GENERATION] == "5"
+
+    def test_deterministic(self):
+        a = generate_catalog(full=False)
+        b = generate_catalog(full=False)
+        assert [t.name for t in a] == [t.name for t in b]
+        assert [o.price for t in a for o in t.offerings] == [
+            o.price for t in b for o in t.offerings
+        ]
+
+
+class TestProvisioner:
+    def test_defaults(self):
+        p = Provisioner(name="p").with_defaults()
+        keys = {r.key for r in p.requirements}
+        assert L.OS in keys and L.ARCH in keys and L.CAPACITY_TYPE in keys
+        assert L.INSTANCE_CATEGORY in keys and L.INSTANCE_GENERATION in keys
+
+    def test_defaults_not_applied_when_set(self):
+        p = Provisioner(
+            name="p", requirements=[Requirement(L.INSTANCE_TYPE, IN, ["m5.large"])]
+        ).with_defaults()
+        keys = [r.key for r in p.requirements]
+        assert L.INSTANCE_CATEGORY not in keys
+
+    def test_taint_toleration(self):
+        p = Provisioner(name="p", taints=[Taint("team", L.EFFECT_NO_SCHEDULE, "a")])
+        pod_no = PodSpec(requests={"cpu": 1})
+        pod_yes = PodSpec(
+            requests={"cpu": 1},
+            tolerations=[Toleration(key="team", operator="Equal", value="a")],
+        )
+        assert not p.tolerates(pod_no)
+        assert p.tolerates(pod_yes)
+
+    def test_validation(self):
+        bad = Provisioner(name="p", labels={"karpenter.sh/hacked": "x"}, weight=200)
+        errs = bad.validate()
+        assert any("restricted" in e for e in errs)
+        assert any("weight" in e for e in errs)
+        assert Provisioner(name="ok").validate() == []
+
+
+class TestTensorize:
+    def _simple(self, small_catalog, n=10):
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}, owner_key="d1") for i in range(n)]
+        prov = Provisioner(name="default").with_defaults()
+        return tensorize(pods, [prov], small_catalog)
+
+    def test_grouping_dedups(self, small_catalog):
+        st = self._simple(small_catalog, 50)
+        assert st.G == 1
+        assert st.counts[0] == 50
+
+    def test_ffd_order(self, small_catalog):
+        pods = [PodSpec(name="small", requests={"cpu": 0.5})] + [
+            PodSpec(name="big", requests={"cpu": 4.0})
+        ]
+        st = tensorize(pods, [Provisioner(name="d").with_defaults()], small_catalog)
+        assert st.G == 2
+        assert st.magnitude[0] > st.magnitude[1]
+
+    def test_candidates_respect_provisioner_reqs(self, small_catalog):
+        prov = Provisioner(
+            name="d", requirements=[Requirement(L.INSTANCE_FAMILY, IN, ["m5"])]
+        ).with_defaults()
+        st = tensorize([PodSpec(requests={"cpu": 1})], [prov], small_catalog)
+        assert st.C > 0
+        assert all(t.startswith("m5.") for _, t in st.cand_names)
+
+    def test_domains(self, small_catalog):
+        st = self._simple(small_catalog)
+        assert st.n_zones == 3
+        assert st.D == 6  # 3 zones x 2 capacity types
+
+    def test_default_provisioner_is_on_demand_only(self, small_catalog):
+        st = self._simple(small_catalog)
+        # defaults force on-demand: spot domains must be unavailable
+        assert st.cand_avail.sum() == st.C * 3  # 3 od zones per candidate
+
+    def test_feasibility_masks_zone_requirement(self, small_catalog):
+        pod = PodSpec(
+            requests={"cpu": 1},
+            node_selector={L.ZONE: "zone-1a"},
+        )
+        st = tensorize([pod], [Provisioner(name="d").with_defaults()], small_catalog)
+        # the pod's pm must admit zone-1a and reject zone-1b at the zone key
+        zk = st.vocab.key_id[L.ZONE]
+        va = st.vocab.value_id[zk]["zone-1a"]
+        vb = st.vocab.value_id[zk]["zone-1b"]
+        assert st.pm[0, zk, va // 32] >> (va % 32) & 1
+        assert not (st.pm[0, zk, vb // 32] >> (vb % 32) & 1)
+
+    def test_unavailable_offerings_masked(self, small_catalog):
+        st = tensorize(
+            [PodSpec(requests={"cpu": 1})],
+            [Provisioner(name="d").with_defaults()],
+            small_catalog,
+            unavailable={("m5.xlarge", "zone-1a", L.CAPACITY_TYPE_ON_DEMAND)},
+        )
+        ci = [i for i, (_, t) in enumerate(st.cand_names) if t == "m5.xlarge"]
+        assert len(ci) == 1
+        avail = st.cand_avail[ci[0]]
+        assert avail.sum() == 2  # only 2 od zones left

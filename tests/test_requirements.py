@@ -1,0 +1,173 @@
+"""Requirement algebra semantics (scheduling.md:134-167 parity)."""
+
+import pytest
+
+from karpenter_tpu.models.requirements import (
+    DOES_NOT_EXIST,
+    EXISTS,
+    GT,
+    IN,
+    LT,
+    NOT_IN,
+    Requirement,
+    Requirements,
+    ValueSet,
+)
+
+
+class TestValueSet:
+    def test_in(self):
+        vs = Requirement("k", IN, ["a", "b"]).value_set()
+        assert vs.contains("a") and vs.contains("b") and not vs.contains("c")
+
+    def test_not_in(self):
+        vs = Requirement("k", NOT_IN, ["a"]).value_set()
+        assert not vs.contains("a") and vs.contains("z")
+
+    def test_exists(self):
+        assert Requirement("k", EXISTS).value_set().contains("anything")
+
+    def test_does_not_exist_empty(self):
+        assert Requirement("k", DOES_NOT_EXIST).value_set().is_empty()
+
+    def test_gt_lt(self):
+        gt = Requirement("k", GT, ["2"]).value_set()
+        assert gt.contains("3") and not gt.contains("2") and not gt.contains("abc")
+        lt = Requirement("k", LT, ["5"]).value_set()
+        assert lt.contains("4") and not lt.contains("5")
+
+    def test_intersect_in_in(self):
+        a = ValueSet.of("a", "b")
+        b = ValueSet.of("b", "c")
+        got = a.intersect(b)
+        assert got.contains("b") and not got.contains("a") and not got.contains("c")
+
+    def test_intersect_in_notin(self):
+        a = ValueSet.of("a", "b")
+        b = Requirement("k", NOT_IN, ["a"]).value_set()
+        got = a.intersect(b)
+        assert got.contains("b") and not got.contains("a")
+
+    def test_intersect_notin_notin(self):
+        a = Requirement("k", NOT_IN, ["a"]).value_set()
+        b = Requirement("k", NOT_IN, ["b"]).value_set()
+        got = a.intersect(b)
+        assert not got.contains("a") and not got.contains("b") and got.contains("c")
+
+    def test_gt_and_in(self):
+        vs = Requirement("k", GT, ["2"]).value_set().intersect(ValueSet.of("1", "3"))
+        assert vs.contains("3") and not vs.contains("1")
+
+    def test_contradictory_bounds_empty(self):
+        vs = Requirement("k", GT, ["5"]).value_set().intersect(
+            Requirement("k", LT, ["5"]).value_set()
+        )
+        assert vs.is_empty()  # nothing strictly between 5 and 5
+
+
+class TestRequirements:
+    def test_add_intersects(self):
+        reqs = Requirements([Requirement("zone", IN, ["a", "b"])])
+        reqs.add(Requirement("zone", IN, ["b", "c"]))
+        assert list(reqs.get("zone").enumerate_finite()) == ["b"]
+
+    def test_compatible_labels(self):
+        reqs = Requirements([
+            Requirement("arch", IN, ["amd64"]),
+            Requirement("gpu", DOES_NOT_EXIST),
+        ])
+        assert reqs.compatible({"arch": "amd64"}) is None
+        assert reqs.compatible({"arch": "arm64"}) == "arch"
+        assert reqs.compatible({"arch": "amd64", "gpu": "t4"}) == "gpu"
+
+    def test_missing_label_fails_nonempty_requirement(self):
+        reqs = Requirements([Requirement("team", IN, ["a"])])
+        assert reqs.compatible({}) == "team"
+
+    def test_intersects_requirements(self):
+        a = Requirements([Requirement("zone", IN, ["a", "b"])])
+        b = Requirements([Requirement("zone", IN, ["b"])])
+        c = Requirements([Requirement("zone", IN, ["c"])])
+        assert a.intersects(b) is None
+        assert a.intersects(c) == "zone"
+
+    def test_intersects_disjoint_keys_ok(self):
+        a = Requirements([Requirement("x", IN, ["1"])])
+        b = Requirements([Requirement("y", IN, ["2"])])
+        assert a.intersects(b) is None
+
+    def test_both_does_not_exist_compatible(self):
+        a = Requirements([Requirement("k", DOES_NOT_EXIST)])
+        b = Requirements([Requirement("k", DOES_NOT_EXIST)])
+        assert a.intersects(b) is None
+
+    def test_to_list_roundtrip(self):
+        reqs = Requirements([
+            Requirement("a", IN, ["x", "y"]),
+            Requirement("b", NOT_IN, ["z"]),
+            Requirement("c", EXISTS),
+            Requirement("d", DOES_NOT_EXIST),
+            Requirement("e", GT, ["3"]),
+        ])
+        round2 = Requirements(reqs.to_list())
+        for key in ("a", "b", "c", "d", "e"):
+            assert round2.has(key)
+        assert round2.get("a").contains("x") and not round2.get("a").contains("z")
+        assert round2.get("e").contains("4") and not round2.get("e").contains("3")
+
+
+class TestQuantity:
+    def test_parse(self):
+        from karpenter_tpu.utils.quantity import parse_quantity
+
+        assert parse_quantity("100m") == pytest.approx(0.1)
+        assert parse_quantity("1.5Gi") == 1.5 * 1024**3
+        assert parse_quantity("2") == 2.0
+        assert parse_quantity("1500Mi") == 1500 * 1024**2
+        assert parse_quantity("1e3") == 1000.0
+        assert parse_quantity(2) == 2.0
+
+    def test_invalid(self):
+        from karpenter_tpu.utils.quantity import parse_quantity
+
+        with pytest.raises(ValueError):
+            parse_quantity("abc")
+
+
+class TestAbsentLabelSemantics:
+    """kube NodeSelectorRequirement: NotIn/DoesNotExist match missing labels;
+    In/Exists/Gt/Lt do not."""
+
+    def test_not_in_matches_absent(self):
+        reqs = Requirements([Requirement("team", NOT_IN, ["a"])])
+        assert reqs.compatible({}) is None
+        assert reqs.compatible({"team": "a"}) == "team"
+        assert reqs.compatible({"team": "b"}) is None
+
+    def test_exists_requires_presence(self):
+        reqs = Requirements([Requirement("team", EXISTS)])
+        assert reqs.compatible({}) == "team"
+        assert reqs.compatible({"team": "x"}) is None
+
+    def test_exists_intersect_notin_still_requires_presence(self):
+        vs = Requirement("k", EXISTS).value_set().intersect(
+            Requirement("k", NOT_IN, ["a"]).value_set()
+        )
+        assert not vs.allows_absence()
+        assert vs.contains("b") and not vs.contains("a")
+
+    def test_gt_requires_presence(self):
+        reqs = Requirements([Requirement("gen", GT, ["2"])])
+        assert reqs.compatible({}) == "gen"
+
+    def test_fractional_bounds_consistent_with_contains(self):
+        vs = Requirement("k", GT, ["4.5"]).value_set().intersect(
+            Requirement("k", LT, ["5.5"]).value_set()
+        )
+        assert not vs.is_empty()
+        assert vs.contains("5")
+
+    def test_exists_roundtrip(self):
+        reqs = Requirements([Requirement("k", EXISTS)])
+        lst = reqs.to_list()
+        assert len(lst) == 1 and lst[0].operator == EXISTS
