@@ -1,0 +1,86 @@
+"""Solver input/output types shared by the CPU oracle and the TPU solver."""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ..models import labels as L
+from ..models.instancetype import InstanceType
+from ..models.pod import PodSpec, Taint
+from ..models.resources import ResourceList, add, fits, subtract
+
+_node_counter = itertools.count()
+
+
+@dataclass
+class SimNode:
+    """A (possibly hypothetical) node the solver packs onto.
+
+    Existing cluster nodes and solver-proposed nodes share this shape; the
+    reference's equivalent is core's in-flight machine + state.Cluster node
+    (SURVEY.md §2.2 state.Cluster).
+    """
+
+    instance_type: str
+    provisioner: str
+    zone: str
+    capacity_type: str
+    price: float  # $/hr
+    allocatable: ResourceList
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    pods: List[PodSpec] = field(default_factory=list)
+    existing: bool = False  # True for nodes already in the cluster
+    name: str = ""
+    created_at: float = 0.0
+    expires_at: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if not self.name:
+            self.name = f"node-{next(_node_counter)}"
+
+    def used(self) -> ResourceList:
+        out: ResourceList = {L.RESOURCE_PODS: float(len(self.pods))}
+        for p in self.pods:
+            for k, v in p.requests.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def remaining(self) -> ResourceList:
+        return subtract(self.allocatable, self.used())
+
+    def fits(self, requests: ResourceList) -> bool:
+        req = dict(requests)
+        req.setdefault(L.RESOURCE_PODS, 1.0)
+        return fits(req, self.remaining())
+
+
+@dataclass
+class SolveResult:
+    """Outcome of one scheduling solve."""
+
+    nodes: List[SimNode]                    # newly proposed nodes (with pods bound)
+    assignments: Dict[str, str]             # pod name -> node name (incl. existing)
+    infeasible: Dict[str, str]              # pod name -> reason
+    existing_nodes: List[SimNode] = field(default_factory=list)
+    solve_ms: float = 0.0
+
+    @property
+    def new_node_cost(self) -> float:
+        return sum(n.price for n in self.nodes)
+
+    @property
+    def n_scheduled(self) -> int:
+        return len(self.assignments)
+
+    def summary(self) -> str:
+        per_type: Dict[str, int] = {}
+        for n in self.nodes:
+            per_type[n.instance_type] = per_type.get(n.instance_type, 0) + 1
+        types = ", ".join(f"{k}x{v}" for k, v in sorted(per_type.items()))
+        return (
+            f"{self.n_scheduled} pods -> {len(self.nodes)} new nodes "
+            f"(${self.new_node_cost:.3f}/hr: {types}); {len(self.infeasible)} infeasible"
+        )

@@ -1,0 +1,217 @@
+"""CPU reference FFD solver behavior (the correctness oracle)."""
+
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.catalog import generate_catalog
+from karpenter_tpu.models.instancetype import GIB
+from karpenter_tpu.models.pod import (
+    LabelSelector,
+    PodAffinityTerm,
+    PodSpec,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.requirements import IN, Requirement
+from karpenter_tpu.solver import reference
+from karpenter_tpu.solver.types import SimNode
+
+
+def default_prov(**kw):
+    return Provisioner(name=kw.pop("name", "default"), **kw).with_defaults()
+
+
+class TestBasicPacking:
+    def test_single_pod_cheapest_fit(self, small_catalog):
+        res = reference.solve(
+            [PodSpec(name="p", requests={"cpu": 1.0, "memory": 1 * GIB})],
+            [default_prov()], small_catalog,
+        )
+        assert res.infeasible == {}
+        assert len(res.nodes) == 1
+        # cheapest od type that fits 1 cpu / 1GiB: c5.large ($0.085)
+        assert res.nodes[0].instance_type == "c5.large"
+
+    def test_many_identical_pods_pack_densely(self, small_catalog):
+        # 100 x 1.5 CPU pods -> reference e2e packs 1 pod/t3a-small-ish; with
+        # our defaulted c/m/r catalog the solver should use big nodes
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.5}) for i in range(100)]
+        res = reference.solve(pods, [default_prov()], small_catalog)
+        assert res.infeasible == {}
+        assert res.n_scheduled == 100
+        # all pods land somewhere; nodes well utilized (>60% cpu on average)
+        total_alloc = sum(n.allocatable[L.RESOURCE_CPU] for n in res.nodes)
+        assert 150 <= total_alloc <= 150 / 0.6
+
+    def test_ffd_big_pods_first(self, small_catalog):
+        pods = [PodSpec(name=f"s{i}", requests={"cpu": 0.25}) for i in range(20)] + [
+            PodSpec(name=f"b{i}", requests={"cpu": 14.0}) for i in range(2)
+        ]
+        res = reference.solve(pods, [default_prov()], small_catalog)
+        assert res.infeasible == {}
+        # big pods need 16-vcpu nodes; smalls should backfill those nodes
+        assert res.n_scheduled == 22
+
+    def test_infeasible_giant_pod(self, small_catalog):
+        res = reference.solve(
+            [PodSpec(name="giant", requests={"cpu": 1000.0})],
+            [default_prov()], small_catalog,
+        )
+        assert "giant" in res.infeasible
+        assert res.nodes == []
+
+    def test_existing_nodes_first_fit(self, small_catalog):
+        m5x = next(t for t in small_catalog if t.name == "m5.xlarge")
+        existing = SimNode(
+            instance_type="m5.xlarge", provisioner="default", zone="zone-1a",
+            capacity_type="on-demand", price=0.192, allocatable=dict(m5x.allocatable),
+            labels={**m5x.labels(), L.ZONE: "zone-1a", L.CAPACITY_TYPE: "on-demand",
+                    L.PROVISIONER_NAME: "default"},
+            existing=True,
+        )
+        res = reference.solve(
+            [PodSpec(name="p", requests={"cpu": 1.0})],
+            [default_prov()], small_catalog, existing_nodes=[existing],
+        )
+        assert res.nodes == []  # no new node needed
+        assert res.assignments["p"] == existing.name
+
+
+class TestConstraints:
+    def test_node_selector_zone(self, small_catalog):
+        res = reference.solve(
+            [PodSpec(name="p", requests={"cpu": 1}, node_selector={L.ZONE: "zone-1b"})],
+            [default_prov()], small_catalog,
+        )
+        assert res.nodes[0].zone == "zone-1b"
+
+    def test_taints_block_untolerating(self, small_catalog):
+        tainted = Provisioner(
+            name="tainted", taints=[Taint("dedicated", L.EFFECT_NO_SCHEDULE, "gpu")]
+        ).with_defaults()
+        res = reference.solve(
+            [PodSpec(name="p", requests={"cpu": 1})], [tainted], small_catalog
+        )
+        assert "p" in res.infeasible
+
+        res2 = reference.solve(
+            [PodSpec(name="p", requests={"cpu": 1},
+                     tolerations=[Toleration(key="dedicated", operator="Exists")])],
+            [tainted], small_catalog,
+        )
+        assert res2.infeasible == {}
+
+    def test_spot_requirement(self, small_catalog):
+        prov = Provisioner(
+            name="spot",
+            requirements=[Requirement(L.CAPACITY_TYPE, IN, [L.CAPACITY_TYPE_SPOT])],
+        ).with_defaults()
+        res = reference.solve(
+            [PodSpec(name="p", requests={"cpu": 1})], [prov], small_catalog
+        )
+        assert res.nodes[0].capacity_type == L.CAPACITY_TYPE_SPOT
+
+    def test_zone_topology_spread(self, small_catalog):
+        sel = LabelSelector.of({"app": "web"})
+        pods = [
+            PodSpec(
+                name=f"w{i}", labels={"app": "web"}, requests={"cpu": 1},
+                topology_spread=[TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)],
+            )
+            for i in range(9)
+        ]
+        res = reference.solve(pods, [default_prov()], small_catalog)
+        assert res.infeasible == {}
+        by_zone = {}
+        node_by_name = {n.name: n for n in res.nodes}
+        for pod, node in res.assignments.items():
+            z = node_by_name[node].zone
+            by_zone[z] = by_zone.get(z, 0) + 1
+        assert sorted(by_zone.values()) == [3, 3, 3]
+
+    def test_hostname_anti_affinity_one_per_node(self, small_catalog):
+        sel = LabelSelector.of({"app": "db"})
+        pods = [
+            PodSpec(
+                name=f"db{i}", labels={"app": "db"}, requests={"cpu": 0.5},
+                affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME, anti=True)],
+            )
+            for i in range(5)
+        ]
+        res = reference.solve(pods, [default_prov()], small_catalog)
+        assert res.infeasible == {}
+        assert len(res.nodes) == 5  # one per node despite tiny requests
+        for n in res.nodes:
+            assert len(n.pods) == 1
+
+    def test_provisioner_limits_cap_capacity(self, small_catalog):
+        prov = Provisioner(name="capped", limits={"cpu": 8.0}).with_defaults()
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 3.0}) for i in range(10)]
+        res = reference.solve(pods, [prov], small_catalog)
+        total_capacity = sum(
+            next(t for t in small_catalog if t.name == n.instance_type).capacity["cpu"]
+            for n in res.nodes
+        )
+        assert total_capacity <= 8.0
+        assert len(res.infeasible) > 0
+
+    def test_weighted_provisioner_preferred(self, small_catalog):
+        cheap_spot = Provisioner(
+            name="spot", weight=10,
+            requirements=[Requirement(L.CAPACITY_TYPE, IN, [L.CAPACITY_TYPE_SPOT])],
+        ).with_defaults()
+        od = Provisioner(name="od", weight=1).with_defaults()
+        res = reference.solve(
+            [PodSpec(name="p", requests={"cpu": 1})], [cheap_spot, od], small_catalog
+        )
+        # both feasible; spot is cheaper and higher weight
+        assert res.nodes[0].provisioner == "spot"
+
+    def test_unavailable_offering_routed_around(self, small_catalog):
+        # make the would-be-chosen offering unavailable; solver picks next
+        base = reference.solve(
+            [PodSpec(name="p", requests={"cpu": 1, "memory": 1 * GIB})],
+            [default_prov()], small_catalog,
+        )
+        chosen = (base.nodes[0].instance_type, base.nodes[0].zone, base.nodes[0].capacity_type)
+        res = reference.solve(
+            [PodSpec(name="p", requests={"cpu": 1, "memory": 1 * GIB})],
+            [default_prov()], small_catalog,
+            unavailable={chosen},
+        )
+        assert res.infeasible == {}
+        got = (res.nodes[0].instance_type, res.nodes[0].zone, res.nodes[0].capacity_type)
+        assert got != chosen
+
+    def test_daemonset_overhead_reserved(self, small_catalog):
+        ds = PodSpec(name="logging-agent", requests={"cpu": 0.5, "memory": 0.5 * GIB})
+        pods = [PodSpec(name="p", requests={"cpu": 1.5})]
+        res = reference.solve(pods, [default_prov()], small_catalog, daemonsets=[ds])
+        assert res.infeasible == {}
+        node = res.nodes[0]
+        # c5.large alloc ~1.8 cpu minus 0.5 daemon = 1.3 < 1.5, so a bigger
+        # node than the no-daemonset case is required
+        assert node.allocatable[L.RESOURCE_CPU] >= 1.5
+
+
+class TestScale:
+    def test_1k_uniform_fast(self, small_catalog):
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(1000)]
+        res = reference.solve(pods, [default_prov()], small_catalog)
+        assert res.infeasible == {}
+        assert res.n_scheduled == 1000
+        assert res.solve_ms < 2000
+
+    def test_mixed_groups_deterministic(self, small_catalog):
+        def mk():
+            pods = []
+            for i in range(200):
+                pods.append(PodSpec(name=f"a{i}", requests={"cpu": 1.0}, owner_key="a"))
+                pods.append(PodSpec(name=f"b{i}", requests={"cpu": 0.5, "memory": 4 * GIB}, owner_key="b"))
+            return reference.solve(pods, [default_prov()], small_catalog)
+
+        r1, r2 = mk(), mk()
+        assert [n.instance_type for n in r1.nodes] == [n.instance_type for n in r2.nodes]
+        assert r1.new_node_cost == r2.new_node_cost
