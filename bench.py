@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Headline benchmark: BASELINE config #2 — 50k mixed CPU/mem pods, full
+catalog, 3-AZ topology spread — TPU batch solver vs the in-repo CPU FFD
+baseline (BASELINE.md: metric is solve latency + node cost vs Go-style FFD).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <tpu solve ms>, "unit": "ms",
+   "vs_baseline": <cpu_ffd_ms / tpu_ms>, ...extra diagnostic fields}
+"""
+
+import json
+import sys
+import time
+
+
+def build_scenario():
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.instancetype import GIB
+    from karpenter_tpu.models.pod import LabelSelector, PodSpec, TopologySpreadConstraint
+    from karpenter_tpu.models.provisioner import Provisioner
+
+    catalog = generate_catalog(full=True)
+    pods = []
+    for d in range(20):
+        cpu = 0.25 * (1 + d % 8)
+        mem = (0.5 + (d % 6)) * GIB
+        sel = LabelSelector.of({"app": f"d{d}"})
+        for i in range(2500):
+            pods.append(
+                PodSpec(
+                    name=f"d{d}-{i}",
+                    labels={"app": f"d{d}"},
+                    requests={"cpu": cpu, "memory": mem},
+                    topology_spread=[
+                        TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)
+                    ],
+                    owner_key=f"d{d}",
+                )
+            )
+    prov = Provisioner(name="default").with_defaults()
+    return pods, [prov], catalog
+
+
+def main():
+    from karpenter_tpu.models.tensorize import tensorize
+    from karpenter_tpu.solver import reference
+    from karpenter_tpu.solver.tpu import solve_tensors
+
+    pods, provs, catalog = build_scenario()
+
+    # CPU FFD baseline (the in-repo Go-equivalent oracle)
+    t0 = time.perf_counter()
+    oracle = reference.solve(pods, provs, catalog)
+    cpu_ms = (time.perf_counter() - t0) * 1000.0
+
+    # TPU solve (tensorize is host prep; solve time is the solver itself)
+    st = tensorize(pods, provs, catalog)
+    out = solve_tensors(st, track_assignments=False)
+
+    cost_ratio = (
+        out.result.new_node_cost / oracle.new_node_cost if oracle.new_node_cost else 1.0
+    )
+    import jax
+
+    print(
+        json.dumps(
+            {
+                "metric": "solve_50k_pods_full_catalog_3az_spread",
+                "value": round(out.solve_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(cpu_ms / max(out.solve_ms, 1e-9), 3),
+                "cpu_ffd_ms": round(cpu_ms, 1),
+                "compile_ms": round(out.compile_ms, 1),
+                "cost_ratio_vs_ffd": round(cost_ratio, 4),
+                "tpu_nodes": len(out.result.nodes),
+                "ffd_nodes": len(oracle.nodes),
+                "infeasible": len(out.result.infeasible),
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+"""Event recording (core ``events.Recorder`` analog, SURVEY.md §2.2).
+
+The reference publishes k8s Events (unconsolidatable reasons, interruption
+notices, etc.).  Here events accumulate in-memory with a pluggable sink so
+controllers and tests can assert on them; a real deployment wires a sink to
+its control plane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+@dataclass(frozen=True)
+class Event:
+    kind: str        # object kind: Pod | Node | Machine | Provisioner
+    name: str        # object name
+    reason: str      # CamelCase reason, e.g. "SpotInterrupted", "Unconsolidatable"
+    message: str
+    event_type: str = "Normal"  # Normal | Warning
+
+
+class Recorder:
+    def __init__(self, sink: Optional[Callable[[Event], None]] = None) -> None:
+        self.events: List[Event] = []
+        self._sink = sink
+
+    def publish(self, event: Event) -> None:
+        self.events.append(event)
+        if self._sink:
+            self._sink(event)
+
+    def of(self, reason: str) -> List[Event]:
+        return [e for e in self.events if e.reason == reason]
+
+    def clear(self) -> None:
+        self.events.clear()

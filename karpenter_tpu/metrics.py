@@ -1,0 +1,150 @@
+"""Prometheus-style metrics registry + the cloud-provider method decorator.
+
+Mirrors the reference's metric surface (concepts/metrics.md:11-93): counters,
+gauges and histograms keyed by (name, labels), plus ``decorate(provider)``
+which wraps every CloudProvider method in a duration histogram exactly like
+core's ``metrics.Decorate`` (cmd/controller/main.go:46).  Exposition is
+text-format compatible so a scraper can consume ``registry.expose()``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _lkey(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Counter:
+    def __init__(self) -> None:
+        self.values: Dict[tuple, float] = defaultdict(float)
+
+    def inc(self, labels: Optional[Dict[str, str]] = None, value: float = 1.0) -> None:
+        self.values[_lkey(labels)] += value
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self.values.get(_lkey(labels), 0.0)
+
+
+class Gauge:
+    def __init__(self) -> None:
+        self.values: Dict[tuple, float] = {}
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        self.values[_lkey(labels)] = value
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self.values.get(_lkey(labels), 0.0)
+
+
+class Histogram:
+    def __init__(self, buckets=_DEFAULT_BUCKETS) -> None:
+        self.buckets = buckets
+        self.counts: Dict[tuple, List[int]] = defaultdict(lambda: [0] * (len(buckets) + 1))
+        self.sums: Dict[tuple, float] = defaultdict(float)
+        self.totals: Dict[tuple, int] = defaultdict(int)
+
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        key = _lkey(labels)
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[key][i] += 1
+                break
+        else:
+            self.counts[key][-1] += 1
+        self.sums[key] += value
+        self.totals[key] += 1
+
+    def count(self, labels: Optional[Dict[str, str]] = None) -> int:
+        return self.totals.get(_lkey(labels), 0)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self.counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        return self.gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str) -> Histogram:
+        return self.histograms.setdefault(name, Histogram())
+
+    def expose(self) -> str:
+        """Prometheus text exposition."""
+        lines: List[str] = []
+        for name, c in sorted(self.counters.items()):
+            lines.append(f"# TYPE {name} counter")
+            for lkey, v in sorted(c.values.items()):
+                lbl = ",".join(f'{k}="{val}"' for k, val in lkey)
+                lines.append(f"{name}{{{lbl}}} {v:g}" if lbl else f"{name} {v:g}")
+        for name, g in sorted(self.gauges.items()):
+            lines.append(f"# TYPE {name} gauge")
+            for lkey, v in sorted(g.values.items()):
+                lbl = ",".join(f'{k}="{val}"' for k, val in lkey)
+                lines.append(f"{name}{{{lbl}}} {v:g}" if lbl else f"{name} {v:g}")
+        for name, h in sorted(self.histograms.items()):
+            lines.append(f"# TYPE {name} histogram")
+            for lkey, total in sorted(h.totals.items()):
+                lbl = ",".join(f'{k}="{val}"' for k, val in lkey)
+                base = f"{name}_count{{{lbl}}}" if lbl else f"{name}_count"
+                lines.append(f"{base} {total}")
+        return "\n".join(lines)
+
+
+# global default registry (controllers accept an override)
+registry = Registry()
+
+# metric names mirroring concepts/metrics.md
+SCHEDULING_DURATION = "karpenter_scheduling_duration_seconds"
+CLOUDPROVIDER_DURATION = "karpenter_cloudprovider_duration_seconds"
+NODES_CREATED = "karpenter_nodes_created_total"
+NODES_TERMINATED = "karpenter_nodes_terminated_total"
+DEPROVISIONING_ACTIONS = "karpenter_deprovisioning_actions_performed_total"
+DEPROVISIONING_DURATION = "karpenter_deprovisioning_evaluation_duration_seconds"
+INTERRUPTION_RECEIVED = "karpenter_interruption_received_messages_total"
+INTERRUPTION_LATENCY = "karpenter_interruption_message_latency_seconds"
+PODS_STARTUP_DURATION = "karpenter_pods_startup_time_seconds"
+PROVISIONER_USAGE = "karpenter_provisioner_usage"
+PROVISIONER_LIMIT = "karpenter_provisioner_limit"
+BATCH_SIZE = "karpenter_provisioner_batch_size"
+SOLVER_BACKEND_DURATION = "karpenter_solver_backend_duration_seconds"
+
+
+def decorate(provider, reg: Optional[Registry] = None):
+    """Wrap every public method of a CloudProvider in a duration histogram
+    (core metrics.Decorate analog)."""
+    reg = reg or registry
+    hist = reg.histogram(CLOUDPROVIDER_DURATION)
+
+    class Decorated:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            attr = getattr(self._inner, name)
+            if not callable(attr) or name.startswith("_"):
+                return attr
+
+            def wrapped(*args, **kw):
+                t0 = time.perf_counter()
+                try:
+                    return attr(*args, **kw)
+                finally:
+                    hist.observe(
+                        time.perf_counter() - t0,
+                        {"controller": "cloudprovider", "method": name},
+                    )
+
+            return wrapped
+
+    return Decorated(provider)

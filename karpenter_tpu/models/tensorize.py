@@ -99,6 +99,7 @@ class SolveTensors:
     # candidate axis
     cand_names: List[Tuple[str, str]]   # (provisioner, instance type)
     cand_alloc: np.ndarray   # [C, R] f32 allocatable
+    cand_cap: np.ndarray     # [C, R] f32 raw capacity (for provisioner limits)
     cand_vw: np.ndarray      # [C, K] int32 (value-id // 32)
     cand_vb: np.ndarray      # [C, K] int32 (value-id % 32)
     cand_prov: np.ndarray    # [C] int32
@@ -117,7 +118,13 @@ class SolveTensors:
     dom_vw: np.ndarray       # [D, 2] int32 packed word idx for (zone key, ct key)
     dom_vb: np.ndarray       # [D, 2] int32 bit idx
     zone_names: List[str]
+    ct_names: List[str]      # capacity types in domain-minor order (d = z*|ct| + ct)
     n_zones: int
+    # selector table backing the S axis: (LabelSelector, topology_key, kind)
+    selector_defs: List[Tuple[LabelSelector, str, str]] = field(default_factory=list)
+    # groups with positive pod-affinity terms: not solvable on-device (v1);
+    # callers route these to the CPU oracle
+    g_positive_affinity: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=bool))
 
     @property
     def G(self) -> int:
@@ -201,6 +208,7 @@ def tensorize(
     provisioners: Sequence[Provisioner],
     instance_types: Sequence[InstanceType],
     *,
+    daemonsets: Sequence[PodSpec] = (),
     vocab: Optional[Vocab] = None,
     unavailable: Optional[set] = None,  # {(instance_type, zone, capacity_type)} ICE-style mask
 ) -> SolveTensors:
@@ -232,6 +240,9 @@ def tensorize(
             for v in req.values:
                 vocab.value(req.key, v)
         for rname in g.requests:
+            vocab.resource(rname)
+    for d in daemonsets:
+        for rname in d.requests:
             vocab.resource(rname)
     zone_key = vocab.key(L.ZONE)
     ct_key = vocab.key(L.CAPACITY_TYPE)
@@ -339,6 +350,7 @@ def tensorize(
     C = len(pairs)
     cand_names: List[Tuple[str, str]] = []
     cand_alloc = np.zeros((max(1, C), R), dtype=np.float32)
+    cand_cap = np.zeros((max(1, C), R), dtype=np.float32)
     candV = np.zeros((max(1, C), K), dtype=np.int32)
     cand_prov = np.zeros(max(1, C), dtype=np.int32)
     cand_price = np.full((max(1, C), D), np.inf, dtype=np.float32)
@@ -346,9 +358,21 @@ def tensorize(
     dom_index = {zc: i for i, zc in enumerate(doms)}
     for ci, (pi, prov, it, merged) in enumerate(pairs):
         cand_names.append((prov.name, it.name))
+        labels_nodeside = {**it.labels(), **prov.labels}
         alloc = dict(it.allocatable)
+        # daemonset overhead: same filter as the oracle (tolerate provisioner
+        # taints + requirements compatible with node-side labels)
+        for d in daemonsets:
+            if any(t.blocks(d.tolerations) for t in prov.taints):
+                continue
+            if any(r.compatible(labels_nodeside) is not None for r in d.scheduling_requirements()):
+                continue
+            for rname, v in d.requests.items():
+                alloc[rname] = alloc.get(rname, 0.0) - v
+            alloc[L.RESOURCE_PODS] = alloc.get(L.RESOURCE_PODS, 0.0) - 1.0
         cand_alloc[ci] = vocab.resources_to_row(alloc).astype(np.float32)
-        labels = {**it.labels(), **prov.labels, L.PROVISIONER_NAME: prov.name}
+        cand_cap[ci] = vocab.resources_to_row(it.capacity).astype(np.float32)
+        labels = {**labels_nodeside, L.PROVISIONER_NAME: prov.name}
         candV[ci] = vocab.labels_to_ids(labels)
         cand_prov[ci] = prov_index[prov.name]
         preqs = prov_reqs[prov.name]
@@ -389,6 +413,7 @@ def tensorize(
         g_sel_match=g_sel_match,
         cand_names=cand_names,
         cand_alloc=cand_alloc,
+        cand_cap=cand_cap,
         cand_vw=candV // 32,
         cand_vb=candV % 32,
         cand_prov=cand_prov,
@@ -403,5 +428,11 @@ def tensorize(
         dom_vw=dom_vw,
         dom_vb=dom_vb,
         zone_names=zones,
+        ct_names=cts,
         n_zones=len(zones),
+        selector_defs=list(slots.selectors),
+        g_positive_affinity=np.array(
+            [any(not t.anti for t in g.pods[0].affinity_terms) for g in groups],
+            dtype=bool,
+        ),
     )

@@ -1,0 +1,96 @@
+"""Small JAX ops shared by the solvers.
+
+These are the building blocks the TPU solver composes: packed-bitmask
+requirement tests, lexicographic argmin (deterministic tie-breaking to mirror
+the oracle's (score, price, candidate, offering) ordering), and integer
+water-filling for topology-spread balancing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+BIG = jnp.float32(3.4e38)
+
+
+def gather_pm_bits(pm_g: jnp.ndarray, vw: jnp.ndarray, vb: jnp.ndarray) -> jnp.ndarray:
+    """pm_g: [K, W]; vw/vb: [C, K] -> [C, K] bool bit tests via vmap over K."""
+
+    def per_key(pm_k, vw_k, vb_k):  # pm_k: [W], vw_k/vb_k: [C]
+        words = pm_k[vw_k]
+        return ((words >> vb_k.astype(jnp.uint32)) & jnp.uint32(1)).astype(bool)
+
+    return jax.vmap(per_key, in_axes=(0, 1, 1), out_axes=1)(pm_g, vw, vb)
+
+
+def lex_argmin(*keys: jnp.ndarray) -> jnp.ndarray:
+    """Index of the lexicographic minimum across equally-shaped float keys.
+
+    Mirrors Python tuple-comparison ordering; later keys break ties.  Ties
+    remaining after the last key resolve to the lowest index (jnp.argmin).
+    """
+    flat = [k.reshape(-1).astype(jnp.float32) for k in keys]
+    mask = jnp.ones_like(flat[0], dtype=bool)
+    for k in flat:
+        cur = jnp.where(mask, k, BIG)
+        m = jnp.min(cur)
+        mask = mask & (cur <= m)
+    return jnp.argmax(mask)  # first True
+
+
+def water_fill(
+    current: jnp.ndarray, cap: jnp.ndarray, total: jnp.ndarray, eligible: jnp.ndarray
+) -> jnp.ndarray:
+    """Integer water-fill: allocate ``total`` units across zones, raising the
+    lowest ``current`` counts first (sequential min-count placement in closed
+    form), bounded by per-zone ``cap``; ineligible zones get 0.
+
+    Returns alloc [Z] with sum(alloc) <= total (shortfall means capacity ran
+    out).  32 rounds of bisection on the common level.
+    """
+    Z = current.shape[0]
+    cur = jnp.where(eligible, current.astype(jnp.float32), BIG)
+    capf = jnp.where(eligible, cap.astype(jnp.float32), 0.0)
+    hi = jnp.max(jnp.where(eligible, cur, 0.0)) + total.astype(jnp.float32) + 1.0
+    lo = jnp.float32(0.0)
+
+    def alloc_at(level):
+        return jnp.minimum(capf, jnp.maximum(0.0, level - cur))
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = 0.5 * (lo + hi)
+        used = jnp.sum(alloc_at(mid))
+        return jnp.where(used >= total, lo, mid), jnp.where(used >= total, mid, hi)
+
+    lo, hi = jax.lax.fori_loop(0, 40, body, (lo, hi))
+    alloc = jnp.floor(alloc_at(hi))
+    # floor() may overshoot/undershoot by < Z units; trim deterministically
+    # (highest zone index first), then top up zones with slack
+    excess = jnp.maximum(0.0, jnp.sum(alloc) - total)
+    idx = jnp.arange(Z, dtype=jnp.float32)
+    # trim: remove 1 from zones (desc index) while excess remains
+    order = jnp.argsort(-idx)
+    trim = jnp.cumsum(jnp.where(alloc[order] > 0, 1.0, 0.0))
+    take_back = jnp.where(trim <= excess, jnp.where(alloc[order] > 0, 1.0, 0.0), 0.0)
+    alloc = alloc.at[order].add(-take_back)
+    # top up: add 1 to zones with slack (asc index) while shortfall remains
+    shortfall = jnp.maximum(0.0, total - jnp.sum(alloc))
+    slack = capf - alloc
+    fill = jnp.cumsum(jnp.where(slack > 0, 1.0, 0.0))
+    add = jnp.where(fill <= shortfall, jnp.where(slack > 0, 1.0, 0.0), 0.0)
+    alloc = alloc + add
+    return jnp.maximum(alloc, 0.0).astype(jnp.int32)
+
+
+def prefix_allocate(cap: jnp.ndarray, quota: jnp.ndarray) -> jnp.ndarray:
+    """First-fit allocation along an ordered axis: take as much as possible
+    from each slot in order until ``quota`` is exhausted.
+
+    cap: [N] float — capacity per slot (in order)
+    quota: scalar — total to place
+    returns take [N] with sum(take) == min(quota, sum(cap)).
+    """
+    before = jnp.cumsum(cap) - cap
+    return jnp.clip(quota - before, 0.0, cap)

@@ -1,0 +1,81 @@
+"""Device mesh + sharding layout for the solver.
+
+The reference scales with goroutines on one process (SURVEY.md §2.3); the
+TPU-native answer is a ``jax.sharding.Mesh`` with named axes and GSPMD
+partitioning (SURVEY.md §5 "long-context" slot):
+
+- ``pods``  — shards the pod-group axis (G) of the requirement masks and the
+  node-slot axis (NR) of the packing state; the analog of data parallelism.
+- ``types`` — shards the candidate axis (C) of the catalog tensors; the
+  analog of tensor/model parallelism.
+
+Feasibility (``F[G, C]``) is computed fully sharded on both axes — this is
+the O(G*C*K) hot tensor contraction.  The packing scan's per-step vector math
+shards over node slots; XLA inserts the prefix-sum collectives.  Consolidation
+what-if evaluation (solver/consolidation.py) shards candidate subsets over
+``pods`` x ``types`` jointly — embarrassingly parallel batched solves.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+POD_AXIS = "pods"
+TYPE_AXIS = "types"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """Build a (pods, types) mesh over the available devices.
+
+    Prefers a 2D factorization (e.g. 8 -> 4x2) so both the group axis and the
+    candidate axis shard; degenerates gracefully to 1D.
+    """
+    devices = jax.devices()
+    if n_devices is not None and len(devices) < n_devices:
+        # fall back to the (possibly virtualized) CPU platform — used by the
+        # multi-chip dryrun where real chips aren't available
+        try:
+            cpus = jax.devices("cpu")
+        except RuntimeError:
+            cpus = []
+        if len(cpus) >= n_devices:
+            devices = cpus
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    # largest factor pair (a, b) with a >= b
+    b = int(np.floor(np.sqrt(n)))
+    while n % b:
+        b -= 1
+    a = n // b
+    dev_array = np.array(devices).reshape(a, b)
+    return Mesh(dev_array, (POD_AXIS, TYPE_AXIS))
+
+
+def feasibility_shardings(mesh: Mesh):
+    """(in_shardings, out_shardings) for solver.tpu.compute_feasibility."""
+    s = lambda *names: NamedSharding(mesh, P(*names))
+    ins = dict(
+        pm=s(POD_AXIS),            # [G, K, W]
+        requests=s(POD_AXIS),      # [G, R]
+        gp_ok=s(POD_AXIS),         # [G, P]
+        cand_vw=s(TYPE_AXIS),      # [C, K]
+        cand_vb=s(TYPE_AXIS),
+        cand_alloc=s(TYPE_AXIS),
+        cand_prov=s(TYPE_AXIS),
+        key_check=s(),
+        dom_vw=s(),
+        dom_vb=s(),
+    )
+    outs = (s(POD_AXIS, TYPE_AXIS), s(POD_AXIS))  # F[G,C], dom_ok[G,D]
+    return ins, outs
+
+
+def replicate(mesh: Mesh, tree):
+    """Place a pytree fully replicated on the mesh."""
+    sh = NamedSharding(mesh, P())
+    return jax.device_put(tree, sh)

@@ -1,0 +1,635 @@
+"""TPU batch solver — vectorized FFD bin-packing as a jitted JAX program.
+
+This is the component BASELINE.json's north star names: karpenter-core's
+``scheduling.Solve`` first-fit-decreasing loop (SURVEY.md §3.2 step 3)
+re-expressed as dense tensor math so 50k pods x the full catalog solve in
+milliseconds on a TPU.
+
+Design (tpu-first, not a port of the Go loop):
+
+- **Feasibility is tensor algebra.**  ``F[g, c] = label_ok & fit_ok & prov_ok``
+  computed by packed-bitmask gathers (models/vocab.py) and broadcast resource
+  compares; zone/capacity-type feasibility joins per-domain:
+  ``Fd[c, d] = F[g, c] & avail[c, d] & zone_ok[g, d] & ct_ok[g, d]``.
+- **The pack is a scan over pod *groups*, not pods.**  Identical pods (same
+  constraints+requests) collapse into one scan step; within a step every
+  placement decision is closed-form vector math over node slots:
+  first-fit = prefix-sum allocation in slot-creation order
+  (ops/masks.prefix_allocate), topology spread = integer water-fill over
+  zones (ops/masks.water_fill), new-node selection = lexicographic argmin
+  over (candidate x domain) score tensors.  No data-dependent Python control
+  flow — one traced step, ``lax.scan`` over G.
+- **Node state is slot-per-node.**  Preallocated arrays of NR node slots
+  (existing nodes first, then creation order), so "first fit in creation
+  order" is literally array order.
+
+Known v1 semantic gaps vs the CPU oracle (solver/reference.py), accepted
+within the 1.02x cost-parity budget and flagged for later rounds:
+- positive pod-affinity groups are not solved on-device (tensorize marks
+  them; callers route those pods to the oracle),
+- maxSkew > 1 spread is balanced (water-filled) instead of first-fit-within-
+  band,
+- when a provisioner limit binds mid-group the remainder is marked
+  infeasible instead of falling back to the next-best candidate.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import labels as L
+from ..models.tensorize import NO_SELECTOR, SolveTensors
+from ..ops.masks import BIG, gather_pm_bits, lex_argmin, prefix_allocate, water_fill
+from .types import SimNode, SolveResult
+
+BIGN = jnp.float32(1e9)  # "unbounded" node/pod counts
+
+
+# ---------------------------------------------------------------------------
+# feasibility precompute
+# ---------------------------------------------------------------------------
+
+
+def compute_feasibility(
+    pm: jnp.ndarray,          # [G, K, W] uint32
+    requests: jnp.ndarray,    # [G, R]
+    gp_ok: jnp.ndarray,       # [G, P]
+    cand_vw: jnp.ndarray,     # [C, K]
+    cand_vb: jnp.ndarray,     # [C, K]
+    cand_alloc: jnp.ndarray,  # [C, R]
+    cand_prov: jnp.ndarray,   # [C]
+    key_check: jnp.ndarray,   # [K]
+    dom_vw: jnp.ndarray,      # [D, 2]
+    dom_vb: jnp.ndarray,      # [D, 2]
+    zone_key: int,
+    ct_key: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (F[G, C] candidate feasibility, dom_ok[G, D] zone&ct allowed)."""
+
+    def one_group(args):
+        pm_g, req_g = args
+        bits = gather_pm_bits(pm_g, cand_vw, cand_vb)      # [C, K]
+        lab = jnp.all(bits | ~key_check[None, :], axis=1)  # [C]
+        fit = jnp.all(
+            (req_g[None, :] <= cand_alloc + 1e-6) | (req_g[None, :] <= 0), axis=1
+        )
+        return lab & fit
+
+    # chunked vmap bounds the materialized [chunk, C, K] gather intermediate
+    G = pm.shape[0]
+    outs = []
+    for i in range(0, G, 512):
+        outs.append(jax.vmap(one_group)((pm[i : i + 512], requests[i : i + 512])))
+    F = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+    F = F & gp_ok[jnp.arange(G)[:, None], cand_prov[None, :]]
+
+    # domain allowance from the zone / capacity-type keys of each group's mask
+    def dom_one(pm_g):
+        zw = pm_g[zone_key][dom_vw[:, 0]]
+        zok = ((zw >> dom_vb[:, 0].astype(jnp.uint32)) & jnp.uint32(1)).astype(bool)
+        cw = pm_g[ct_key][dom_vw[:, 1]]
+        cok = ((cw >> dom_vb[:, 1].astype(jnp.uint32)) & jnp.uint32(1)).astype(bool)
+        return zok & cok
+
+    dom_ok = jax.vmap(dom_one)(pm)
+    return F, dom_ok
+
+
+# ---------------------------------------------------------------------------
+# the scan step
+# ---------------------------------------------------------------------------
+
+
+def _make_step(
+    consts: dict,
+    NR: int,
+    Z: int,
+    track: bool,
+):
+    """Build the per-group scan step closure over constant tensors."""
+    counts = consts["counts"]          # [G]
+    requests = consts["requests"]      # [G, R]
+    F = consts["F"]                    # [G, C]
+    dom_ok = consts["dom_ok"]          # [G, D]
+    g_zone_spread = consts["g_zone_spread"]
+    g_zone_skew = consts["g_zone_skew"]
+    g_host_spread = consts["g_host_spread"]
+    g_host_cap = consts["g_host_cap"]
+    g_zone_anti = consts["g_zone_anti"]
+    g_sel_match = consts["g_sel_match"]  # [S, G]
+    cand_alloc = consts["cand_alloc"]  # [C, R]
+    cand_cap = consts["cand_cap"]      # [C, R]
+    cand_prov = consts["cand_prov"]    # [C]
+    cand_price = consts["cand_price"]  # [C, D]
+    cand_avail = consts["cand_avail"]  # [C, D]
+    prov_limits = consts["prov_limits"]  # [P, R]
+    dom_zone = consts["dom_zone"]      # [D]
+    ex_ok = consts["ex_ok"]            # [G, NE_pad] existing-node label/taint compat
+
+    C, D = cand_price.shape
+    NE_pad = ex_ok.shape[1]
+    slot_idx = jnp.arange(NR, dtype=jnp.int32)
+
+    def step(carry, g):
+        (res, row_zone, row_dom, row_cand, row_price, selcnt, active,
+         n_used, zc, tot, prov_used, infeasible) = carry
+
+        req_g = requests[g]                      # [R]
+        cnt = counts[g].astype(jnp.float32)
+        Fg = F[g]                                # [C]
+        dok = dom_ok[g]                          # [D]
+        Fd_g = (Fg[:, None] & cand_avail & dok[None, :])  # [C, D]
+
+        # ---- per-slot feasibility & capacity --------------------------
+        safe_cand = jnp.maximum(row_cand, 0)
+        safe_dom = jnp.maximum(row_dom, 0)
+        rf_cand = Fd_g[safe_cand, safe_dom]
+        # slots >= NE_pad always have row_cand >= 0 (solver-created), so the
+        # clamped gather below never feeds a wrong ex_ok value into rf
+        exv = ex_ok[g][jnp.minimum(slot_idx, NE_pad - 1)]
+        rf = active & jnp.where(row_cand >= 0, rf_cand, exv)
+
+        ratios = jnp.where(req_g[None, :] > 0, jnp.floor((res + 1e-6) / jnp.maximum(req_g[None, :], 1e-9)), BIGN)
+        cap = jnp.min(ratios, axis=1)            # [NR]
+
+        sh = g_host_spread[g]
+        hk = g_host_cap[g].astype(jnp.float32)
+        selrow = selcnt[:, jnp.maximum(sh, 0)].astype(jnp.float32)
+        hcap = jnp.where(hk > 0, hk - selrow, jnp.where(selrow > 0, 0.0, BIGN))
+        cap = jnp.where(sh >= 0, jnp.minimum(cap, hcap), cap)
+        cap = jnp.maximum(cap, 0.0) * rf
+
+        # ---- zone-level caps ------------------------------------------
+        zsp = g_zone_spread[g]
+        za = g_zone_anti[g]
+        zoned = (zsp >= 0) | (za >= 0)
+
+        # eligible zones: any allowed domain in the zone
+        el = jnp.zeros(Z, dtype=bool).at[dom_zone].max(dok)
+        # zone anti-affinity cap
+        zc_an = zc[jnp.maximum(za, 0)].astype(jnp.float32)          # [Z]
+        self_match = g_sel_match[jnp.maximum(za, 0), g]
+        anti_cap = jnp.where(
+            self_match, jnp.maximum(1.0 - zc_an, 0.0),
+            jnp.where(zc_an > 0, 0.0, BIGN),
+        )
+        anti_cap = jnp.where(za >= 0, anti_cap, BIGN)               # [Z]
+
+        rowcap_z = jnp.zeros(Z, dtype=jnp.float32).at[jnp.maximum(row_zone, 0)].add(
+            jnp.where(active, cap, 0.0)
+        )
+
+        # ---- new-node candidate scoring --------------------------------
+        nr_ratios = jnp.where(
+            req_g[None, :] > 0,
+            jnp.floor((cand_alloc + 1e-6) / jnp.maximum(req_g[None, :], 1e-9)),
+            BIGN,
+        )
+        ppn = jnp.min(nr_ratios, axis=1)                            # [C]
+        hcap_new = jnp.where((sh >= 0) & (hk > 0), hk, BIGN)
+        ppn = jnp.minimum(ppn, hcap_new)
+        lim_ok = jnp.all(
+            prov_used[cand_prov] + cand_cap <= prov_limits[cand_prov] + 1e-6, axis=1
+        )                                                            # [C]
+        new_ok = Fd_g & (ppn[:, None] >= 1.0) & lim_ok[:, None]      # [C, D]
+        zone_of_dom = dom_zone                                       # [D]
+        new_ok_z = jnp.zeros(Z, dtype=bool).at[zone_of_dom].max(jnp.any(new_ok, axis=0))
+
+        cap_z = jnp.minimum(rowcap_z + jnp.where(new_ok_z, BIGN, 0.0), anti_cap)
+        cap_z = jnp.where(el, cap_z, 0.0)
+
+        # ---- allocation: rows then new nodes ---------------------------
+        zc_sp = jnp.where(zsp >= 0, zc[jnp.maximum(zsp, 0)], jnp.zeros(Z, jnp.int32)).astype(jnp.float32)
+
+        def zoned_alloc(_):
+            alloc_z = water_fill(zc_sp, cap_z, cnt, el).astype(jnp.float32)  # [Z]
+            # per-zone prefix allocation over slots in creation order
+            zone1h = (row_zone[:, None] == jnp.arange(Z)[None, :])           # [NR, Z]
+            capz_slots = jnp.where(zone1h, cap[:, None], 0.0)
+            before = jnp.cumsum(capz_slots, axis=0) - capz_slots
+            take_slots = jnp.clip(alloc_z[None, :] - before, 0.0, capz_slots)
+            take = jnp.sum(jnp.where(zone1h, take_slots, 0.0), axis=1)
+            taken_z = jnp.sum(jnp.where(zone1h, take_slots, 0.0), axis=0)
+            rem_z = jnp.maximum(alloc_z - taken_z, 0.0)
+            return take, rem_z
+
+        def simple_alloc(_):
+            take = prefix_allocate(cap, cnt)
+            rem = cnt - jnp.sum(take)
+            return take, jnp.where(jnp.arange(Z) == 0, rem, 0.0)  # placeholder; zone chosen below
+
+        take, rem_z = jax.lax.cond(zoned, zoned_alloc, simple_alloc, operand=None)
+
+        # ---- new-node creation -------------------------------------------
+        # Mirrors the oracle: while pods remain, pick argmin
+        # price / min(ppn, remaining); nodes of the chosen type are created in
+        # bulk while remaining >= ppn, then the tail re-scores once with the
+        # smaller remainder (matching the per-pod re-scoring sequence).
+        ci_key = jnp.broadcast_to(jnp.arange(C, dtype=jnp.float32)[:, None], (C, D))
+        di_key = jnp.broadcast_to(jnp.arange(D, dtype=jnp.float32)[None, :], (C, D))
+        price_key = jnp.where(new_ok, cand_price, BIG)
+
+        def pick(rem, dom_mask):
+            """argmin over (C, D & dom_mask) of price/min(ppn, rem)."""
+            denom = jnp.maximum(jnp.minimum(ppn, jnp.maximum(rem, 1.0)), 1.0)
+            score = jnp.where(new_ok & dom_mask[None, :], cand_price / denom[:, None], BIG)
+            pk = jnp.where(new_ok & dom_mask[None, :], cand_price, BIG)
+            flat = lex_argmin(score, pk, ci_key, di_key)
+            bc = (flat // D).astype(jnp.int32)
+            bd = (flat % D).astype(jnp.int32)
+            ok = score.reshape(-1)[flat] < BIG
+            return bc, bd, ok
+
+        state = (res, row_zone, row_dom, row_cand, row_price, active, prov_used,
+                 jnp.zeros(NR, dtype=jnp.float32), n_used)
+
+        def write_block(state, n_nodes, per_node, last_extra, bc, bd):
+            """Append n_nodes slots of candidate bc/domain bd; each takes
+            per_node pods except the last which takes last_extra."""
+            (res, row_zone, row_dom, row_cand, row_price, active, prov_used,
+             new_take, cursor) = state
+            n_nodes = jnp.minimum(n_nodes, NR - cursor)  # slot budget
+            in_block = (slot_idx >= cursor) & (slot_idx < cursor + n_nodes)
+            is_last = slot_idx == (cursor + n_nodes - 1)
+            blk = jnp.where(in_block, jnp.where(is_last, last_extra, per_node), 0.0)
+            new_take = new_take + blk
+            res = jnp.where(in_block[:, None], cand_alloc[bc][None, :], res)
+            row_zone = jnp.where(in_block, dom_zone[bd], row_zone)
+            row_dom = jnp.where(in_block, bd, row_dom)
+            row_cand = jnp.where(in_block, bc, row_cand)
+            row_price = jnp.where(in_block, cand_price[bc, bd], row_price)
+            active = active | in_block
+            prov_used = prov_used.at[cand_prov[bc]].add(
+                cand_cap[bc] * n_nodes.astype(jnp.float32)
+            )
+            return (res, row_zone, row_dom, row_cand, row_price, active,
+                    prov_used, new_take, cursor + n_nodes)
+
+        def limit_headroom(prov_used_cur, bc):
+            """Max nodes of candidate bc before its provisioner limit binds."""
+            p = cand_prov[bc]
+            head = prov_limits[p] - prov_used_cur[p]          # [R]
+            cap_row = cand_cap[bc]
+            per = jnp.where(cap_row > 0, jnp.floor((head + 1e-6) / jnp.maximum(cap_row, 1e-9)), BIGN)
+            return jnp.clip(jnp.min(per), 0.0, BIGN)
+
+        def two_stage(state, rem, dom_mask):
+            bc, bd, ok = pick(rem, dom_mask)
+            ppn_b = jnp.maximum(ppn[bc], 1.0)
+            n_bulk_f = jnp.where(ok, jnp.floor(rem / ppn_b), 0.0)
+            n_bulk = jnp.minimum(n_bulk_f, limit_headroom(state[6], bc)).astype(jnp.int32)
+            state = write_block(state, n_bulk, ppn_b, ppn_b, bc, bd)
+            rem_t = jnp.maximum(rem - n_bulk.astype(jnp.float32) * ppn_b, 0.0)
+            ct_, dt_, ok_t = pick(rem_t, dom_mask)
+            ppn_t = jnp.maximum(ppn[ct_], 1.0)
+            n_tail_f = jnp.where(ok_t & (rem_t > 0), jnp.ceil(rem_t / ppn_t), 0.0)
+            n_tail = jnp.minimum(n_tail_f, limit_headroom(state[6], ct_)).astype(jnp.int32)
+            last = rem_t - (n_tail.astype(jnp.float32) - 1.0) * ppn_t
+            state = write_block(state, n_tail, ppn_t, jnp.clip(last, 0.0, ppn_t), ct_, dt_)
+            return state
+
+        def create_simple(state):
+            return two_stage(state, jnp.sum(rem_z), jnp.ones(D, dtype=bool))
+
+        def create_zoned(state):
+            for z in range(Z):  # Z static and small
+                state = two_stage(state, rem_z[z], zone_of_dom == z)
+            return state
+
+        state = jax.lax.cond(zoned, create_zoned, create_simple, state)
+        (res, row_zone, row_dom, row_cand, row_price, active, prov_used,
+         new_take, n_used) = state
+
+        total_take = take + new_take
+        res = res - total_take[:, None] * req_g[None, :]
+
+        # ---- counters -----------------------------------------------------
+        match_g = g_sel_match[:, g].astype(jnp.float32)                        # [S]
+        selcnt = selcnt + (total_take[:, None] * match_g[None, :]).astype(selcnt.dtype)
+        placed_z = jnp.zeros(Z, dtype=jnp.float32).at[jnp.maximum(row_zone, 0)].add(
+            jnp.where(active, total_take, 0.0)
+        )
+        zc = zc + (match_g[:, None] * placed_z[None, :]).astype(zc.dtype)
+        placed = jnp.sum(total_take)
+        tot = tot + (match_g * placed).astype(tot.dtype)
+        infeasible = infeasible.at[g].set(jnp.round(cnt - placed).astype(jnp.int32))
+
+        carry = (res, row_zone, row_dom, row_cand, row_price, selcnt, active,
+                 n_used, zc, tot, prov_used, infeasible)
+        ys = total_take.astype(jnp.int32) if track else jnp.int32(0)
+        return carry, ys
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# host-facing API
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TpuSolveOutput:
+    result: SolveResult
+    takes: Optional[np.ndarray]  # [G, NR] pods placed per slot per group step
+    n_used: int
+    solve_ms: float
+    compile_ms: float
+
+
+class TpuSolver:
+    """Builds and caches the jitted solve for a tensor shape signature."""
+
+    def __init__(self) -> None:
+        self._cache: Dict[tuple, object] = {}
+
+    def prepare(
+        self,
+        st: SolveTensors,
+        *,
+        existing_nodes: Sequence[SimNode] = (),
+        max_nodes: Optional[int] = None,
+        track_assignments: bool = True,
+        mesh=None,
+    ):
+        """Build (run_fn, init_carry).  ``mesh`` shards the group/candidate/
+        node-slot axes over a jax.sharding.Mesh (parallel/mesh.py layout)."""
+        G, C, D, R = st.G, max(1, st.C), st.D, st.R
+        S, Z = st.S, max(1, st.n_zones)
+        K, W = st.pm.shape[1], st.pm.shape[2]
+        NE = len(existing_nodes)
+
+        total_pods = int(st.counts.sum())
+        if max_nodes is None:
+            max_nodes = NE + total_pods  # worst case: one pod per node
+        NR = max(1, max_nodes)
+
+        # ---- mesh padding: shard axes must divide evenly ----------------
+        pad_g = pad_c = 0
+        if mesh is not None:
+            from ..parallel.mesh import POD_AXIS, TYPE_AXIS
+
+            a = mesh.shape[POD_AXIS]
+            b = mesh.shape[TYPE_AXIS]
+            pad_g = (-G) % a
+            pad_c = (-C) % b
+            NR = NR + ((-NR) % a)
+
+        def _pad(arr, n, axis, value):
+            if n == 0:
+                return arr
+            widths = [(0, 0)] * arr.ndim
+            widths[axis] = (0, n)
+            return np.pad(arr, widths, constant_values=value)
+
+        np_counts = _pad(st.counts, pad_g, 0, 0)
+        np_requests = _pad(st.requests, pad_g, 0, 0)
+        np_pm = _pad(st.pm, pad_g, 0, 0)
+        np_gzs = _pad(st.g_zone_spread, pad_g, 0, -1)
+        np_gzk = _pad(st.g_zone_skew, pad_g, 0, 1)
+        np_ghs = _pad(st.g_host_spread, pad_g, 0, -1)
+        np_ghc = _pad(st.g_host_cap, pad_g, 0, 0)
+        np_gza = _pad(st.g_zone_anti, pad_g, 0, -1)
+        np_gsm = _pad(st.g_sel_match, pad_g, 1, False)
+        np_gp_ok = _pad(st.gp_ok, pad_g, 0, False)
+        np_cvw = _pad(st.cand_vw, pad_c, 0, 0)
+        np_cvb = _pad(st.cand_vb, pad_c, 0, 0)
+        np_calloc = _pad(st.cand_alloc, pad_c, 0, 0)
+        np_ccap = _pad(st.cand_cap, pad_c, 0, 0)
+        np_cprov = _pad(st.cand_prov, pad_c, 0, 0)
+        np_cprice = _pad(st.cand_price, pad_c, 0, np.float32(3.0e38))
+        np_cavail = _pad(st.cand_avail, pad_c, 0, False)
+        G = G + pad_g
+
+        # ---- existing-node tensors (host-side compat precompute) -------
+        NE_pad = max(1, NE)
+        ex_res = np.zeros((NR, R), dtype=np.float32)
+        ex_zone = np.zeros(NR, dtype=np.int32)
+        ex_sel = np.zeros((NR, S), dtype=np.int32)
+        ex_ok = np.zeros((G, NE_pad), dtype=bool)
+        ex_price = np.zeros(NR, dtype=np.float32)
+        zone_index = {z: i for i, z in enumerate(st.zone_names)}
+        zc0 = np.zeros((S, Z), dtype=np.int32)
+        tot0 = np.zeros(S, dtype=np.int32)
+        prov_used0 = np.zeros((max(1, len(st.prov_names)), R), dtype=np.float32)
+        prov_index = {n: i for i, n in enumerate(st.prov_names)}
+
+        for ni, node in enumerate(existing_nodes):
+            ex_res[ni] = st.vocab.resources_to_row(node.remaining()).astype(np.float32)
+            ex_zone[ni] = zone_index.get(node.zone, 0)
+            ex_price[ni] = node.price
+            pi = prov_index.get(node.provisioner)
+            if pi is not None:
+                prov_used0[pi] += st.vocab.resources_to_row(node.allocatable).astype(np.float32)
+            for gi, g in enumerate(st.groups):
+                rep = g.pods[0]
+                ex_ok[gi, ni] = (
+                    not any(t.blocks(rep.tolerations) for t in node.taints)
+                    and g.requirements.compatible(node.labels) is None
+                )
+        # selector counts on existing nodes + zone counters
+        for si, (sel, topo, kind) in enumerate(st.selector_defs):
+            for ni, node in enumerate(existing_nodes):
+                n_match = sum(1 for p in node.pods if sel.matches(p.labels))
+                ex_sel[ni, si] = n_match
+                zc0[si, zone_index.get(node.zone, 0)] += n_match
+                tot0[si] += n_match
+
+        consts = dict(
+            counts=jnp.asarray(np_counts),
+            requests=jnp.asarray(np_requests),
+            g_zone_spread=jnp.asarray(np_gzs),
+            g_zone_skew=jnp.asarray(np_gzk),
+            g_host_spread=jnp.asarray(np_ghs),
+            g_host_cap=jnp.asarray(np_ghc),
+            g_zone_anti=jnp.asarray(np_gza),
+            g_sel_match=jnp.asarray(np_gsm),
+            cand_alloc=jnp.asarray(np_calloc),
+            cand_cap=jnp.asarray(np_ccap),
+            cand_prov=jnp.asarray(np_cprov),
+            cand_price=jnp.asarray(np.where(np.isinf(np_cprice), np.float32(3.0e38), np_cprice).astype(np.float32)),
+            cand_avail=jnp.asarray(np_cavail),
+            prov_limits=jnp.asarray(np.where(np.isinf(st.prov_limits), np.float32(3.0e38), st.prov_limits)),
+            dom_zone=jnp.asarray(st.dom_zone),
+            ex_ok=jnp.asarray(ex_ok),
+        )
+
+        zone_key = st.vocab.key_id[L.ZONE]
+        ct_key = st.vocab.key_id[L.CAPACITY_TYPE]
+
+        if mesh is not None:
+            from ..parallel.mesh import POD_AXIS, TYPE_AXIS
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sg = NamedSharding(mesh, P(POD_AXIS))      # group axis
+            sc = NamedSharding(mesh, P(TYPE_AXIS))     # candidate axis
+            sr = NamedSharding(mesh, P())              # replicated
+            place = {
+                "counts": sg, "requests": sg, "g_zone_spread": sg, "g_zone_skew": sg,
+                "g_host_spread": sg, "g_host_cap": sg, "g_zone_anti": sg,
+                "g_sel_match": sr, "cand_alloc": sc, "cand_cap": sc,
+                "cand_prov": sc, "cand_price": sc, "cand_avail": sc,
+                "prov_limits": sr, "dom_zone": sr, "ex_ok": sg,
+            }
+            consts = {k: jax.device_put(v, place.get(k, sr)) for k, v in consts.items()}
+
+        F, dom_ok = compute_feasibility(
+            jnp.asarray(np_pm), consts["requests"], jnp.asarray(np_gp_ok),
+            jnp.asarray(np_cvw), jnp.asarray(np_cvb), consts["cand_alloc"],
+            consts["cand_prov"], jnp.asarray(st.key_check),
+            jnp.asarray(st.dom_vw), jnp.asarray(st.dom_vb), zone_key, ct_key,
+        )
+        consts["F"], consts["dom_ok"] = F, dom_ok
+
+        step = _make_step(consts, NR, Z, track_assignments)
+
+        init = (
+            jnp.asarray(ex_res),                                 # res
+            jnp.asarray(ex_zone),                                # row_zone
+            jnp.full(NR, -1, dtype=jnp.int32),                   # row_dom
+            jnp.full(NR, -1, dtype=jnp.int32),                   # row_cand
+            jnp.asarray(ex_price),                               # row_price
+            jnp.asarray(ex_sel),                                 # selcnt
+            jnp.asarray(np.arange(NR) < NE),                     # active
+            jnp.int32(NE),                                       # n_used
+            jnp.asarray(zc0),                                    # zc
+            jnp.asarray(tot0),                                   # tot
+            jnp.asarray(prov_used0),                             # prov_used
+            jnp.zeros(G, dtype=jnp.int32),                       # infeasible
+        )
+        if mesh is not None:
+            from ..parallel.mesh import POD_AXIS
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sn = NamedSharding(mesh, P(POD_AXIS))   # node-slot axis
+            sr = NamedSharding(mesh, P())
+            shardings = (sn, sn, sn, sn, sn, sn, sn, sr, sr, sr, sr, sr)
+            init = tuple(jax.device_put(a, s) for a, s in zip(init, shardings))
+
+        @jax.jit
+        def run(init):
+            return jax.lax.scan(step, init, jnp.arange(G, dtype=jnp.int32))
+
+        return run, init, NE
+
+    def solve(
+        self,
+        st: SolveTensors,
+        *,
+        existing_nodes: Sequence[SimNode] = (),
+        max_nodes: Optional[int] = None,
+        track_assignments: bool = True,
+        mesh=None,
+    ) -> TpuSolveOutput:
+        t0 = time.perf_counter()
+        run, init, NE = self.prepare(
+            st, existing_nodes=existing_nodes, max_nodes=max_nodes,
+            track_assignments=track_assignments, mesh=mesh,
+        )
+        carry, ys = run(init)
+        jax.block_until_ready(carry)
+        compile_ms = (time.perf_counter() - t0) * 1000.0
+
+        t1 = time.perf_counter()
+        carry, ys = run(init)
+        jax.block_until_ready(carry)
+        solve_ms = (time.perf_counter() - t1) * 1000.0
+
+        return self._extract(
+            st, carry, ys if track_assignments else None, existing_nodes,
+            NE, solve_ms, compile_ms,
+        )
+
+    # ---- result extraction ---------------------------------------------
+    def _extract(
+        self, st, carry, ys, existing_nodes, NE, solve_ms, compile_ms
+    ) -> TpuSolveOutput:
+        (res, row_zone, row_dom, row_cand, row_price, selcnt, active,
+         n_used, zc, tot, prov_used, infeasible) = [np.asarray(x) for x in carry]
+        n_used = int(n_used)
+
+        new_nodes: List[SimNode] = []
+        slot_to_node: Dict[int, SimNode] = {}
+        NE_pad = max(1, NE)
+        for si in range(NE, n_used):
+            ci = int(row_cand[si])
+            if ci < 0 or not active[si]:
+                continue
+            prov_name, type_name = st.cand_names[ci]
+            zone = st.zone_names[int(row_zone[si])] if st.zone_names else ""
+            node = SimNode(
+                instance_type=type_name,
+                provisioner=prov_name,
+                zone=zone,
+                capacity_type=self._ct_of_dom(st, int(row_dom[si])),
+                price=float(row_price[si]),
+                allocatable={
+                    st.vocab.resources[r]: float(st.cand_alloc[ci, r])
+                    for r in range(st.cand_alloc.shape[1])
+                },
+                existing=False,
+            )
+            new_nodes.append(node)
+            slot_to_node[si] = node
+
+        for ni, node in enumerate(existing_nodes):
+            slot_to_node[ni] = node
+
+        assignments: Dict[str, str] = {}
+        infeasible_map: Dict[str, str] = {}
+        if ys is not None:
+            takes = np.asarray(ys)  # [G, NR]
+            for gi, g in enumerate(st.groups):
+                placed_slots = np.nonzero(takes[gi])[0]
+                pod_iter = iter(g.pods)
+                for si in placed_slots:
+                    node = slot_to_node.get(int(si))
+                    for _ in range(int(takes[gi, si])):
+                        try:
+                            pod = next(pod_iter)
+                        except StopIteration:
+                            break
+                        assignments[pod.name] = node.name if node else f"slot-{si}"
+                        if node is not None:
+                            node.pods.append(pod)
+                for pod in pod_iter:
+                    infeasible_map[pod.name] = "solver: no feasible placement"
+        else:
+            takes = None
+            for gi, g in enumerate(st.groups):
+                k = int(infeasible[gi])
+                for pod in g.pods[len(g.pods) - k:]:
+                    infeasible_map[pod.name] = "solver: no feasible placement"
+
+        result = SolveResult(
+            nodes=new_nodes,
+            assignments=assignments,
+            infeasible=infeasible_map,
+            existing_nodes=list(existing_nodes),
+            solve_ms=solve_ms,
+        )
+        return TpuSolveOutput(
+            result=result, takes=takes, n_used=n_used,
+            solve_ms=solve_ms, compile_ms=compile_ms,
+        )
+
+    @staticmethod
+    def _ct_of_dom(st, di: int) -> str:
+        # tensorize builds domains zone-major: d = z * |ct| + ct_index
+        n_ct = max(1, len(st.ct_names))
+        if di < 0:
+            return ""
+        return st.ct_names[di % n_ct]
+
+
+_default_solver = TpuSolver()
+
+
+def solve_tensors(st: SolveTensors, **kw) -> TpuSolveOutput:
+    return _default_solver.solve(st, **kw)

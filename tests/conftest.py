@@ -7,10 +7,17 @@ GSPMD-partitioned programs the real mesh would run.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize force-registers the axon TPU platform even
+# when JAX_PLATFORMS=cpu is exported; override at the config layer (this must
+# run before any backend is initialized, which conftest import order ensures).
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,211 @@
+"""TPU solver parity vs the CPU oracle (cost within 1.02x on BASELINE shapes)."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.catalog import generate_catalog
+from karpenter_tpu.models.instancetype import GIB
+from karpenter_tpu.models.pod import (
+    LabelSelector,
+    PodAffinityTerm,
+    PodSpec,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.requirements import IN, Requirement
+from karpenter_tpu.models.tensorize import tensorize
+from karpenter_tpu.solver import reference
+from karpenter_tpu.solver.tpu import solve_tensors
+from karpenter_tpu.solver.types import SimNode
+
+PARITY = 1.02
+
+
+def default_prov(**kw):
+    return Provisioner(name=kw.pop("name", "default"), **kw).with_defaults()
+
+
+def assert_parity(pods, provs, catalog, **tensorize_kw):
+    oracle = reference.solve(pods, provs, catalog,
+                             unavailable=tensorize_kw.get("unavailable"),
+                             daemonsets=tensorize_kw.get("daemonsets", ()))
+    st = tensorize(pods, provs, catalog, **tensorize_kw)
+    out = solve_tensors(st)
+    tpu = out.result
+    assert len(tpu.infeasible) == len(oracle.infeasible), (
+        f"infeasible mismatch: tpu={len(tpu.infeasible)} oracle={len(oracle.infeasible)}"
+    )
+    if oracle.new_node_cost > 0:
+        ratio = tpu.new_node_cost / oracle.new_node_cost
+        assert ratio <= PARITY + 1e-9, (
+            f"cost parity violated: tpu=${tpu.new_node_cost:.3f} "
+            f"oracle=${oracle.new_node_cost:.3f} ratio={ratio:.4f}\n"
+            f"tpu: {tpu.summary()}\noracle: {oracle.summary()}"
+        )
+    assert tpu.n_scheduled == oracle.n_scheduled
+    return oracle, tpu
+
+
+class TestParityBasics:
+    def test_single_group(self, small_catalog):
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(50)]
+        assert_parity(pods, [default_prov()], small_catalog)
+
+    def test_two_resource_groups(self, small_catalog):
+        pods = [PodSpec(name=f"a{i}", requests={"cpu": 1.0}, owner_key="a") for i in range(30)]
+        pods += [PodSpec(name=f"b{i}", requests={"cpu": 0.5, "memory": 6 * GIB}, owner_key="b")
+                 for i in range(30)]
+        assert_parity(pods, [default_prov()], small_catalog)
+
+    def test_backfill_small_into_big(self, small_catalog):
+        pods = [PodSpec(name=f"big{i}", requests={"cpu": 14.0}) for i in range(2)]
+        pods += [PodSpec(name=f"s{i}", requests={"cpu": 0.25}) for i in range(20)]
+        assert_parity(pods, [default_prov()], small_catalog)
+
+    def test_infeasible_pod_counted(self, small_catalog):
+        pods = [PodSpec(name="giant", requests={"cpu": 1000.0}),
+                PodSpec(name="ok", requests={"cpu": 1.0})]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        assert "giant" in tpu.infeasible
+
+    def test_full_catalog(self, full_catalog):
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 2.0, "memory": 4 * GIB})
+                for i in range(100)]
+        assert_parity(pods, [default_prov()], full_catalog)
+
+
+class TestParityConstraints:
+    def test_zone_selector(self, small_catalog):
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0},
+                        node_selector={L.ZONE: "zone-1b"}) for i in range(10)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        assert all(n.zone == "zone-1b" for n in tpu.nodes)
+
+    def test_zone_spread(self, small_catalog):
+        sel = LabelSelector.of({"app": "web"})
+        pods = [PodSpec(name=f"w{i}", labels={"app": "web"}, requests={"cpu": 1.0},
+                        topology_spread=[TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)])
+                for i in range(30)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        zones = {}
+        for n in tpu.nodes:
+            zones[n.zone] = zones.get(n.zone, 0) + len(n.pods)
+        counts = sorted(zones.values())
+        assert max(counts) - min(counts) <= 1
+
+    def test_hostname_anti_affinity(self, small_catalog):
+        sel = LabelSelector.of({"app": "db"})
+        pods = [PodSpec(name=f"db{i}", labels={"app": "db"}, requests={"cpu": 0.5},
+                        affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME, anti=True)])
+                for i in range(5)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        assert len(tpu.nodes) == 5
+        for n in tpu.nodes:
+            assert len(n.pods) == 1
+
+    def test_taints_and_tolerations(self, small_catalog):
+        tainted = Provisioner(
+            name="team-a", taints=[Taint("team", L.EFFECT_NO_SCHEDULE, "a")]
+        ).with_defaults()
+        open_prov = default_prov(name="open")
+        pods = [PodSpec(name=f"t{i}", requests={"cpu": 1.0},
+                        tolerations=[Toleration(key="team", operator="Equal", value="a")])
+                for i in range(5)]
+        pods += [PodSpec(name=f"u{i}", requests={"cpu": 1.0}) for i in range(5)]
+        assert_parity(pods, [tainted, open_prov], small_catalog)
+
+    def test_spot_and_weights(self, small_catalog):
+        spot = Provisioner(
+            name="spot", weight=10,
+            requirements=[Requirement(L.CAPACITY_TYPE, IN, [L.CAPACITY_TYPE_SPOT])],
+        ).with_defaults()
+        od = default_prov(name="od", weight=1)
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(20)]
+        oracle, tpu = assert_parity(pods, [spot, od], small_catalog)
+        assert all(n.capacity_type == L.CAPACITY_TYPE_SPOT for n in tpu.nodes)
+
+    def test_unavailable_offerings(self, small_catalog):
+        base = reference.solve(
+            [PodSpec(name="probe", requests={"cpu": 1.0})], [default_prov()], small_catalog
+        )
+        ice = {(base.nodes[0].instance_type, z, "on-demand")
+               for z in ("zone-1a", "zone-1b", "zone-1c")}
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(10)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog, unavailable=ice)
+        assert all((n.instance_type, n.zone, n.capacity_type) not in ice for n in tpu.nodes)
+
+    def test_daemonset_overhead(self, small_catalog):
+        ds = [PodSpec(name="agent", requests={"cpu": 0.5, "memory": 0.5 * GIB})]
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.5}) for i in range(10)]
+        assert_parity(pods, [default_prov()], small_catalog, daemonsets=ds)
+
+    def test_provisioner_limits(self, small_catalog):
+        prov = Provisioner(name="capped", limits={"cpu": 8.0}).with_defaults()
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 3.0}) for i in range(10)]
+        oracle = reference.solve(pods, [prov], small_catalog)
+        st = tensorize(pods, [prov], small_catalog)
+        tpu = solve_tensors(st).result
+        total_cap = sum(
+            next(t for t in small_catalog if t.name == n.instance_type).capacity["cpu"]
+            for n in tpu.nodes
+        )
+        assert total_cap <= 8.0
+        assert len(tpu.infeasible) > 0
+
+
+class TestExistingNodes:
+    def _existing(self, catalog, type_name="m5.4xlarge", zone="zone-1a", n=1):
+        it = next(t for t in catalog if t.name == type_name)
+        return [
+            SimNode(
+                instance_type=type_name, provisioner="default", zone=zone,
+                capacity_type="on-demand",
+                price=next(o.price for o in it.offerings
+                           if o.zone == zone and o.capacity_type == "on-demand"),
+                allocatable=dict(it.allocatable),
+                labels={**it.labels(), L.ZONE: zone, L.CAPACITY_TYPE: "on-demand",
+                        L.PROVISIONER_NAME: "default"},
+                existing=True,
+            )
+            for _ in range(n)
+        ]
+
+    def test_existing_filled_first(self, small_catalog):
+        existing = self._existing(small_catalog)
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(5)]
+        st = tensorize(pods, [default_prov()], small_catalog)
+        out = solve_tensors(st, existing_nodes=existing)
+        assert out.result.nodes == []  # everything fits on the existing node
+        assert out.result.n_scheduled == 5
+
+    def test_overflow_to_new_nodes(self, small_catalog):
+        existing = self._existing(small_catalog)  # ~15.8 cpu allocatable
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 2.0}) for i in range(12)]
+        st = tensorize(pods, [default_prov()], small_catalog)
+        out = solve_tensors(st, existing_nodes=existing)
+        oracle = reference.solve(pods, [default_prov()], small_catalog,
+                                 existing_nodes=self._existing(small_catalog))
+        assert out.result.n_scheduled == 12
+        assert abs(out.result.new_node_cost - oracle.new_node_cost) < 1e-6
+
+
+class TestScaleParity:
+    def test_config1_1k_uniform(self, small_catalog):
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(1000)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        assert len(tpu.infeasible) == 0
+
+    def test_config5_weighted_spot_od_mix(self, small_catalog):
+        provs = []
+        for i in range(10):
+            ct = L.CAPACITY_TYPE_SPOT if i % 2 else L.CAPACITY_TYPE_ON_DEMAND
+            provs.append(Provisioner(
+                name=f"prov-{i}", weight=10 - i,
+                requirements=[Requirement(L.CAPACITY_TYPE, IN, [ct])],
+            ).with_defaults())
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0 + (i % 3) * 0.5, "memory": 2 * GIB},
+                        owner_key=f"d{i % 3}") for i in range(300)]
+        assert_parity(pods, provs, small_catalog)
