@@ -1,0 +1,101 @@
+"""Generic request batching with idle/max windows.
+
+Two batching layers mirror the reference:
+
+1. ``Window`` — the provisioning pod batcher (idle 1s / max 10s,
+   concepts/settings.md:41-47): accumulate items until the stream goes idle
+   or the max window expires.
+2. ``Coalescer`` — pkg/batcher/batcher.go:29-171 semantics: hash-bucketed
+   request coalescing for cloud API calls (CreateFleet fan-out,
+   DescribeInstances merge); concurrent identical requests share one backend
+   call.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Generic, Hashable, List, Optional, TypeVar
+
+from .utils.clock import Clock
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+DEFAULT_IDLE_SECONDS = 1.0
+DEFAULT_MAX_SECONDS = 10.0
+
+
+class Window(Generic[T]):
+    """Idle/max-duration batching window."""
+
+    def __init__(
+        self,
+        idle_seconds: float = DEFAULT_IDLE_SECONDS,
+        max_seconds: float = DEFAULT_MAX_SECONDS,
+        clock: Optional[Clock] = None,
+    ) -> None:
+        self.idle = idle_seconds
+        self.max = max_seconds
+        self.clock = clock or Clock()
+        self._items: List[T] = []
+        self._first_at: Optional[float] = None
+        self._last_at: Optional[float] = None
+
+    def add(self, item: T) -> None:
+        now = self.clock.now()
+        if self._first_at is None:
+            self._first_at = now
+        self._last_at = now
+        self._items.append(item)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def ready(self) -> bool:
+        if not self._items:
+            return False
+        now = self.clock.now()
+        if now - self._first_at >= self.max:
+            return True
+        return now - self._last_at >= self.idle
+
+    def pop(self) -> List[T]:
+        items, self._items = self._items, []
+        self._first_at = self._last_at = None
+        return items
+
+
+@dataclass
+class _Bucket(Generic[T, U]):
+    requests: List[T] = field(default_factory=list)
+    results: List[U] = field(default_factory=list)
+
+
+class Coalescer(Generic[T, U]):
+    """Coalesce identical requests into one backend call.
+
+    ``execute(reqs) -> results`` is invoked once per distinct hash bucket per
+    flush; each caller gets its own result (fan-out), mirroring
+    batcher.go:130-151's one-call-per-bucket with per-requester responses.
+    """
+
+    def __init__(
+        self,
+        hasher: Callable[[T], Hashable],
+        execute: Callable[[List[T]], List[U]],
+    ) -> None:
+        self.hasher = hasher
+        self.execute = execute
+        self._buckets: Dict[Hashable, List[T]] = {}
+
+    def add(self, request: T) -> Hashable:
+        key = self.hasher(request)
+        self._buckets.setdefault(key, []).append(request)
+        return key
+
+    def flush(self) -> Dict[Hashable, List[U]]:
+        out: Dict[Hashable, List[U]] = {}
+        for key, reqs in self._buckets.items():
+            out[key] = self.execute(reqs)
+        self._buckets.clear()
+        return out

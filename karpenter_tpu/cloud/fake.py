@@ -1,0 +1,177 @@
+"""Fake cloud provider — the test double the whole tier-1 strategy rests on.
+
+Ports the *semantics* of pkg/fake/ec2api.go (584 LoC of fakes; SURVEY.md §4):
+in-memory instances, call capture, error/ICE injection per offering, eventual
+consistency (instances invisible for the first N get/list calls, mirroring the
+DescribeInstances retry loop at instance.go:99-107), and capacity tracking so
+tests can assert exactly what got launched.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..models import labels as L
+from ..models.instancetype import InstanceType
+from ..models.machine import Machine
+from ..models.provisioner import Provisioner
+from ..utils.clock import Clock
+from .base import (
+    CloudProvider,
+    InsufficientCapacityError,
+    MachineNotFoundError,
+)
+
+_instance_counter = itertools.count()
+
+
+@dataclass
+class FakeInstance:
+    provider_id: str
+    machine: Machine
+    created_at: float
+    visible_after_calls: int = 0  # eventual-consistency countdown
+    terminated: bool = False
+    drifted: bool = False
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+class FakeCloudProvider(CloudProvider):
+    def __init__(
+        self,
+        instance_types: Sequence[InstanceType],
+        clock: Optional[Clock] = None,
+        eventual_consistency_calls: int = 0,
+    ) -> None:
+        self.instance_types = list(instance_types)
+        self.clock = clock or Clock()
+        self.eventual_consistency_calls = eventual_consistency_calls
+        self.instances: Dict[str, FakeInstance] = {}
+        self.ice_offerings: Set[Tuple[str, str, str]] = set()  # (type, zone, ct)
+        self.create_calls: List[Machine] = []
+        self.delete_calls: List[str] = []
+        self.next_error: Optional[Exception] = None
+        self.allow_creates = True
+
+    # ---- test injection ------------------------------------------------
+    def inject_ice(self, instance_type: str, zone: str, capacity_type: str) -> None:
+        self.ice_offerings.add((instance_type, zone, capacity_type))
+
+    def clear_ice(self) -> None:
+        self.ice_offerings.clear()
+
+    def mark_drifted(self, provider_id: str) -> None:
+        self.instances[provider_id].drifted = True
+
+    # ---- CloudProvider -------------------------------------------------
+    def create(self, machine: Machine) -> Machine:
+        self.create_calls.append(machine)
+        if self.next_error is not None:
+            err, self.next_error = self.next_error, None
+            raise err
+        if not self.allow_creates:
+            raise RuntimeError("creates disabled")
+
+        # resolve the cheapest offering satisfying the machine's requirements,
+        # mirroring instance.go:406-438 (spot iff allowed, else lowest price)
+        choice, iced = self._resolve(machine)
+        if choice is None:
+            if iced is not None:
+                # every matching offering is ICE'd: surface the cheapest one's
+                # coordinates (what a CreateFleet ICE error carries)
+                raise InsufficientCapacityError(iced[0].name, iced[1].zone, iced[1].capacity_type)
+            wanted = sorted(machine.requirements.get(L.INSTANCE_TYPE).values)
+            raise InsufficientCapacityError(wanted[0] if wanted else "<any>", "<any>", "<any>")
+        it, offering = choice
+
+        pid = f"fake://{it.name}/{next(_instance_counter)}"
+        machine.provider_id = pid
+        machine.instance_type = it.name
+        machine.zone = offering.zone
+        machine.capacity_type = offering.capacity_type
+        machine.price = offering.price
+        machine.capacity = dict(it.capacity)
+        machine.allocatable = dict(it.allocatable)
+        machine.launched_at = self.clock.now()
+        machine.labels = {
+            **machine.labels,
+            **it.labels(),
+            L.ZONE: offering.zone,
+            L.CAPACITY_TYPE: offering.capacity_type,
+            L.INSTANCE_TYPE: it.name,
+            L.PROVISIONER_NAME: machine.provisioner,
+        }
+        self.instances[pid] = FakeInstance(
+            provider_id=pid,
+            machine=machine,
+            created_at=self.clock.now(),
+            visible_after_calls=self.eventual_consistency_calls,
+            tags={"karpenter.sh/cluster": "sim", "karpenter.sh/provisioner-name": machine.provisioner},
+        )
+        return machine
+
+    def _resolve(self, machine: Machine):
+        """Returns (choice, cheapest_iced): cheapest launchable offering
+        satisfying the machine requirements, plus the cheapest ICE'd match
+        (for the error path when nothing is launchable)."""
+        best = None
+        best_iced = None
+        reqs = machine.requirements
+        type_req = reqs.get(L.INSTANCE_TYPE)
+        zone_req = reqs.get(L.ZONE)
+        ct_req = reqs.get(L.CAPACITY_TYPE)
+        for it in self.instance_types:
+            if not type_req.contains(it.name):
+                continue
+            for o in it.offerings:
+                if not o.available:
+                    continue
+                if not zone_req.contains(o.zone) or not ct_req.contains(o.capacity_type):
+                    continue
+                if (it.name, o.zone, o.capacity_type) in self.ice_offerings:
+                    if best_iced is None or o.price < best_iced[1].price:
+                        best_iced = (it, o)
+                    continue
+                if best is None or o.price < best[1].price:
+                    best = (it, o)
+        return best, best_iced
+
+    def delete(self, machine: Machine) -> None:
+        self.delete_calls.append(machine.provider_id)
+        inst = self.instances.get(machine.provider_id)
+        if inst is None or inst.terminated:
+            raise MachineNotFoundError(machine.provider_id)
+        inst.terminated = True
+
+    def get(self, provider_id: str) -> Machine:
+        inst = self.instances.get(provider_id)
+        if inst is None or inst.terminated:
+            raise MachineNotFoundError(provider_id)
+        if inst.visible_after_calls > 0:
+            inst.visible_after_calls -= 1
+            raise MachineNotFoundError(f"{provider_id} (eventual consistency)")
+        return inst.machine
+
+    def list(self) -> List[Machine]:
+        out = []
+        for inst in self.instances.values():
+            if inst.terminated:
+                continue
+            if inst.visible_after_calls > 0:
+                inst.visible_after_calls -= 1
+                continue
+            out.append(inst.machine)
+        return out
+
+    def get_instance_types(self, provisioner: Optional[Provisioner] = None) -> List[InstanceType]:
+        return list(self.instance_types)
+
+    def is_machine_drifted(self, machine: Machine) -> bool:
+        inst = self.instances.get(machine.provider_id)
+        return bool(inst and inst.drifted)
+
+    def name(self) -> str:
+        return "fake"

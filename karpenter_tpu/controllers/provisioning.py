@@ -1,0 +1,155 @@
+"""Provisioning controller: pending pods -> batch -> solve -> create machines.
+
+The reconcile loop of SURVEY.md §3.2: watch unschedulable pods, batch them
+(idle/max windows), invoke the scheduler, then ``CloudProvider.create`` per
+proposed machine; ICE errors feed the unavailable-offerings cache so the next
+solve routes around the missing capacity (§5 failure-detection posture).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..batcher import Window
+from ..cache import UnavailableOfferings
+from ..cloud.base import CloudProvider, InsufficientCapacityError
+from ..events import Event, Recorder
+from ..metrics import BATCH_SIZE, NODES_CREATED, Registry, registry as default_registry
+from ..models import labels as L
+from ..models.machine import Machine
+from ..models.pod import PodSpec
+from ..models.requirements import IN, Requirement, Requirements
+from ..solver.scheduler import BatchScheduler
+from ..solver.types import SimNode, SolveResult
+from ..utils.clock import Clock
+from .state import ClusterState
+
+
+class ProvisioningController:
+    def __init__(
+        self,
+        state: ClusterState,
+        cloud: CloudProvider,
+        scheduler: Optional[BatchScheduler] = None,
+        recorder: Optional[Recorder] = None,
+        registry: Optional[Registry] = None,
+        unavailable: Optional[UnavailableOfferings] = None,
+        clock: Optional[Clock] = None,
+        idle_seconds: float = 1.0,
+        max_seconds: float = 10.0,
+    ) -> None:
+        self.state = state
+        self.cloud = cloud
+        self.scheduler = scheduler or BatchScheduler()
+        self.recorder = recorder or Recorder()
+        self.registry = registry or default_registry
+        self.unavailable = unavailable or UnavailableOfferings(clock=clock or state.clock)
+        self.clock = clock or state.clock
+        self.window: Window[PodSpec] = Window(idle_seconds, max_seconds, clock=self.clock)
+        self._queued: Set[str] = set()
+
+    # ---- reconcile loop ------------------------------------------------
+    def reconcile(self) -> Optional[SolveResult]:
+        """One tick: enqueue pending pods; when the batch window fires, solve
+        and launch.  Returns the SolveResult when a solve happened."""
+        for pod in self.state.pending_pods():
+            if pod.name not in self._queued:
+                self.window.add(pod)
+                self._queued.add(pod.name)
+        if not self.window.ready():
+            return None
+        batch = self.window.pop()
+        self._queued.difference_update(p.name for p in batch)
+        # pods may have been deleted/bound while queued
+        batch = [p for p in batch if p.name in self.state.pods and p.name not in self.state.bindings]
+        if not batch:
+            return None
+        self.registry.histogram(BATCH_SIZE).observe(len(batch))
+        return self._provision(batch)
+
+    def _provision(self, batch: List[PodSpec]) -> SolveResult:
+        provisioners = [p.with_defaults() for p in self.state.provisioners.values()]
+        instance_types = self.cloud.get_instance_types()
+        result = self.scheduler.solve(
+            batch,
+            provisioners,
+            instance_types,
+            existing_nodes=self.state.schedulable_nodes(),
+            daemonsets=self.state.daemonsets,
+            unavailable=self.unavailable.as_set(),
+        )
+
+        for pod_name, reason in result.infeasible.items():
+            self.recorder.publish(
+                Event("Pod", pod_name, "FailedScheduling", reason, "Warning")
+            )
+
+        # pods placed on existing nodes: nominate + bind
+        new_node_names = {n.name for n in result.nodes}
+        for pod_name, node_name in result.assignments.items():
+            if node_name not in new_node_names and node_name in self.state.nodes:
+                self.state.nominate(node_name)
+                self.state.bind(pod_name, node_name)
+
+        # launch one machine per proposed node
+        for node in result.nodes:
+            machine = self._machine_for(node, provisioners)
+            try:
+                machine = self.cloud.create(machine)
+            except InsufficientCapacityError as err:
+                self.unavailable.mark_unavailable(
+                    err.instance_type, err.zone, err.capacity_type
+                )
+                self.recorder.publish(Event(
+                    "Machine", machine.name, "InsufficientCapacity",
+                    str(err), "Warning",
+                ))
+                # pods stay pending; next reconcile re-solves around the ICE
+                continue
+            self.registry.counter(NODES_CREATED).inc(
+                {"provisioner": machine.provisioner}
+            )
+            launched = SimNode(
+                instance_type=machine.instance_type,
+                provisioner=machine.provisioner,
+                zone=machine.zone,
+                capacity_type=machine.capacity_type,
+                price=machine.price,
+                allocatable=dict(machine.allocatable),
+                labels=dict(machine.labels),
+                taints=list(machine.taints),
+                existing=True,
+                name=node.name,  # keep solver's name so assignments map
+                created_at=self.clock.now(),
+            )
+            launched.labels[L.HOSTNAME] = launched.name
+            prov = self.state.provisioners.get(machine.provisioner)
+            if prov and prov.ttl_seconds_until_expired is not None:
+                launched.expires_at = self.clock.now() + prov.ttl_seconds_until_expired
+            ns = self.state.add_node(launched, machine=machine)
+            ns.initialized = True
+            for pod in node.pods:
+                if pod.name in self.state.pods:
+                    self.state.bind(pod.name, launched.name)
+        return result
+
+    def _machine_for(self, node: SimNode, provisioners) -> Machine:
+        """Build the Machine (desired-node) spec from a solver-proposed node,
+        mirroring how core emits machines with requirement sets (§3.2 step 3)."""
+        prov = next((p for p in provisioners if p.name == node.provisioner), None)
+        reqs = Requirements()
+        reqs.add(Requirement(L.INSTANCE_TYPE, IN, [node.instance_type]))
+        reqs.add(Requirement(L.ZONE, IN, [node.zone]))
+        reqs.add(Requirement(L.CAPACITY_TYPE, IN, [node.capacity_type]))
+        requests: Dict[str, float] = {}
+        for p in node.pods:
+            for k, v in p.requests.items():
+                requests[k] = requests.get(k, 0.0) + v
+        return Machine(
+            provisioner=node.provisioner,
+            requirements=reqs,
+            taints=list(prov.taints) if prov else [],
+            labels=dict(prov.labels) if prov else {},
+            resource_requests=requests,
+            node_template=prov.node_template if prov else "default",
+        )

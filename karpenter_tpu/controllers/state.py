@@ -1,0 +1,151 @@
+"""In-memory cluster-state mirror.
+
+Core's ``state.Cluster`` analog (SURVEY.md §2.2: "nodes, pods, bindings,
+in-flight capacity consumed by scheduler + consolidation";
+state.NewCluster(clock, client, cloudProvider) at suite_test.go:152).  All
+durable state lives in the (simulated) API objects; this mirror is rebuilt
+from them — same stateless-by-design posture as the reference (§5
+checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+from ..models import labels as L
+from ..models.machine import Machine
+from ..models.pod import PodSpec
+from ..models.provisioner import Provisioner
+from ..solver.types import SimNode
+from ..utils.clock import Clock
+
+
+@dataclass
+class NodeState:
+    node: SimNode
+    machine: Optional[Machine] = None
+    cordoned: bool = False
+    initialized: bool = False
+    marked_for_deletion: bool = False
+    nominated_until: float = 0.0  # in-flight pods expected to land here
+    empty_since: Optional[float] = None
+
+
+class ClusterState:
+    def __init__(self, clock: Optional[Clock] = None) -> None:
+        self.clock = clock or Clock()
+        self.nodes: Dict[str, NodeState] = {}
+        self.pods: Dict[str, PodSpec] = {}
+        self.bindings: Dict[str, str] = {}  # pod name -> node name
+        self.provisioners: Dict[str, Provisioner] = {}
+        self.daemonsets: List[PodSpec] = []
+        self.seqnum = 0  # bumps on any change; consolidation backs off on no-change
+
+    # ---- mutation ------------------------------------------------------
+    def _changed(self) -> None:
+        self.seqnum += 1
+
+    def apply_provisioner(self, prov: Provisioner) -> None:
+        errs = prov.validate()
+        if errs:
+            raise ValueError(f"invalid provisioner {prov.name}: {errs}")
+        self.provisioners[prov.name] = prov
+        self._changed()
+
+    def delete_provisioner(self, name: str) -> None:
+        self.provisioners.pop(name, None)
+        self._changed()
+
+    def add_pod(self, pod: PodSpec) -> None:
+        self.pods[pod.name] = pod
+        self._changed()
+
+    def delete_pod(self, name: str) -> None:
+        self.pods.pop(name, None)
+        node_name = self.bindings.pop(name, None)
+        if node_name and node_name in self.nodes:
+            ns = self.nodes[node_name]
+            ns.node.pods = [p for p in ns.node.pods if p.name != name]
+        self._changed()
+
+    def add_node(self, node: SimNode, machine: Optional[Machine] = None) -> NodeState:
+        ns = NodeState(node=node, machine=machine)
+        self.nodes[node.name] = ns
+        for p in node.pods:
+            self.bindings[p.name] = node.name
+        self._changed()
+        return ns
+
+    def remove_node(self, name: str) -> List[PodSpec]:
+        """Remove a node; its pods become pending again (rescheduled)."""
+        ns = self.nodes.pop(name, None)
+        if ns is None:
+            return []
+        orphans = list(ns.node.pods)
+        for p in orphans:
+            self.bindings.pop(p.name, None)
+        ns.node.pods = []
+        self._changed()
+        return orphans
+
+    def bind(self, pod_name: str, node_name: str) -> None:
+        pod = self.pods.get(pod_name)
+        ns = self.nodes.get(node_name)
+        if pod is None or ns is None:
+            raise KeyError(f"bind {pod_name}->{node_name}: unknown object")
+        self.bindings[pod_name] = node_name
+        if pod not in ns.node.pods:
+            ns.node.pods.append(pod)
+        ns.empty_since = None
+        self._changed()
+
+    def nominate(self, node_name: str, ttl: float = 30.0) -> None:
+        ns = self.nodes.get(node_name)
+        if ns:
+            ns.nominated_until = self.clock.now() + ttl
+
+    # ---- queries -------------------------------------------------------
+    def pending_pods(self) -> List[PodSpec]:
+        return [p for name, p in self.pods.items() if name not in self.bindings]
+
+    def schedulable_nodes(self) -> List[SimNode]:
+        """Nodes the scheduler may pack onto (not cordoned / being deleted)."""
+        return [
+            ns.node
+            for ns in self.nodes.values()
+            if not ns.cordoned and not ns.marked_for_deletion
+        ]
+
+    def provisioned_nodes(self) -> List[NodeState]:
+        """Nodes owned by a provisioner (candidates for deprovisioning)."""
+        return [
+            ns for ns in self.nodes.values()
+            if ns.node.labels.get(L.PROVISIONER_NAME) in self.provisioners
+        ]
+
+    def node_of(self, pod_name: str) -> Optional[SimNode]:
+        name = self.bindings.get(pod_name)
+        return self.nodes[name].node if name and name in self.nodes else None
+
+    def empty_nodes(self, now: Optional[float] = None) -> List[NodeState]:
+        now = self.clock.now() if now is None else now
+        out = []
+        for ns in self.provisioned_nodes():
+            non_daemon = [p for p in ns.node.pods]
+            if not non_daemon and not ns.marked_for_deletion:
+                if ns.empty_since is None:
+                    ns.empty_since = now
+                out.append(ns)
+            elif non_daemon:
+                ns.empty_since = None
+        return out
+
+    def provisioner_usage(self, name: str) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for ns in self.nodes.values():
+            if ns.node.labels.get(L.PROVISIONER_NAME) != name:
+                continue
+            for k, v in ns.node.allocatable.items():
+                total[k] = total.get(k, 0.0) + v
+        return total

@@ -1,0 +1,132 @@
+"""Batch scheduler facade — routes pods to the TPU solver or the CPU oracle.
+
+The provisioning and deprovisioning controllers call this, never the solvers
+directly (the ``scheduling.Solve`` boundary, SURVEY.md §3.2 step 3).  Pods the
+TPU path can't express (positive pod-affinity, v1 — see solver/tpu.py
+docstring) are carved out and solved by the oracle against the TPU result's
+node set, so one SolveResult comes back either way.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..metrics import SCHEDULING_DURATION, SOLVER_BACKEND_DURATION, Registry, registry as default_registry
+from ..models.instancetype import InstanceType
+from ..models.pod import PodSpec
+from ..models.provisioner import Provisioner
+from ..models.tensorize import tensorize
+from .reference import solve as oracle_solve
+from .tpu import TpuSolver
+from .types import SimNode, SolveResult
+
+
+class BatchScheduler:
+    def __init__(
+        self,
+        backend: str = "auto",  # "auto" | "tpu" | "oracle"
+        registry: Optional[Registry] = None,
+        mesh=None,
+    ) -> None:
+        assert backend in ("auto", "tpu", "oracle")
+        self.backend = backend
+        self.registry = registry or default_registry
+        self.mesh = mesh
+        self._tpu = TpuSolver()
+
+    def solve(
+        self,
+        pods: Sequence[PodSpec],
+        provisioners: Sequence[Provisioner],
+        instance_types: Sequence[InstanceType],
+        *,
+        existing_nodes: Sequence[SimNode] = (),
+        daemonsets: Sequence[PodSpec] = (),
+        unavailable: Optional[Set[tuple]] = None,
+        allow_new_nodes: bool = True,
+        max_new_nodes: Optional[int] = None,
+    ) -> SolveResult:
+        t0 = time.perf_counter()
+        try:
+            if self.backend == "oracle":
+                return oracle_solve(
+                    pods, provisioners, instance_types,
+                    existing_nodes=existing_nodes, daemonsets=daemonsets,
+                    unavailable=unavailable, allow_new_nodes=allow_new_nodes,
+                    max_new_nodes=max_new_nodes,
+                )
+            return self._solve_tpu(
+                pods, provisioners, instance_types, existing_nodes, daemonsets,
+                unavailable, allow_new_nodes, max_new_nodes,
+            )
+        finally:
+            self.registry.histogram(SCHEDULING_DURATION).observe(time.perf_counter() - t0)
+
+    def _solve_tpu(
+        self, pods, provisioners, instance_types, existing_nodes, daemonsets,
+        unavailable, allow_new_nodes, max_new_nodes,
+    ) -> SolveResult:
+        # carve out pods the device solver can't express (positive affinity)
+        tpu_pods = [p for p in pods if not any(not t.anti for t in p.affinity_terms)]
+        cpu_pods = [p for p in pods if any(not t.anti for t in p.affinity_terms)]
+
+        nodes: List[SimNode] = []
+        assignments: Dict[str, str] = {}
+        infeasible: Dict[str, str] = {}
+        solve_ms = 0.0
+
+        if tpu_pods:
+            st = tensorize(
+                tpu_pods, provisioners, instance_types,
+                daemonsets=daemonsets, unavailable=unavailable,
+            )
+            t0 = time.perf_counter()
+            out = self._tpu.solve(
+                st, existing_nodes=list(existing_nodes),
+                max_nodes=(len(existing_nodes) + (max_new_nodes or sum(1 for _ in tpu_pods))),
+                mesh=self.mesh,
+            )
+            self.registry.histogram(SOLVER_BACKEND_DURATION).observe(
+                time.perf_counter() - t0, {"backend": "tpu"}
+            )
+            res = out.result
+            if not allow_new_nodes and res.nodes:
+                # consolidation what-if with no new nodes allowed: pods that
+                # needed new nodes are infeasible
+                for n in res.nodes:
+                    for p in n.pods:
+                        infeasible[p.name] = "needs a new node (disallowed)"
+                res.nodes = []
+                for p in list(res.assignments):
+                    if p in infeasible:
+                        del res.assignments[p]
+            nodes.extend(res.nodes)
+            assignments.update(res.assignments)
+            infeasible.update(res.infeasible)
+            solve_ms += res.solve_ms
+
+        if cpu_pods:
+            t0 = time.perf_counter()
+            res2 = oracle_solve(
+                cpu_pods, provisioners, instance_types,
+                existing_nodes=list(existing_nodes) + nodes,
+                daemonsets=daemonsets, unavailable=unavailable,
+                allow_new_nodes=allow_new_nodes,
+                max_new_nodes=None if max_new_nodes is None else max(0, max_new_nodes - len(nodes)),
+            )
+            self.registry.histogram(SOLVER_BACKEND_DURATION).observe(
+                time.perf_counter() - t0, {"backend": "oracle"}
+            )
+            nodes.extend(res2.nodes)
+            assignments.update(res2.assignments)
+            infeasible.update(res2.infeasible)
+            solve_ms += res2.solve_ms
+
+        return SolveResult(
+            nodes=nodes,
+            assignments=assignments,
+            infeasible=infeasible,
+            existing_nodes=list(existing_nodes),
+            solve_ms=solve_ms,
+        )

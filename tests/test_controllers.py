@@ -1,0 +1,245 @@
+"""End-to-end controller slice: pending pods -> batch -> solve -> fake cloud.
+
+Tier-1 strategy port (SURVEY.md §4): real solver + fake cloud + in-memory
+cluster state, driving the full pod->solve->create path in one process.
+"""
+
+import pytest
+
+from karpenter_tpu.batcher import Coalescer, Window
+from karpenter_tpu.cache import TTLCache, UnavailableOfferings
+from karpenter_tpu.cloud.base import InsufficientCapacityError, MachineNotFoundError
+from karpenter_tpu.cloud.fake import FakeCloudProvider
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.state import ClusterState
+from karpenter_tpu.events import Recorder
+from karpenter_tpu.metrics import Registry, decorate
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.machine import Machine
+from karpenter_tpu.models.pod import PodSpec
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.requirements import IN, Requirement, Requirements
+from karpenter_tpu.solver.scheduler import BatchScheduler
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def env(small_catalog):
+    clock = FakeClock()
+    state = ClusterState(clock=clock)
+    cloud = FakeCloudProvider(small_catalog, clock=clock)
+    recorder = Recorder()
+    registry = Registry()
+    ctrl = ProvisioningController(
+        state, cloud,
+        scheduler=BatchScheduler(backend="oracle", registry=registry),
+        recorder=recorder, registry=registry, clock=clock,
+    )
+    state.apply_provisioner(Provisioner(name="default"))
+    return clock, state, cloud, ctrl, recorder, registry
+
+
+class TestBatchingWindow:
+    def test_idle_window(self):
+        clock = FakeClock()
+        w = Window(idle_seconds=1.0, max_seconds=10.0, clock=clock)
+        w.add("a")
+        assert not w.ready()
+        clock.advance(0.5)
+        w.add("b")
+        assert not w.ready()
+        clock.advance(1.1)  # idle expired
+        assert w.ready()
+        assert w.pop() == ["a", "b"]
+        assert not w.ready()
+
+    def test_max_window(self):
+        clock = FakeClock()
+        w = Window(idle_seconds=1.0, max_seconds=10.0, clock=clock)
+        w.add("a")
+        for _ in range(20):  # keep stream busy: never idle
+            clock.advance(0.6)
+            w.add("x")
+        assert w.ready()  # max window fired even though never idle
+
+    def test_coalescer_buckets(self):
+        calls = []
+
+        def execute(reqs):
+            calls.append(list(reqs))
+            return [f"r-{r}" for r in reqs]
+
+        c = Coalescer(hasher=lambda r: r[0], execute=execute)
+        c.add("ab")
+        c.add("ac")
+        c.add("bx")
+        out = c.flush()
+        assert len(calls) == 2  # two buckets: 'a' and 'b'
+        assert out["a"] == ["r-ab", "r-ac"]
+
+
+class TestCaches:
+    def test_ttl_cache_expiry(self):
+        clock = FakeClock()
+        c = TTLCache(ttl=60.0, clock=clock)
+        c.put("k", 1)
+        assert c.get("k") == 1
+        clock.advance(61)
+        assert c.get("k") is None
+
+    def test_unavailable_offerings_ttl_and_seqnum(self):
+        clock = FakeClock()
+        u = UnavailableOfferings(clock=clock, ttl=180.0)
+        s0 = u.seqnum
+        u.mark_unavailable("m5.xlarge", "zone-1a", "on-demand")
+        assert u.seqnum == s0 + 1
+        assert u.is_unavailable("m5.xlarge", "zone-1a", "on-demand")
+        assert ("m5.xlarge", "zone-1a", "on-demand") in u.as_set()
+        clock.advance(181)
+        assert not u.is_unavailable("m5.xlarge", "zone-1a", "on-demand")
+        assert u.as_set() == set()
+
+
+def pump(ctrl, clock, idle=1.5):
+    """Queue pending pods, let the idle window expire, reconcile."""
+    ctrl.reconcile()
+    clock.advance(idle)
+    return ctrl.reconcile()
+
+
+class TestProvisioningE2E:
+    def test_config1_1k_pods_end_to_end(self, env):
+        """BASELINE config #1: 1k uniform pods, 1 provisioner, 20 types."""
+        clock, state, cloud, ctrl, recorder, registry = env
+        for i in range(1000):
+            state.add_pod(PodSpec(name=f"p{i}", requests={"cpu": 1.0}, owner_key="d"))
+        assert ctrl.reconcile() is None  # window not fired yet
+        clock.advance(1.5)  # idle window expires
+        result = ctrl.reconcile()
+        assert result is not None
+        assert len(state.pending_pods()) == 0
+        assert len(state.nodes) > 0
+        assert len(cloud.instances) == len(state.nodes)
+        # every pod bound to a node that exists
+        for pod_name in state.pods:
+            assert pod_name in state.bindings
+        # metrics recorded
+        assert registry.histogram("karpenter_provisioner_batch_size").count() == 1
+
+    def test_batching_coalesces_pods_across_adds(self, env):
+        clock, state, cloud, ctrl, recorder, registry = env
+        state.add_pod(PodSpec(name="a", requests={"cpu": 0.5}, owner_key="d"))
+        ctrl.reconcile()
+        clock.advance(0.5)
+        state.add_pod(PodSpec(name="b", requests={"cpu": 0.5}, owner_key="d"))
+        ctrl.reconcile()
+        clock.advance(1.2)
+        result = ctrl.reconcile()
+        assert result is not None
+        # both pods in one batch -> both fit one node
+        assert len(state.nodes) == 1
+
+    def test_ice_routes_around_and_retries(self, env):
+        clock, state, cloud, ctrl, recorder, registry = env
+        # find what the solver would pick, then ICE it
+        state.add_pod(PodSpec(name="probe", requests={"cpu": 1.0, "memory": 2**30}))
+        res = pump(ctrl, clock)
+        chosen = res.nodes[0].instance_type
+        zone = res.nodes[0].zone
+        # reset: remove everything
+        state.delete_pod("probe")
+        for name in list(state.nodes):
+            state.remove_node(name)
+        cloud.instances.clear()
+
+        cloud.inject_ice(chosen, zone, "on-demand")
+        cloud.next_error = None
+        state.add_pod(PodSpec(name="p", requests={"cpu": 1.0, "memory": 2**30},
+                              node_selector={L.ZONE: zone}))
+        res1 = pump(ctrl, clock)
+        # first attempt hits ICE at create time -> offering marked, pod pending
+        if "p" not in state.bindings:
+            assert ctrl.unavailable.is_unavailable(chosen, zone, "on-demand")
+            res2 = pump(ctrl, clock)
+            assert "p" in state.bindings
+            node = state.node_of("p")
+            assert node.instance_type != chosen
+        assert len(recorder.of("InsufficientCapacity")) == 1
+
+    def test_infeasible_pod_gets_event(self, env):
+        clock, state, cloud, ctrl, recorder, registry = env
+        state.add_pod(PodSpec(name="giant", requests={"cpu": 10000.0}))
+        pump(ctrl, clock)
+        assert len(recorder.of("FailedScheduling")) == 1
+        assert "giant" not in state.bindings
+
+    def test_existing_capacity_reused(self, env):
+        clock, state, cloud, ctrl, recorder, registry = env
+        state.add_pod(PodSpec(name="first", requests={"cpu": 1.0}, owner_key="d"))
+        pump(ctrl, clock)
+        n_nodes = len(state.nodes)
+        # a second small pod should fit the node we just made
+        state.add_pod(PodSpec(name="second", requests={"cpu": 0.1}, owner_key="d"))
+        pump(ctrl, clock)
+        assert len(state.nodes) == n_nodes
+        assert state.bindings["second"] == state.bindings["first"]
+
+    def test_provisioner_deleted_no_creates(self, env):
+        clock, state, cloud, ctrl, recorder, registry = env
+        state.delete_provisioner("default")
+        state.add_pod(PodSpec(name="p", requests={"cpu": 1.0}))
+        pump(ctrl, clock)
+        assert len(state.nodes) == 0
+        assert "p" not in state.bindings
+
+
+class TestTpuBackendE2E:
+    def test_tpu_scheduler_end_to_end(self, small_catalog):
+        clock = FakeClock()
+        state = ClusterState(clock=clock)
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+        ctrl = ProvisioningController(
+            state, cloud, scheduler=BatchScheduler(backend="tpu"), clock=clock,
+        )
+        state.apply_provisioner(Provisioner(name="default"))
+        for i in range(50):
+            state.add_pod(PodSpec(name=f"p{i}", requests={"cpu": 1.0}, owner_key="d"))
+        result = pump(ctrl, clock)
+        assert result is not None
+        assert len(state.pending_pods()) == 0
+        assert all(p in state.bindings for p in state.pods)
+
+
+class TestFakeCloud:
+    def test_create_resolves_cheapest(self, small_catalog):
+        cloud = FakeCloudProvider(small_catalog)
+        reqs = Requirements([Requirement(L.INSTANCE_TYPE, IN, ["m5.large"])])
+        m = cloud.create(Machine(requirements=reqs))
+        assert m.instance_type == "m5.large"
+        assert m.provider_id.startswith("fake://")
+        assert m.capacity_type == "spot"  # unconstrained: spot is cheapest
+
+    def test_eventual_consistency(self, small_catalog):
+        cloud = FakeCloudProvider(small_catalog, eventual_consistency_calls=2)
+        reqs = Requirements([Requirement(L.INSTANCE_TYPE, IN, ["m5.large"])])
+        m = cloud.create(Machine(requirements=reqs))
+        with pytest.raises(MachineNotFoundError):
+            cloud.get(m.provider_id)
+        with pytest.raises(MachineNotFoundError):
+            cloud.get(m.provider_id)
+        assert cloud.get(m.provider_id).provider_id == m.provider_id
+
+    def test_delete_then_not_found(self, small_catalog):
+        cloud = FakeCloudProvider(small_catalog)
+        reqs = Requirements([Requirement(L.INSTANCE_TYPE, IN, ["m5.large"])])
+        m = cloud.create(Machine(requirements=reqs))
+        cloud.delete(m)
+        with pytest.raises(MachineNotFoundError):
+            cloud.get(m.provider_id)
+
+    def test_metrics_decorator(self, small_catalog):
+        reg = Registry()
+        cloud = decorate(FakeCloudProvider(small_catalog), reg)
+        cloud.list()
+        hist = reg.histogram("karpenter_cloudprovider_duration_seconds")
+        assert hist.count({"controller": "cloudprovider", "method": "list"}) == 1
