@@ -1,0 +1,301 @@
+"""Deprovisioning controller — expiration, drift, emptiness, consolidation.
+
+The second TPU-offload target (SURVEY.md §3.3): the consolidation what-if
+("can these nodes' pods fit on the remaining nodes plus at most one cheaper
+new node?") reuses the batch scheduler, so every simulated re-scheduling pass
+runs on the TPU solver.
+
+Mechanism order and semantics follow designs/deprovisioning.md:31 (expiration
+-> drift -> emptiness -> consolidation), concepts/deprovisioning.md:64-95
+(empty-node deletes, multi-node, then single-node; spot nodes are delete-only
+:83-85) and designs/consolidation.md:25-67 (disruption-cost candidate
+ordering; replacement launched before delete; 5-min minimum node lifetime;
+stabilization while pods are pending; back-off when cluster state is
+unchanged).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..cloud.base import CloudProvider
+from ..events import Event, Recorder
+from ..metrics import (
+    DEPROVISIONING_ACTIONS,
+    DEPROVISIONING_DURATION,
+    Registry,
+    registry as default_registry,
+)
+from ..models import labels as L
+from ..models.pod import PodSpec
+from ..solver.scheduler import BatchScheduler
+from ..solver.types import SimNode
+from ..utils.clock import Clock
+from .state import ClusterState, NodeState
+from .termination import TerminationController
+
+MIN_NODE_LIFETIME = 5 * 60.0          # designs/consolidation.md:67
+DEFAULT_BATCH_IDLE_AFTER_NO_ACTION = 15.0
+
+
+@dataclass
+class Action:
+    kind: str                         # "delete" | "replace"
+    mechanism: str                    # "emptiness" | "expiration" | "drift" | "consolidation"
+    nodes: List[str]
+    replacement: Optional[SimNode] = None
+    savings: float = 0.0
+
+
+class DeprovisioningController:
+    def __init__(
+        self,
+        state: ClusterState,
+        cloud: CloudProvider,
+        termination: TerminationController,
+        provisioning=None,                      # ProvisioningController, for replacements
+        scheduler: Optional[BatchScheduler] = None,
+        recorder: Optional[Recorder] = None,
+        registry: Optional[Registry] = None,
+        clock: Optional[Clock] = None,
+        drift_enabled: bool = False,            # feature gate (settings.md:76-78)
+    ) -> None:
+        self.state = state
+        self.cloud = cloud
+        self.termination = termination
+        self.provisioning = provisioning
+        self.scheduler = scheduler or BatchScheduler(backend="oracle")
+        self.recorder = recorder or Recorder()
+        self.registry = registry or default_registry
+        self.clock = clock or state.clock
+        self.drift_enabled = drift_enabled
+        self.unavailable = getattr(provisioning, "unavailable", None)
+        self._last_seqnum = -1
+        self._last_action_at = 0.0
+        self._last_eval_at = -1e18
+
+    # ---- tick ------------------------------------------------------------
+    def reconcile(self) -> Optional[Action]:
+        t0 = time.perf_counter()
+        try:
+            # Time-based mechanisms (expiration/drift/emptiness) run every
+            # tick — they fire on clock advance, which never bumps seqnum.
+            action = (
+                self._expiration()
+                or (self._drift() if self.drift_enabled else None)
+                or self._emptiness()
+            )
+            if action is None and self._should_evaluate_consolidation():
+                action = self._consolidation()
+                if action is None:
+                    self._last_seqnum = self.state.seqnum
+                    self._last_eval_at = self.clock.now()
+            if action is not None:
+                self._execute(action)
+                self._last_action_at = self.clock.now()
+            return action
+        finally:
+            self.registry.histogram(DEPROVISIONING_DURATION).observe(
+                time.perf_counter() - t0
+            )
+
+    def _should_evaluate_consolidation(self) -> bool:
+        """Back off while the cluster is unchanged (consolidation.md:64) but
+        re-arm on a timer so time-driven eligibility (minimum node lifetime,
+        TTL'd ICE entries) is eventually re-examined."""
+        if self.state.seqnum != self._last_seqnum:
+            return True
+        return self.clock.now() - self._last_eval_at >= DEFAULT_BATCH_IDLE_AFTER_NO_ACTION
+
+    # ---- mechanisms -------------------------------------------------------
+    def _expiration(self) -> Optional[Action]:
+        now = self.clock.now()
+        for ns in self.state.provisioned_nodes():
+            if ns.marked_for_deletion or ns.node.expires_at is None:
+                continue
+            if now >= ns.node.expires_at:
+                return Action("replace", "expiration", [ns.node.name])
+        return None
+
+    def _drift(self) -> Optional[Action]:
+        for ns in self.state.provisioned_nodes():
+            if ns.marked_for_deletion or ns.machine is None:
+                continue
+            if self.cloud.is_machine_drifted(ns.machine):
+                return Action("replace", "drift", [ns.node.name])
+        return None
+
+    def _emptiness(self) -> Optional[Action]:
+        """ttlSecondsAfterEmpty deletes (mutually exclusive with consolidation
+        per provisioner — designs/consolidation.md 'Emptiness TTL')."""
+        now = self.clock.now()
+        names = []
+        for ns in self.state.empty_nodes():
+            prov = self.state.provisioners.get(ns.node.labels.get(L.PROVISIONER_NAME, ""))
+            if prov is None or prov.consolidation_enabled:
+                continue
+            if prov.ttl_seconds_after_empty is None:
+                continue
+            if ns.empty_since is not None and now - ns.empty_since >= prov.ttl_seconds_after_empty:
+                names.append(ns.node.name)
+        return Action("delete", "emptiness", names) if names else None
+
+    # ---- consolidation ----------------------------------------------------
+    def _candidates(self) -> List[Tuple[float, NodeState]]:
+        """Consolidatable nodes ordered by ascending disruption cost
+        (consolidation.md:25-36)."""
+        now = self.clock.now()
+        out = []
+        for ns in self.state.provisioned_nodes():
+            if ns.marked_for_deletion or ns.cordoned or not ns.initialized:
+                continue
+            if ns.nominated_until > now:
+                continue  # in-flight pods expected to land here; don't disrupt
+            prov = self.state.provisioners.get(ns.node.labels.get(L.PROVISIONER_NAME, ""))
+            if prov is None or not prov.consolidation_enabled:
+                continue
+            if now - ns.node.created_at < MIN_NODE_LIFETIME:
+                continue
+            if any(p.do_not_evict for p in ns.node.pods):
+                continue
+            if self.termination.blocked(ns.node.name):
+                continue
+            out.append((self._disruption_cost(ns), ns))
+        out.sort(key=lambda t: (t[0], t[1].node.name))
+        return out
+
+    def _disruption_cost(self, ns: NodeState) -> float:
+        """pods x priority x deletion-cost, weighted by lifetime remaining."""
+        cost = 0.0
+        for p in ns.node.pods:
+            cost += p.deletion_cost * (1.0 + max(0, p.priority) / 1000.0)
+        if ns.node.expires_at is not None:
+            total = max(ns.node.expires_at - ns.node.created_at, 1e-9)
+            remaining = max(ns.node.expires_at - self.clock.now(), 0.0)
+            cost *= remaining / total
+        return cost
+
+    def _consolidation(self) -> Optional[Action]:
+        if self.state.pending_pods():
+            return None  # stabilization: wait for the cluster to settle
+        cands = self._candidates()
+        if not cands:
+            return None
+
+        # 1) empty-node deletes (deprovisioning.md:70-75)
+        empties = [ns.node.name for _, ns in cands if not ns.node.pods]
+        if empties:
+            return Action("delete", "consolidation", empties)
+
+        # 2) multi-node: binary search the largest disruption-cost prefix
+        #    that can be deleted together with <=1 replacement
+        best_multi = None
+        lo, hi = 2, len(cands)
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            attempt = self._simulate([ns for _, ns in cands[:mid]])
+            if attempt is not None:
+                best_multi = attempt
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        if best_multi is not None:
+            return best_multi
+
+        # 3) single-node: first candidate (lowest disruption) that works
+        for _, ns in cands:
+            attempt = self._simulate([ns])
+            if attempt is not None:
+                return attempt
+        return None
+
+    def _simulate(self, targets: Sequence[NodeState]) -> Optional[Action]:
+        """Can these nodes' pods fit on the remaining nodes + <=1 cheaper new
+        node?  (the §3.3 what-if — runs on the batch solver)."""
+        target_names = {ns.node.name for ns in targets}
+        pods: List[PodSpec] = [p for ns in targets for p in ns.node.pods]
+        others = [
+            n for n in self.state.schedulable_nodes() if n.name not in target_names
+        ]
+        provisioners = [p.with_defaults() for p in self.state.provisioners.values()]
+        result = self.scheduler.solve(
+            pods,
+            provisioners,
+            self.cloud.get_instance_types(),
+            existing_nodes=others,
+            daemonsets=self.state.daemonsets,
+            unavailable=self.unavailable.as_set() if self.unavailable else None,
+            allow_new_nodes=True,
+            max_new_nodes=1,
+        )
+        if result.infeasible:
+            return None
+        current_cost = sum(ns.node.price for ns in targets)
+        new_cost = result.new_node_cost
+        if new_cost <= 0:
+            return Action("delete", "consolidation", sorted(target_names),
+                          savings=current_cost)
+        # replacement path: must be strictly cheaper, and spot nodes are
+        # delete-only (deprovisioning.md:83-85)
+        if any(ns.node.capacity_type == L.CAPACITY_TYPE_SPOT for ns in targets):
+            return None
+        if new_cost >= current_cost:
+            return None
+        return Action(
+            "replace", "consolidation", sorted(target_names),
+            replacement=result.nodes[0], savings=current_cost - new_cost,
+        )
+
+    # ---- execution --------------------------------------------------------
+    def _execute(self, action: Action) -> None:
+        self.registry.counter(DEPROVISIONING_ACTIONS).inc(
+            {"action": f"{action.kind}/{action.mechanism}"}
+        )
+        if action.kind == "replace" and action.mechanism == "consolidation" and action.replacement:
+            # launch the replacement BEFORE deleting (consolidation.md:15)
+            if self.provisioning is not None:
+                machine = self.provisioning._machine_for(
+                    action.replacement,
+                    [p.with_defaults() for p in self.state.provisioners.values()],
+                )
+                try:
+                    machine = self.provisioning.cloud.create(machine)
+                except Exception as err:  # ICE etc: abort the action
+                    from ..cloud.base import InsufficientCapacityError
+
+                    if isinstance(err, InsufficientCapacityError) and self.unavailable:
+                        # feed the ICE cache so the next solve routes around it
+                        self.unavailable.mark_unavailable(
+                            err.instance_type, err.zone, err.capacity_type
+                        )
+                    # arm the backoff so the same doomed action isn't hot-retried
+                    self._last_seqnum = self.state.seqnum
+                    self._last_eval_at = self.clock.now()
+                    self.recorder.publish(Event(
+                        "Machine", machine.name, "ReplacementFailed", str(err), "Warning"
+                    ))
+                    return
+                node = SimNode(
+                    instance_type=machine.instance_type,
+                    provisioner=machine.provisioner,
+                    zone=machine.zone,
+                    capacity_type=machine.capacity_type,
+                    price=machine.price,
+                    allocatable=dict(machine.allocatable),
+                    labels=dict(machine.labels),
+                    taints=list(machine.taints),
+                    existing=True,
+                    created_at=self.clock.now(),
+                )
+                node.labels[L.HOSTNAME] = node.name
+                ns = self.state.add_node(node, machine=machine)
+                ns.initialized = True
+        for name in action.nodes:
+            self.recorder.publish(Event(
+                "Node", name, "DeprovisioningTriggered",
+                f"{action.mechanism}: {action.kind} (saves ${action.savings:.3f}/hr)",
+            ))
+            self.termination.begin(name)
+        self.termination.reconcile()

@@ -60,8 +60,13 @@ class ProvisioningController:
             return None
         batch = self.window.pop()
         self._queued.difference_update(p.name for p in batch)
-        # pods may have been deleted/bound while queued
-        batch = [p for p in batch if p.name in self.state.pods and p.name not in self.state.bindings]
+        # pods may have been deleted/bound/replaced while queued: re-resolve
+        # the live spec from state so a same-name re-add isn't solved stale
+        batch = [
+            self.state.pods[p.name]
+            for p in batch
+            if p.name in self.state.pods and p.name not in self.state.bindings
+        ]
         if not batch:
             return None
         self.registry.histogram(BATCH_SIZE).observe(len(batch))

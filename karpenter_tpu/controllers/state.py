@@ -132,7 +132,7 @@ class ClusterState:
         now = self.clock.now() if now is None else now
         out = []
         for ns in self.provisioned_nodes():
-            non_daemon = [p for p in ns.node.pods]
+            non_daemon = [p for p in ns.node.pods if not p.is_daemon]
             if not non_daemon and not ns.marked_for_deletion:
                 if ns.empty_since is None:
                     ns.empty_since = now

@@ -115,6 +115,7 @@ class PodSpec:
     deletion_cost: float = 1.0  # pod-deletion-cost annotation analog
     owner_key: str = ""  # deployment/replicaset identity, for dedup grouping
     do_not_evict: bool = False
+    is_daemon: bool = False  # daemonset-owned: never blocks drain/emptiness
     uid: int = field(default_factory=lambda: next(_pod_counter))
 
     def __post_init__(self) -> None:

@@ -82,9 +82,10 @@ class BatchScheduler:
                 daemonsets=daemonsets, unavailable=unavailable,
             )
             t0 = time.perf_counter()
+            new_budget = len(tpu_pods) if max_new_nodes is None else max_new_nodes
             out = self._tpu.solve(
                 st, existing_nodes=list(existing_nodes),
-                max_nodes=(len(existing_nodes) + (max_new_nodes or sum(1 for _ in tpu_pods))),
+                max_nodes=len(existing_nodes) + new_budget,
                 mesh=self.mesh,
             )
             self.registry.histogram(SOLVER_BACKEND_DURATION).observe(

@@ -157,13 +157,14 @@ class TestProvisioningE2E:
         state.add_pod(PodSpec(name="p", requests={"cpu": 1.0, "memory": 2**30},
                               node_selector={L.ZONE: zone}))
         res1 = pump(ctrl, clock)
-        # first attempt hits ICE at create time -> offering marked, pod pending
-        if "p" not in state.bindings:
-            assert ctrl.unavailable.is_unavailable(chosen, zone, "on-demand")
-            res2 = pump(ctrl, clock)
-            assert "p" in state.bindings
-            node = state.node_of("p")
-            assert node.instance_type != chosen
+        # the machine pins (type, zone, capacity-type), so the first create
+        # MUST hit the injected ICE: offering marked, pod left pending
+        assert "p" not in state.bindings
+        assert ctrl.unavailable.is_unavailable(chosen, zone, "on-demand")
+        res2 = pump(ctrl, clock)
+        assert "p" in state.bindings
+        node = state.node_of("p")
+        assert node.instance_type != chosen
         assert len(recorder.of("InsufficientCapacity")) == 1
 
     def test_infeasible_pod_gets_event(self, env):
