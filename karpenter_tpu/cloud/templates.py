@@ -1,0 +1,305 @@
+"""Node templates, image-family resolution, userdata bootstrap, and the
+launch-template cache.
+
+Re-creates the reference's L2 launch stack in provider-neutral form:
+
+- ``NodeTemplate`` — the AWSNodeTemplate CRD analog
+  (pkg/apis/v1alpha1/awsnodetemplate.go): image family + selectors, userdata,
+  block devices, metadata options, tags; status carries discovered
+  subnets/security-groups (filled by the nodetemplate controller).
+- image families — strategy interface like amifamily/resolver.go:72-79:
+  per-family default image aliases (SSM-alias analog), bootstrap script
+  generation (MIME-merge for the eks-like family per
+  bootstrap/eksbootstrap.go:165-263, TOML for the bottlerocket-like family),
+  and per-(arch, accelerator) image variants (al2.go:37-45).
+- ``LaunchTemplateProvider`` — one cached launch template per resolved
+  (image, userdata, ...) hash with create-on-miss, eviction-deletes, and
+  invalidate-on-not-found (launchtemplate.go:130-136, 291-305, 120-128).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..models import labels as L
+from ..models.instancetype import InstanceType
+from ..models.pod import Taint
+
+# ---------------------------------------------------------------------------
+# image families
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Image:
+    image_id: str
+    arch: str
+    accelerated: bool = False
+    created_at: float = 0.0
+
+
+class ImageFamily:
+    """Strategy interface (amifamily/resolver.go AMIFamily analog)."""
+
+    name = "base"
+
+    def default_images(self) -> List[Image]:
+        raise NotImplementedError
+
+    def bootstrap_script(
+        self,
+        cluster_name: str,
+        labels: Dict[str, str],
+        taints: Sequence[Taint],
+        kubelet_flags: Dict[str, str],
+        custom_userdata: str = "",
+    ) -> str:
+        raise NotImplementedError
+
+
+class StandardFamily(ImageFamily):
+    """eks/AL2-like: shell bootstrap merged with custom userdata via MIME
+    multipart (eksbootstrap.go:165-263 semantics)."""
+
+    name = "standard"
+
+    def default_images(self) -> List[Image]:
+        return [
+            Image("img-standard-amd64", L.ARCH_AMD64, created_at=2.0),
+            Image("img-standard-arm64", L.ARCH_ARM64, created_at=2.0),
+            Image("img-standard-gpu", L.ARCH_AMD64, accelerated=True, created_at=2.0),
+        ]
+
+    def bootstrap_script(self, cluster_name, labels, taints, kubelet_flags, custom_userdata="") -> str:
+        label_arg = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        taint_arg = ",".join(f"{t.key}={t.value}:{t.effect}" for t in taints)
+        flags = " ".join(f"--{k}={v}" for k, v in sorted(kubelet_flags.items()))
+        script = (
+            "#!/bin/bash\n"
+            f"/etc/node/bootstrap.sh '{cluster_name}' "
+            f"--kubelet-extra-args '--node-labels={label_arg} "
+            f"--register-with-taints={taint_arg} {flags}'\n"
+        )
+        if not custom_userdata:
+            return script
+        # MIME multipart merge: custom part first, bootstrap last
+        boundary = "//"
+        return (
+            f'MIME-Version: 1.0\nContent-Type: multipart/mixed; boundary="{boundary}"\n\n'
+            f"--{boundary}\nContent-Type: text/x-shellscript; charset=\"us-ascii\"\n\n"
+            f"{custom_userdata}\n"
+            f"--{boundary}\nContent-Type: text/x-shellscript; charset=\"us-ascii\"\n\n"
+            f"{script}\n--{boundary}--\n"
+        )
+
+
+class TomlFamily(ImageFamily):
+    """bottlerocket-like: structured TOML config; custom userdata must itself
+    be TOML and is merged key-wise (bottlerocketsettings.go semantics)."""
+
+    name = "toml"
+
+    def default_images(self) -> List[Image]:
+        return [
+            Image("img-toml-amd64", L.ARCH_AMD64, created_at=1.0),
+            Image("img-toml-arm64", L.ARCH_ARM64, created_at=1.0),
+        ]
+
+    def bootstrap_script(self, cluster_name, labels, taints, kubelet_flags, custom_userdata="") -> str:
+        lines = ["[settings.kubernetes]", f'cluster-name = "{cluster_name}"']
+        if custom_userdata:
+            lines.append(custom_userdata.strip())
+        lines.append("[settings.kubernetes.node-labels]")
+        for k, v in sorted(labels.items()):
+            lines.append(f'"{k}" = "{v}"')
+        if taints:
+            lines.append("[settings.kubernetes.node-taints]")
+            for t in taints:
+                lines.append(f'"{t.key}" = "{t.value}:{t.effect}"')
+        return "\n".join(lines) + "\n"
+
+
+class CustomFamily(ImageFamily):
+    """Pass-through userdata; requires explicit image selectors
+    (amifamily/custom.go)."""
+
+    name = "custom"
+
+    def default_images(self) -> List[Image]:
+        return []
+
+    def bootstrap_script(self, cluster_name, labels, taints, kubelet_flags, custom_userdata="") -> str:
+        return custom_userdata
+
+
+_FAMILIES = {f.name: f for f in (StandardFamily(), TomlFamily(), CustomFamily())}
+
+
+def get_family(name: str) -> ImageFamily:
+    """resolver.go:143-154 GetAMIFamily analog (defaults to standard)."""
+    return _FAMILIES.get(name, _FAMILIES["standard"])
+
+
+# ---------------------------------------------------------------------------
+# node template
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BlockDevice:
+    device_name: str = "/dev/xvda"
+    size_gib: float = 20.0
+    volume_type: str = "gp3"
+    encrypted: bool = True
+
+
+@dataclass
+class NodeTemplate:
+    """AWSNodeTemplate analog: how to build nodes for a provisioner."""
+
+    name: str = "default"
+    image_family: str = "standard"
+    image_selector: Dict[str, str] = field(default_factory=dict)  # tag/id selectors
+    subnet_selector: Dict[str, str] = field(default_factory=dict)
+    security_group_selector: Dict[str, str] = field(default_factory=dict)
+    user_data: str = ""
+    instance_profile: str = ""
+    block_devices: List[BlockDevice] = field(default_factory=list)
+    metadata_http_tokens: str = "required"
+    metadata_hop_limit: int = 2
+    tags: Dict[str, str] = field(default_factory=dict)
+    detailed_monitoring: bool = False
+    # status (filled by the nodetemplate controller)
+    status_subnets: List[str] = field(default_factory=list)
+    status_security_groups: List[str] = field(default_factory=list)
+    status_images: List[Image] = field(default_factory=list)
+
+    def validate(self) -> List[str]:
+        errs = []
+        if self.image_family == "custom" and not self.image_selector:
+            errs.append("custom image family requires an image selector")
+        if self.metadata_http_tokens not in ("required", "optional"):
+            errs.append(f"bad metadata_http_tokens {self.metadata_http_tokens!r}")
+        for bd in self.block_devices:
+            if bd.size_gib <= 0:
+                errs.append(f"block device {bd.device_name}: size must be positive")
+        return errs
+
+
+# ---------------------------------------------------------------------------
+# image resolution
+# ---------------------------------------------------------------------------
+
+
+def resolve_images(
+    template: NodeTemplate,
+    available_images: Sequence[Image] = (),
+) -> List[Image]:
+    """Selector-based discovery (ami.go:158-230) or family defaults
+    (ami.go:135-149), newest-first (ami.go:232-241)."""
+    family = get_family(template.image_family)
+    if template.image_selector:
+        ids = {v for k, v in template.image_selector.items() if k == "id"}
+        pool = list(available_images) or family.default_images()
+        picked = [i for i in pool if not ids or i.image_id in ids]
+    else:
+        picked = family.default_images()
+    return sorted(picked, key=lambda i: (-i.created_at, i.image_id))
+
+
+def image_for_instance_type(images: Sequence[Image], it: InstanceType) -> Optional[Image]:
+    """Pick the image matching the type's arch/accelerator (ami.go:99-133)."""
+    arch = it.labels().get(L.ARCH, L.ARCH_AMD64)
+    accelerated = L.RESOURCE_GPU in it.capacity
+    for img in images:
+        if img.arch == arch and img.accelerated == accelerated:
+            return img
+    for img in images:  # fall back on arch match alone
+        if img.arch == arch:
+            return img
+    return None
+
+
+# ---------------------------------------------------------------------------
+# launch templates
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class LaunchTemplate:
+    name: str
+    image_id: str
+    user_data_b64: str
+    instance_profile: str
+    security_groups: Tuple[str, ...]
+    tags: Tuple[Tuple[str, str], ...]
+
+
+class LaunchTemplateProvider:
+    """Hash-keyed ensure-exists cache (launchtemplate.go:54-317)."""
+
+    def __init__(self, cluster_name: str = "sim", max_templates: int = 256) -> None:
+        self.cluster_name = cluster_name
+        self.max_templates = max_templates
+        self._cache: Dict[str, LaunchTemplate] = {}
+        self.created: List[str] = []
+        self.deleted: List[str] = []
+
+    @staticmethod
+    def _hash(*parts: str) -> str:
+        return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+    def ensure(
+        self,
+        template: NodeTemplate,
+        image: Image,
+        labels: Dict[str, str],
+        taints: Sequence[Taint],
+        kubelet_flags: Optional[Dict[str, str]] = None,
+    ) -> LaunchTemplate:
+        family = get_family(template.image_family)
+        userdata = family.bootstrap_script(
+            self.cluster_name, labels, taints, kubelet_flags or {}, template.user_data
+        )
+        key = self._hash(
+            image.image_id, userdata, template.instance_profile,
+            ",".join(sorted(template.status_security_groups)),
+            str(sorted(template.tags.items())),
+        )
+        got = self._cache.get(key)
+        if got is not None:
+            return got
+        lt = LaunchTemplate(
+            name=f"karpenter.k8s.tpu/{key}",
+            image_id=image.image_id,
+            user_data_b64=base64.b64encode(userdata.encode()).decode(),
+            instance_profile=template.instance_profile,
+            security_groups=tuple(sorted(template.status_security_groups)),
+            tags=tuple(sorted(template.tags.items())),
+        )
+        if len(self._cache) >= self.max_templates:
+            # evict-deletes (launchtemplate.go:291-305)
+            evict_key = next(iter(self._cache))
+            self.deleted.append(self._cache.pop(evict_key).name)
+        self._cache[key] = lt
+        self.created.append(lt.name)
+        return lt
+
+    def invalidate(self, name: str) -> None:
+        """Drop a template reported not-found by the cloud
+        (launchtemplate.go:120-128); next ensure() recreates it."""
+        for key, lt in list(self._cache.items()):
+            if lt.name == name:
+                del self._cache[key]
+
+    def hydrate(self, existing: Sequence[LaunchTemplate]) -> None:
+        """Warm the cache from the cloud on leadership (launchtemplate.go:272-289)."""
+        for lt in existing:
+            key = lt.name.rsplit("/", 1)[-1]
+            self._cache.setdefault(key, lt)
+
+    def __len__(self) -> int:
+        return len(self._cache)

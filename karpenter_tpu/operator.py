@@ -1,0 +1,252 @@
+"""Operator runtime — process bootstrap, controller wiring, run loop.
+
+The cmd/controller/main.go + core operator.NewOperator analog (SURVEY.md
+§3.1): builds the cloud provider, wraps it in the metrics decorator, registers
+every controller, exposes /metrics and /healthz over HTTP, and drives the
+reconcile loops.  Leader election is modeled as a pluggable gate (a real
+deployment plugs a lease-based elector; the sim elects immediately), and
+leadership gates cache hydration exactly like launchtemplate.go:77-88.
+
+Run a self-contained simulation:  ``python -m karpenter_tpu.operator --demo``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional
+
+from .batcher import Window
+from .cache import UnavailableOfferings
+from .cloud.base import CloudProvider
+from .cloud.fake import FakeCloudProvider
+from .controllers.deprovisioning import DeprovisioningController
+from .controllers.garbagecollect import GarbageCollectController, LinkController
+from .controllers.interruption import InterruptionController, MessageQueue
+from .controllers.nodetemplate import NodeTemplateController
+from .controllers.provisioning import ProvisioningController
+from .controllers.state import ClusterState
+from .controllers.termination import TerminationController
+from .events import Recorder
+from .metrics import Registry, decorate, registry as default_registry
+from .models.catalog import generate_catalog
+from .models.pod import PodSpec
+from .models.provisioner import Provisioner
+from .providers.pricing import PricingProvider
+from .providers.securitygroup import SecurityGroupProvider
+from .providers.subnet import SubnetProvider
+from .settings import Settings, SettingsStore
+from .solver.scheduler import BatchScheduler
+from .utils.clock import Clock
+
+
+class LeaderElector:
+    """Pluggable leadership gate (operator.Elected() analog)."""
+
+    def __init__(self, elect: Callable[[], bool] = lambda: True) -> None:
+        self._elect = elect
+        self.elected = False
+        self._on_elected: List[Callable[[], None]] = []
+
+    def on_elected(self, fn: Callable[[], None]) -> None:
+        self._on_elected.append(fn)
+
+    def tick(self) -> bool:
+        if not self.elected and self._elect():
+            self.elected = True
+            for fn in self._on_elected:
+                fn()
+        return self.elected
+
+
+class Operator:
+    def __init__(
+        self,
+        cloud: CloudProvider,
+        clock: Optional[Clock] = None,
+        settings: Optional[SettingsStore] = None,
+        registry: Optional[Registry] = None,
+        scheduler_backend: str = "auto",
+        metrics_port: int = 0,  # 0 disables the HTTP server
+    ) -> None:
+        self.clock = clock or Clock()
+        self.settings = settings or SettingsStore()
+        self.registry = registry or default_registry
+        self.recorder = Recorder()
+        self.elector = LeaderElector()
+        self.metrics_port = metrics_port
+
+        self.state = ClusterState(clock=self.clock)
+        self.cloud = decorate(cloud, self.registry)
+        self.unavailable = UnavailableOfferings(clock=self.clock)
+        self.scheduler = BatchScheduler(backend=scheduler_backend, registry=self.registry)
+        self.pricing = PricingProvider(cloud.get_instance_types(), clock=self.clock)
+        self.subnets = SubnetProvider()
+        self.security_groups = SecurityGroupProvider(clock=self.clock)
+        self.queue = MessageQueue()
+
+        s = self.settings.current
+        self.provisioning = ProvisioningController(
+            self.state, self.cloud, scheduler=self.scheduler, recorder=self.recorder,
+            registry=self.registry, unavailable=self.unavailable, clock=self.clock,
+            idle_seconds=s.batch_idle_duration, max_seconds=s.batch_max_duration,
+        )
+        self.termination = TerminationController(
+            self.state, self.cloud, recorder=self.recorder,
+            registry=self.registry, clock=self.clock,
+        )
+        self.deprovisioning = DeprovisioningController(
+            self.state, self.cloud, self.termination, provisioning=self.provisioning,
+            scheduler=self.scheduler, recorder=self.recorder, registry=self.registry,
+            clock=self.clock, drift_enabled=s.drift_enabled,
+        )
+        self.interruption = InterruptionController(
+            self.state, self.termination, self.queue, unavailable=self.unavailable,
+            recorder=self.recorder, registry=self.registry, clock=self.clock,
+        )
+        self.gc = GarbageCollectController(self.state, self.cloud, recorder=self.recorder, clock=self.clock)
+        self.link = LinkController(self.state, self.cloud, recorder=self.recorder, clock=self.clock)
+        self.nodetemplates = NodeTemplateController(self.subnets, self.security_groups, clock=self.clock)
+
+        self.settings.subscribe(self._on_settings)
+        self.elector.on_elected(self._hydrate)
+        self._http: Optional[ThreadingHTTPServer] = None
+        self._stop = threading.Event()
+
+    # ---- wiring ---------------------------------------------------------
+    def _on_settings(self, s: Settings) -> None:
+        self.provisioning.window = Window(
+            s.batch_idle_duration, s.batch_max_duration, clock=self.clock
+        )
+        self.deprovisioning.drift_enabled = s.drift_enabled
+
+    def _hydrate(self) -> None:
+        """Leadership-gated warm-state rebuild (SURVEY §5 checkpoint/resume):
+        re-adopt orphaned instances, refresh prices."""
+        self.link.reconcile()
+        self.pricing.maybe_refresh()
+
+    # ---- health / metrics -----------------------------------------------
+    def healthz(self) -> bool:
+        return self.cloud.liveness() and self.pricing.liveness_ok()
+
+    def start_http(self) -> Optional[int]:
+        if self.metrics_port == 0:
+            return None
+        op = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = op.registry.expose().encode()
+                    self.send_response(200)
+                elif self.path == "/healthz":
+                    ok = op.healthz()
+                    body = (b"ok" if ok else b"unhealthy")
+                    self.send_response(200 if ok else 503)
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._http = ThreadingHTTPServer(("127.0.0.1", self.metrics_port), Handler)
+        port = self._http.server_address[1]
+        threading.Thread(target=self._http.serve_forever, daemon=True).start()
+        return port
+
+    def stop_http(self) -> None:
+        if self._http:
+            self._http.shutdown()
+            self._http = None
+
+    # ---- loop -----------------------------------------------------------
+    def tick(self) -> None:
+        """One pass over every controller (singleton-controller semantics)."""
+        if not self.elector.tick():
+            return
+        self.interruption.reconcile()
+        self.provisioning.reconcile()
+        self.deprovisioning.reconcile()
+        self.termination.reconcile()
+        self.nodetemplates.reconcile()
+        self.gc.reconcile()
+        self.pricing.maybe_refresh()
+
+    def run(self, interval: float = 1.0, max_ticks: Optional[int] = None) -> None:
+        n = 0
+        while not self._stop.is_set():
+            self.tick()
+            n += 1
+            if max_ticks is not None and n >= max_ticks:
+                break
+            self.clock.sleep(interval)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.stop_http()
+
+
+def _demo(args) -> None:
+    """Self-contained scale-up/scale-down simulation against the fake cloud."""
+    from .utils.clock import FakeClock
+
+    clock = FakeClock()
+    cloud = FakeCloudProvider(generate_catalog(full=not args.small), clock=clock)
+    op = Operator(cloud, clock=clock, scheduler_backend=args.backend,
+                  metrics_port=args.metrics_port)
+    port = op.start_http()
+    if port:
+        print(f"metrics on http://127.0.0.1:{port}/metrics")
+    op.state.apply_provisioner(Provisioner(name="default", consolidation_enabled=True))
+
+    print(f"scale-up: {args.pods} pods")
+    for i in range(args.pods):
+        op.state.add_pod(PodSpec(
+            name=f"pod-{i}", requests={"cpu": 0.5 + (i % 4) * 0.5}, owner_key=f"d{i%5}",
+        ))
+    for _ in range(4):
+        op.tick()
+        clock.advance(1.0)
+    cost = sum(ns.node.price for ns in op.state.nodes.values())
+    print(f"  -> {len(op.state.nodes)} nodes, ${cost:.2f}/hr, "
+          f"pending={len(op.state.pending_pods())}")
+
+    print("scale-down: deleting 70% of pods")
+    for i in range(0, int(args.pods * 0.7)):
+        op.state.delete_pod(f"pod-{i}")
+    clock.advance(6 * 60)
+    for _ in range(8):
+        op.tick()
+        clock.advance(2.0)
+    cost2 = sum(ns.node.price for ns in op.state.nodes.values())
+    print(f"  -> {len(op.state.nodes)} nodes, ${cost2:.2f}/hr, "
+          f"pending={len(op.state.pending_pods())}, saved ${cost - cost2:.2f}/hr")
+    op.shutdown()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="karpenter-tpu")
+    parser.add_argument("--demo", action="store_true", help="run the fake-cloud simulation")
+    parser.add_argument("--pods", type=int, default=200)
+    parser.add_argument("--small", action="store_true", help="20-type catalog")
+    parser.add_argument("--backend", default="oracle", choices=["auto", "tpu", "oracle"])
+    parser.add_argument("--metrics-port", type=int, default=0)
+    args = parser.parse_args(argv)
+    if args.demo:
+        _demo(args)
+        return 0
+    parser.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,86 @@
+"""Operator runtime: wiring, leadership gating, HTTP endpoints, settings."""
+
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.cloud.fake import FakeCloudProvider
+from karpenter_tpu.metrics import Registry
+from karpenter_tpu.models.machine import Machine
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import PodSpec
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.requirements import IN, Requirement, Requirements
+from karpenter_tpu.operator import LeaderElector, Operator
+from karpenter_tpu.settings import SettingsStore
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def op(small_catalog):
+    clock = FakeClock()
+    cloud = FakeCloudProvider(small_catalog, clock=clock)
+    op = Operator(cloud, clock=clock, scheduler_backend="oracle", registry=Registry())
+    op.state.apply_provisioner(Provisioner(name="default", consolidation_enabled=True))
+    return op
+
+
+class TestOperator:
+    def test_scale_up_via_ticks(self, op):
+        for i in range(20):
+            op.state.add_pod(PodSpec(name=f"p{i}", requests={"cpu": 0.5}, owner_key="d"))
+        for _ in range(3):
+            op.tick()
+            op.clock.advance(1.5)
+        assert len(op.state.pending_pods()) == 0
+        assert len(op.state.nodes) >= 1
+
+    def test_leadership_gates_reconciles(self, small_catalog):
+        clock = FakeClock()
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+        op = Operator(cloud, clock=clock, scheduler_backend="oracle", registry=Registry())
+        op.elector = LeaderElector(elect=lambda: False)
+        op.state.apply_provisioner(Provisioner(name="default"))
+        op.state.add_pod(PodSpec(name="p", requests={"cpu": 0.5}))
+        for _ in range(3):
+            op.tick()
+            clock.advance(2.0)
+        assert len(op.state.nodes) == 0  # never elected -> no reconciles
+
+    def test_hydration_on_election_adopts_orphans(self, small_catalog):
+        clock = FakeClock()
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+        # pre-existing instance from a previous leader
+        cloud.create(Machine(
+            provisioner="default",
+            requirements=Requirements([Requirement(L.INSTANCE_TYPE, IN, ["m5.large"])]),
+        ))
+        op = Operator(cloud, clock=clock, scheduler_backend="oracle", registry=Registry())
+        op.state.apply_provisioner(Provisioner(name="default"))
+        op.tick()  # elects + hydrates
+        assert len(op.state.nodes) == 1  # adopted by link controller
+
+    def test_settings_hot_reload_rewires_batch_window(self, op):
+        op.settings.update(batch_idle_duration=0.1, batch_max_duration=5.0)
+        assert op.provisioning.window.idle == 0.1
+        op.settings.update(drift_enabled=True)
+        assert op.deprovisioning.drift_enabled is True
+
+    def test_http_metrics_and_healthz(self, small_catalog):
+        clock = FakeClock()
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+        op = Operator(cloud, clock=clock, scheduler_backend="oracle",
+                      registry=Registry(), metrics_port=18765)
+        port = op.start_http()
+        try:
+            op.state.apply_provisioner(Provisioner(name="default"))
+            op.state.add_pod(PodSpec(name="p", requests={"cpu": 0.5}))
+            op.tick(); clock.advance(1.5); op.tick()
+            body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics").read().decode()
+            assert "karpenter_nodes_created_total" in body
+            health = urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+            assert health.status == 200
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"http://127.0.0.1:{port}/nope")
+        finally:
+            op.shutdown()
