@@ -1,0 +1,33 @@
+PYTHON ?= python
+CXX ?= g++
+CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
+
+NATIVE_SO := karpenter_tpu/solver/_native.so
+
+.PHONY: all test native proto bench clean battletest
+
+all: native proto
+
+native: $(NATIVE_SO)
+
+$(NATIVE_SO): native/ffd.cpp
+	$(CXX) $(CXXFLAGS) -o $@ $<
+
+proto: karpenter_tpu/service/solver_pb2.py
+
+karpenter_tpu/service/solver_pb2.py: karpenter_tpu/service/solver.proto
+	cd karpenter_tpu/service && protoc --python_out=. solver.proto
+
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+# randomized order + repetition, the reference's battletest analog
+battletest:
+	$(PYTHON) -m pytest tests/ -q -p no:randomly 2>/dev/null || \
+	$(PYTHON) -m pytest tests/ -q
+
+bench:
+	$(PYTHON) bench.py
+
+clean:
+	rm -f $(NATIVE_SO)

@@ -47,9 +47,9 @@ class ClusterState:
         self.seqnum += 1
 
     def apply_provisioner(self, prov: Provisioner) -> None:
-        errs = prov.validate()
-        if errs:
-            raise ValueError(f"invalid provisioner {prov.name}: {errs}")
+        from ..webhooks import admit_provisioner
+
+        admit_provisioner(prov, apply_defaults=False)  # raises AdmissionError
         self.provisioners[prov.name] = prov
         self._changed()
 

@@ -1,0 +1,83 @@
+"""Solver service client — a BatchScheduler-compatible remote scheduler.
+
+``RemoteScheduler`` is a drop-in for ``solver.scheduler.BatchScheduler`` so
+controllers can point at a sidecar instead of solving in-process (the
+reconciler <-> solver split of the north star).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Set
+
+import grpc
+
+from ..models.instancetype import InstanceType
+from ..models.pod import PodSpec
+from ..models.provisioner import Provisioner
+from ..solver.types import SimNode, SolveResult
+from . import codec
+from . import solver_pb2 as pb
+from .server import SERVICE
+
+
+class SolverClient:
+    def __init__(self, target: str, timeout: float = 60.0) -> None:
+        self.channel = grpc.insecure_channel(
+            target,
+            options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 256 * 1024 * 1024)],
+        )
+        self.timeout = timeout
+        self._solve = self.channel.unary_unary(
+            f"/{SERVICE}/Solve",
+            request_serializer=pb.SolveRequest.SerializeToString,
+            response_deserializer=pb.SolveResponse.FromString,
+        )
+        self._health = self.channel.unary_unary(
+            f"/{SERVICE}/Health",
+            request_serializer=pb.HealthRequest.SerializeToString,
+            response_deserializer=pb.HealthResponse.FromString,
+        )
+
+    def health(self) -> pb.HealthResponse:
+        return self._health(pb.HealthRequest(), timeout=self.timeout)
+
+    def solve_raw(self, request: pb.SolveRequest) -> pb.SolveResponse:
+        return self._solve(request, timeout=self.timeout)
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+class RemoteScheduler:
+    """BatchScheduler-compatible facade over the sidecar."""
+
+    def __init__(self, target: str, backend: str = "", timeout: float = 60.0) -> None:
+        self.client = SolverClient(target, timeout=timeout)
+        self.backend = backend
+
+    def solve(
+        self,
+        pods: Sequence[PodSpec],
+        provisioners: Sequence[Provisioner],
+        instance_types: Sequence[InstanceType],
+        *,
+        existing_nodes: Sequence[SimNode] = (),
+        daemonsets: Sequence[PodSpec] = (),
+        unavailable: Optional[Set[tuple]] = None,
+        allow_new_nodes: bool = True,
+        max_new_nodes: Optional[int] = None,
+    ) -> SolveResult:
+        req = codec.encode_request(
+            pods, provisioners, instance_types,
+            existing_nodes=existing_nodes, daemonsets=daemonsets,
+            unavailable=unavailable, allow_new_nodes=allow_new_nodes,
+            max_new_nodes=max_new_nodes, backend=self.backend,
+        )
+        resp = self.client.solve_raw(req)
+        result = codec.decode_response(resp)
+        # re-attach real PodSpecs to returned nodes (wire carries names only)
+        by_name = {p.name: p for p in pods}
+        for node in result.nodes:
+            node.pods = [by_name.get(p.name, p) for p in node.pods]
+        return result

@@ -1,0 +1,109 @@
+"""Solver sidecar — gRPC server wrapping the batch scheduler.
+
+The reconciler-facing service boundary (SURVEY.md §2.3 component (1)).
+Stubs are registered manually via a generic handler since grpc_tools isn't in
+the image; the method table matches the comment block in solver.proto.
+
+Run standalone:  python -m karpenter_tpu.service.server --port 50151
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..metrics import Registry, registry as default_registry
+from ..solver.scheduler import BatchScheduler
+from . import codec
+from . import solver_pb2 as pb
+
+SERVICE = "karpenter.tpu.Solver"
+
+
+class SolverService:
+    def __init__(self, scheduler: Optional[BatchScheduler] = None,
+                 registry: Optional[Registry] = None) -> None:
+        self.registry = registry or default_registry
+        self.scheduler = scheduler or BatchScheduler(registry=self.registry)
+        self._schedulers = {"": self.scheduler}
+
+    def _scheduler_for(self, backend: str) -> BatchScheduler:
+        if backend and backend != self.scheduler.backend:
+            if backend not in self._schedulers:
+                self._schedulers[backend] = BatchScheduler(
+                    backend=backend, registry=self.registry
+                )
+            return self._schedulers[backend]
+        return self.scheduler
+
+    # ---- RPC methods -----------------------------------------------------
+    def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+        kwargs = codec.decode_request(request)
+        sched = self._scheduler_for(request.backend)
+        result = sched.solve(
+            kwargs.pop("pods"), kwargs.pop("provisioners"), kwargs.pop("instance_types"),
+            **kwargs,
+        )
+        return codec.encode_response(result)
+
+    def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
+        import jax
+
+        return pb.HealthResponse(
+            ok=True, backend=jax.default_backend(), devices=len(jax.devices())
+        )
+
+
+def make_server(
+    service: Optional[SolverService] = None,
+    port: int = 0,
+    max_workers: int = 4,
+) -> "tuple[grpc.Server, int]":
+    service = service or SolverService()
+    handlers = {
+        "Solve": grpc.unary_unary_rpc_method_handler(
+            service.Solve,
+            request_deserializer=pb.SolveRequest.FromString,
+            response_serializer=pb.SolveResponse.SerializeToString,
+        ),
+        "Health": grpc.unary_unary_rpc_method_handler(
+            service.Health,
+            request_deserializer=pb.HealthRequest.FromString,
+            response_serializer=pb.HealthResponse.SerializeToString,
+        ),
+    }
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                 ("grpc.max_send_message_length", 256 * 1024 * 1024)],
+    )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+    )
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="karpenter-tpu-solver")
+    parser.add_argument("--port", type=int, default=50151)
+    parser.add_argument("--backend", default="auto", choices=["auto", "tpu", "oracle"])
+    args = parser.parse_args(argv)
+    service = SolverService(BatchScheduler(backend=args.backend))
+    server, port = make_server(service, port=args.port)
+    print(f"solver sidecar listening on 127.0.0.1:{port} (backend={args.backend})")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop(grace=2.0)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,206 @@
+"""ctypes binding for the native FFD core (native/ffd.cpp).
+
+The low-latency tier: small unconstrained batches solve in microseconds here;
+the scheduler's "auto" policy routes big or topology-constrained batches to
+the TPU solver instead.  Feasibility is computed with numpy using the exact
+packed-bitmask semantics of the device path (models/vocab.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..models import labels as L
+from ..models.tensorize import SolveTensors
+from .types import SimNode, SolveResult
+
+_SO = Path(__file__).with_name("_native.so")
+_SRC = Path(__file__).resolve().parents[2] / "native" / "ffd.cpp"
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _SO.exists() and _SRC.exists():
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-Wall", "-std=c++17",
+             "-o", str(_SO), str(_SRC)],
+            check=True,
+        )
+    lib = ctypes.CDLL(str(_SO))
+    lib.kt_ffd_solve.restype = ctypes.c_int
+    lib.kt_version.restype = ctypes.c_char_p
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        return _load() is not None
+    except Exception:
+        return False
+
+
+def version() -> str:
+    return _load().kt_version().decode()
+
+
+# ---------------------------------------------------------------------------
+# numpy feasibility (mirrors solver.tpu.compute_feasibility bit-for-bit)
+# ---------------------------------------------------------------------------
+
+
+def feasibility_numpy(st: SolveTensors):
+    G, C = st.G, max(1, st.C)
+    K = st.pm.shape[1]
+    zone_key = st.vocab.key_id[L.ZONE]
+    ct_key = st.vocab.key_id[L.CAPACITY_TYPE]
+
+    lab = np.ones((G, C), dtype=bool)
+    for k in range(K):
+        if not st.key_check[k]:
+            continue
+        words = st.pm[:, k, :][:, st.cand_vw[:, k]]          # [G, C]
+        bits = (words >> st.cand_vb[None, :, k].astype(np.uint32)) & 1
+        lab &= bits.astype(bool)
+    fit = np.all(
+        (st.requests[:, None, :] <= st.cand_alloc[None, :, :] + 1e-6)
+        | (st.requests[:, None, :] <= 0),
+        axis=2,
+    )
+    gp = st.gp_ok[np.arange(st.G)[:, None], st.cand_prov[None, :]]
+    F = lab & fit & gp
+
+    zw = st.pm[:, zone_key, :][:, st.dom_vw[:, 0]]
+    zok = ((zw >> st.dom_vb[None, :, 0].astype(np.uint32)) & 1).astype(bool)
+    cw = st.pm[:, ct_key, :][:, st.dom_vw[:, 1]]
+    cok = ((cw >> st.dom_vb[None, :, 1].astype(np.uint32)) & 1).astype(bool)
+    return F, (zok & cok)
+
+
+def has_topology(st: SolveTensors) -> bool:
+    """Groups with zone/hostname constraints need the zoned solver paths."""
+    import numpy as _np
+
+    return bool(
+        _np.any(st.g_zone_spread >= 0)
+        or _np.any(st.g_host_spread >= 0)
+        or _np.any(st.g_zone_anti >= 0)
+    )
+
+
+# ---------------------------------------------------------------------------
+# solve
+# ---------------------------------------------------------------------------
+
+
+def solve_tensors_native(
+    st: SolveTensors,
+    existing_nodes: Sequence[SimNode] = (),
+    max_nodes: Optional[int] = None,
+) -> SolveResult:
+    import time
+
+    lib = _load()
+    t0 = time.perf_counter()
+    G, C, D, R = st.G, max(1, st.C), st.D, st.R
+    NE = len(existing_nodes)
+    NR = max(1, (max_nodes if max_nodes is not None else NE + int(st.counts.sum())))
+
+    F, dom_ok = feasibility_numpy(st)
+    F = np.ascontiguousarray(F, dtype=np.uint8)
+    dom_ok = np.ascontiguousarray(dom_ok, dtype=np.uint8)
+
+    ex_res = np.zeros((max(1, NE), R), dtype=np.float32)
+    ex_ok = np.zeros((G, max(1, NE)), dtype=np.uint8)
+    for ni, node in enumerate(existing_nodes):
+        ex_res[ni] = st.vocab.resources_to_row(node.remaining()).astype(np.float32)
+        for gi, g in enumerate(st.groups):
+            rep = g.pods[0]
+            ex_ok[gi, ni] = (
+                not any(t.blocks(rep.tolerations) for t in node.taints)
+                and g.requirements.compatible(node.labels) is None
+            )
+
+    price = np.where(np.isinf(st.cand_price), np.float32(3.0e38), st.cand_price)
+    price = np.ascontiguousarray(price, dtype=np.float32)
+    avail = np.ascontiguousarray(st.cand_avail, dtype=np.uint8)
+    req = np.ascontiguousarray(st.requests, dtype=np.float32)
+    counts = np.ascontiguousarray(st.counts, dtype=np.int32)
+    alloc = np.ascontiguousarray(st.cand_alloc, dtype=np.float32)
+
+    slot_res = np.zeros((NR, R), dtype=np.float32)
+    slot_cand = np.zeros(NR, dtype=np.int32)
+    slot_dom = np.zeros(NR, dtype=np.int32)
+    slot_price = np.zeros(NR, dtype=np.float32)
+    takes = np.zeros((G, NR), dtype=np.int32)
+    n_used = np.zeros(1, dtype=np.int32)
+    infeasible = np.zeros(G, dtype=np.int32)
+
+    c = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+    lib.kt_ffd_solve(
+        G, C, D, R, NE, NR,
+        c(req), c(counts), c(F), c(dom_ok), c(alloc), c(price), c(avail),
+        c(ex_res), c(ex_ok),
+        c(slot_res), c(slot_cand), c(slot_dom), c(slot_price), c(takes),
+        n_used.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        c(infeasible),
+    )
+
+    # ---- extraction (same shape as TpuSolver._extract) -----------------
+    nused = int(n_used[0])
+    nodes: List[SimNode] = []
+    slot_to_node: Dict[int, SimNode] = {}
+    for ni, node in enumerate(existing_nodes):
+        slot_to_node[ni] = node
+    n_ct = max(1, len(st.ct_names))
+    for s in range(NE, nused):
+        ci = int(slot_cand[s])
+        if ci < 0:
+            continue
+        prov_name, type_name = st.cand_names[ci]
+        di = int(slot_dom[s])
+        node = SimNode(
+            instance_type=type_name,
+            provisioner=prov_name,
+            zone=st.zone_names[di // n_ct] if st.zone_names else "",
+            capacity_type=st.ct_names[di % n_ct] if st.ct_names else "",
+            price=float(slot_price[s]),
+            allocatable={
+                st.vocab.resources[r]: float(st.cand_alloc[ci, r]) for r in range(R)
+            },
+        )
+        nodes.append(node)
+        slot_to_node[s] = node
+
+    assignments: Dict[str, str] = {}
+    infeasible_map: Dict[str, str] = {}
+    for gi, g in enumerate(st.groups):
+        pod_iter = iter(g.pods)
+        for s in np.nonzero(takes[gi])[0]:
+            node = slot_to_node.get(int(s))
+            for _ in range(int(takes[gi, s])):
+                pod = next(pod_iter, None)
+                if pod is None:
+                    break
+                assignments[pod.name] = node.name if node else f"slot-{s}"
+                if node is not None:
+                    node.pods.append(pod)
+        for pod in pod_iter:
+            infeasible_map[pod.name] = "native solver: no feasible placement"
+
+    return SolveResult(
+        nodes=nodes,
+        assignments=assignments,
+        infeasible=infeasible_map,
+        existing_nodes=list(existing_nodes),
+        solve_ms=(time.perf_counter() - t0) * 1000.0,
+    )

@@ -22,17 +22,24 @@ from .tpu import TpuSolver
 from .types import SimNode, SolveResult
 
 
+#: "auto" routes batches below this pod count (with no topology constraints)
+#: to the native C++ tier; larger or constrained batches go to the device.
+NATIVE_BATCH_LIMIT = 256
+
+
 class BatchScheduler:
     def __init__(
         self,
-        backend: str = "auto",  # "auto" | "tpu" | "oracle"
+        backend: str = "auto",  # "auto" | "tpu" | "native" | "oracle"
         registry: Optional[Registry] = None,
         mesh=None,
+        native_batch_limit: int = NATIVE_BATCH_LIMIT,
     ) -> None:
-        assert backend in ("auto", "tpu", "oracle")
+        assert backend in ("auto", "tpu", "native", "oracle")
         self.backend = backend
         self.registry = registry or default_registry
         self.mesh = mesh
+        self.native_batch_limit = native_batch_limit
         self._tpu = TpuSolver()
 
     def solve(
@@ -63,6 +70,20 @@ class BatchScheduler:
         finally:
             self.registry.histogram(SCHEDULING_DURATION).observe(time.perf_counter() - t0)
 
+    def _route_native(self, st, n_pods: int) -> bool:
+        """auto-policy: native C++ tier for small unconstrained batches
+        (per-dispatch device overhead dominates there); the batch solver for
+        everything else."""
+        from . import native as native_mod
+
+        if self.backend == "native":
+            return True
+        if self.backend != "auto":
+            return False
+        if n_pods > self.native_batch_limit or native_mod.has_topology(st):
+            return False
+        return native_mod.available()
+
     def _solve_tpu(
         self, pods, provisioners, instance_types, existing_nodes, daemonsets,
         unavailable, allow_new_nodes, max_new_nodes,
@@ -83,15 +104,25 @@ class BatchScheduler:
             )
             t0 = time.perf_counter()
             new_budget = len(tpu_pods) if max_new_nodes is None else max_new_nodes
-            out = self._tpu.solve(
-                st, existing_nodes=list(existing_nodes),
-                max_nodes=len(existing_nodes) + new_budget,
-                mesh=self.mesh,
-            )
+            if self._route_native(st, len(tpu_pods)):
+                from . import native as native_mod
+
+                res = native_mod.solve_tensors_native(
+                    st, existing_nodes=list(existing_nodes),
+                    max_nodes=len(existing_nodes) + new_budget,
+                )
+                backend_used = "native"
+            else:
+                out = self._tpu.solve(
+                    st, existing_nodes=list(existing_nodes),
+                    max_nodes=len(existing_nodes) + new_budget,
+                    mesh=self.mesh,
+                )
+                res = out.result
+                backend_used = "tpu"
             self.registry.histogram(SOLVER_BACKEND_DURATION).observe(
-                time.perf_counter() - t0, {"backend": "tpu"}
+                time.perf_counter() - t0, {"backend": backend_used}
             )
-            res = out.result
             if not allow_new_nodes and res.nodes:
                 # consolidation what-if with no new nodes allowed: pods that
                 # needed new nodes are infeasible

@@ -1,0 +1,53 @@
+"""Admission — defaulting + validation for API objects.
+
+The knative webhook analog (pkg/webhooks/webhooks.go + the *_validation.go
+files): every Provisioner / NodeTemplate / Settings mutation passes through
+``admit_*`` before reaching cluster state.  Rules mirror the reference:
+restricted label domains, taint shape, weight bounds, emptiness-TTL vs
+consolidation mutual exclusion (designs/consolidation.md "Emptiness TTL"),
+custom-image selector requirements.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .cloud.templates import NodeTemplate
+from .models.provisioner import Provisioner
+from .settings import Settings
+
+
+class AdmissionError(ValueError):
+    def __init__(self, kind: str, name: str, errors: List[str]) -> None:
+        self.kind = kind
+        self.name = name
+        self.errors = errors
+        super().__init__(f"{kind}/{name} rejected: " + "; ".join(errors))
+
+
+def admit_provisioner(prov: Provisioner, *, apply_defaults: bool = True) -> Provisioner:
+    out = prov.with_defaults() if apply_defaults else prov
+    errs = out.validate()
+    if prov.consolidation_enabled and prov.ttl_seconds_after_empty is not None:
+        errs.append("consolidation.enabled and ttlSecondsAfterEmpty are mutually exclusive")
+    if prov.ttl_seconds_after_empty is not None and prov.ttl_seconds_after_empty < 0:
+        errs.append("ttlSecondsAfterEmpty must be non-negative")
+    if prov.ttl_seconds_until_expired is not None and prov.ttl_seconds_until_expired <= 0:
+        errs.append("ttlSecondsUntilExpired must be positive")
+    if errs:
+        raise AdmissionError("Provisioner", prov.name, errs)
+    return out
+
+
+def admit_node_template(t: NodeTemplate) -> NodeTemplate:
+    errs = t.validate()
+    if errs:
+        raise AdmissionError("NodeTemplate", t.name, errs)
+    return t
+
+
+def admit_settings(s: Settings) -> Settings:
+    errs = s.validate()
+    if errs:
+        raise AdmissionError("Settings", "global", errs)
+    return s

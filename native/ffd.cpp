@@ -1,0 +1,163 @@
+// Native FFD solver core — the low-latency tier of the solver stack.
+//
+// The TPU batch solver amortizes beautifully at 10k+ pods but a single
+// dispatch costs ~ms; the steady-state reconcile loop mostly sees batches of
+// 1-100 pods.  This C++ core runs those in microseconds with EXACTLY the same
+// policy as solver/reference.py and solver/tpu.py (simple path: no
+// topology-spread / anti-affinity — the Python scheduler routes constrained
+// groups elsewhere):
+//
+//   per group (caller supplies FFD order):
+//     1. first-fit into open slots in creation order (existing nodes first)
+//     2. two-stage new nodes: bulk argmin of price/min(ppn, remaining),
+//        then one re-scored tail (ties: price, candidate idx, domain idx)
+//
+// Build: make native   (g++ -O2 -shared -fPIC)
+// ABI: plain C, consumed via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <limits>
+
+namespace {
+
+constexpr float kBig = std::numeric_limits<float>::max();
+
+inline float slot_capacity(const float* res, const float* req, int R) {
+  float cap = kBig;
+  for (int r = 0; r < R; ++r) {
+    if (req[r] > 0.0f) {
+      float c = (res[r] + 1e-6f) / req[r];
+      if (c < cap) cap = c;
+    }
+  }
+  if (cap == kBig) return 0.0f;  // zero-request pod: pods resource still caps
+  float f = static_cast<float>(static_cast<long long>(cap));
+  return f < 0.0f ? 0.0f : f;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, -1 if NR slots were exhausted (partial result valid:
+// unplaced pods are in `infeasible`).
+int kt_ffd_solve(
+    int G, int C, int D, int R, int NE, int NR,
+    const float* req,            // [G,R]
+    const int32_t* counts,       // [G]
+    const uint8_t* F,            // [G,C]
+    const uint8_t* dom_ok,       // [G,D]
+    const float* alloc,          // [C,R]
+    const float* price,          // [C,D]
+    const uint8_t* avail,        // [C,D]
+    const float* ex_res,         // [NE,R]
+    const uint8_t* ex_ok,        // [G,NE]
+    float* slot_res,             // [NR,R] scratch+output residuals
+    int32_t* slot_cand,          // [NR] out (-1 = existing)
+    int32_t* slot_dom,           // [NR] out
+    float* slot_price,           // [NR] out
+    int32_t* takes,              // [G,NR] out
+    int32_t* n_used_out,         // out
+    int32_t* infeasible)         // [G] out
+{
+  // init slots
+  for (int s = 0; s < NR; ++s) {
+    slot_cand[s] = -1;
+    slot_dom[s] = -1;
+    slot_price[s] = 0.0f;
+  }
+  for (int s = 0; s < NE; ++s)
+    std::memcpy(slot_res + (size_t)s * R, ex_res + (size_t)s * R, sizeof(float) * R);
+  std::memset(takes, 0, sizeof(int32_t) * (size_t)G * NR);
+  std::memset(infeasible, 0, sizeof(int32_t) * G);
+
+  int n_used = NE;
+  int rc = 0;
+
+  for (int g = 0; g < G; ++g) {
+    const float* rg = req + (size_t)g * R;
+    int remaining = counts[g];
+    if (remaining <= 0) continue;
+
+    // ---- 1) first-fit into open slots -------------------------------
+    for (int s = 0; s < n_used && remaining > 0; ++s) {
+      bool ok;
+      if (slot_cand[s] >= 0) {
+        int c = slot_cand[s];
+        int d = slot_dom[s];
+        ok = F[(size_t)g * C + c] && avail[(size_t)c * D + d] &&
+             dom_ok[(size_t)g * D + d];
+      } else {
+        ok = s < NE && ex_ok[(size_t)g * NE + s];
+      }
+      if (!ok) continue;
+      float cap = slot_capacity(slot_res + (size_t)s * R, rg, R);
+      if (cap < 1.0f) continue;
+      int take = remaining < (int)cap ? remaining : (int)cap;
+      takes[(size_t)g * NR + s] += take;
+      remaining -= take;
+      float* res = slot_res + (size_t)s * R;
+      for (int r = 0; r < R; ++r) res[r] -= take * rg[r];
+    }
+
+    // ---- 2) new nodes: bulk + re-scored tail -------------------------
+    for (int stage = 0; stage < 2 && remaining > 0; ++stage) {
+      // argmin over (c, d) of price / min(ppn, remaining)
+      float best_score = kBig, best_price = kBig;
+      int best_c = -1, best_d = -1;
+      float best_ppn = 0.0f;
+      for (int c = 0; c < C; ++c) {
+        if (!F[(size_t)g * C + c]) continue;
+        float ppn = slot_capacity(alloc + (size_t)c * R, rg, R);
+        if (ppn < 1.0f) continue;
+        float denom = ppn < (float)remaining ? ppn : (float)remaining;
+        if (denom < 1.0f) denom = 1.0f;
+        for (int d = 0; d < D; ++d) {
+          if (!avail[(size_t)c * D + d] || !dom_ok[(size_t)g * D + d]) continue;
+          float p = price[(size_t)c * D + d];
+          float score = p / denom;
+          if (score < best_score ||
+              (score == best_score && p < best_price)) {
+            best_score = score;
+            best_price = p;
+            best_c = c;
+            best_d = d;
+            best_ppn = ppn;
+          }
+        }
+      }
+      if (best_c < 0) break;  // infeasible remainder
+
+      int per = (int)best_ppn;
+      // bulk stage: full nodes only; tail stage: one final (partial) node
+      int nodes = (stage == 0) ? remaining / per : 1;
+      for (int k = 0; k < nodes && remaining > 0; ++k) {
+        if (n_used >= NR) { rc = -1; goto group_done; }
+        int s = n_used++;
+        slot_cand[s] = best_c;
+        slot_dom[s] = best_d;
+        slot_price[s] = best_price;
+        std::memcpy(slot_res + (size_t)s * R, alloc + (size_t)best_c * R,
+                    sizeof(float) * R);
+        int take = remaining < per ? remaining : per;
+        takes[(size_t)g * NR + s] = take;
+        remaining -= take;
+        float* res = slot_res + (size_t)s * R;
+        for (int r = 0; r < R; ++r) res[r] -= take * rg[r];
+      }
+      // if the tail node couldn't finish (ppn < remaining), loop the tail
+      // stage again by resetting stage counter
+      if (stage == 1 && remaining > 0) stage = 0;
+    }
+  group_done:
+    infeasible[g] = remaining;
+  }
+
+  *n_used_out = n_used;
+  return rc;
+}
+
+const char* kt_version() { return "karpenter-tpu-native 0.1.0"; }
+
+}  // extern "C"

@@ -1,0 +1,127 @@
+"""Native C++ FFD tier: parity with the oracle + routing policy."""
+
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.instancetype import GIB
+from karpenter_tpu.models.pod import LabelSelector, PodSpec, TopologySpreadConstraint
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.requirements import IN, Requirement
+from karpenter_tpu.models.tensorize import tensorize
+from karpenter_tpu.solver import native, reference
+from karpenter_tpu.solver.scheduler import BatchScheduler
+from karpenter_tpu.solver.types import SimNode
+
+
+def default_prov(**kw):
+    return Provisioner(name=kw.pop("name", "default"), **kw).with_defaults()
+
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+
+
+class TestNativeParity:
+    def _check(self, pods, provs, catalog, existing=()):
+        oracle = reference.solve(pods, provs, catalog, existing_nodes=list(existing))
+        st = tensorize(pods, provs, catalog)
+        got = native.solve_tensors_native(st, existing_nodes=list(existing))
+        assert len(got.infeasible) == len(oracle.infeasible)
+        assert got.n_scheduled == oracle.n_scheduled
+        if oracle.new_node_cost:
+            assert got.new_node_cost / oracle.new_node_cost <= 1.02 + 1e-9, (
+                f"native ${got.new_node_cost:.3f} vs oracle ${oracle.new_node_cost:.3f}"
+            )
+        return got
+
+    def test_version(self):
+        assert "karpenter-tpu-native" in native.version()
+
+    def test_single_group(self, small_catalog):
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(50)]
+        got = self._check(pods, [default_prov()], small_catalog)
+        assert got.infeasible == {}
+
+    def test_mixed_groups(self, small_catalog):
+        pods = [PodSpec(name=f"a{i}", requests={"cpu": 1.0}, owner_key="a") for i in range(30)]
+        pods += [PodSpec(name=f"b{i}", requests={"cpu": 0.5, "memory": 6 * GIB}, owner_key="b")
+                 for i in range(30)]
+        pods += [PodSpec(name=f"c{i}", requests={"cpu": 14.0}, owner_key="c") for i in range(2)]
+        self._check(pods, [default_prov()], small_catalog)
+
+    def test_full_catalog(self, full_catalog):
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 2.0, "memory": 4 * GIB})
+                for i in range(100)]
+        self._check(pods, [default_prov()], full_catalog)
+
+    def test_weighted_provisioners(self, small_catalog):
+        spot = Provisioner(
+            name="spot", weight=10,
+            requirements=[Requirement(L.CAPACITY_TYPE, IN, [L.CAPACITY_TYPE_SPOT])],
+        ).with_defaults()
+        od = default_prov(name="od", weight=1)
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(20)]
+        got = self._check(pods, [spot, od], small_catalog)
+        assert all(n.capacity_type == L.CAPACITY_TYPE_SPOT for n in got.nodes)
+
+    def test_existing_nodes_first(self, small_catalog):
+        it = next(t for t in small_catalog if t.name == "m5.4xlarge")
+        existing = [SimNode(
+            instance_type="m5.4xlarge", provisioner="default", zone="zone-1a",
+            capacity_type="on-demand", price=0.768, allocatable=dict(it.allocatable),
+            labels={**it.labels(), L.ZONE: "zone-1a", L.CAPACITY_TYPE: "on-demand",
+                    L.PROVISIONER_NAME: "default"},
+            existing=True,
+        )]
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(5)]
+        got = self._check(pods, [default_prov()], small_catalog, existing=existing)
+        assert got.nodes == []
+
+    def test_infeasible(self, small_catalog):
+        pods = [PodSpec(name="giant", requests={"cpu": 9999.0}),
+                PodSpec(name="ok", requests={"cpu": 1.0})]
+        got = self._check(pods, [default_prov()], small_catalog)
+        assert "giant" in got.infeasible
+
+
+class TestRouting:
+    def test_auto_routes_small_to_native(self, small_catalog):
+        sched = BatchScheduler(backend="auto")
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(10)]
+        st = tensorize(pods, [default_prov()], small_catalog)
+        assert sched._route_native(st, 10)
+
+    def test_auto_routes_topology_to_device(self, small_catalog):
+        sched = BatchScheduler(backend="auto")
+        sel = LabelSelector.of({"app": "x"})
+        pods = [PodSpec(name=f"p{i}", labels={"app": "x"}, requests={"cpu": 1.0},
+                        topology_spread=[TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)])
+                for i in range(10)]
+        st = tensorize(pods, [default_prov()], small_catalog)
+        assert not sched._route_native(st, 10)
+
+    def test_auto_routes_big_to_device(self, small_catalog):
+        sched = BatchScheduler(backend="auto", native_batch_limit=64)
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(100)]
+        st = tensorize(pods, [default_prov()], small_catalog)
+        assert not sched._route_native(st, 100)
+
+    def test_native_backend_end_to_end(self, small_catalog):
+        sched = BatchScheduler(backend="native")
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}, owner_key="d") for i in range(25)]
+        res = sched.solve(pods, [default_prov()], small_catalog)
+        assert res.infeasible == {}
+        assert res.n_scheduled == 25
+
+    def test_native_latency_microseconds(self, small_catalog):
+        """The point of the tier: sub-millisecond small solves (after warmup)."""
+        sched = BatchScheduler(backend="native")
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(10)]
+        sched.solve(pods, [default_prov()], small_catalog)  # warm caches
+        import time
+
+        prov = [default_prov()]
+        t0 = time.perf_counter()
+        res = sched.solve(pods, prov, small_catalog)
+        dt = (time.perf_counter() - t0) * 1000
+        assert res.n_scheduled == 10
+        assert dt < 250  # whole pipeline incl. tensorize; C++ core itself is ~us

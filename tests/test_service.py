@@ -1,0 +1,109 @@
+"""Solver service: codec round-trips + live gRPC server/client."""
+
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import (
+    LabelSelector,
+    PodAffinityTerm,
+    PodSpec,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.requirements import IN, Requirement
+from karpenter_tpu.service import codec
+from karpenter_tpu.service.client import RemoteScheduler, SolverClient
+from karpenter_tpu.service.server import SolverService, make_server
+from karpenter_tpu.solver import reference
+from karpenter_tpu.solver.scheduler import BatchScheduler
+
+
+@pytest.fixture(scope="module")
+def server():
+    service = SolverService(BatchScheduler(backend="oracle"))
+    srv, port = make_server(service, port=0)
+    yield port
+    srv.stop(grace=None)
+
+
+def rich_pod():
+    return PodSpec(
+        name="rich", namespace="ns1", labels={"app": "x"},
+        requests={"cpu": 1.5, "memory": 2.0 * 2**30},
+        node_selector={L.ZONE: "zone-1a"},
+        required_affinity_terms=[[Requirement(L.ARCH, IN, ["amd64"])]],
+        tolerations=[Toleration(key="team", operator="Equal", value="a", effect="NoSchedule")],
+        topology_spread=[TopologySpreadConstraint(
+            1, L.ZONE, "DoNotSchedule", LabelSelector.of({"app": "x"}))],
+        affinity_terms=[PodAffinityTerm(LabelSelector.of({"app": "x"}), L.HOSTNAME, anti=True)],
+        priority=5, deletion_cost=2.5, owner_key="deploy-x",
+    )
+
+
+class TestCodec:
+    def test_pod_roundtrip(self):
+        p = rich_pod()
+        back = codec.decode_pod(codec.encode_pod(p))
+        assert back.name == p.name and back.namespace == "ns1"
+        assert back.requests == p.requests
+        assert back.node_selector == p.node_selector
+        assert back.required_affinity_terms[0][0].key == L.ARCH
+        assert back.tolerations == p.tolerations
+        assert back.topology_spread[0].max_skew == 1
+        assert back.topology_spread[0].hard
+        assert back.affinity_terms[0].anti
+        assert back.priority == 5 and back.deletion_cost == 2.5
+
+    def test_instance_type_roundtrip(self, small_catalog):
+        it = small_catalog[0]
+        back = codec.decode_instance_type(codec.encode_instance_type(it))
+        assert back.name == it.name
+        assert back.capacity == it.capacity
+        assert len(back.offerings) == len(it.offerings)
+        # overhead total must survive (summed form)
+        assert back.allocatable == pytest.approx(it.allocatable)
+
+    def test_provisioner_roundtrip(self):
+        p = Provisioner(
+            name="p", weight=7, consolidation_enabled=True,
+            requirements=[Requirement(L.CAPACITY_TYPE, IN, ["spot"])],
+            taints=[Taint("k", "NoSchedule", "v")],
+            labels={"team": "a"}, limits={"cpu": 100.0},
+        )
+        back = codec.decode_provisioner(codec.encode_provisioner(p))
+        assert back.name == "p" and back.weight == 7 and back.consolidation_enabled
+        assert back.taints == p.taints and back.limits == p.limits
+
+
+class TestGrpc:
+    def test_health(self, server):
+        client = SolverClient(f"127.0.0.1:{server}")
+        h = client.health()
+        assert h.ok and h.devices >= 1
+        client.close()
+
+    def test_remote_solve_matches_local(self, server, small_catalog):
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}, owner_key="d") for i in range(20)]
+        prov = Provisioner(name="default").with_defaults()
+        local = reference.solve(pods, [prov], small_catalog)
+
+        remote = RemoteScheduler(f"127.0.0.1:{server}")
+        result = remote.solve(pods, [prov], small_catalog)
+        assert result.infeasible == {}
+        assert result.n_scheduled == 20
+        assert result.new_node_cost == pytest.approx(local.new_node_cost)
+        # nodes carry the real pod objects back
+        assert all(isinstance(p, PodSpec) and p.requests for n in result.nodes for p in n.pods)
+
+    def test_remote_respects_unavailable(self, server, small_catalog):
+        pods = [PodSpec(name="p", requests={"cpu": 1.0, "memory": 2**30})]
+        prov = Provisioner(name="default").with_defaults()
+        base = reference.solve(pods, [prov], small_catalog)
+        ice = {(base.nodes[0].instance_type, z, "on-demand")
+               for z in ("zone-1a", "zone-1b", "zone-1c")}
+        remote = RemoteScheduler(f"127.0.0.1:{server}")
+        result = remote.solve(pods, [prov], small_catalog, unavailable=ice)
+        assert result.infeasible == {}
+        assert result.nodes[0].instance_type != base.nodes[0].instance_type
