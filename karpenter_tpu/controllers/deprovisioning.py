@@ -38,6 +38,9 @@ from .termination import TerminationController
 
 MIN_NODE_LIFETIME = 5 * 60.0          # designs/consolidation.md:67
 DEFAULT_BATCH_IDLE_AFTER_NO_ACTION = 15.0
+#: above this candidate count, run the one-device-call delete screen
+#: (solver/consolidation.py) before any sequential what-ifs
+SCREEN_THRESHOLD = 32
 
 
 @dataclass
@@ -188,6 +191,23 @@ class DeprovisioningController:
         empties = [ns.node.name for _, ns in cands if not ns.node.pods]
         if empties:
             return Action("delete", "consolidation", empties)
+
+        # 1b) large clusters: screen all single-node deletes in one device
+        #     call, then confirm the cheapest-disruption hits exactly
+        if len(cands) >= SCREEN_THRESHOLD:
+            from ..solver.consolidation import compat_matrix, screen_delete_candidates
+
+            all_nodes = self.state.schedulable_nodes()
+            idx_of = {n.name: i for i, n in enumerate(all_nodes)}
+            screen = screen_delete_candidates(all_nodes, compat_matrix(all_nodes))
+            for _, ns in cands:
+                i = idx_of.get(ns.node.name)
+                if i is None or not screen.deletable[i]:
+                    continue
+                attempt = self._simulate([ns])
+                if attempt is not None and attempt.kind == "delete":
+                    return attempt
+            # fall through: no screened delete confirmed; try replace paths
 
         # 2) multi-node: binary search the largest disruption-cost prefix
         #    that can be deleted together with <=1 replacement

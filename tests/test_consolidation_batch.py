@@ -1,0 +1,137 @@
+"""Batched on-device consolidation screen (BASELINE config #4 shape)."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import PodSpec, Taint
+from karpenter_tpu.solver.consolidation import (
+    compat_matrix,
+    screen_delete_candidates,
+)
+from karpenter_tpu.solver.types import SimNode
+
+
+def mk_node(name, cpu_alloc, pods_cpu, zone="zone-1a", taints=(), labels=None):
+    node = SimNode(
+        instance_type="m5.xlarge", provisioner="default", zone=zone,
+        capacity_type="on-demand", price=0.192,
+        allocatable={L.RESOURCE_CPU: cpu_alloc, L.RESOURCE_MEMORY: 64 * 2**30,
+                     L.RESOURCE_PODS: 50.0},
+        labels=labels or {L.ZONE: zone},
+        taints=list(taints),
+        name=name,
+    )
+    for i, c in enumerate(pods_cpu):
+        node.pods.append(PodSpec(name=f"{name}-p{i}", requests={L.RESOURCE_CPU: c}))
+    return node
+
+
+class TestScreen:
+    def test_obviously_deletable(self):
+        # node b's 1 cpu of pods fits node a's 3 cpu of headroom
+        a = mk_node("a", 4.0, [1.0])
+        b = mk_node("b", 4.0, [1.0])
+        res = screen_delete_candidates([a, b])
+        assert res.deletable.tolist() == [True, True]
+
+    def test_full_cluster_not_deletable(self):
+        a = mk_node("a", 4.0, [2.0, 1.9])
+        b = mk_node("b", 4.0, [2.0, 1.9])
+        res = screen_delete_candidates([a, b])
+        assert res.deletable.tolist() == [False, False]
+
+    def test_empty_node_always_deletable(self):
+        a = mk_node("a", 4.0, [3.9])
+        b = mk_node("b", 2.0, [])  # too small to absorb a's pod
+        res = screen_delete_candidates([a, b])
+        assert res.deletable.tolist() == [False, True]
+
+    def test_compat_matrix_blocks_taints(self):
+        a = mk_node("a", 8.0, [1.0])
+        b = mk_node("b", 8.0, [1.0], taints=[Taint("team", L.EFFECT_NO_SCHEDULE, "x")])
+        # a's pods don't tolerate b's taint: a undeletable (nowhere to go)
+        compat = compat_matrix([a, b])
+        assert not compat[0, 1] and compat[1, 0]
+        res = screen_delete_candidates([a, b], compat)
+        assert res.deletable.tolist() == [False, True]
+
+    def test_zone_selector_respected(self):
+        a = mk_node("a", 8.0, [], zone="zone-1a")
+        b = mk_node("b", 8.0, [], zone="zone-1b")
+        b.pods.append(PodSpec(name="pinned", requests={L.RESOURCE_CPU: 1.0},
+                              node_selector={L.ZONE: "zone-1b"}))
+        compat = compat_matrix([a, b])
+        assert not compat[1, 0]  # pinned pod can't move to zone-1a
+        res = screen_delete_candidates([a, b], compat)
+        assert res.deletable.tolist() == [True, False]
+
+    def test_pmax_overflow_conservative(self):
+        a = mk_node("a", 48.0, [0.1] * 70)  # 70 pods > pmax=64
+        b = mk_node("b", 48.0, [])
+        res = screen_delete_candidates([a, b], pmax=64)
+        assert not res.deletable[0]
+
+    def test_config4_scale_5k_nodes(self):
+        """BASELINE config #4: 5k under-utilized nodes -> screen in one call."""
+        rng = np.random.RandomState(7)
+        nodes = []
+        for i in range(5000):
+            # ~25% utilized nodes: 16-cpu allocatable, ~4 cpu of pods
+            pods = [float(c) for c in rng.choice([0.5, 1.0, 2.0], size=rng.randint(1, 5))]
+            nodes.append(mk_node(f"n{i}", 16.0, pods))
+        res = screen_delete_candidates(nodes, pmax=8)
+        frac = res.deletable.mean()
+        # an under-utilized fleet should be mostly consolidatable
+        assert frac > 0.5
+        assert res.eval_ms < 60_000  # sanity; TPU target is ms-scale
+        print(f"config4: {res.n_candidates} candidates, {frac:.0%} deletable, "
+              f"eval={res.eval_ms:.0f}ms compile={res.compile_ms:.0f}ms")
+
+
+class TestControllerIntegration:
+    def test_screen_path_fires_above_threshold(self, small_catalog):
+        from karpenter_tpu.cloud.fake import FakeCloudProvider
+        from karpenter_tpu.controllers.deprovisioning import (
+            MIN_NODE_LIFETIME,
+            DeprovisioningController,
+        )
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.controllers.state import ClusterState
+        from karpenter_tpu.controllers.termination import TerminationController
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.models.provisioner import Provisioner
+        from karpenter_tpu.models.requirements import IN, Requirement
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        state = ClusterState(clock=clock)
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+        reg = Registry()
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        prov_ctrl = ProvisioningController(state, cloud, scheduler=sched, registry=reg, clock=clock)
+        term = TerminationController(state, cloud, registry=reg, clock=clock)
+        deprov = DeprovisioningController(state, cloud, term, provisioning=prov_ctrl,
+                                          scheduler=sched, registry=reg, clock=clock)
+        state.apply_provisioner(Provisioner(
+            name="default", consolidation_enabled=True,
+            requirements=[Requirement(L.INSTANCE_TYPE, IN, ["c5.2xlarge"])],
+        ))
+        # 40 nodes x 7 pods, then empty most of them out
+        for i in range(280):
+            state.add_pod(PodSpec(name=f"p{i}", requests={"cpu": 1.0}, owner_key="d"))
+        prov_ctrl.reconcile(); clock.advance(1.5); prov_ctrl.reconcile()
+        assert len(state.nodes) >= 32
+        for i in range(270):
+            state.delete_pod(f"p{i}")
+        clock.advance(MIN_NODE_LIFETIME + 1)
+        action = deprov.reconcile()
+        assert action is not None
+        # loop to steady state
+        for _ in range(60):
+            prov_ctrl.reconcile(); clock.advance(2.0); prov_ctrl.reconcile()
+            if deprov.reconcile() is None and not state.pending_pods():
+                break
+        assert len(state.nodes) < 10
+        assert not state.pending_pods()
