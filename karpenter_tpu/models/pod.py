@@ -154,7 +154,18 @@ class PodSpec:
     # ---- dedup key ----------------------------------------------------
     def group_key(self) -> tuple:
         """Pods with equal keys are interchangeable to the solver (same
-        constraints + requests), enabling the group-dedup scan in solver/tpu.py."""
+        constraints + requests), enabling the group-dedup scan in solver/tpu.py.
+
+        Cached: the scheduling-relevant fields are treated as immutable after
+        construction (replace the pod object to change them)."""
+        cached = self.__dict__.get("_group_key")
+        if cached is not None:
+            return cached
+        key = self._compute_group_key()
+        self.__dict__["_group_key"] = key
+        return key
+
+    def _compute_group_key(self) -> tuple:
         return (
             self.namespace,
             tuple(sorted(self.labels.items())),

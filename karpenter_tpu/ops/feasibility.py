@@ -1,0 +1,72 @@
+"""Feasibility as MXU math.
+
+Two device formulations of "group g's requirement mask admits candidate c":
+
+1. **Gather path** (solver/tpu.py compute_feasibility): per-key packed-word
+   gathers.  Fine for small G; intermediates are [chunk, C, K].
+2. **Matmul path** (here): expand the packed masks to 0/1 bits over the value
+   vocabulary and contract in ONE bf16 matmul:
+
+       count[g, c] = pm_bits[g, (k,v)] @ sel[(k,v), c]
+       F[g, c]     = (count[g, c] == n_checked_keys)
+
+   where ``sel[(k,v), c] = 1`` iff candidate c carries value v for key k (or
+   k is unchecked — contributing exactly 1 per key either way).  Bit counts
+   are small integers, exact in bf16-with-f32-accumulation, so this is not an
+   approximation.  A 10k-group x 2k-candidate problem is a
+   [10k, K*V] x [K*V, 2k] matmul — exactly what the MXU is for.
+
+The scheduler uses this path when G is large (heterogeneous pods, BASELINE
+config #3 shape); both paths are tested equal.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def expand_pm_bits(pm: np.ndarray, key_check: np.ndarray) -> np.ndarray:
+    """[G, K, W] packed uint32 -> [G, K*32W] float bits (checked keys only;
+    unchecked keys emit a constant 1 so the count target stays K)."""
+    G, K, W = pm.shape
+    # little-endian bit expansion per word
+    shifts = np.arange(32, dtype=np.uint32)
+    bits = ((pm[..., :, None] >> shifts[None, None, None, :]) & 1).astype(np.float32)
+    bits = bits.reshape(G, K, W * 32)
+    bits[:, ~key_check, :] = 0.0
+    bits[:, ~key_check, 0] = 1.0  # unchecked key: always contributes 1
+    return bits.reshape(G, K * W * 32)
+
+
+def candidate_selector(
+    cand_vw: np.ndarray, cand_vb: np.ndarray, key_check: np.ndarray, W: int
+) -> np.ndarray:
+    """[C, K] value coords -> [K*32W, C] one-hot selector."""
+    C, K = cand_vw.shape
+    V = W * 32
+    sel = np.zeros((K, V, C), dtype=np.float32)
+    vid = cand_vw * 32 + cand_vb  # [C, K]
+    for k in range(K):
+        if key_check[k]:
+            sel[k, vid[:, k], np.arange(C)] = 1.0
+        else:
+            sel[k, 0, :] = 1.0  # pair with the constant-1 bit
+    return sel.reshape(K * V, C)
+
+
+def feasibility_matmul(
+    pm_bits: jnp.ndarray,     # [G, K*V] float32 (or bf16)
+    sel: jnp.ndarray,         # [K*V, C]
+    n_keys: int,
+) -> jnp.ndarray:
+    """F[G, C] via one MXU contraction."""
+    count = jax.lax.dot_general(
+        pm_bits.astype(jnp.bfloat16), sel.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return count >= jnp.float32(n_keys) - 0.5

@@ -27,6 +27,23 @@ from .types import SimNode, SolveResult
 NATIVE_BATCH_LIMIT = 256
 
 
+def _harden_preferences(pod: PodSpec) -> PodSpec:
+    """Fold preferred affinity terms into the required set (attempt 1 of the
+    relaxation ladder).  Returns the pod unchanged when it has none."""
+    if not pod.preferred_affinity_terms:
+        return pod
+    import copy
+
+    out = copy.copy(pod)
+    out.required_affinity_terms = [
+        list(term) + [r for pt in pod.preferred_affinity_terms for r in pt]
+        for term in (pod.required_affinity_terms or [[]])
+    ]
+    out.preferred_affinity_terms = []
+    out.__dict__.pop("_group_key", None)  # hardened copy needs its own key
+    return out
+
+
 class BatchScheduler:
     def __init__(
         self,
@@ -54,21 +71,53 @@ class BatchScheduler:
         allow_new_nodes: bool = True,
         max_new_nodes: Optional[int] = None,
     ) -> SolveResult:
+        """Solve with preference relaxation: pods carrying preferred affinity
+        terms are first solved with those preferences hardened; any that come
+        back infeasible retry relaxed (the reference's scheduler relaxes
+        preferences one failure at a time — scheduling.md:205-233)."""
         t0 = time.perf_counter()
         try:
-            if self.backend == "oracle":
-                return oracle_solve(
-                    pods, provisioners, instance_types,
-                    existing_nodes=existing_nodes, daemonsets=daemonsets,
-                    unavailable=unavailable, allow_new_nodes=allow_new_nodes,
-                    max_new_nodes=max_new_nodes,
-                )
-            return self._solve_tpu(
-                pods, provisioners, instance_types, existing_nodes, daemonsets,
-                unavailable, allow_new_nodes, max_new_nodes,
+            hardened = [_harden_preferences(p) for p in pods]
+            result = self._solve_once(
+                hardened, provisioners, instance_types, existing_nodes,
+                daemonsets, unavailable, allow_new_nodes, max_new_nodes,
             )
+            retry = [p for p in pods if p.name in result.infeasible
+                     and p.preferred_affinity_terms]
+            if retry:
+                relaxed = self._solve_once(
+                    retry, provisioners, instance_types,
+                    list(existing_nodes) + result.nodes, daemonsets,
+                    unavailable, allow_new_nodes,
+                    None if max_new_nodes is None
+                    else max(0, max_new_nodes - len(result.nodes)),
+                )
+                for name in list(result.infeasible):
+                    if name in relaxed.assignments:
+                        del result.infeasible[name]
+                result.infeasible.update(relaxed.infeasible)
+                result.assignments.update(relaxed.assignments)
+                result.nodes.extend(relaxed.nodes)
+                result.solve_ms += relaxed.solve_ms
+            return result
         finally:
             self.registry.histogram(SCHEDULING_DURATION).observe(time.perf_counter() - t0)
+
+    def _solve_once(
+        self, pods, provisioners, instance_types, existing_nodes, daemonsets,
+        unavailable, allow_new_nodes, max_new_nodes,
+    ) -> SolveResult:
+        if self.backend == "oracle":
+            return oracle_solve(
+                pods, provisioners, instance_types,
+                existing_nodes=existing_nodes, daemonsets=daemonsets,
+                unavailable=unavailable, allow_new_nodes=allow_new_nodes,
+                max_new_nodes=max_new_nodes,
+            )
+        return self._solve_tpu(
+            pods, provisioners, instance_types, existing_nodes, daemonsets,
+            unavailable, allow_new_nodes, max_new_nodes,
+        )
 
     def _route_native(self, st, n_pods: int) -> bool:
         """auto-policy: native C++ tier for small unconstrained batches

@@ -329,6 +329,15 @@ def _make_step(
     return step
 
 
+@partial(jax.jit, static_argnames=("NR", "Z", "track"))
+def _run_scan(consts, init, NR: int, Z: int, track: bool):
+    """Module-level jitted scan: the jit cache persists across solves, so
+    bucketed shapes recompile once per signature, not once per call."""
+    step = _make_step(consts, NR, Z, track)
+    G = consts["counts"].shape[0]
+    return jax.lax.scan(step, init, jnp.arange(G, dtype=jnp.int32))
+
+
 # ---------------------------------------------------------------------------
 # host-facing API
 # ---------------------------------------------------------------------------
@@ -370,16 +379,26 @@ class TpuSolver:
             max_nodes = NE + total_pods  # worst case: one pod per node
         NR = max(1, max_nodes)
 
-        # ---- mesh padding: shard axes must divide evenly ----------------
-        pad_g = pad_c = 0
+        # ---- shape bucketing + mesh padding ------------------------------
+        # The scan compiles per (G, C, NR, ...) signature; bucketing the axes
+        # makes repeated controller solves hit the persistent jit cache
+        # instead of paying a fresh XLA compile per batch shape.
+        a = b = 1
         if mesh is not None:
             from ..parallel.mesh import POD_AXIS, TYPE_AXIS
 
             a = mesh.shape[POD_AXIS]
             b = mesh.shape[TYPE_AXIS]
-            pad_g = (-G) % a
-            pad_c = (-C) % b
-            NR = NR + ((-NR) % a)
+
+        def _bucket(n: int, quantum: int, axis_div: int) -> int:
+            q = max(quantum, axis_div)
+            q = ((q + axis_div - 1) // axis_div) * axis_div
+            out = ((n + q - 1) // q) * q
+            return max(out, axis_div)
+
+        pad_g = _bucket(G, 16, a) - G
+        pad_c = _bucket(C, 64, b) - C
+        NR = _bucket(NR, 512, a)
 
         def _pad(arr, n, axis, value):
             if n == 0:
@@ -408,7 +427,7 @@ class TpuSolver:
         G = G + pad_g
 
         # ---- existing-node tensors (host-side compat precompute) -------
-        NE_pad = max(1, NE)
+        NE_pad = ((max(1, NE) + 15) // 16) * 16  # bucketed: stable jit shapes
         ex_res = np.zeros((NR, R), dtype=np.float32)
         ex_zone = np.zeros(NR, dtype=np.int32)
         ex_sel = np.zeros((NR, S), dtype=np.int32)
@@ -487,8 +506,6 @@ class TpuSolver:
         )
         consts["F"], consts["dom_ok"] = F, dom_ok
 
-        step = _make_step(consts, NR, Z, track_assignments)
-
         init = (
             jnp.asarray(ex_res),                                 # res
             jnp.asarray(ex_zone),                                # row_zone
@@ -512,9 +529,8 @@ class TpuSolver:
             shardings = (sn, sn, sn, sn, sn, sn, sn, sr, sr, sr, sr, sr)
             init = tuple(jax.device_put(a, s) for a, s in zip(init, shardings))
 
-        @jax.jit
         def run(init):
-            return jax.lax.scan(step, init, jnp.arange(G, dtype=jnp.int32))
+            return _run_scan(consts, init, NR, Z, track_assignments)
 
         return run, init, NE
 

@@ -209,3 +209,42 @@ class TestScaleParity:
         pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0 + (i % 3) * 0.5, "memory": 2 * GIB},
                         owner_key=f"d{i % 3}") for i in range(300)]
         assert_parity(pods, provs, small_catalog)
+
+
+class TestPreferenceRelaxation:
+    def test_preferred_zone_honored_when_feasible(self, small_catalog):
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        pods = [PodSpec(
+            name=f"p{i}", requests={"cpu": 1.0},
+            preferred_affinity_terms=[[Requirement(L.ZONE, IN, ["zone-1b"])]],
+        ) for i in range(5)]
+        sched = BatchScheduler(backend="oracle")
+        res = sched.solve(pods, [default_prov()], small_catalog)
+        assert res.infeasible == {}
+        assert all(n.zone == "zone-1b" for n in res.nodes)
+
+    def test_infeasible_preference_relaxed(self, small_catalog):
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        # preference for a zone that doesn't exist: hardened solve fails,
+        # relaxation retries without it and succeeds
+        pods = [PodSpec(
+            name="p", requests={"cpu": 1.0},
+            preferred_affinity_terms=[[Requirement(L.ZONE, IN, ["mars-1a"])]],
+        )]
+        sched = BatchScheduler(backend="oracle")
+        res = sched.solve(pods, [default_prov()], small_catalog)
+        assert res.infeasible == {}
+        assert res.n_scheduled == 1
+
+    def test_hard_requirement_never_relaxed(self, small_catalog):
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        pods = [PodSpec(
+            name="p", requests={"cpu": 1.0},
+            node_selector={L.ZONE: "mars-1a"},  # hard: stays infeasible
+        )]
+        sched = BatchScheduler(backend="oracle")
+        res = sched.solve(pods, [default_prov()], small_catalog)
+        assert "p" in res.infeasible
