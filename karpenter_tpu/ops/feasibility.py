@@ -1,4 +1,4 @@
-"""Feasibility as MXU math.
+"""Label feasibility as MXU math.
 
 Two device formulations of "group g's requirement mask admits candidate c":
 
@@ -8,65 +8,72 @@ Two device formulations of "group g's requirement mask admits candidate c":
    vocabulary and contract in ONE bf16 matmul:
 
        count[g, c] = pm_bits[g, (k,v)] @ sel[(k,v), c]
-       F[g, c]     = (count[g, c] == n_checked_keys)
+       F[g, c]     = (count[g, c] == K)        # K = TOTAL key count
 
-   where ``sel[(k,v), c] = 1`` iff candidate c carries value v for key k (or
-   k is unchecked — contributing exactly 1 per key either way).  Bit counts
-   are small integers, exact in bf16-with-f32-accumulation, so this is not an
+   where ``sel[(k,v), c] = 1`` iff candidate c carries value v for key k, and
+   every *unchecked* key (zone/capacity-type, handled on the domain axis)
+   contributes exactly 1 on both sides via a constant bit at v=0 — so the
+   count target is the total K, not the checked-key count.  Bit counts are
+   small integers, exact in bf16-with-f32-accumulation, so this is not an
    approximation.  A 10k-group x 2k-candidate problem is a
    [10k, K*V] x [K*V, 2k] matmul — exactly what the MXU is for.
 
-The scheduler uses this path when G is large (heterogeneous pods, BASELINE
-config #3 shape); both paths are tested equal.
+solver/tpu.py routes here when G >= MATMUL_MIN_G (heterogeneous pods,
+BASELINE config #3 shape); tests/test_tpu_solver.py gates both paths equal.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 
+#: group count at which compute_feasibility switches from the chunked gather
+#: path to the matmul path
+MATMUL_MIN_G = 1024
 
-def expand_pm_bits(pm: np.ndarray, key_check: np.ndarray) -> np.ndarray:
-    """[G, K, W] packed uint32 -> [G, K*32W] float bits (checked keys only;
-    unchecked keys emit a constant 1 so the count target stays K)."""
-    G, K, W = pm.shape
-    # little-endian bit expansion per word
-    shifts = np.arange(32, dtype=np.uint32)
-    bits = ((pm[..., :, None] >> shifts[None, None, None, :]) & 1).astype(np.float32)
-    bits = bits.reshape(G, K, W * 32)
-    bits[:, ~key_check, :] = 0.0
-    bits[:, ~key_check, 0] = 1.0  # unchecked key: always contributes 1
-    return bits.reshape(G, K * W * 32)
+#: per-matmul group chunk bounding the [chunk, K*V] bit expansion
+_CHUNK_G = 8192
 
 
 def candidate_selector(
-    cand_vw: np.ndarray, cand_vb: np.ndarray, key_check: np.ndarray, W: int
-) -> np.ndarray:
-    """[C, K] value coords -> [K*32W, C] one-hot selector."""
-    C, K = cand_vw.shape
-    V = W * 32
-    sel = np.zeros((K, V, C), dtype=np.float32)
-    vid = cand_vw * 32 + cand_vb  # [C, K]
-    for k in range(K):
-        if key_check[k]:
-            sel[k, vid[:, k], np.arange(C)] = 1.0
-        else:
-            sel[k, 0, :] = 1.0  # pair with the constant-1 bit
-    return sel.reshape(K * V, C)
-
-
-def feasibility_matmul(
-    pm_bits: jnp.ndarray,     # [G, K*V] float32 (or bf16)
-    sel: jnp.ndarray,         # [K*V, C]
-    n_keys: int,
+    cand_vw: jnp.ndarray,   # [C, K] value-id // 32
+    cand_vb: jnp.ndarray,   # [C, K] value-id % 32
+    key_check: jnp.ndarray, # [K] bool
+    W: int,
 ) -> jnp.ndarray:
-    """F[G, C] via one MXU contraction."""
-    count = jax.lax.dot_general(
-        pm_bits.astype(jnp.bfloat16), sel.astype(jnp.bfloat16),
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    return count >= jnp.float32(n_keys) - 0.5
+    """[K*32W, C] one-hot selector of each candidate's value per key.
+
+    Unchecked keys select the constant-1 bit at v=0."""
+    V = W * 32
+    vid = cand_vw * 32 + cand_vb                       # [C, K]
+    vid_eff = jnp.where(key_check[None, :], vid, 0)
+    oh = jax.nn.one_hot(vid_eff.T, V, dtype=jnp.bfloat16)   # [K, C, V]
+    return jnp.transpose(oh, (0, 2, 1)).reshape(-1, cand_vw.shape[0])
+
+
+def label_feasibility_matmul(
+    pm: jnp.ndarray,        # [G, K, W] uint32 packed requirement masks
+    sel: jnp.ndarray,       # [K*32W, C] from candidate_selector
+    key_check: jnp.ndarray, # [K] bool
+) -> jnp.ndarray:
+    """F_label[G, C]: group g admits candidate c on every checked key."""
+    G, K, W = pm.shape
+    V = W * 32
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+
+    def chunk(pm_c):
+        bits = ((pm_c[..., None] >> shifts) & jnp.uint32(1)).astype(jnp.bfloat16)
+        bits = bits.reshape(pm_c.shape[0], K, V)
+        # unchecked key: zero its vocabulary bits, then emit the constant 1
+        bits = jnp.where(key_check[None, :, None], bits, jnp.bfloat16(0))
+        const1 = jnp.where(key_check, bits[:, :, 0], jnp.bfloat16(1))
+        bits = bits.at[:, :, 0].set(const1)
+        count = jax.lax.dot_general(
+            bits.reshape(pm_c.shape[0], K * V), sel,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return count >= jnp.float32(K) - 0.5
+
+    outs = [chunk(pm[i : i + _CHUNK_G]) for i in range(0, G, _CHUNK_G)]
+    return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]

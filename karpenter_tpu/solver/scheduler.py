@@ -9,6 +9,7 @@ node set, so one SolveResult comes back either way.
 
 from __future__ import annotations
 
+import copy
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -27,16 +28,15 @@ from .types import SimNode, SolveResult
 NATIVE_BATCH_LIMIT = 256
 
 
-def _harden_preferences(pod: PodSpec) -> PodSpec:
-    """Fold preferred affinity terms into the required set (attempt 1 of the
-    relaxation ladder).  Returns the pod unchanged when it has none."""
-    if not pod.preferred_affinity_terms:
+def _harden_preferences(pod: PodSpec, keep: Optional[int] = None) -> PodSpec:
+    """Fold the first ``keep`` preferred affinity terms (all when None) into
+    the required set.  Returns the pod unchanged when none are kept."""
+    kept = pod.preferred_affinity_terms[: len(pod.preferred_affinity_terms) if keep is None else keep]
+    if not kept:
         return pod
-    import copy
-
     out = copy.copy(pod)
     out.required_affinity_terms = [
-        list(term) + [r for pt in pod.preferred_affinity_terms for r in pt]
+        list(term) + [r for pt in kept for r in pt]
         for term in (pod.required_affinity_terms or [[]])
     ]
     out.preferred_affinity_terms = []
@@ -72,9 +72,10 @@ class BatchScheduler:
         max_new_nodes: Optional[int] = None,
     ) -> SolveResult:
         """Solve with preference relaxation: pods carrying preferred affinity
-        terms are first solved with those preferences hardened; any that come
-        back infeasible retry relaxed (the reference's scheduler relaxes
-        preferences one failure at a time — scheduling.md:205-233)."""
+        terms are first solved with all preferences hardened; any that come
+        back infeasible retry dropping one preferred term at a time, last
+        first (the reference's scheduler relaxes preferences one failure at a
+        time — scheduling.md:205-233)."""
         t0 = time.perf_counter()
         try:
             hardened = [_harden_preferences(p) for p in pods]
@@ -82,23 +83,53 @@ class BatchScheduler:
                 hardened, provisioners, instance_types, existing_nodes,
                 daemonsets, unavailable, allow_new_nodes, max_new_nodes,
             )
-            retry = [p for p in pods if p.name in result.infeasible
-                     and p.preferred_affinity_terms]
-            if retry:
-                relaxed = self._solve_once(
-                    retry, provisioners, instance_types,
-                    list(existing_nodes) + result.nodes, daemonsets,
-                    unavailable, allow_new_nodes,
-                    None if max_new_nodes is None
-                    else max(0, max_new_nodes - len(result.nodes)),
-                )
+            def merge_retry(retry_result):
                 for name in list(result.infeasible):
-                    if name in relaxed.assignments:
+                    if name in retry_result.assignments:
                         del result.infeasible[name]
-                result.infeasible.update(relaxed.infeasible)
-                result.assignments.update(relaxed.assignments)
-                result.nodes.extend(relaxed.nodes)
-                result.solve_ms += relaxed.solve_ms
+                result.infeasible.update(retry_result.infeasible)
+                result.assignments.update(retry_result.assignments)
+                result.nodes.extend(retry_result.nodes)
+                result.solve_ms += retry_result.solve_ms
+
+            def budget_left():
+                return (None if max_new_nodes is None
+                        else max(0, max_new_nodes - len(result.nodes)))
+
+            max_pref = max((len(p.preferred_affinity_terms) for p in pods), default=0)
+            for keep in range(max_pref - 1, -1, -1):
+                retry = [p for p in pods if p.name in result.infeasible
+                         and len(p.preferred_affinity_terms) > keep]
+                if not retry:
+                    continue
+                merge_retry(self._solve_once(
+                    [_harden_preferences(p, keep) for p in retry],
+                    provisioners, instance_types,
+                    list(existing_nodes) + result.nodes, daemonsets,
+                    unavailable, allow_new_nodes, budget_left(),
+                ))
+
+            # OR'd required-affinity terms beyond the first: the solvers pack
+            # under term[0] only (tensorize.group_pods), so still-infeasible
+            # pods retry under each alternate term in order — the term list is
+            # a disjunction (scheduling.md nodeSelectorTerms semantics).
+            max_terms = max((len(p.required_affinity_terms) for p in pods), default=0)
+            for k in range(1, max_terms):
+                alts = []
+                for p in pods:
+                    if p.name in result.infeasible and len(p.required_affinity_terms) > k:
+                        q = copy.copy(p)
+                        q.required_affinity_terms = [p.required_affinity_terms[k]]
+                        q.preferred_affinity_terms = []
+                        q.__dict__.pop("_group_key", None)
+                        alts.append(q)
+                if not alts:
+                    break
+                merge_retry(self._solve_once(
+                    alts, provisioners, instance_types,
+                    list(existing_nodes) + result.nodes, daemonsets,
+                    unavailable, allow_new_nodes, budget_left(),
+                ))
             return result
         finally:
             self.registry.histogram(SCHEDULING_DURATION).observe(time.perf_counter() - t0)

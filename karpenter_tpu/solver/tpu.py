@@ -29,8 +29,10 @@ within the 1.02x cost-parity budget and flagged for later rounds:
   them; callers route those pods to the oracle),
 - maxSkew > 1 spread is balanced (water-filled) instead of first-fit-within-
   band,
-- when a provisioner limit binds mid-group the remainder is marked
-  infeasible instead of falling back to the next-best candidate.
+- provisioner-limit fallback depth is bounded: 2 (bulk, tail) creation rounds
+  per zone pass = 4 candidate picks, so a group whose pods would have to
+  cascade through >3 limit-capped provisioners leaves the residue infeasible
+  where the oracle's unbounded invalidate-and-retry would keep going.
 """
 
 from __future__ import annotations
@@ -72,22 +74,34 @@ def compute_feasibility(
     ct_key: int,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (F[G, C] candidate feasibility, dom_ok[G, D] zone&ct allowed)."""
+    from ..ops.feasibility import MATMUL_MIN_G, candidate_selector, label_feasibility_matmul
 
-    def one_group(args):
-        pm_g, req_g = args
-        bits = gather_pm_bits(pm_g, cand_vw, cand_vb)      # [C, K]
-        lab = jnp.all(bits | ~key_check[None, :], axis=1)  # [C]
-        fit = jnp.all(
+    G = pm.shape[0]
+
+    def fit_group(req_g):
+        return jnp.all(
             (req_g[None, :] <= cand_alloc + 1e-6) | (req_g[None, :] <= 0), axis=1
         )
-        return lab & fit
 
-    # chunked vmap bounds the materialized [chunk, C, K] gather intermediate
-    G = pm.shape[0]
-    outs = []
-    for i in range(0, G, 512):
-        outs.append(jax.vmap(one_group)((pm[i : i + 512], requests[i : i + 512])))
-    F = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+    if G >= MATMUL_MIN_G:
+        # heterogeneous-pod shapes: one bf16 MXU contraction over the value
+        # vocabulary replaces G x C x K gathers (ops/feasibility.py)
+        sel = candidate_selector(cand_vw, cand_vb, key_check, pm.shape[2])
+        lab = label_feasibility_matmul(pm, sel, key_check)
+        fit = jax.vmap(fit_group)(requests)
+        F = lab & fit
+    else:
+        def one_group(args):
+            pm_g, req_g = args
+            bits = gather_pm_bits(pm_g, cand_vw, cand_vb)      # [C, K]
+            lab = jnp.all(bits | ~key_check[None, :], axis=1)  # [C]
+            return lab & fit_group(req_g)
+
+        # chunked vmap bounds the materialized [chunk, C, K] gather intermediate
+        outs = []
+        for i in range(0, G, 512):
+            outs.append(jax.vmap(one_group)((pm[i : i + 512], requests[i : i + 512])))
+        F = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
     F = F & gp_ok[jnp.arange(G)[:, None], cand_prov[None, :]]
 
     # domain allowance from the zone / capacity-type keys of each group's mask
@@ -132,6 +146,9 @@ def _make_step(
     prov_limits = consts["prov_limits"]  # [P, R]
     dom_zone = consts["dom_zone"]      # [D]
     ex_ok = consts["ex_ok"]            # [G, NE_pad] existing-node label/taint compat
+    node_budget = consts["node_budget"]  # [] int32 — semantic max_nodes cap
+    # NR is bucketed up for jit-shape stability; node_budget carries the
+    # caller's real max_nodes so the budget survives the padding.
 
     C, D = cand_price.shape
     NE_pad = ex_ok.shape[1]
@@ -234,13 +251,23 @@ def _make_step(
         # smaller remainder (matching the per-pod re-scoring sequence).
         ci_key = jnp.broadcast_to(jnp.arange(C, dtype=jnp.float32)[:, None], (C, D))
         di_key = jnp.broadcast_to(jnp.arange(D, dtype=jnp.float32)[None, :], (C, D))
-        price_key = jnp.where(new_ok, cand_price, BIG)
+        new_ok_nolim = Fd_g & (ppn[:, None] >= 1.0)
 
-        def pick(rem, dom_mask):
-            """argmin over (C, D & dom_mask) of price/min(ppn, rem)."""
+        def pick(rem, dom_mask, prov_used_cur):
+            """argmin over (C, D & dom_mask) of price/min(ppn, rem).
+
+            Limit feasibility is recomputed from the *current* provisioner
+            usage so once a limit binds mid-group the next pick falls back to
+            the next-best candidate (mirroring the oracle's invalidate-and-
+            retry at reference.py _create_node)."""
+            lim_ok_cur = jnp.all(
+                prov_used_cur[cand_prov] + cand_cap <= prov_limits[cand_prov] + 1e-6,
+                axis=1,
+            )
+            ok_cd = new_ok_nolim & lim_ok_cur[:, None] & dom_mask[None, :]
             denom = jnp.maximum(jnp.minimum(ppn, jnp.maximum(rem, 1.0)), 1.0)
-            score = jnp.where(new_ok & dom_mask[None, :], cand_price / denom[:, None], BIG)
-            pk = jnp.where(new_ok & dom_mask[None, :], cand_price, BIG)
+            score = jnp.where(ok_cd, cand_price / denom[:, None], BIG)
+            pk = jnp.where(ok_cd, cand_price, BIG)
             flat = lex_argmin(score, pk, ci_key, di_key)
             bc = (flat // D).astype(jnp.int32)
             bd = (flat % D).astype(jnp.int32)
@@ -252,10 +279,11 @@ def _make_step(
 
         def write_block(state, n_nodes, per_node, last_extra, bc, bd):
             """Append n_nodes slots of candidate bc/domain bd; each takes
-            per_node pods except the last which takes last_extra."""
+            per_node pods except the last which takes last_extra.  Returns
+            (state, pods actually placed)."""
             (res, row_zone, row_dom, row_cand, row_price, active, prov_used,
              new_take, cursor) = state
-            n_nodes = jnp.minimum(n_nodes, NR - cursor)  # slot budget
+            n_nodes = jnp.minimum(n_nodes, jnp.minimum(NR, node_budget) - cursor)
             in_block = (slot_idx >= cursor) & (slot_idx < cursor + n_nodes)
             is_last = slot_idx == (cursor + n_nodes - 1)
             blk = jnp.where(in_block, jnp.where(is_last, last_extra, per_node), 0.0)
@@ -269,8 +297,9 @@ def _make_step(
             prov_used = prov_used.at[cand_prov[bc]].add(
                 cand_cap[bc] * n_nodes.astype(jnp.float32)
             )
-            return (res, row_zone, row_dom, row_cand, row_price, active,
-                    prov_used, new_take, cursor + n_nodes)
+            state = (res, row_zone, row_dom, row_cand, row_price, active,
+                     prov_used, new_take, cursor + n_nodes)
+            return state, jnp.sum(blk)
 
         def limit_headroom(prov_used_cur, bc):
             """Max nodes of candidate bc before its provisioner limit binds."""
@@ -280,19 +309,30 @@ def _make_step(
             per = jnp.where(cap_row > 0, jnp.floor((head + 1e-6) / jnp.maximum(cap_row, 1e-9)), BIGN)
             return jnp.clip(jnp.min(per), 0.0, BIGN)
 
-        def two_stage(state, rem, dom_mask):
-            bc, bd, ok = pick(rem, dom_mask)
+        def stage_pair(state, rem, dom_mask):
+            """One (bulk, tail) creation round; returns leftover pods."""
+            bc, bd, ok = pick(rem, dom_mask, state[6])
             ppn_b = jnp.maximum(ppn[bc], 1.0)
             n_bulk_f = jnp.where(ok, jnp.floor(rem / ppn_b), 0.0)
             n_bulk = jnp.minimum(n_bulk_f, limit_headroom(state[6], bc)).astype(jnp.int32)
-            state = write_block(state, n_bulk, ppn_b, ppn_b, bc, bd)
-            rem_t = jnp.maximum(rem - n_bulk.astype(jnp.float32) * ppn_b, 0.0)
-            ct_, dt_, ok_t = pick(rem_t, dom_mask)
+            state, took_b = write_block(state, n_bulk, ppn_b, ppn_b, bc, bd)
+            rem_t = jnp.maximum(rem - took_b, 0.0)
+            ct_, dt_, ok_t = pick(rem_t, dom_mask, state[6])
             ppn_t = jnp.maximum(ppn[ct_], 1.0)
             n_tail_f = jnp.where(ok_t & (rem_t > 0), jnp.ceil(rem_t / ppn_t), 0.0)
             n_tail = jnp.minimum(n_tail_f, limit_headroom(state[6], ct_)).astype(jnp.int32)
             last = rem_t - (n_tail.astype(jnp.float32) - 1.0) * ppn_t
-            state = write_block(state, n_tail, ppn_t, jnp.clip(last, 0.0, ppn_t), ct_, dt_)
+            state, took_t = write_block(
+                state, n_tail, ppn_t, jnp.clip(last, 0.0, ppn_t), ct_, dt_
+            )
+            return state, jnp.maximum(rem_t - took_t, 0.0)
+
+        def two_stage(state, rem, dom_mask):
+            # round 2 only fires when a provisioner limit (or slot budget)
+            # clamped round 1; pick() re-derives limit feasibility, so the
+            # remainder falls back to the next-best candidate type.
+            state, rem = stage_pair(state, rem, dom_mask)
+            state, _ = stage_pair(state, rem, dom_mask)
             return state
 
         def create_simple(state):
@@ -377,7 +417,8 @@ class TpuSolver:
         total_pods = int(st.counts.sum())
         if max_nodes is None:
             max_nodes = NE + total_pods  # worst case: one pod per node
-        NR = max(1, max_nodes)
+        node_budget = max(1, max_nodes)
+        NR = node_budget
 
         # ---- shape bucketing + mesh padding ------------------------------
         # The scan compiles per (G, C, NR, ...) signature; bucketing the axes
@@ -477,6 +518,7 @@ class TpuSolver:
             prov_limits=jnp.asarray(np.where(np.isinf(st.prov_limits), np.float32(3.0e38), st.prov_limits)),
             dom_zone=jnp.asarray(st.dom_zone),
             ex_ok=jnp.asarray(ex_ok),
+            node_budget=jnp.int32(node_budget),
         )
 
         zone_key = st.vocab.key_id[L.ZONE]

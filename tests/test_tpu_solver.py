@@ -155,6 +155,76 @@ class TestParityConstraints:
         assert total_cap <= 8.0
         assert len(tpu.infeasible) > 0
 
+    def test_limit_fallback_to_next_provisioner(self, small_catalog):
+        """When the preferred provisioner's limit binds mid-group, the
+        remainder must fall back to the next provisioner, not go infeasible."""
+        capped = Provisioner(name="capped", weight=10, limits={"cpu": 8.0}).with_defaults()
+        fallback = Provisioner(name="fallback", weight=5).with_defaults()
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 3.0}) for i in range(10)]
+        oracle = reference.solve(pods, [capped, fallback], small_catalog)
+        st = tensorize(pods, [capped, fallback], small_catalog)
+        tpu = solve_tensors(st).result
+        assert len(oracle.infeasible) == 0
+        assert len(tpu.infeasible) == 0
+        assert tpu.n_scheduled == 10
+        # capped provisioner must not exceed its limit
+        capped_cap = sum(
+            next(t for t in small_catalog if t.name == n.instance_type).capacity["cpu"]
+            for n in tpu.nodes if n.provisioner == "capped"
+        )
+        assert capped_cap <= 8.0
+        assert tpu.new_node_cost / oracle.new_node_cost <= PARITY + 1e-9
+
+
+class TestFeasibilityPaths:
+    def test_matmul_equals_gather(self, small_catalog):
+        """The MXU matmul label-feasibility path must bit-match the gather
+        path (solver/tpu.py routes to matmul when G >= MATMUL_MIN_G)."""
+        import jax
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.feasibility import (
+            candidate_selector,
+            label_feasibility_matmul,
+        )
+        from karpenter_tpu.ops.masks import gather_pm_bits
+
+        pods = []
+        for i in range(40):
+            kw = {}
+            if i % 3 == 0:
+                kw["node_selector"] = {L.ZONE: f"zone-1{'abc'[i % 3]}"}
+            if i % 4 == 0:
+                kw["node_selector"] = {L.ARCH: "amd64", "team": f"t{i % 5}"}
+            pods.append(PodSpec(name=f"p{i}", requests={"cpu": 0.5 + (i % 4)}, **kw))
+        provs = [default_prov(), Provisioner(name="gpu", labels={"team": "t0"}).with_defaults()]
+        st = tensorize(pods, provs, small_catalog)
+
+        pm = jnp.asarray(st.pm)
+        cvw, cvb = jnp.asarray(st.cand_vw), jnp.asarray(st.cand_vb)
+        kc = jnp.asarray(st.key_check)
+
+        def one_group(pm_g):
+            bits = gather_pm_bits(pm_g, cvw, cvb)
+            return jnp.all(bits | ~kc[None, :], axis=1)
+
+        lab_gather = np.asarray(jax.vmap(one_group)(pm))
+        sel = candidate_selector(cvw, cvb, kc, st.pm.shape[2])
+        lab_matmul = np.asarray(label_feasibility_matmul(pm, sel, kc))
+        np.testing.assert_array_equal(lab_gather, lab_matmul)
+
+
+class TestNodeBudget:
+    def test_max_nodes_respected_despite_bucketing(self, small_catalog):
+        """NR is bucketed up for jit-shape stability; the semantic max_nodes
+        cap must survive (node_budget in the scan consts)."""
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 2.0}) for i in range(100)]
+        st = tensorize(pods, [default_prov()], small_catalog)
+        out = solve_tensors(st, max_nodes=2)
+        assert len(out.result.nodes) <= 2
+        assert len(out.result.infeasible) > 0
+        assert out.result.n_scheduled + len(out.result.infeasible) == 100
+
 
 class TestExistingNodes:
     def _existing(self, catalog, type_name="m5.4xlarge", zone="zone-1a", n=1):
@@ -244,6 +314,55 @@ class TestPreferenceRelaxation:
         pods = [PodSpec(
             name="p", requests={"cpu": 1.0},
             node_selector={L.ZONE: "mars-1a"},  # hard: stays infeasible
+        )]
+        sched = BatchScheduler(backend="oracle")
+        res = sched.solve(pods, [default_prov()], small_catalog)
+        assert "p" in res.infeasible
+
+    def test_mixed_preferences_relaxed_one_at_a_time(self, small_catalog):
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        # term[0] satisfiable (zone-1b), term[1] not (mars): the ladder must
+        # drop only term[1] and still honor term[0], not both.
+        pods = [PodSpec(
+            name=f"p{i}", requests={"cpu": 1.0},
+            preferred_affinity_terms=[
+                [Requirement(L.ZONE, IN, ["zone-1b"])],
+                [Requirement(L.ZONE, IN, ["mars-1a"])],
+            ],
+        ) for i in range(3)]
+        sched = BatchScheduler(backend="oracle")
+        res = sched.solve(pods, [default_prov()], small_catalog)
+        assert res.infeasible == {}
+        assert all(n.zone == "zone-1b" for n in res.nodes)
+
+    def test_or_affinity_second_term_explored(self, small_catalog):
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        # term[0] names a zone that doesn't exist; term[1] is satisfiable.
+        # The OR ladder must schedule the pod under term[1].
+        pods = [PodSpec(
+            name=f"p{i}", requests={"cpu": 1.0},
+            required_affinity_terms=[
+                [Requirement(L.ZONE, IN, ["mars-1a"])],
+                [Requirement(L.ZONE, IN, ["zone-1b"])],
+            ],
+        ) for i in range(4)]
+        for backend in ("oracle", "tpu"):
+            sched = BatchScheduler(backend=backend)
+            res = sched.solve(pods, [default_prov()], small_catalog)
+            assert res.infeasible == {}, backend
+            assert all(n.zone == "zone-1b" for n in res.nodes), backend
+
+    def test_or_affinity_all_terms_infeasible(self, small_catalog):
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        pods = [PodSpec(
+            name="p", requests={"cpu": 1.0},
+            required_affinity_terms=[
+                [Requirement(L.ZONE, IN, ["mars-1a"])],
+                [Requirement(L.ZONE, IN, ["mars-1b"])],
+            ],
         )]
         sched = BatchScheduler(backend="oracle")
         res = sched.solve(pods, [default_prov()], small_catalog)
