@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""All five BASELINE.json benchmark configs — TPU solver vs the in-repo CPU
+FFD oracle (BASELINE.md "Targets for this repo").
+
+Prints ONE JSON line PER config:
+
+  {"config": N, "metric": ..., "value": <device ms>, "unit": "ms",
+   "vs_baseline": <cpu_ms / device_ms>, "cost_ratio_vs_ffd": ..., ...}
+
+``bench.py`` stays the single-line headline (config #2); this is the full
+sweep the parity story rests on.  Run: ``python bench_all.py [--configs 1,3]``.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _ffd_and_tpu(pods, provs, catalog, label):
+    """Shared harness: CPU oracle once, TPU solve (compile excluded), report."""
+    from karpenter_tpu.models.tensorize import tensorize
+    from karpenter_tpu.solver import reference
+    from karpenter_tpu.solver.tpu import solve_tensors
+
+    t0 = time.perf_counter()
+    oracle = reference.solve(pods, provs, catalog)
+    cpu_ms = (time.perf_counter() - t0) * 1000.0
+
+    st = tensorize(pods, provs, catalog)
+    out = solve_tensors(st, track_assignments=False)
+    tpu = out.result
+    cost_ratio = (
+        tpu.new_node_cost / oracle.new_node_cost if oracle.new_node_cost > 0 else 1.0
+    )
+    return {
+        "metric": label,
+        "value": round(out.solve_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_ms / max(out.solve_ms, 1e-9), 3),
+        "cpu_ffd_ms": round(cpu_ms, 1),
+        "compile_ms": round(out.compile_ms, 1),
+        "cost_ratio_vs_ffd": round(cost_ratio, 4),
+        "tpu_nodes": len(tpu.nodes),
+        "ffd_nodes": len(oracle.nodes),
+        "infeasible": len(tpu.infeasible),
+        "infeasible_ffd": len(oracle.infeasible),
+    }
+
+
+def config1():
+    """1k uniform-CPU pods, 1 Provisioner, 20 instance types."""
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.pod import PodSpec
+    from karpenter_tpu.models.provisioner import Provisioner
+
+    catalog = generate_catalog(full=False)
+    pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}, owner_key="u")
+            for i in range(1000)]
+    provs = [Provisioner(name="default").with_defaults()]
+    rec = _ffd_and_tpu(pods, provs, catalog, "c1_1k_uniform_20types")
+
+    # at this size device dispatch overhead dominates; also measure the
+    # native C++ FFD tier the scheduler routes small unconstrained batches to
+    from karpenter_tpu.models.tensorize import tensorize
+    from karpenter_tpu.solver import native as native_mod
+
+    if native_mod.available():
+        st = tensorize(pods, provs, catalog)
+        t0 = time.perf_counter()
+        nres = native_mod.solve_tensors_native(st, existing_nodes=[], max_nodes=1000)
+        rec["native_ms"] = round((time.perf_counter() - t0) * 1000.0, 3)
+        rec["native_nodes"] = len(nres.nodes)
+    return rec
+
+
+def config2():
+    """50k mixed CPU/mem pods, full catalog, 3-AZ spread (bench.py headline)."""
+    from bench import build_scenario
+
+    pods, provs, catalog = build_scenario()
+    return _ffd_and_tpu(pods, provs, catalog, "c2_50k_mixed_full_catalog_3az")
+
+
+def config3():
+    """10k pods with pod anti-affinity + taints/tolerations (hostname spread)."""
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.instancetype import GIB
+    from karpenter_tpu.models.pod import (
+        LabelSelector, PodAffinityTerm, PodSpec, Taint, Toleration,
+    )
+    from karpenter_tpu.models.provisioner import Provisioner
+
+    catalog = generate_catalog(full=True)
+    pods = []
+    for s in range(100):
+        sel = LabelSelector.of({"app": f"svc{s}"})
+        tol = ([Toleration(key="dedicated", operator="Equal", value="svc",
+                           effect=L.EFFECT_NO_SCHEDULE)] if s % 2 else [])
+        for i in range(100):
+            pods.append(PodSpec(
+                name=f"svc{s}-{i}", labels={"app": f"svc{s}"},
+                requests={"cpu": 0.5 + (s % 4) * 0.25, "memory": (1 + s % 3) * GIB},
+                affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME, anti=True)],
+                tolerations=tol, owner_key=f"svc{s}",
+            ))
+    provs = [
+        Provisioner(name="dedicated", weight=10,
+                    taints=[Taint(key="dedicated", effect=L.EFFECT_NO_SCHEDULE,
+                                  value="svc")]).with_defaults(),
+        Provisioner(name="default", weight=5).with_defaults(),
+    ]
+    return _ffd_and_tpu(pods, provs, catalog, "c3_10k_antiaffinity_taints_hostname")
+
+
+def config4():
+    """Multi-node consolidation screen: 5k under-utilized nodes."""
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.instancetype import GIB
+    from karpenter_tpu.models.pod import PodSpec
+    from karpenter_tpu.solver.consolidation import screen_delete_candidates
+    from karpenter_tpu.solver.types import SimNode
+
+    catalog = generate_catalog(full=False)
+    it = next(t for t in catalog if t.allocatable.get("cpu", 0) >= 15)
+    rng = np.random.default_rng(42)
+    nodes = []
+    for i in range(5000):
+        node = SimNode(
+            instance_type=it.name, provisioner="default", zone=f"zone-1{'abc'[i % 3]}",
+            capacity_type="on-demand", price=it.offerings[0].price,
+            allocatable=dict(it.allocatable),
+        )
+        # ~30% utilization: under-utilized fleet, the consolidation target
+        for k in range(int(rng.integers(2, 6))):
+            node.pods.append(PodSpec(
+                name=f"n{i}-p{k}",
+                requests={"cpu": float(rng.uniform(0.25, 1.5)),
+                          "memory": float(rng.uniform(0.5, 2.0)) * GIB},
+            ))
+        nodes.append(node)
+
+    # CPU baseline: the same first-fit screen, sequentially per candidate
+    resources = [L.RESOURCE_CPU, L.RESOURCE_MEMORY, L.RESOURCE_PODS]
+    residual = np.zeros((len(nodes), 3), dtype=np.float64)
+    for i, n in enumerate(nodes):
+        rem = n.remaining()
+        residual[i] = [max(0.0, rem.get(r, 0.0)) for r in resources]
+    t0 = time.perf_counter()
+    cpu_deletable = np.zeros(len(nodes), dtype=bool)
+    for i, n in enumerate(nodes):
+        res = residual.copy()
+        res[i] = 0.0
+        ok = True
+        for p in sorted(n.pods, key=lambda p: -p.requests.get("cpu", 0)):
+            row = np.array([p.requests.get(L.RESOURCE_CPU, 0.0),
+                            p.requests.get(L.RESOURCE_MEMORY, 0.0), 1.0])
+            fits = (res >= row - 1e-9).all(axis=1)
+            j = int(np.argmax(fits))
+            if not fits[j]:
+                ok = False
+                break
+            res[j] -= row
+        cpu_deletable[i] = ok
+    cpu_ms = (time.perf_counter() - t0) * 1000.0
+
+    pmax = max(8, max(len(n.pods) for n in nodes))
+    out = screen_delete_candidates(nodes, pmax=pmax)
+    agree = float((out.deletable == cpu_deletable).mean())
+    return {
+        "metric": "c4_consolidation_screen_5k_nodes",
+        "value": round(out.eval_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_ms / max(out.eval_ms, 1e-9), 3),
+        "cpu_screen_ms": round(cpu_ms, 1),
+        "compile_ms": round(out.compile_ms, 1),
+        "deletable": int(out.deletable.sum()),
+        "agreement_with_cpu": round(agree, 4),
+    }
+
+
+def config5():
+    """Spot+on-demand price-aware pack, 10 weighted Provisioners, 5k pods."""
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.instancetype import GIB
+    from karpenter_tpu.models.pod import PodSpec
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.models.requirements import IN, Requirement
+
+    catalog = generate_catalog(full=True)
+    provs = []
+    for i in range(10):
+        ct = L.CAPACITY_TYPE_SPOT if i % 2 else L.CAPACITY_TYPE_ON_DEMAND
+        provs.append(Provisioner(
+            name=f"prov-{i}", weight=10 - i,
+            requirements=[Requirement(L.CAPACITY_TYPE, IN, [ct])],
+        ).with_defaults())
+    pods = [PodSpec(name=f"p{i}", requests={"cpu": 0.5 + (i % 5) * 0.5,
+                                            "memory": (1 + i % 4) * GIB},
+                    owner_key=f"d{i % 8}")
+            for i in range(5000)]
+    return _ffd_and_tpu(pods, provs, catalog, "c5_spot_od_10weighted_provs_5k")
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="1,2,3,4,5",
+                    help="comma-separated config numbers to run")
+    args = ap.parse_args()
+    picked = [int(x) for x in args.configs.split(",") if x.strip()]
+    for n in picked:
+        rec = CONFIGS[n]()
+        rec = {"config": n, **rec}
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,39 +75,16 @@ class BatchScheduler:
         terms are first solved with all preferences hardened; any that come
         back infeasible retry dropping one preferred term at a time, last
         first (the reference's scheduler relaxes preferences one failure at a
-        time — scheduling.md:205-233)."""
+        time — scheduling.md:205-233).  Pods with OR'd required-affinity terms
+        that stay infeasible under term[0] retry under each alternate term —
+        with the full preference ladder re-applied per term, so a pod landing
+        on term[1] still honors its satisfiable preferences."""
         t0 = time.perf_counter()
         try:
-            hardened = [_harden_preferences(p) for p in pods]
-            result = self._solve_once(
-                hardened, provisioners, instance_types, existing_nodes,
+            result = self._solve_wave(
+                pods, provisioners, instance_types, list(existing_nodes),
                 daemonsets, unavailable, allow_new_nodes, max_new_nodes,
             )
-            def merge_retry(retry_result):
-                for name in list(result.infeasible):
-                    if name in retry_result.assignments:
-                        del result.infeasible[name]
-                result.infeasible.update(retry_result.infeasible)
-                result.assignments.update(retry_result.assignments)
-                result.nodes.extend(retry_result.nodes)
-                result.solve_ms += retry_result.solve_ms
-
-            def budget_left():
-                return (None if max_new_nodes is None
-                        else max(0, max_new_nodes - len(result.nodes)))
-
-            max_pref = max((len(p.preferred_affinity_terms) for p in pods), default=0)
-            for keep in range(max_pref - 1, -1, -1):
-                retry = [p for p in pods if p.name in result.infeasible
-                         and len(p.preferred_affinity_terms) > keep]
-                if not retry:
-                    continue
-                merge_retry(self._solve_once(
-                    [_harden_preferences(p, keep) for p in retry],
-                    provisioners, instance_types,
-                    list(existing_nodes) + result.nodes, daemonsets,
-                    unavailable, allow_new_nodes, budget_left(),
-                ))
 
             # OR'd required-affinity terms beyond the first: the solvers pack
             # under term[0] only (tensorize.group_pods), so still-infeasible
@@ -120,19 +97,60 @@ class BatchScheduler:
                     if p.name in result.infeasible and len(p.required_affinity_terms) > k:
                         q = copy.copy(p)
                         q.required_affinity_terms = [p.required_affinity_terms[k]]
-                        q.preferred_affinity_terms = []
                         q.__dict__.pop("_group_key", None)
                         alts.append(q)
                 if not alts:
                     break
-                merge_retry(self._solve_once(
+                wave = self._solve_wave(
                     alts, provisioners, instance_types,
                     list(existing_nodes) + result.nodes, daemonsets,
-                    unavailable, allow_new_nodes, budget_left(),
-                ))
+                    unavailable, allow_new_nodes,
+                    None if max_new_nodes is None
+                    else max(0, max_new_nodes - len(result.nodes)),
+                )
+                for name in list(result.infeasible):
+                    if name in wave.assignments:
+                        del result.infeasible[name]
+                result.infeasible.update(wave.infeasible)
+                result.assignments.update(wave.assignments)
+                result.nodes.extend(wave.nodes)
+                result.solve_ms += wave.solve_ms
             return result
         finally:
             self.registry.histogram(SCHEDULING_DURATION).observe(time.perf_counter() - t0)
+
+    def _solve_wave(
+        self, pods, provisioners, instance_types, existing_nodes, daemonsets,
+        unavailable, allow_new_nodes, max_new_nodes,
+    ) -> SolveResult:
+        """One pod wave with the preference-relaxation ladder applied."""
+        result = self._solve_once(
+            [_harden_preferences(p) for p in pods], provisioners,
+            instance_types, existing_nodes, daemonsets, unavailable,
+            allow_new_nodes, max_new_nodes,
+        )
+        max_pref = max((len(p.preferred_affinity_terms) for p in pods), default=0)
+        for keep in range(max_pref - 1, -1, -1):
+            retry = [p for p in pods if p.name in result.infeasible
+                     and len(p.preferred_affinity_terms) > keep]
+            if not retry:
+                continue
+            sub = self._solve_once(
+                [_harden_preferences(p, keep) for p in retry],
+                provisioners, instance_types,
+                list(existing_nodes) + result.nodes, daemonsets,
+                unavailable, allow_new_nodes,
+                None if max_new_nodes is None
+                else max(0, max_new_nodes - len(result.nodes)),
+            )
+            for name in list(result.infeasible):
+                if name in sub.assignments:
+                    del result.infeasible[name]
+            result.infeasible.update(sub.infeasible)
+            result.assignments.update(sub.assignments)
+            result.nodes.extend(sub.nodes)
+            result.solve_ms += sub.solve_ms
+        return result
 
     def _solve_once(
         self, pods, provisioners, instance_types, existing_nodes, daemonsets,

@@ -209,13 +209,17 @@ def _make_step(
             jnp.floor((cand_alloc + 1e-6) / jnp.maximum(req_g[None, :], 1e-9)),
             BIGN,
         )
+        # scoring uses resource-only pods-per-node (the oracle's
+        # _best_in_zone does the same): a hostname-capped group still buys
+        # node types sized for co-location with OTHER groups, which later
+        # steps backfill.  take_pn is what this group actually places per node.
         ppn = jnp.min(nr_ratios, axis=1)                            # [C]
         hcap_new = jnp.where((sh >= 0) & (hk > 0), hk, BIGN)
-        ppn = jnp.minimum(ppn, hcap_new)
+        take_pn = jnp.minimum(ppn, hcap_new)
         lim_ok = jnp.all(
             prov_used[cand_prov] + cand_cap <= prov_limits[cand_prov] + 1e-6, axis=1
         )                                                            # [C]
-        new_ok = Fd_g & (ppn[:, None] >= 1.0) & lim_ok[:, None]      # [C, D]
+        new_ok = Fd_g & (take_pn[:, None] >= 1.0) & lim_ok[:, None]  # [C, D]
         zone_of_dom = dom_zone                                       # [D]
         new_ok_z = jnp.zeros(Z, dtype=bool).at[zone_of_dom].max(jnp.any(new_ok, axis=0))
 
@@ -251,7 +255,7 @@ def _make_step(
         # smaller remainder (matching the per-pod re-scoring sequence).
         ci_key = jnp.broadcast_to(jnp.arange(C, dtype=jnp.float32)[:, None], (C, D))
         di_key = jnp.broadcast_to(jnp.arange(D, dtype=jnp.float32)[None, :], (C, D))
-        new_ok_nolim = Fd_g & (ppn[:, None] >= 1.0)
+        new_ok_nolim = Fd_g & (take_pn[:, None] >= 1.0)
 
         def pick(rem, dom_mask, prov_used_cur):
             """argmin over (C, D & dom_mask) of price/min(ppn, rem).
@@ -283,7 +287,12 @@ def _make_step(
             (state, pods actually placed)."""
             (res, row_zone, row_dom, row_cand, row_price, active, prov_used,
              new_take, cursor) = state
-            n_nodes = jnp.minimum(n_nodes, jnp.minimum(NR, node_budget) - cursor)
+            # budget clamp; floor at 0 — cursor starts at NE which may already
+            # exceed a small node_budget, and a negative count must not walk
+            # the cursor backward or deduct phantom prov_used capacity
+            n_nodes = jnp.maximum(
+                jnp.minimum(n_nodes, jnp.minimum(NR, node_budget) - cursor), 0
+            )
             in_block = (slot_idx >= cursor) & (slot_idx < cursor + n_nodes)
             is_last = slot_idx == (cursor + n_nodes - 1)
             blk = jnp.where(in_block, jnp.where(is_last, last_extra, per_node), 0.0)
@@ -312,13 +321,13 @@ def _make_step(
         def stage_pair(state, rem, dom_mask):
             """One (bulk, tail) creation round; returns leftover pods."""
             bc, bd, ok = pick(rem, dom_mask, state[6])
-            ppn_b = jnp.maximum(ppn[bc], 1.0)
+            ppn_b = jnp.maximum(take_pn[bc], 1.0)
             n_bulk_f = jnp.where(ok, jnp.floor(rem / ppn_b), 0.0)
             n_bulk = jnp.minimum(n_bulk_f, limit_headroom(state[6], bc)).astype(jnp.int32)
             state, took_b = write_block(state, n_bulk, ppn_b, ppn_b, bc, bd)
             rem_t = jnp.maximum(rem - took_b, 0.0)
             ct_, dt_, ok_t = pick(rem_t, dom_mask, state[6])
-            ppn_t = jnp.maximum(ppn[ct_], 1.0)
+            ppn_t = jnp.maximum(take_pn[ct_], 1.0)
             n_tail_f = jnp.where(ok_t & (rem_t > 0), jnp.ceil(rem_t / ppn_t), 0.0)
             n_tail = jnp.minimum(n_tail_f, limit_headroom(state[6], ct_)).astype(jnp.int32)
             last = rem_t - (n_tail.astype(jnp.float32) - 1.0) * ppn_t

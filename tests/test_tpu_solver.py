@@ -225,6 +225,30 @@ class TestNodeBudget:
         assert len(out.result.infeasible) > 0
         assert out.result.n_scheduled + len(out.result.infeasible) == 100
 
+    def test_budget_below_existing_count_is_safe(self, small_catalog):
+        """max_nodes < len(existing_nodes) must not walk the slot cursor
+        backward (phantom prov_used deductions): no new nodes, existing
+        capacity still usable."""
+        it = next(t for t in small_catalog if t.name == "m5.4xlarge")
+        existing = [
+            SimNode(
+                instance_type=it.name, provisioner="default", zone="zone-1a",
+                capacity_type="on-demand", price=1.0,
+                allocatable=dict(it.allocatable),
+                labels={**it.labels(), L.ZONE: "zone-1a",
+                        L.CAPACITY_TYPE: "on-demand",
+                        L.PROVISIONER_NAME: "default"},
+                existing=True,
+            )
+            for _ in range(3)
+        ]
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(5)]
+        st = tensorize(pods, [default_prov()], small_catalog)
+        out = solve_tensors(st, existing_nodes=existing, max_nodes=1)
+        assert out.result.nodes == []
+        assert out.result.n_scheduled == 5  # existing capacity still served
+        assert out.n_used == 3
+
 
 class TestExistingNodes:
     def _existing(self, catalog, type_name="m5.4xlarge", zone="zone-1a", n=1):
@@ -353,6 +377,24 @@ class TestPreferenceRelaxation:
             res = sched.solve(pods, [default_prov()], small_catalog)
             assert res.infeasible == {}, backend
             assert all(n.zone == "zone-1b" for n in res.nodes), backend
+
+    def test_or_term_keeps_preferences(self, small_catalog):
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        # required term[0] infeasible; term[1] admits zone-1a|zone-1b; the
+        # preference for zone-1b must still be honored under term[1].
+        pods = [PodSpec(
+            name="p", requests={"cpu": 1.0},
+            required_affinity_terms=[
+                [Requirement(L.ZONE, IN, ["mars-1a"])],
+                [Requirement(L.ZONE, IN, ["zone-1a", "zone-1b"])],
+            ],
+            preferred_affinity_terms=[[Requirement(L.ZONE, IN, ["zone-1b"])]],
+        )]
+        sched = BatchScheduler(backend="oracle")
+        res = sched.solve(pods, [default_prov()], small_catalog)
+        assert res.infeasible == {}
+        assert all(n.zone == "zone-1b" for n in res.nodes)
 
     def test_or_affinity_all_terms_infeasible(self, small_catalog):
         from karpenter_tpu.solver.scheduler import BatchScheduler
