@@ -44,6 +44,23 @@ def _harden_preferences(pod: PodSpec, keep: Optional[int] = None) -> PodSpec:
     return out
 
 
+def _merge(result: SolveResult, sub: SolveResult) -> None:
+    """Fold a retry wave's outcome into ``result`` (shared by the preference
+    ladder and the OR-term ladder so their merge semantics cannot diverge)."""
+    for name in list(result.infeasible):
+        if name in sub.assignments:
+            del result.infeasible[name]
+    result.infeasible.update(sub.infeasible)
+    result.assignments.update(sub.assignments)
+    result.nodes.extend(sub.nodes)
+    result.solve_ms += sub.solve_ms
+
+
+def _budget_left(result: SolveResult, max_new_nodes: Optional[int]) -> Optional[int]:
+    return (None if max_new_nodes is None
+            else max(0, max_new_nodes - len(result.nodes)))
+
+
 class BatchScheduler:
     def __init__(
         self,
@@ -101,20 +118,12 @@ class BatchScheduler:
                         alts.append(q)
                 if not alts:
                     break
-                wave = self._solve_wave(
+                _merge(result, self._solve_wave(
                     alts, provisioners, instance_types,
                     list(existing_nodes) + result.nodes, daemonsets,
                     unavailable, allow_new_nodes,
-                    None if max_new_nodes is None
-                    else max(0, max_new_nodes - len(result.nodes)),
-                )
-                for name in list(result.infeasible):
-                    if name in wave.assignments:
-                        del result.infeasible[name]
-                result.infeasible.update(wave.infeasible)
-                result.assignments.update(wave.assignments)
-                result.nodes.extend(wave.nodes)
-                result.solve_ms += wave.solve_ms
+                    _budget_left(result, max_new_nodes),
+                ))
             return result
         finally:
             self.registry.histogram(SCHEDULING_DURATION).observe(time.perf_counter() - t0)
@@ -135,21 +144,13 @@ class BatchScheduler:
                      and len(p.preferred_affinity_terms) > keep]
             if not retry:
                 continue
-            sub = self._solve_once(
+            _merge(result, self._solve_once(
                 [_harden_preferences(p, keep) for p in retry],
                 provisioners, instance_types,
                 list(existing_nodes) + result.nodes, daemonsets,
                 unavailable, allow_new_nodes,
-                None if max_new_nodes is None
-                else max(0, max_new_nodes - len(result.nodes)),
-            )
-            for name in list(result.infeasible):
-                if name in sub.assignments:
-                    del result.infeasible[name]
-            result.infeasible.update(sub.infeasible)
-            result.assignments.update(sub.assignments)
-            result.nodes.extend(sub.nodes)
-            result.solve_ms += sub.solve_ms
+                _budget_left(result, max_new_nodes),
+            ))
         return result
 
     def _solve_once(

@@ -290,12 +290,17 @@ def _make_step(
             # budget clamp; floor at 0 — cursor starts at NE which may already
             # exceed a small node_budget, and a negative count must not walk
             # the cursor backward or deduct phantom prov_used capacity
+            n_req = n_nodes
             n_nodes = jnp.maximum(
                 jnp.minimum(n_nodes, jnp.minimum(NR, node_budget) - cursor), 0
             )
             in_block = (slot_idx >= cursor) & (slot_idx < cursor + n_nodes)
             is_last = slot_idx == (cursor + n_nodes - 1)
-            blk = jnp.where(in_block, jnp.where(is_last, last_extra, per_node), 0.0)
+            # last_extra is the partial fill of the block's true final node;
+            # when the budget truncated the block, every written node is an
+            # interior one and must take the full per_node
+            last_take = jnp.where(n_nodes >= n_req, last_extra, per_node)
+            blk = jnp.where(in_block, jnp.where(is_last, last_take, per_node), 0.0)
             new_take = new_take + blk
             res = jnp.where(in_block[:, None], cand_alloc[bc][None, :], res)
             row_zone = jnp.where(in_block, dom_zone[bd], row_zone)

@@ -1,0 +1,137 @@
+"""Randomized differential fuzzing: TPU solver vs the CPU oracle.
+
+The reference's hardening tier is ``make battletest`` — race-detector runs
+with randomized spec order and injected delays (reference Makefile:69-76).
+The analog for a numeric solver is *differential fuzzing*: seeded random
+scenarios over the whole constraint surface (requests, selectors, spreads,
+anti-affinity, taints/tolerations, weighted/limited provisioners, ICE'd
+offerings, existing nodes), each gated on the same invariants the curated
+parity suites use:
+
+- identical scheduled/infeasible pod counts,
+- new-node cost within the 1.02x parity budget,
+- determinism: re-solving the same tensors yields identical packing.
+
+Scenario axes are kept bucket-stable (pod counts < 512, the 20-type catalog)
+so the persistent jit cache makes the sweep cheap after the first seed.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.instancetype import GIB
+from karpenter_tpu.models.pod import (
+    LabelSelector,
+    PodAffinityTerm,
+    PodSpec,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.requirements import IN, Requirement
+from karpenter_tpu.models.tensorize import tensorize
+from karpenter_tpu.solver import reference
+from karpenter_tpu.solver.tpu import solve_tensors
+
+PARITY = 1.02
+SEEDS = range(10)
+
+
+def random_scenario(seed: int, catalog):
+    rng = np.random.default_rng(seed)
+    zones = ["zone-1a", "zone-1b", "zone-1c"]
+
+    # -- provisioners: 1-3, weighted; maybe a taint, maybe a cpu limit -----
+    provs = []
+    n_prov = int(rng.integers(1, 4))
+    for i in range(n_prov):
+        kw = {}
+        if rng.random() < 0.3:
+            kw["taints"] = [Taint(key="team", effect=L.EFFECT_NO_SCHEDULE, value="a")]
+        if rng.random() < 0.3:
+            kw["limits"] = {"cpu": float(rng.integers(16, 128))}
+        if rng.random() < 0.4:
+            ct = L.CAPACITY_TYPE_SPOT if rng.random() < 0.5 else L.CAPACITY_TYPE_ON_DEMAND
+            kw["requirements"] = [Requirement(L.CAPACITY_TYPE, IN, [ct])]
+        provs.append(Provisioner(name=f"prov{i}", weight=int(rng.integers(1, 11)), **kw).with_defaults())
+
+    # -- pods: up to 8 deployment-like groups, constraint mix -------------
+    pods = []
+    n_dep = int(rng.integers(1, 9))
+    for d in range(n_dep):
+        n = int(rng.integers(3, 40))
+        cpu = float(rng.choice([0.25, 0.5, 1.0, 2.0, 3.5]))
+        mem = float(rng.choice([0.5, 1.0, 2.0, 6.0])) * GIB
+        labels = {"app": f"d{d}"}
+        sel = LabelSelector.of(labels)
+        kw = {}
+        r = rng.random()
+        if r < 0.25:
+            kw["topology_spread"] = [TopologySpreadConstraint(
+                int(rng.integers(1, 4)), L.ZONE, "DoNotSchedule", sel)]
+        elif r < 0.45:
+            kw["affinity_terms"] = [PodAffinityTerm(sel, L.HOSTNAME, anti=True)]
+        elif r < 0.55:
+            kw["topology_spread"] = [TopologySpreadConstraint(
+                int(rng.integers(1, 3)), L.HOSTNAME, "DoNotSchedule", sel)]
+        if rng.random() < 0.25:
+            kw["node_selector"] = {L.ZONE: str(rng.choice(zones))}
+        if rng.random() < 0.2:
+            kw["tolerations"] = [Toleration(key="team", operator="Equal", value="a",
+                                            effect=L.EFFECT_NO_SCHEDULE)]
+        for i in range(n):
+            pods.append(PodSpec(name=f"d{d}-{i}", labels=dict(labels),
+                                requests={"cpu": cpu, "memory": mem},
+                                owner_key=f"d{d}", **kw))
+
+    # -- ICE'd offerings ----------------------------------------------------
+    unavailable = set()
+    if rng.random() < 0.4:
+        for _ in range(int(rng.integers(1, 6))):
+            it = catalog[int(rng.integers(0, len(catalog)))]
+            o = it.offerings[int(rng.integers(0, len(it.offerings)))]
+            unavailable.add((it.name, o.zone, o.capacity_type))
+
+    return pods, provs, unavailable
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_cost_and_feasibility_parity(seed, small_catalog):
+    pods, provs, unavailable = random_scenario(seed, small_catalog)
+    oracle = reference.solve(pods, provs, small_catalog, unavailable=unavailable)
+    st = tensorize(pods, provs, small_catalog, unavailable=unavailable)
+    out = solve_tensors(st)
+    tpu = out.result
+
+    assert tpu.n_scheduled == oracle.n_scheduled, (
+        f"seed {seed}: scheduled tpu={tpu.n_scheduled} oracle={oracle.n_scheduled} "
+        f"(tpu infeasible={len(tpu.infeasible)}, oracle={len(oracle.infeasible)})"
+    )
+    if oracle.new_node_cost > 0:
+        ratio = tpu.new_node_cost / oracle.new_node_cost
+        assert ratio <= PARITY + 1e-9, (
+            f"seed {seed}: cost ratio {ratio:.4f} "
+            f"(tpu ${tpu.new_node_cost:.3f} vs oracle ${oracle.new_node_cost:.3f})\n"
+            f"tpu: {tpu.summary()}\noracle: {oracle.summary()}"
+        )
+
+
+def test_fuzz_determinism(small_catalog):
+    """Same tensors solved twice must produce the identical packing."""
+    pods, provs, unavailable = random_scenario(3, small_catalog)
+    st = tensorize(pods, provs, small_catalog, unavailable=unavailable)
+    a = solve_tensors(st)
+    b = solve_tensors(st)
+
+    def canonical(res):
+        # node names come from a global counter; compare packing shape, not ids
+        idx = {n.name: i for i, n in enumerate(res.nodes)}
+        return (
+            {p: idx[n] for p, n in res.assignments.items()},
+            [(n.instance_type, n.zone, n.capacity_type) for n in res.nodes],
+        )
+
+    assert canonical(a.result) == canonical(b.result)
+    assert abs(a.result.new_node_cost - b.result.new_node_cost) < 1e-9

@@ -225,6 +225,21 @@ class TestNodeBudget:
         assert len(out.result.infeasible) > 0
         assert out.result.n_scheduled + len(out.result.infeasible) == 100
 
+    def test_budget_truncated_tail_fills_nodes(self, small_catalog):
+        """When the node budget truncates a creation block, the written nodes
+        must still be filled to per-node capacity (not take the partial
+        last_extra meant for the untruncated block's final node)."""
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 3.0}) for i in range(10)]
+        oracle = reference.solve(pods, [default_prov()], small_catalog,
+                                 max_new_nodes=2)
+        st = tensorize(pods, [default_prov()], small_catalog)
+        out = solve_tensors(st, max_nodes=2)
+        assert len(out.result.nodes) <= 2
+        assert out.result.n_scheduled == oracle.n_scheduled, (
+            f"tpu scheduled {out.result.n_scheduled} vs oracle "
+            f"{oracle.n_scheduled} under the same 2-node budget"
+        )
+
     def test_budget_below_existing_count_is_safe(self, small_catalog):
         """max_nodes < len(existing_nodes) must not walk the slot cursor
         backward (phantom prov_used deductions): no new nodes, existing
