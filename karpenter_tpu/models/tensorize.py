@@ -122,8 +122,14 @@ class SolveTensors:
     n_zones: int
     # selector table backing the S axis: (LabelSelector, topology_key, kind)
     selector_defs: List[Tuple[LabelSelector, str, str]] = field(default_factory=list)
-    # groups with positive pod-affinity terms: not solvable on-device (v1);
-    # callers route these to the CPU oracle
+    # positive pod-affinity slots (NO_SELECTOR when absent): the solver's
+    # per-group modes are (A) matching pods exist -> co-locate with them,
+    # (B) none but self-matching -> seed one zone/node, (C) infeasible
+    g_zone_paff: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.int32))
+    g_host_paff: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.int32))
+    # groups whose positive-affinity shape the device can't express (>1
+    # positive term per topology key, or a key other than zone/hostname);
+    # callers route these pods to the CPU oracle
     g_positive_affinity: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=bool))
 
     @property
@@ -147,6 +153,24 @@ class SolveTensors:
     @property
     def S(self) -> int:
         return self.g_sel_match.shape[0]
+
+
+def device_inexpressible(pod: PodSpec) -> bool:
+    """Positive-affinity shapes the device solver can't express (v1): more
+    than one positive term per topology key, or a key other than
+    zone/hostname.  Single source of truth — the scheduler's oracle carve-out
+    and tensorize's ``g_positive_affinity`` flag both use this."""
+    nz = nh = 0
+    for t in pod.affinity_terms:
+        if t.anti:
+            continue
+        if t.topology_key == L.ZONE:
+            nz += 1
+        elif t.topology_key == L.HOSTNAME:
+            nh += 1
+        else:
+            return True
+    return nz > 1 or nh > 1
 
 
 def _ffd_magnitude(requests: Mapping[str, float]) -> float:
@@ -254,8 +278,20 @@ def tensorize(
     g_host_spread = np.full(len(groups), NO_SELECTOR, dtype=np.int32)
     g_host_cap = np.zeros(len(groups), dtype=np.int32)
     g_zone_anti = np.full(len(groups), NO_SELECTOR, dtype=np.int32)
+    g_zone_paff = np.full(len(groups), NO_SELECTOR, dtype=np.int32)
+    g_host_paff = np.full(len(groups), NO_SELECTOR, dtype=np.int32)
+    g_unsupported = np.zeros(len(groups), dtype=bool)
     for gi, g in enumerate(groups):
         rep = g.pods[0]
+        g_unsupported[gi] = device_inexpressible(rep)
+        for term in rep.affinity_terms_required():
+            if term.topology_key not in (L.ZONE, L.HOSTNAME):
+                continue
+            sid = slots.intern(term.label_selector, term.topology_key, "affinity")
+            if term.topology_key == L.ZONE:
+                g_zone_paff[gi] = sid
+            else:
+                g_host_paff[gi] = sid
         for tsc in rep.topology_spread:
             if not tsc.hard:
                 continue  # ScheduleAnyway is advisory; v1 ignores soft spread
@@ -431,8 +467,7 @@ def tensorize(
         ct_names=cts,
         n_zones=len(zones),
         selector_defs=list(slots.selectors),
-        g_positive_affinity=np.array(
-            [any(not t.anti for t in g.pods[0].affinity_terms) for g in groups],
-            dtype=bool,
-        ),
+        g_zone_paff=g_zone_paff,
+        g_host_paff=g_host_paff,
+        g_positive_affinity=g_unsupported,
     )

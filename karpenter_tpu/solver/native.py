@@ -94,6 +94,8 @@ def has_topology(st: SolveTensors) -> bool:
         _np.any(st.g_zone_spread >= 0)
         or _np.any(st.g_host_spread >= 0)
         or _np.any(st.g_zone_anti >= 0)
+        or _np.any(st.g_zone_paff >= 0)
+        or _np.any(st.g_host_paff >= 0)
     )
 
 

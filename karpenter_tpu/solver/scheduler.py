@@ -14,10 +14,11 @@ import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..metrics import SCHEDULING_DURATION, SOLVER_BACKEND_DURATION, Registry, registry as default_registry
+from ..models import labels as L
 from ..models.instancetype import InstanceType
 from ..models.pod import PodSpec
 from ..models.provisioner import Provisioner
-from ..models.tensorize import tensorize
+from ..models.tensorize import device_inexpressible, tensorize
 from .reference import solve as oracle_solve
 from .tpu import TpuSolver
 from .types import SimNode, SolveResult
@@ -187,14 +188,42 @@ class BatchScheduler:
         self, pods, provisioners, instance_types, existing_nodes, daemonsets,
         unavailable, allow_new_nodes, max_new_nodes,
     ) -> SolveResult:
-        # carve out pods the device solver can't express (positive affinity)
-        tpu_pods = [p for p in pods if not any(not t.anti for t in p.affinity_terms)]
-        cpu_pods = [p for p in pods if any(not t.anti for t in p.affinity_terms)]
+        # carve out pods the device solver can't express (rare shapes only)
+        tpu_pods = [p for p in pods if not device_inexpressible(p)]
+        cpu_pods = [p for p in pods if device_inexpressible(p)]
+
+        # positive affinity couples the two batches: whichever side's
+        # affinity selectors match the other side's pods must solve SECOND,
+        # so the counts it co-locates against already exist.  Default (and
+        # tie-break) is device-first, oracle against its result.
+        def _refers(src, dst):
+            sels = [t.label_selector for p in src for t in p.affinity_terms
+                    if not t.anti]
+            return any(s.matches(q.labels) for s in sels for q in dst)
+
+        cpu_first = bool(cpu_pods and tpu_pods
+                         and _refers(tpu_pods, cpu_pods)
+                         and not _refers(cpu_pods, tpu_pods))
 
         nodes: List[SimNode] = []
         assignments: Dict[str, str] = {}
         infeasible: Dict[str, str] = {}
         solve_ms = 0.0
+
+        if cpu_first:
+            res0 = oracle_solve(
+                cpu_pods, provisioners, instance_types,
+                existing_nodes=list(existing_nodes), daemonsets=daemonsets,
+                unavailable=unavailable, allow_new_nodes=allow_new_nodes,
+                max_new_nodes=max_new_nodes,
+            )
+            nodes.extend(res0.nodes)
+            assignments.update(res0.assignments)
+            infeasible.update(res0.infeasible)
+            solve_ms += res0.solve_ms
+            cpu_pods = []
+            if max_new_nodes is not None:
+                max_new_nodes = max(0, max_new_nodes - len(res0.nodes))
 
         if tpu_pods:
             st = tensorize(
@@ -207,14 +236,14 @@ class BatchScheduler:
                 from . import native as native_mod
 
                 res = native_mod.solve_tensors_native(
-                    st, existing_nodes=list(existing_nodes),
-                    max_nodes=len(existing_nodes) + new_budget,
+                    st, existing_nodes=list(existing_nodes) + nodes,
+                    max_nodes=len(existing_nodes) + len(nodes) + new_budget,
                 )
                 backend_used = "native"
             else:
                 out = self._tpu.solve(
-                    st, existing_nodes=list(existing_nodes),
-                    max_nodes=len(existing_nodes) + new_budget,
+                    st, existing_nodes=list(existing_nodes) + nodes,
+                    max_nodes=len(existing_nodes) + len(nodes) + new_budget,
                     mesh=self.mesh,
                 )
                 res = out.result

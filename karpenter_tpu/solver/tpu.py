@@ -25,8 +25,10 @@ Design (tpu-first, not a port of the Go loop):
 
 Known v1 semantic gaps vs the CPU oracle (solver/reference.py), accepted
 within the 1.02x cost-parity budget and flagged for later rounds:
-- positive pod-affinity groups are not solved on-device (tensorize marks
-  them; callers route those pods to the oracle),
+- positive pod-affinity IS solved on-device (per-group modes: co-locate with
+  existing matches / seed one zone-or-node / infeasible), but only one
+  positive term per topology key and only zone/hostname keys; other shapes
+  are marked by tensorize and routed to the oracle by the scheduler,
 - maxSkew > 1 spread is balanced (water-filled) instead of first-fit-within-
   band,
 - provisioner-limit fallback depth is bounded: 2 (bulk, tail) creation rounds
@@ -137,6 +139,8 @@ def _make_step(
     g_host_spread = consts["g_host_spread"]
     g_host_cap = consts["g_host_cap"]
     g_zone_anti = consts["g_zone_anti"]
+    g_zone_paff = consts["g_zone_paff"]
+    g_host_paff = consts["g_host_paff"]
     g_sel_match = consts["g_sel_match"]  # [S, G]
     cand_alloc = consts["cand_alloc"]  # [C, R]
     cand_cap = consts["cand_cap"]      # [C, R]
@@ -173,6 +177,34 @@ def _make_step(
         exv = ex_ok[g][jnp.minimum(slot_idx, NE_pad - 1)]
         rf = active & jnp.where(row_cand >= 0, rf_cand, exv)
 
+        # ---- positive pod-affinity modes (reference.py _zone_allowed /
+        # _host_cap / _new_node_host_cap semantics, per group):
+        #   A: matching pods exist -> co-locate (their zones / their nodes,
+        #      no fresh hostname domain),
+        #   B: none exist, group self-matches -> seed ONE zone / ONE node,
+        #   C: none exist, no self-match -> infeasible.
+        zpa = g_zone_paff[g]
+        zpa_on = zpa >= 0
+        zpa_i = jnp.maximum(zpa, 0)
+        ztot = tot[zpa_i] > 0
+        zself = g_sel_match[zpa_i, g]
+        zone_seed = zpa_on & ~ztot & zself
+        zdead = zpa_on & ~ztot & ~zself
+
+        hpa = g_host_paff[g]
+        hpa_on = hpa >= 0
+        hpa_i = jnp.maximum(hpa, 0)
+        htot = tot[hpa_i] > 0
+        hhave = selcnt[:, hpa_i] > 0
+        hself = g_sel_match[hpa_i, g]
+        host_seed = hpa_on & ~htot & hself
+        host_gated = hpa_on & htot
+        hdead = hpa_on & ~htot & ~hself
+
+        rf = rf & (~host_gated | hhave) & ~hdead & ~zdead
+        # an empty node never satisfies mode-A/C hostname affinity
+        new_allowed = ~host_gated & ~hdead & ~zdead
+
         ratios = jnp.where(req_g[None, :] > 0, jnp.floor((res + 1e-6) / jnp.maximum(req_g[None, :], 1e-9)), BIGN)
         cap = jnp.min(ratios, axis=1)            # [NR]
 
@@ -186,10 +218,13 @@ def _make_step(
         # ---- zone-level caps ------------------------------------------
         zsp = g_zone_spread[g]
         za = g_zone_anti[g]
-        zoned = (zsp >= 0) | (za >= 0)
+        zoned = (zsp >= 0) | (za >= 0) | zpa_on
 
         # eligible zones: any allowed domain in the zone
         el = jnp.zeros(Z, dtype=bool).at[dom_zone].max(dok)
+        # zone positive affinity, modes A and C
+        zcpa = zc[zpa_i] > 0                                        # [Z]
+        el = el & (~(zpa_on & ztot) | zcpa) & ~zdead
         # zone anti-affinity cap
         zc_an = zc[jnp.maximum(za, 0)].astype(jnp.float32)          # [Z]
         self_match = g_sel_match[jnp.maximum(za, 0), g]
@@ -202,6 +237,16 @@ def _make_step(
         rowcap_z = jnp.zeros(Z, dtype=jnp.float32).at[jnp.maximum(row_zone, 0)].add(
             jnp.where(active, cap, 0.0)
         )
+
+        # per-zone budget from zone anti-affinity + zone-spread headroom
+        # (oracle _zone_allowed: counts[z] + 1 - min_eligible <= maxSkew);
+        # the seed flows must honor it — the normal flow gets it via cap_z
+        zc_sp = jnp.where(zsp >= 0, zc[jnp.maximum(zsp, 0)], jnp.zeros(Z, jnp.int32)).astype(jnp.float32)
+        min_sp = jnp.min(jnp.where(el, zc_sp, BIGN))
+        spread_cap = jnp.where(
+            zsp >= 0, g_zone_skew[g].astype(jnp.float32) + min_sp - zc_sp, BIGN
+        )
+        zone_budget = jnp.minimum(anti_cap, jnp.maximum(spread_cap, 0.0))   # [Z]
 
         # ---- new-node candidate scoring --------------------------------
         nr_ratios = jnp.where(
@@ -219,43 +264,17 @@ def _make_step(
         lim_ok = jnp.all(
             prov_used[cand_prov] + cand_cap <= prov_limits[cand_prov] + 1e-6, axis=1
         )                                                            # [C]
-        new_ok = Fd_g & (take_pn[:, None] >= 1.0) & lim_ok[:, None]  # [C, D]
+        new_ok = (Fd_g & (take_pn[:, None] >= 1.0) & lim_ok[:, None]
+                  & new_allowed)                                     # [C, D]
         zone_of_dom = dom_zone                                       # [D]
-        new_ok_z = jnp.zeros(Z, dtype=bool).at[zone_of_dom].max(jnp.any(new_ok, axis=0))
 
-        cap_z = jnp.minimum(rowcap_z + jnp.where(new_ok_z, BIGN, 0.0), anti_cap)
-        cap_z = jnp.where(el, cap_z, 0.0)
-
-        # ---- allocation: rows then new nodes ---------------------------
-        zc_sp = jnp.where(zsp >= 0, zc[jnp.maximum(zsp, 0)], jnp.zeros(Z, jnp.int32)).astype(jnp.float32)
-
-        def zoned_alloc(_):
-            alloc_z = water_fill(zc_sp, cap_z, cnt, el).astype(jnp.float32)  # [Z]
-            # per-zone prefix allocation over slots in creation order
-            zone1h = (row_zone[:, None] == jnp.arange(Z)[None, :])           # [NR, Z]
-            capz_slots = jnp.where(zone1h, cap[:, None], 0.0)
-            before = jnp.cumsum(capz_slots, axis=0) - capz_slots
-            take_slots = jnp.clip(alloc_z[None, :] - before, 0.0, capz_slots)
-            take = jnp.sum(jnp.where(zone1h, take_slots, 0.0), axis=1)
-            taken_z = jnp.sum(jnp.where(zone1h, take_slots, 0.0), axis=0)
-            rem_z = jnp.maximum(alloc_z - taken_z, 0.0)
-            return take, rem_z
-
-        def simple_alloc(_):
-            take = prefix_allocate(cap, cnt)
-            rem = cnt - jnp.sum(take)
-            return take, jnp.where(jnp.arange(Z) == 0, rem, 0.0)  # placeholder; zone chosen below
-
-        take, rem_z = jax.lax.cond(zoned, zoned_alloc, simple_alloc, operand=None)
-
-        # ---- new-node creation -------------------------------------------
-        # Mirrors the oracle: while pods remain, pick argmin
-        # price / min(ppn, remaining); nodes of the chosen type are created in
-        # bulk while remaining >= ppn, then the tail re-scores once with the
-        # smaller remainder (matching the per-pod re-scoring sequence).
+        # ---- candidate pick (used by creation AND the zone-seed choice) --
+        # Mirrors the oracle: argmin price / min(ppn, remaining); nodes of the
+        # chosen type are created in bulk while remaining >= ppn, then the
+        # tail re-scores once with the smaller remainder.
         ci_key = jnp.broadcast_to(jnp.arange(C, dtype=jnp.float32)[:, None], (C, D))
         di_key = jnp.broadcast_to(jnp.arange(D, dtype=jnp.float32)[None, :], (C, D))
-        new_ok_nolim = Fd_g & (take_pn[:, None] >= 1.0)
+        new_ok_nolim = Fd_g & (take_pn[:, None] >= 1.0) & new_allowed
 
         def pick(rem, dom_mask, prov_used_cur):
             """argmin over (C, D & dom_mask) of price/min(ppn, rem).
@@ -277,6 +296,45 @@ def _make_step(
             bd = (flat % D).astype(jnp.int32)
             ok = score.reshape(-1)[flat] < BIG
             return bc, bd, ok
+
+        # ---- zone-seed (mode B): the whole group lands in ONE zone — the
+        # earliest open slot's zone, else the best new-node zone (this is what
+        # the sequential oracle converges to: after the first placement every
+        # later pod must join a zone with a matching pod)
+        def _z_seed(_):
+            # only zones with anti-affinity/spread headroom are seedable
+            elb = el & (zone_budget >= 1.0)
+            ok_slots0 = rf & (cap >= 1.0) & elb[jnp.maximum(row_zone, 0)]
+            has0 = jnp.any(ok_slots0)
+            z_first = row_zone[jnp.argmax(ok_slots0)]
+            _bc0, bd0, okp0 = pick(cnt, elb[dom_zone], prov_used)
+            return jnp.where(has0, z_first, jnp.where(okp0, dom_zone[bd0], -1))
+
+        z_star = jax.lax.cond(zone_seed, _z_seed,
+                              lambda _: jnp.int32(-1), operand=None)
+        el = jnp.where(zone_seed, el & (jnp.arange(Z) == z_star), el)
+
+        new_ok_z = jnp.zeros(Z, dtype=bool).at[zone_of_dom].max(jnp.any(new_ok, axis=0))
+        cap_z = jnp.minimum(rowcap_z + jnp.where(new_ok_z, BIGN, 0.0), anti_cap)
+        cap_z = jnp.where(el, cap_z, 0.0)
+
+        # ---- allocation: rows then new nodes ---------------------------
+        def zoned_alloc(_):
+            alloc_z = water_fill(zc_sp, cap_z, cnt, el).astype(jnp.float32)  # [Z]
+            # per-zone prefix allocation over slots in creation order
+            zone1h = (row_zone[:, None] == jnp.arange(Z)[None, :])           # [NR, Z]
+            capz_slots = jnp.where(zone1h, cap[:, None], 0.0)
+            before = jnp.cumsum(capz_slots, axis=0) - capz_slots
+            take_slots = jnp.clip(alloc_z[None, :] - before, 0.0, capz_slots)
+            take = jnp.sum(jnp.where(zone1h, take_slots, 0.0), axis=1)
+            taken_z = jnp.sum(jnp.where(zone1h, take_slots, 0.0), axis=0)
+            rem_z = jnp.maximum(alloc_z - taken_z, 0.0)
+            return take, rem_z
+
+        def simple_alloc(_):
+            take = prefix_allocate(cap, cnt)
+            rem = cnt - jnp.sum(take)
+            return take, jnp.where(jnp.arange(Z) == 0, rem, 0.0)  # placeholder; zone chosen below
 
         state = (res, row_zone, row_dom, row_cand, row_price, active, prov_used,
                  jnp.zeros(NR, dtype=jnp.float32), n_used)
@@ -349,15 +407,46 @@ def _make_step(
             state, _ = stage_pair(state, rem, dom_mask)
             return state
 
-        def create_simple(state):
-            return two_stage(state, jnp.sum(rem_z), jnp.ones(D, dtype=bool))
+        def normal_flow(state):
+            take, rem_z = jax.lax.cond(zoned, zoned_alloc, simple_alloc, operand=None)
 
-        def create_zoned(state):
-            for z in range(Z):  # Z static and small
-                state = two_stage(state, rem_z[z], zone_of_dom == z)
-            return state
+            def create_simple(state):
+                return two_stage(state, jnp.sum(rem_z), jnp.ones(D, dtype=bool))
 
-        state = jax.lax.cond(zoned, create_zoned, create_simple, state)
+            def create_zoned(state):
+                for z in range(Z):  # Z static and small
+                    state = two_stage(state, rem_z[z], zone_of_dom == z)
+                return state
+
+            state = jax.lax.cond(zoned, create_zoned, create_simple, state)
+            return state, take
+
+        def host_seed_flow(state):
+            # mode-B hostname affinity: every pod of the group must land on
+            # the SAME node — first-fit the earliest compatible open slot,
+            # else create one node; the un-fitting remainder is infeasible
+            # (exactly where the sequential oracle ends up: after pod 1 seeds
+            # a node, pods 2..k must join it, and a fresh node is never
+            # admissible again because matching pods now exist).
+            elb = el & (zone_budget >= 1.0)
+            ok_slots = rf & (cap >= 1.0) & elb[jnp.maximum(row_zone, 0)]
+            has = jnp.any(ok_slots)
+            first = jnp.argmax(ok_slots)
+            z_first = jnp.maximum(row_zone[first], 0)
+            take = jnp.zeros(NR, dtype=jnp.float32).at[first].set(
+                jnp.where(has,
+                          jnp.minimum(jnp.minimum(cnt, cap[first]),
+                                      zone_budget[z_first]),
+                          0.0)
+            )
+            bc, bd, okp = pick(cnt, elb[dom_zone], state[6])
+            n_new = jnp.where(~has & okp, 1, 0).astype(jnp.int32)
+            per = jnp.minimum(jnp.minimum(cnt, jnp.maximum(take_pn[bc], 1.0)),
+                              jnp.maximum(zone_budget[dom_zone[bd]], 0.0))
+            state, _ = write_block(state, n_new, per, per, bc, bd)
+            return state, take
+
+        state, take = jax.lax.cond(host_seed, host_seed_flow, normal_flow, state)
         (res, row_zone, row_dom, row_cand, row_price, active, prov_used,
          new_take, n_used) = state
 
@@ -470,6 +559,8 @@ class TpuSolver:
         np_ghs = _pad(st.g_host_spread, pad_g, 0, -1)
         np_ghc = _pad(st.g_host_cap, pad_g, 0, 0)
         np_gza = _pad(st.g_zone_anti, pad_g, 0, -1)
+        np_gzp = _pad(st.g_zone_paff, pad_g, 0, -1)
+        np_ghp = _pad(st.g_host_paff, pad_g, 0, -1)
         np_gsm = _pad(st.g_sel_match, pad_g, 1, False)
         np_gp_ok = _pad(st.gp_ok, pad_g, 0, False)
         np_cvw = _pad(st.cand_vw, pad_c, 0, 0)
@@ -523,6 +614,8 @@ class TpuSolver:
             g_host_spread=jnp.asarray(np_ghs),
             g_host_cap=jnp.asarray(np_ghc),
             g_zone_anti=jnp.asarray(np_gza),
+            g_zone_paff=jnp.asarray(np_gzp),
+            g_host_paff=jnp.asarray(np_ghp),
             g_sel_match=jnp.asarray(np_gsm),
             cand_alloc=jnp.asarray(np_calloc),
             cand_cap=jnp.asarray(np_ccap),
@@ -548,6 +641,7 @@ class TpuSolver:
             place = {
                 "counts": sg, "requests": sg, "g_zone_spread": sg, "g_zone_skew": sg,
                 "g_host_spread": sg, "g_host_cap": sg, "g_zone_anti": sg,
+                "g_zone_paff": sg, "g_host_paff": sg,
                 "g_sel_match": sr, "cand_alloc": sc, "cand_cap": sc,
                 "cand_prov": sc, "cand_price": sc, "cand_avail": sc,
                 "prov_limits": sr, "dom_zone": sr, "ex_ok": sg,

@@ -76,6 +76,12 @@ def random_scenario(seed: int, catalog):
         elif r < 0.55:
             kw["topology_spread"] = [TopologySpreadConstraint(
                 int(rng.integers(1, 3)), L.HOSTNAME, "DoNotSchedule", sel)]
+        elif r < 0.63:
+            kw["affinity_terms"] = [PodAffinityTerm(sel, L.ZONE)]  # self zone paff
+        elif r < 0.70 and d > 0:
+            kw["affinity_terms"] = [PodAffinityTerm(
+                LabelSelector.of({"app": f"d{int(rng.integers(0, d))}"}),
+                L.ZONE if rng.random() < 0.5 else L.HOSTNAME)]
         if rng.random() < 0.25:
             kw["node_selector"] = {L.ZONE: str(rng.choice(zones))}
         if rng.random() < 0.2:

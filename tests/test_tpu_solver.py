@@ -176,6 +176,149 @@ class TestParityConstraints:
         assert tpu.new_node_cost / oracle.new_node_cost <= PARITY + 1e-9
 
 
+class TestPositiveAffinity:
+    """Positive pod-affinity on-device (solver/tpu.py modes A/B/C) vs oracle."""
+
+    def test_zone_self_affinity_seeds_one_zone(self, small_catalog):
+        sel = LabelSelector.of({"app": "web"})
+        pods = [PodSpec(name=f"w{i}", labels={"app": "web"},
+                        requests={"cpu": 1.0},
+                        affinity_terms=[PodAffinityTerm(sel, L.ZONE)],
+                        owner_key="web") for i in range(20)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        zones = {n.zone for n in tpu.nodes}
+        assert len(zones) == 1  # the whole group seeded a single zone
+
+    def test_zone_affinity_follows_other_service(self, small_catalog):
+        sel_a = LabelSelector.of({"app": "a"})
+        # service a is FFD-larger so it places first; b must join a's zone
+        pods = [PodSpec(name=f"a{i}", labels={"app": "a"},
+                        requests={"cpu": 4.0}, owner_key="a",
+                        node_selector={L.ZONE: "zone-1b"}) for i in range(4)]
+        pods += [PodSpec(name=f"b{i}", labels={"app": "b"},
+                         requests={"cpu": 0.5}, owner_key="b",
+                         affinity_terms=[PodAffinityTerm(sel_a, L.ZONE)])
+                 for i in range(8)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        node_zone = {n.name: n.zone for n in tpu.nodes}
+        for i in range(8):
+            assert node_zone[tpu.assignments[f"b{i}"]] == "zone-1b"
+
+    def test_hostname_self_affinity_one_node(self, small_catalog):
+        sel = LabelSelector.of({"app": "pack"})
+        pods = [PodSpec(name=f"p{i}", labels={"app": "pack"},
+                        requests={"cpu": 0.5},
+                        affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME)],
+                        owner_key="pack") for i in range(6)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        # everything that scheduled is on ONE node (both solvers may strand
+        # overflow identically when the $/pod-greedy node pick is small)
+        assert len(set(tpu.assignments.values())) <= 1
+        assert len(tpu.assignments) >= 1
+
+    def test_hostname_self_affinity_overflow_infeasible(self, small_catalog):
+        # more pods than any single node can hold: remainder is infeasible
+        sel = LabelSelector.of({"app": "big"})
+        pods = [PodSpec(name=f"p{i}", labels={"app": "big"},
+                        requests={"cpu": 6.0},
+                        affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME)],
+                        owner_key="big") for i in range(10)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        assert len(tpu.infeasible) > 0
+        assert len({tpu.assignments[p] for p in tpu.assignments}) == 1
+
+    def test_hostname_affinity_to_other_service(self, small_catalog):
+        sel_a = LabelSelector.of({"app": "a"})
+        pods = [PodSpec(name=f"a{i}", labels={"app": "a"},
+                        requests={"cpu": 4.0}, owner_key="a") for i in range(3)]
+        pods += [PodSpec(name=f"b{i}", labels={"app": "b"},
+                         requests={"cpu": 0.25}, owner_key="b",
+                         affinity_terms=[PodAffinityTerm(sel_a, L.HOSTNAME)])
+                 for i in range(6)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        a_nodes = {tpu.assignments[f"a{i}"] for i in range(3)}
+        for i in range(6):
+            assert tpu.assignments[f"b{i}"] in a_nodes
+
+    def test_unmatchable_affinity_infeasible(self, small_catalog):
+        sel = LabelSelector.of({"app": "ghost"})
+        pods = [PodSpec(name="p", labels={"app": "solo"},
+                        requests={"cpu": 0.5},
+                        affinity_terms=[PodAffinityTerm(sel, L.ZONE)])]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        assert "p" in tpu.infeasible
+
+    def test_inexpressible_shape_routes_to_oracle(self, small_catalog):
+        from karpenter_tpu.models.tensorize import device_inexpressible
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        sel = LabelSelector.of({"app": "x"})
+        pod = PodSpec(name="p", labels={"app": "x"}, requests={"cpu": 0.5},
+                      affinity_terms=[PodAffinityTerm(sel, L.ZONE),
+                                      PodAffinityTerm(sel, L.ZONE)])
+        assert device_inexpressible(pod)
+        res = BatchScheduler(backend="tpu").solve([pod], [default_prov()], small_catalog)
+        assert res.n_scheduled == 1
+
+    def test_host_seed_respects_zone_anti_affinity(self, small_catalog):
+        """host_seed_flow must honor the zone anti-affinity cap: a group with
+        self hostname-affinity AND self zone-anti-affinity places at most one
+        matching pod per zone."""
+        sel = LabelSelector.of({"app": "m"})
+        pods = [PodSpec(name=f"p{i}", labels={"app": "m"},
+                        requests={"cpu": 0.5},
+                        affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME),
+                                        PodAffinityTerm(sel, L.ZONE, anti=True)])
+                for i in range(5)]
+        oracle = reference.solve(pods, [default_prov()], small_catalog)
+        st = tensorize(pods, [default_prov()], small_catalog)
+        tpu = solve_tensors(st).result
+        assert tpu.n_scheduled == oracle.n_scheduled
+        assert len(tpu.assignments) <= 1  # one pod on one node max
+
+    def test_zone_seed_avoids_anti_blocked_zone(self, small_catalog):
+        """_z_seed must not lock a seeding group into a zone its own
+        anti-affinity forbids."""
+        blk_sel = LabelSelector.of({"app": "blk"})
+        pods = [PodSpec(name=f"b{i}", labels={"app": "blk"},
+                        requests={"cpu": 4.0},
+                        node_selector={L.ZONE: "zone-1a"}, owner_key="blk")
+                for i in range(2)]
+        self_sel = LabelSelector.of({"app": "w"})
+        pods += [PodSpec(name=f"w{i}", labels={"app": "w"},
+                         requests={"cpu": 0.5}, owner_key="w",
+                         affinity_terms=[PodAffinityTerm(self_sel, L.ZONE),
+                                         PodAffinityTerm(blk_sel, L.ZONE, anti=True)])
+                 for i in range(4)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        assert len(tpu.infeasible) == 0
+        w_zones = {n.zone for n in tpu.nodes
+                   if any(p.name.startswith("w") for p in n.pods)}
+        assert "zone-1a" not in w_zones
+
+    def test_device_pods_with_affinity_to_carved_out_pods(self, small_catalog):
+        """Expressible pods referencing carve-out (oracle-routed) pods must
+        solve AFTER them so co-location counts exist."""
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        selx = LabelSelector.of({"app": "x"})
+        pods = [PodSpec(name=f"x{i}", labels={"app": "x"}, requests={"cpu": 2.0},
+                        affinity_terms=[PodAffinityTerm(selx, L.ZONE),
+                                        PodAffinityTerm(selx, L.ZONE)],
+                        owner_key="x")
+                for i in range(3)]
+        pods += [PodSpec(name=f"y{i}", labels={"app": "y"}, requests={"cpu": 0.5},
+                         affinity_terms=[PodAffinityTerm(selx, L.ZONE)],
+                         owner_key="y")
+                 for i in range(4)]
+        res = BatchScheduler(backend="tpu").solve(pods, [default_prov()], small_catalog)
+        assert res.infeasible == {}, res.infeasible
+        zone_of = {n.name: n.zone for n in res.nodes}
+        x_zones = {zone_of[res.assignments[f"x{i}"]] for i in range(3)}
+        y_zones = {zone_of[res.assignments[f"y{i}"]] for i in range(4)}
+        assert y_zones <= x_zones
+
+
 class TestFeasibilityPaths:
     def test_matmul_equals_gather(self, small_catalog):
         """The MXU matmul label-feasibility path must bit-match the gather
