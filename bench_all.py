@@ -168,7 +168,7 @@ def config4():
     cpu_ms = (time.perf_counter() - t0) * 1000.0
 
     pmax = max(8, max(len(n.pods) for n in nodes))
-    out = screen_delete_candidates(nodes, pmax=pmax)
+    out = screen_delete_candidates(nodes, pmax=pmax, measure=True)
     agree = float((out.deletable == cpu_deletable).mean())
     return {
         "metric": "c4_consolidation_screen_5k_nodes",

@@ -41,6 +41,11 @@ DEFAULT_BATCH_IDLE_AFTER_NO_ACTION = 15.0
 #: above this candidate count, run the one-device-call delete screen
 #: (solver/consolidation.py) before any sequential what-ifs
 SCREEN_THRESHOLD = 32
+#: minimum consolidation candidates before the batched multi-subset screen
+#: runs (below this, the sequential prefix search is cheap and exact)
+SUBSET_SCREEN_MIN = 4
+#: cap on structured subsets screened per pass
+MAX_SUBSETS = 64
 
 
 @dataclass
@@ -192,25 +197,45 @@ class DeprovisioningController:
         if empties:
             return Action("delete", "consolidation", empties)
 
-        # 1b) large clusters: screen all single-node deletes in one device
-        #     call, then confirm the cheapest-disruption hits exactly
-        if len(cands) >= SCREEN_THRESHOLD:
-            from ..solver.consolidation import compat_matrix, screen_delete_candidates
+        # shared screen inputs: compat rows are computed only for candidate
+        # sources (O(|cands| x N) host work, not O(N^2))
+        all_nodes = self.state.schedulable_nodes()
+        idx_of = {n.name: i for i, n in enumerate(all_nodes)}
+        cand_idx = [idx_of[ns.node.name] for _, ns in cands
+                    if ns.node.name in idx_of]
+        compat = None
 
-            all_nodes = self.state.schedulable_nodes()
-            idx_of = {n.name: i for i, n in enumerate(all_nodes)}
-            screen = screen_delete_candidates(all_nodes, compat_matrix(all_nodes))
+        # 1b) large clusters: screen all candidate single-node deletes in one
+        #     device call, then confirm the cheapest-disruption hits exactly
+        if len(cands) >= SCREEN_THRESHOLD:
+            from ..solver.consolidation import compat_matrix, screen_subset_deletes
+
+            compat = compat_matrix(all_nodes, sources=cand_idx)
+            screen = screen_subset_deletes(
+                all_nodes, [[i] for i in cand_idx], compat
+            )
+            deletable_idx = {i for k, i in enumerate(cand_idx)
+                             if screen.deletable[k]}
             for _, ns in cands:
-                i = idx_of.get(ns.node.name)
-                if i is None or not screen.deletable[i]:
+                if idx_of.get(ns.node.name) not in deletable_idx:
                     continue
                 attempt = self._simulate([ns])
                 if attempt is not None and attempt.kind == "delete":
                     return attempt
             # fall through: no screened delete confirmed; try replace paths
 
-        # 2) multi-node: binary search the largest disruption-cost prefix
-        #    that can be deleted together with <=1 replacement
+        # 2a) multi-node subsets: screen MANY structured candidate subsets
+        #     (prefixes, per-type, per-zone groups) in ONE device call, then
+        #     exact-confirm the top few by savings.  Beyond the reference's
+        #     prefix-only heuristic — the win SURVEY §7.6 reserves for the
+        #     device ("vectorized over many candidate sets at once").
+        if len(cands) >= SUBSET_SCREEN_MIN:
+            attempt = self._multi_subset_screen(cands, all_nodes, idx_of, compat)
+            if attempt is not None:
+                return attempt
+
+        # 2b) multi-node: binary search the largest disruption-cost prefix
+        #     that can be deleted together with <=1 replacement
         best_multi = None
         lo, hi = 2, len(cands)
         while lo <= hi:
@@ -228,6 +253,74 @@ class DeprovisioningController:
         for _, ns in cands:
             attempt = self._simulate([ns])
             if attempt is not None:
+                return attempt
+        return None
+
+    def _multi_subsets(self, cands, idx_of) -> List[List[int]]:
+        """Structured subsets (node indices) worth screening: disruption-cost
+        prefixes, per-instance-type groups, per-zone groups."""
+        cand_idx = [idx_of[ns.node.name] for _, ns in cands
+                    if ns.node.name in idx_of]
+        subsets: List[List[int]] = []
+        seen = set()
+
+        def add(ix):
+            ix = sorted(set(ix))
+            if len(ix) < 2:
+                return
+            key = tuple(ix)
+            if key not in seen and len(subsets) < MAX_SUBSETS:
+                seen.add(key)
+                subsets.append(ix)
+
+        size = 2
+        while size <= len(cand_idx):
+            add(cand_idx[:size])
+            size = size + 1 if size < 4 else int(size * 1.5)
+        by_type: Dict[str, List[int]] = {}
+        by_zone: Dict[str, List[int]] = {}
+        for _, ns in cands:
+            i = idx_of.get(ns.node.name)
+            if i is None:
+                continue
+            by_type.setdefault(ns.node.instance_type, []).append(i)
+            by_zone.setdefault(ns.node.zone, []).append(i)
+        for group in list(by_type.values()) + list(by_zone.values()):
+            add(group[:8])
+            add(group[:4])
+        return subsets
+
+    #: exact-confirm at most this many screened subset hits per pass (the
+    #: screen is resource-only; topology-heavy clusters can produce false
+    #: hits, and each confirm is a full solver what-if)
+    MAX_SUBSET_CONFIRMS = 3
+
+    def _multi_subset_screen(self, cands, all_nodes, idx_of, compat) -> Optional[Action]:
+        """One device call over many candidate subsets; exact-confirm the top
+        few screened deletes by savings."""
+        from ..solver.consolidation import compat_matrix, screen_subset_deletes
+
+        subsets = self._multi_subsets(cands, idx_of)
+        if not subsets:
+            return None
+        if compat is None:
+            cand_idx = [idx_of[ns.node.name] for _, ns in cands
+                        if ns.node.name in idx_of]
+            compat = compat_matrix(all_nodes, sources=cand_idx)
+        screen = screen_subset_deletes(all_nodes, subsets, compat)
+        ns_of = {idx_of[ns.node.name]: ns for _, ns in cands
+                 if ns.node.name in idx_of}
+        hits = [
+            (sum(all_nodes[i].price for i in subset), subset)
+            for k, subset in enumerate(subsets) if screen.deletable[k]
+        ]
+        hits.sort(key=lambda t: (-t[0], t[1]))
+        for _, subset in hits[: self.MAX_SUBSET_CONFIRMS]:
+            targets = [ns_of[i] for i in subset if i in ns_of]
+            if len(targets) != len(subset):
+                continue
+            attempt = self._simulate(targets)
+            if attempt is not None and attempt.kind == "delete":
                 return attempt
         return None
 

@@ -1,23 +1,25 @@
 """Batched consolidation what-ifs on the device.
 
 The Go reference evaluates consolidation candidates one simulated scheduling
-pass at a time (SURVEY.md §3.3); this module vectorizes the dominant question
-— "which single nodes could be deleted, with their pods absorbed by the rest
-of the cluster?" — over EVERY candidate at once (SURVEY §7.6: "multi-node
-candidate subsets on-TPU ... the big win vs the Go heuristic").
+pass at a time (SURVEY.md §3.3); this module vectorizes the dominant
+questions — "which single nodes could be deleted, with their pods absorbed by
+the rest of the cluster?" and "which node *subsets* could be deleted
+together?" — over EVERY candidate at once (SURVEY §7.6: "multi-node candidate
+subsets on-TPU ... the big win vs the Go heuristic").
 
-Formulation: for candidate node i, greedily pack node i's pods (largest
-first, same FFD key as the solvers) into the other nodes' residual capacity,
-honoring label/taint compatibility.  One ``vmap`` over candidates of one
-``lax.scan`` over padded pod slots; state is the [N, R] residual matrix per
-candidate.  A cluster of N nodes with <= Pmax pods per candidate costs
-O(N^2 * Pmax * R) flops — dense, regular, MXU/VPU-friendly — and returns a
-boolean per node in a single device call.
+Formulation: for candidate subset S, greedily pack the union of S's pods
+(largest first, same FFD key as the solvers) into the non-members' residual
+capacity, honoring per-(source, target) label/taint compatibility.  One
+``vmap`` over subsets of one ``lax.scan`` over padded pod slots; state is the
+[N, R] residual matrix per subset.  Dense, regular, MXU/VPU-friendly — and
+one device call for the whole screen.
 
-The deprovisioning controller uses this as a *screen*: provably-deletable
-candidates are then confirmed by the exact sequential what-if (cheap, since
-the screen already filtered), preserving decision parity while cutting the
-evaluation count by orders of magnitude on big clusters (BASELINE config #4).
+The kernel is a single module-level jit over shape-bucketed arrays, so
+steady-state controller reconciles hit the persistent jit cache instead of
+recompiling (same pattern as solver/tpu.py's _run_scan).  The screen is
+resource+compat only: topology constraints are NOT evaluated here, so the
+deprovisioning controller exact-confirms every hit with the sequential
+what-if before acting.
 """
 
 from __future__ import annotations
@@ -33,6 +35,8 @@ import numpy as np
 from ..models import labels as L
 from .types import SimNode
 
+_RESOURCES = (L.RESOURCE_CPU, L.RESOURCE_MEMORY, L.RESOURCE_PODS)
+
 
 @dataclass
 class DeleteScreenResult:
@@ -42,111 +46,182 @@ class DeleteScreenResult:
     compile_ms: float
 
 
-def _pod_rows(node: SimNode, resources: List[str], pmax: int) -> np.ndarray:
-    rows = np.zeros((pmax, len(resources)), dtype=np.float32)
-    pods = sorted(
-        node.pods,
-        key=lambda p: -(p.requests.get(L.RESOURCE_CPU, 0.0)
-                        + p.requests.get(L.RESOURCE_MEMORY, 0.0) / (4 * 1024.0**3)),
-    )[:pmax]
-    for i, p in enumerate(pods):
-        for r, name in enumerate(resources):
-            rows[i, r] = p.requests.get(name, 0.0)
-        # the pods resource
-        if L.RESOURCE_PODS in resources:
-            rows[i, resources.index(L.RESOURCE_PODS)] = 1.0
-    return rows
+@dataclass
+class SubsetScreenResult:
+    deletable: np.ndarray        # [K] bool — subset's pods fit on non-members
+    n_subsets: int
+    eval_ms: float
+    compile_ms: float
+
+
+def _ffd_key(p) -> float:
+    return -(p.requests.get(L.RESOURCE_CPU, 0.0)
+             + p.requests.get(L.RESOURCE_MEMORY, 0.0) / (4 * 1024.0**3))
+
+
+def _bucket(n: int, q: int) -> int:
+    return max(q, ((n + q - 1) // q) * q)
+
+
+@jax.jit
+def _screen_kernel(residual, member, pods, src, compat):
+    """[K] bool: per subset, does a greedy first-fit place every pod of the
+    member nodes onto compatible non-member residuals?"""
+
+    def one_subset(member_k, pods_k, src_k):
+        res0 = jnp.where(member_k[:, None], 0.0, residual)
+
+        def place(res, args):
+            pod, s = args
+            ok_t = compat[s] & ~member_k
+            fits = jnp.all(res + 1e-6 >= pod[None, :], axis=1) & ok_t
+            any_fit = jnp.any(fits)
+            idx = jnp.argmax(fits)
+            is_real = jnp.any(pod > 0)
+            deduct = jnp.where(is_real & any_fit, pod, 0.0)
+            res = res.at[idx].add(-deduct)
+            return res, jnp.where(is_real, any_fit, True)
+
+        _, oks = jax.lax.scan(place, res0, (pods_k, src_k))
+        return jnp.all(oks)
+
+    return jax.vmap(one_subset)(member, pods, src)
+
+
+def screen_subset_deletes(
+    nodes: Sequence[SimNode],
+    subsets: Sequence[Sequence[int]],   # K subsets of node indices
+    compat: Optional[np.ndarray] = None,
+    pmax_total: int = 128,
+    measure: bool = False,
+) -> SubsetScreenResult:
+    """One device call: for every candidate subset, can the union of its
+    members' pods fit on the non-members' residual capacity?
+
+    Pods carry their source-node index so ``compat`` stays per-(source,
+    target).  Subsets whose pod union exceeds ``pmax_total`` are
+    conservatively marked undeletable.  With ``measure=True`` the kernel runs
+    twice to split compile_ms from steady-state eval_ms (benchmarks); the
+    default single run is what control loops want.
+    """
+    t0 = time.perf_counter()
+    N = len(nodes)
+    K = len(subsets)
+    R = len(_RESOURCES)
+    # shape bucketing -> persistent jit-cache hits across reconciles
+    NP_ = _bucket(N, 256)
+    KP = _bucket(K, 8)
+
+    residual = np.zeros((NP_, R), dtype=np.float32)
+    for i, n in enumerate(nodes):
+        rem = n.remaining()
+        residual[i] = [max(0.0, rem.get(r, 0.0)) for r in _RESOURCES]
+
+    member = np.zeros((KP, NP_), dtype=bool)
+    pods_mat = np.zeros((KP, pmax_total, R), dtype=np.float32)
+    pods_src = np.zeros((KP, pmax_total), dtype=np.int32)
+    overflow = np.zeros(KP, dtype=bool)
+    pods_ridx = _RESOURCES.index(L.RESOURCE_PODS)
+    for k, subset in enumerate(subsets):
+        member[k, list(subset)] = True
+        entries = [(_ffd_key(p), i, p) for i in subset for p in nodes[i].pods]
+        if len(entries) > pmax_total:
+            overflow[k] = True
+            continue
+        entries.sort(key=lambda e: e[0])
+        for j, (_, i, p) in enumerate(entries):
+            for r, name in enumerate(_RESOURCES):
+                pods_mat[k, j, r] = p.requests.get(name, 0.0)
+            pods_mat[k, j, pods_ridx] = 1.0
+            pods_src[k, j] = i
+
+    cm = np.zeros((NP_, NP_), dtype=bool)
+    if compat is None:
+        cm[:N, :N] = True
+    else:
+        cm[:N, :N] = compat
+
+    args = (jnp.asarray(residual), jnp.asarray(member), jnp.asarray(pods_mat),
+            jnp.asarray(pods_src), jnp.asarray(cm))
+    # NOTE: timings include the (tiny) result readback — block_until_ready
+    # can report completion early through the device tunnel, faking ~0ms
+    # evals; a D2H read of the result is the only reliable fence observed
+    out_host = np.asarray(_screen_kernel(*args))
+    first_ms = (time.perf_counter() - t0) * 1000.0
+    if measure:
+        # median of 3 timed runs on per-run perturbed residuals (outputs
+        # discarded): the device runtime also memoizes executions of
+        # identical (executable, inputs)
+        rng = np.random.default_rng(0)
+        times = []
+        for _ in range(3):
+            res_i = residual + rng.uniform(0.0, 1e-5, residual.shape).astype(np.float32)
+            args_i = (jax.device_put(res_i),) + args[1:]
+            jax.block_until_ready(args_i[0])
+            t1 = time.perf_counter()
+            np.asarray(_screen_kernel(*args_i))
+            times.append((time.perf_counter() - t1) * 1000.0)
+        eval_ms = sorted(times)[1]
+        compile_ms = first_ms
+    else:
+        eval_ms, compile_ms = first_ms, 0.0
+
+    return SubsetScreenResult(
+        deletable=out_host[:K] & ~overflow[:K],
+        n_subsets=K, eval_ms=eval_ms, compile_ms=compile_ms,
+    )
 
 
 def screen_delete_candidates(
     nodes: Sequence[SimNode],
-    compat: Optional[np.ndarray] = None,   # [N, N] pod-source x target compat
+    compat: Optional[np.ndarray] = None,
     pmax: int = 64,
+    measure: bool = False,
 ) -> DeleteScreenResult:
-    """One device call: for every node i, can its pods (up to ``pmax``) fit on
-    the other nodes' residual capacity?
-
-    ``compat[i, j]``: pods of node i may run on node j (labels/taints checked
-    host-side once — O(N^2) string work, amortized by the vectorized pack).
-    Nodes with more than ``pmax`` pods are conservatively marked undeletable.
-    """
-    t0 = time.perf_counter()
-    N = len(nodes)
-    resources = [L.RESOURCE_CPU, L.RESOURCE_MEMORY, L.RESOURCE_PODS]
-    R = len(resources)
-
-    residual = np.zeros((N, R), dtype=np.float32)
-    pods_mat = np.zeros((N, pmax, R), dtype=np.float32)
-    overflow = np.zeros(N, dtype=bool)
-    for i, node in enumerate(nodes):
-        rem = node.remaining()
-        for r, name in enumerate(resources):
-            residual[i, r] = max(0.0, rem.get(name, 0.0))
-        pods_mat[i] = _pod_rows(node, resources, pmax)
-        overflow[i] = len(node.pods) > pmax
-
-    if compat is None:
-        compat = np.ones((N, N), dtype=bool)
-    np.fill_diagonal(compat, False)  # a candidate's own capacity doesn't count
-
-    residual_j = jnp.asarray(residual)
-    pods_j = jnp.asarray(pods_mat)
-    compat_j = jnp.asarray(compat)
-
-    @jax.jit
-    def run():
-        def one_candidate(pods_i, compat_i):
-            # residuals of the *other* nodes (candidate's own rows masked out)
-            res0 = jnp.where(compat_i[:, None], residual_j, 0.0)
-
-            def place(res, pod):
-                # first-fit: lowest-index node where every resource fits
-                fits = jnp.all(res + 1e-6 >= pod[None, :], axis=1)
-                # a zero pod (padding) fits anywhere; mark index 0, deduct 0
-                any_fit = jnp.any(fits)
-                idx = jnp.argmax(fits)
-                is_real = jnp.any(pod > 0)
-                deduct = jnp.where(is_real & any_fit, pod, 0.0)
-                res = res.at[idx].add(-deduct)
-                ok = jnp.where(is_real, any_fit, True)
-                return res, ok
-
-            _, oks = jax.lax.scan(place, res0, pods_i)
-            return jnp.all(oks)
-
-        return jax.vmap(one_candidate)(pods_j, compat_j)
-
-    out = run()
-    jax.block_until_ready(out)
-    compile_ms = (time.perf_counter() - t0) * 1000.0
-    t1 = time.perf_counter()
-    out = run()
-    jax.block_until_ready(out)
-    eval_ms = (time.perf_counter() - t1) * 1000.0
-
-    deletable = np.asarray(out) & ~overflow
+    """Single-node screen = the subset screen over all singletons.  A
+    candidate's own capacity never counts (it is the deleted node)."""
+    if compat is not None:
+        compat = compat.copy()
+        np.fill_diagonal(compat, False)
+    else:
+        compat = ~np.eye(len(nodes), dtype=bool)
+    res = screen_subset_deletes(
+        nodes, [[i] for i in range(len(nodes))], compat,
+        pmax_total=pmax, measure=measure,
+    )
     return DeleteScreenResult(
-        deletable=deletable, n_candidates=N, eval_ms=eval_ms, compile_ms=compile_ms
+        deletable=res.deletable, n_candidates=len(nodes),
+        eval_ms=res.eval_ms, compile_ms=res.compile_ms,
     )
 
 
-def compat_matrix(nodes: Sequence[SimNode]) -> np.ndarray:
+def compat_matrix(
+    nodes: Sequence[SimNode],
+    sources: Optional[Sequence[int]] = None,
+) -> np.ndarray:
     """Host-side label/taint compatibility: pods of node i can run on node j.
 
-    Conservative: every pod of i must tolerate j's taints and have its
-    node-selector satisfied by j's labels (full requirement algebra — the
-    exact sequential what-if re-verifies anything the screen admits).
+    ``sources`` limits the computed rows to those node indices (the screen
+    only reads rows for member/candidate nodes) — O(|sources| * N) string
+    work instead of O(N^2); uncomputed rows stay False.  Conservative: every
+    pod of i must tolerate j's taints and have its node-selector satisfied by
+    j's labels (full requirement algebra — the exact sequential what-if
+    re-verifies anything the screen admits).
     """
     N = len(nodes)
-    out = np.ones((N, N), dtype=bool)
-    for i, src in enumerate(nodes):
-        if not src.pods:
+    src = range(N) if sources is None else sources
+    out = np.zeros((N, N), dtype=bool)
+    for i in src:
+        node_i = nodes[i]
+        if not node_i.pods:
+            out[i, :] = True
+            out[i, i] = False
             continue
         for j, dst in enumerate(nodes):
             if i == j:
                 continue
             ok = True
-            for p in src.pods:
+            for p in node_i.pods:
                 if any(t.blocks(p.tolerations) for t in dst.taints):
                     ok = False
                     break

@@ -699,12 +699,18 @@ class TpuSolver:
             track_assignments=track_assignments, mesh=mesh,
         )
         carry, ys = run(init)
-        jax.block_until_ready(carry)
+        np.asarray(carry[7])  # D2H fence; see timing note below
         compile_ms = (time.perf_counter() - t0) * 1000.0
 
+        # Timing run, results discarded.  Two quirks of the tunneled device
+        # runtime make the naive re-run dishonest: block_until_ready can
+        # acknowledge before execution completes (so we fence with a tiny
+        # D2H read, ~one RTT), and executions with bit-identical inputs can
+        # be deduped to ~0ms (so the re-run gets an epsilon-shifted input).
+        init2 = (init[0] + jnp.float32(1e-9),) + tuple(init[1:])
         t1 = time.perf_counter()
-        carry, ys = run(init)
-        jax.block_until_ready(carry)
+        carry2, _ys2 = run(init2)
+        np.asarray(carry2[7])
         solve_ms = (time.perf_counter() - t1) * 1000.0
 
         return self._extract(

@@ -8,6 +8,7 @@ from karpenter_tpu.models.pod import PodSpec, Taint
 from karpenter_tpu.solver.consolidation import (
     compat_matrix,
     screen_delete_candidates,
+    screen_subset_deletes,
 )
 from karpenter_tpu.solver.types import SimNode
 
@@ -70,6 +71,39 @@ class TestScreen:
         a = mk_node("a", 48.0, [0.1] * 70)  # 70 pods > pmax=64
         b = mk_node("b", 48.0, [])
         res = screen_delete_candidates([a, b], pmax=64)
+        assert not res.deletable[0]
+
+    def test_subset_screen_pairs(self):
+        """Multi-node what-if: a PAIR may be deletable while the triple is
+        not — evaluated for many subsets in one device call."""
+        # two lightly loaded nodes + one absorber with 6 cpu headroom
+        a = mk_node("a", 4.0, [1.0])
+        b = mk_node("b", 4.0, [1.0])
+        c = mk_node("c", 8.0, [2.0])      # 6 cpu free
+        d = mk_node("d", 4.0, [3.5])      # nearly full
+        nodes = [a, b, c, d]
+        res = screen_subset_deletes(
+            nodes, [[0, 1], [0, 1, 3], [2, 3], [0, 1, 2]]
+        )
+        # {a,b}: 2 cpu of pods -> c absorbs. {a,b,d}: 5.5 cpu -> c absorbs.
+        # {c,d}: d's 3.5-cpu pod exceeds a/b's 3-cpu gaps -> no.
+        # {a,b,c}: 4 cpu of pods onto d (0.5 free) -> no.
+        assert res.deletable.tolist() == [True, True, False, False]
+
+    def test_subset_screen_respects_compat(self):
+        a = mk_node("a", 8.0, [1.0])
+        b = mk_node("b", 8.0, [1.0], taints=[Taint("team", L.EFFECT_NO_SCHEDULE, "x")])
+        c = mk_node("c", 8.0, [1.0])
+        compat = compat_matrix([a, b, c])
+        # {a, c}: pods must land on b, but they don't tolerate b's taint
+        res = screen_subset_deletes([a, b, c], [[0, 2], [0]], compat)
+        assert res.deletable.tolist() == [False, True]
+
+    def test_subset_overflow_conservative(self):
+        a = mk_node("a", 48.0, [0.1] * 60)
+        b = mk_node("b", 48.0, [0.1] * 60)
+        c = mk_node("c", 48.0, [])
+        res = screen_subset_deletes([a, b, c], [[0, 1]], pmax_total=100)
         assert not res.deletable[0]
 
     def test_config4_scale_5k_nodes(self):

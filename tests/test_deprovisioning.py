@@ -177,6 +177,32 @@ class TestMultiNode:
         assert not state.pending_pods()
 
 
+class TestMultiSubsetScreen:
+    def test_subset_screen_finds_pairwise_delete(self, small_catalog):
+        """With >= SUBSET_SCREEN_MIN candidates, the batched subset screen
+        runs first and confirms a multi-node delete exactly."""
+        from karpenter_tpu.controllers.deprovisioning import SUBSET_SCREEN_MIN
+
+        clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(
+            small_catalog,
+            Provisioner(name="default", consolidation_enabled=True, requirements=[C2X]),
+        )
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 0.5}, owner_key="d")
+                for i in range(60)]
+        schedule(state, prov_ctrl, clock, pods)
+        n0 = len(state.nodes)
+        assert n0 >= SUBSET_SCREEN_MIN
+        # shrink to a handful of pods so several nodes can empty out together
+        for p in list(state.pods)[: len(state.pods) - 5]:
+            state.delete_pod(p)
+        clock.advance(MIN_NODE_LIFETIME + 1)
+        action = deprov.reconcile()
+        assert action is not None and action.kind == "delete"
+        assert len(action.nodes) >= 2  # a genuine multi-node action
+        pump(prov_ctrl, clock)
+        assert not state.pending_pods()
+
+
 class TestBlockers:
     def test_do_not_evict_blocks(self, small_catalog):
         clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(small_catalog)
