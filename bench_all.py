@@ -206,12 +206,75 @@ def config5():
     return _ffd_and_tpu(pods, provs, catalog, "c5_spot_od_10weighted_provs_5k")
 
 
-CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+def config6():
+    """Interruption-controller throughput at 15k queued messages — the
+    reference's own benchmark shape (interruption_benchmark_test.go runs
+    100/1k/5k/15k SQS messages; no numbers published, so measured here)."""
+    from karpenter_tpu.cloud.fake import FakeCloudProvider
+    from karpenter_tpu.controllers.interruption import (
+        SPOT_INTERRUPTION, STATE_CHANGE, InterruptionController,
+        InterruptionMessage, MessageQueue,
+    )
+    from karpenter_tpu.controllers.state import ClusterState
+    from karpenter_tpu.controllers.termination import TerminationController
+    from karpenter_tpu.events import Recorder
+    from karpenter_tpu.metrics import Registry
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.machine import Machine
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.solver.types import SimNode
+    from karpenter_tpu.utils.clock import FakeClock
+
+    catalog = generate_catalog(full=False)
+    it = catalog[0]
+    rates = {}
+    for n_msgs in (100, 1_000, 5_000, 15_000):
+        clock = FakeClock()
+        state = ClusterState(clock=clock)
+        cloud = FakeCloudProvider(catalog, clock=clock)
+        reg = Registry()
+        term = TerminationController(state, cloud, recorder=Recorder(),
+                                     registry=reg, clock=clock)
+        state.apply_provisioner(Provisioner(name="default"))
+        queue = MessageQueue()
+        ic = InterruptionController(state, term, queue, recorder=Recorder(),
+                                    registry=reg, clock=clock)
+        # 2k-node cluster; messages target real + unknown instances (~50/50)
+        for i in range(2000):
+            node = SimNode(instance_type=it.name, provisioner="default",
+                           zone="zone-1a", capacity_type="spot", price=0.1,
+                           allocatable=dict(it.allocatable), name=f"n{i}")
+            machine = Machine(name=f"m{i}", provider_id=f"i-{i:08d}")
+            state.add_node(node, machine=machine)
+        for i in range(n_msgs):
+            kind = SPOT_INTERRUPTION if i % 2 else STATE_CHANGE
+            iid = f"i-{i % 4000:08d}"  # half miss the cluster
+            queue.send(InterruptionMessage(kind, iid, clock.now(),
+                                           state="stopping"))
+        t0 = time.perf_counter()
+        handled = ic.reconcile()
+        dt = time.perf_counter() - t0
+        assert handled == n_msgs
+        rates[n_msgs] = n_msgs / dt
+    return {
+        "metric": "c6_interruption_controller_msgs_per_sec",
+        "value": round(rates[15_000], 1),
+        "unit": "msgs/s",
+        "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md)
+        "rate_100": round(rates[100], 1),
+        "rate_1k": round(rates[1_000], 1),
+        "rate_5k": round(rates[5_000], 1),
+        "rate_15k": round(rates[15_000], 1),
+    }
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
+           6: config6}
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5",
+    ap.add_argument("--configs", default="1,2,3,4,5,6",
                     help="comma-separated config numbers to run")
     args = ap.parse_args()
     picked = [int(x) for x in args.configs.split(",") if x.strip()]

@@ -197,42 +197,47 @@ class DeprovisioningController:
         if empties:
             return Action("delete", "consolidation", empties)
 
-        # shared screen inputs: compat rows are computed only for candidate
-        # sources (O(|cands| x N) host work, not O(N^2))
-        all_nodes = self.state.schedulable_nodes()
-        idx_of = {n.name: i for i, n in enumerate(all_nodes)}
-        cand_idx = [idx_of[ns.node.name] for _, ns in cands
-                    if ns.node.name in idx_of]
-        compat = None
-
-        # 1b) large clusters: screen all candidate single-node deletes in one
-        #     device call, then confirm the cheapest-disruption hits exactly
-        if len(cands) >= SCREEN_THRESHOLD:
+        # 1b/2a) device screen: candidate singletons (large clusters) AND
+        #     structured multi-subsets (prefixes, per-type, per-zone groups)
+        #     evaluated in ONE device call, then exact-confirmed — singles
+        #     first in disruption order, then the top multi hits by savings.
+        #     Beyond the reference's prefix-only heuristic — the win SURVEY
+        #     §7.6 reserves for the device ("vectorized over many candidate
+        #     sets at once").
+        run_single = len(cands) >= SCREEN_THRESHOLD
+        run_multi = len(cands) >= SUBSET_SCREEN_MIN
+        if run_single or run_multi:
             from ..solver.consolidation import compat_matrix, screen_subset_deletes
 
+            all_nodes = self.state.schedulable_nodes()
+            idx_of = {n.name: i for i, n in enumerate(all_nodes)}
+            cand_idx = [idx_of[ns.node.name] for _, ns in cands
+                        if ns.node.name in idx_of]
+            # compat rows are computed only for candidate sources
+            # (O(|cands| x N) host work, not O(N^2))
             compat = compat_matrix(all_nodes, sources=cand_idx)
-            screen = screen_subset_deletes(
-                all_nodes, [[i] for i in cand_idx], compat
-            )
-            deletable_idx = {i for k, i in enumerate(cand_idx)
-                             if screen.deletable[k]}
-            for _, ns in cands:
-                if idx_of.get(ns.node.name) not in deletable_idx:
-                    continue
-                attempt = self._simulate([ns])
-                if attempt is not None and attempt.kind == "delete":
-                    return attempt
-            # fall through: no screened delete confirmed; try replace paths
+            singles = [[i] for i in cand_idx] if run_single else []
+            multis = self._multi_subsets(cand_idx, cands, idx_of) if run_multi else []
+            screen = screen_subset_deletes(all_nodes, singles + multis, compat)
 
-        # 2a) multi-node subsets: screen MANY structured candidate subsets
-        #     (prefixes, per-type, per-zone groups) in ONE device call, then
-        #     exact-confirm the top few by savings.  Beyond the reference's
-        #     prefix-only heuristic — the win SURVEY §7.6 reserves for the
-        #     device ("vectorized over many candidate sets at once").
-        if len(cands) >= SUBSET_SCREEN_MIN:
-            attempt = self._multi_subset_screen(cands, all_nodes, idx_of, compat)
-            if attempt is not None:
-                return attempt
+            if run_single:
+                deletable_idx = {i for k, i in enumerate(cand_idx)
+                                 if screen.deletable[k]}
+                for _, ns in cands:
+                    if idx_of.get(ns.node.name) not in deletable_idx:
+                        continue
+                    attempt = self._simulate([ns])
+                    if attempt is not None and attempt.kind == "delete":
+                        return attempt
+                # fall through: no screened single confirmed; try multi/replace
+
+            if multis:
+                attempt = self._confirm_subsets(
+                    cands, all_nodes, idx_of, multis,
+                    screen.deletable[len(singles):],
+                )
+                if attempt is not None:
+                    return attempt
 
         # 2b) multi-node: binary search the largest disruption-cost prefix
         #     that can be deleted together with <=1 replacement
@@ -256,11 +261,10 @@ class DeprovisioningController:
                 return attempt
         return None
 
-    def _multi_subsets(self, cands, idx_of) -> List[List[int]]:
+    def _multi_subsets(self, cand_idx, cands, idx_of) -> List[List[int]]:
         """Structured subsets (node indices) worth screening: disruption-cost
-        prefixes, per-instance-type groups, per-zone groups."""
-        cand_idx = [idx_of[ns.node.name] for _, ns in cands
-                    if ns.node.name in idx_of]
+        prefixes (always including the full candidate set), per-instance-type
+        groups, per-zone groups."""
         subsets: List[List[int]] = []
         seen = set()
 
@@ -277,6 +281,7 @@ class DeprovisioningController:
         while size <= len(cand_idx):
             add(cand_idx[:size])
             size = size + 1 if size < 4 else int(size * 1.5)
+        add(cand_idx)  # the geometric ladder can step over the full set
         by_type: Dict[str, List[int]] = {}
         by_zone: Dict[str, List[int]] = {}
         for _, ns in cands:
@@ -295,24 +300,14 @@ class DeprovisioningController:
     #: hits, and each confirm is a full solver what-if)
     MAX_SUBSET_CONFIRMS = 3
 
-    def _multi_subset_screen(self, cands, all_nodes, idx_of, compat) -> Optional[Action]:
-        """One device call over many candidate subsets; exact-confirm the top
-        few screened deletes by savings."""
-        from ..solver.consolidation import compat_matrix, screen_subset_deletes
-
-        subsets = self._multi_subsets(cands, idx_of)
-        if not subsets:
-            return None
-        if compat is None:
-            cand_idx = [idx_of[ns.node.name] for _, ns in cands
-                        if ns.node.name in idx_of]
-            compat = compat_matrix(all_nodes, sources=cand_idx)
-        screen = screen_subset_deletes(all_nodes, subsets, compat)
+    def _confirm_subsets(self, cands, all_nodes, idx_of, subsets,
+                         deletable) -> Optional[Action]:
+        """Exact-confirm the top screened multi-subset deletes by savings."""
         ns_of = {idx_of[ns.node.name]: ns for _, ns in cands
                  if ns.node.name in idx_of}
         hits = [
             (sum(all_nodes[i].price for i in subset), subset)
-            for k, subset in enumerate(subsets) if screen.deletable[k]
+            for k, subset in enumerate(subsets) if deletable[k]
         ]
         hits.sort(key=lambda t: (-t[0], t[1]))
         for _, subset in hits[: self.MAX_SUBSET_CONFIRMS]:

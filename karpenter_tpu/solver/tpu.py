@@ -381,15 +381,24 @@ def _make_step(
             per = jnp.where(cap_row > 0, jnp.floor((head + 1e-6) / jnp.maximum(cap_row, 1e-9)), BIGN)
             return jnp.clip(jnp.min(per), 0.0, BIGN)
 
-        def stage_pair(state, rem, dom_mask):
-            """One (bulk, tail) creation round; returns leftover pods."""
-            bc, bd, ok = pick(rem, dom_mask, state[6])
+        def stage_pair(state, rem, dom_mask, score_rem):
+            """One (bulk, tail) creation round; returns leftover pods.
+
+            ``score_rem`` is the remaining count used in the $/pod scoring
+            denominator — the GROUP's remainder, not this zone's share.  The
+            sequential oracle scores every placement against the whole
+            group's remaining pods (reference.py _best_in_zone), so a
+            3-zone-spread group still buys node types sized for the full
+            group; scoring per-zone thirds buys smaller types and ~2x the
+            node count at similar cost."""
+            bc, bd, ok = pick(score_rem, dom_mask, state[6])
             ppn_b = jnp.maximum(take_pn[bc], 1.0)
             n_bulk_f = jnp.where(ok, jnp.floor(rem / ppn_b), 0.0)
             n_bulk = jnp.minimum(n_bulk_f, limit_headroom(state[6], bc)).astype(jnp.int32)
             state, took_b = write_block(state, n_bulk, ppn_b, ppn_b, bc, bd)
             rem_t = jnp.maximum(rem - took_b, 0.0)
-            ct_, dt_, ok_t = pick(rem_t, dom_mask, state[6])
+            score_t = jnp.maximum(score_rem - took_b, rem_t)
+            ct_, dt_, ok_t = pick(score_t, dom_mask, state[6])
             ppn_t = jnp.maximum(take_pn[ct_], 1.0)
             n_tail_f = jnp.where(ok_t & (rem_t > 0), jnp.ceil(rem_t / ppn_t), 0.0)
             n_tail = jnp.minimum(n_tail_f, limit_headroom(state[6], ct_)).astype(jnp.int32)
@@ -399,12 +408,14 @@ def _make_step(
             )
             return state, jnp.maximum(rem_t - took_t, 0.0)
 
-        def two_stage(state, rem, dom_mask):
+        def two_stage(state, rem, dom_mask, score_rem=None):
             # round 2 only fires when a provisioner limit (or slot budget)
             # clamped round 1; pick() re-derives limit feasibility, so the
             # remainder falls back to the next-best candidate type.
-            state, rem = stage_pair(state, rem, dom_mask)
-            state, _ = stage_pair(state, rem, dom_mask)
+            if score_rem is None:
+                score_rem = rem
+            state, left = stage_pair(state, rem, dom_mask, score_rem)
+            state, _ = stage_pair(state, left, dom_mask, jnp.maximum(score_rem - (rem - left), left))
             return state
 
         def normal_flow(state):
@@ -414,8 +425,11 @@ def _make_step(
                 return two_stage(state, jnp.sum(rem_z), jnp.ones(D, dtype=bool))
 
             def create_zoned(state):
+                left = jnp.sum(rem_z)
                 for z in range(Z):  # Z static and small
-                    state = two_stage(state, rem_z[z], zone_of_dom == z)
+                    state = two_stage(state, rem_z[z], zone_of_dom == z,
+                                      score_rem=left)
+                    left = jnp.maximum(left - rem_z[z], 0.0)
                 return state
 
             state = jax.lax.cond(zoned, create_zoned, create_simple, state)
