@@ -14,7 +14,15 @@ from ..batcher import Window
 from ..cache import UnavailableOfferings
 from ..cloud.base import CloudProvider, InsufficientCapacityError
 from ..events import Event, Recorder
-from ..metrics import BATCH_SIZE, NODES_CREATED, Registry, registry as default_registry
+from ..metrics import (
+    BATCH_SIZE,
+    NODES_CREATED,
+    PODS_STARTUP_DURATION,
+    PROVISIONER_LIMIT,
+    PROVISIONER_USAGE,
+    Registry,
+    registry as default_registry,
+)
 from ..models import labels as L
 from ..models.machine import Machine
 from ..models.pod import PodSpec
@@ -136,7 +144,37 @@ class ProvisioningController:
             for pod in node.pods:
                 if pod.name in self.state.pods:
                     self.state.bind(pod.name, launched.name)
+        self._observe_bind_latency(result)
+        self._update_limit_gauges()
         return result
+
+    def _observe_bind_latency(self, result: SolveResult) -> None:
+        """Pod startup latency: add_pod -> bound (pods_startup_time analog)."""
+        now = self.clock.now()
+        hist = self.registry.histogram(PODS_STARTUP_DURATION)
+        for pod_name in result.assignments:
+            if pod_name in self.state.bindings:
+                t0 = self.state.pod_added_at.get(pod_name)
+                if t0 is not None:
+                    hist.observe(max(0.0, now - t0))
+
+    def _update_limit_gauges(self) -> None:
+        """Per-provisioner usage vs configured limits (metrics.md gauges)."""
+        usage: dict = {}
+        for ns in self.state.nodes.values():
+            prov_name = ns.node.labels.get(L.PROVISIONER_NAME, "")
+            if not prov_name:
+                continue
+            per = usage.setdefault(prov_name, {})
+            for rname, v in ns.node.allocatable.items():
+                per[rname] = per.get(rname, 0.0) + v
+        for prov_name, prov in self.state.provisioners.items():
+            for rname, v in usage.get(prov_name, {}).items():
+                self.registry.gauge(PROVISIONER_USAGE).set(
+                    v, {"provisioner": prov_name, "resource_type": rname})
+            for rname, lim in prov.limits.items():
+                self.registry.gauge(PROVISIONER_LIMIT).set(
+                    lim, {"provisioner": prov_name, "resource_type": rname})
 
     def _machine_for(self, node: SimNode, provisioners) -> Machine:
         """Build the Machine (desired-node) spec from a solver-proposed node,

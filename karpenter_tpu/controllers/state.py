@@ -40,6 +40,7 @@ class ClusterState:
         self.bindings: Dict[str, str] = {}  # pod name -> node name
         self.provisioners: Dict[str, Provisioner] = {}
         self.daemonsets: List[PodSpec] = []
+        self.pod_added_at: Dict[str, float] = {}  # feeds pod-startup latency
         self.seqnum = 0  # bumps on any change; consolidation backs off on no-change
 
     # ---- mutation ------------------------------------------------------
@@ -59,10 +60,12 @@ class ClusterState:
 
     def add_pod(self, pod: PodSpec) -> None:
         self.pods[pod.name] = pod
+        self.pod_added_at.setdefault(pod.name, self.clock.now())
         self._changed()
 
     def delete_pod(self, name: str) -> None:
         self.pods.pop(name, None)
+        self.pod_added_at.pop(name, None)
         node_name = self.bindings.pop(name, None)
         if node_name and node_name in self.nodes:
             ns = self.nodes[node_name]

@@ -119,6 +119,51 @@ PROVISIONER_LIMIT = "karpenter_provisioner_limit"
 BATCH_SIZE = "karpenter_provisioner_batch_size"
 SOLVER_BACKEND_DURATION = "karpenter_solver_backend_duration_seconds"
 
+#: metric inventory: name -> (type, labels, help).  docs/METRICS.md is
+#: generated from this table (``karpenter-tpu metrics-doc``), mirroring the
+#: reference's docs-from-metric-definitions generation (Makefile:150-153).
+INVENTORY = {
+    SCHEDULING_DURATION: (
+        "histogram", (),
+        "End-to-end batch scheduling duration per solve, seconds."),
+    CLOUDPROVIDER_DURATION: (
+        "histogram", ("controller", "method"),
+        "Duration of each CloudProvider method call (metrics decorator)."),
+    NODES_CREATED: (
+        "counter", ("provisioner",),
+        "Nodes launched, by provisioner."),
+    NODES_TERMINATED: (
+        "counter", ("provisioner",),
+        "Nodes terminated, by provisioner."),
+    DEPROVISIONING_ACTIONS: (
+        "counter", ("action",),
+        "Deprovisioning actions performed (kind/mechanism)."),
+    DEPROVISIONING_DURATION: (
+        "histogram", (),
+        "Deprovisioning evaluation pass duration, seconds."),
+    INTERRUPTION_RECEIVED: (
+        "counter", ("message_type",),
+        "Interruption queue messages received, by message type."),
+    INTERRUPTION_LATENCY: (
+        "histogram", ("message_type",),
+        "Delay from interruption event timestamp to handling, seconds."),
+    PODS_STARTUP_DURATION: (
+        "histogram", (),
+        "Time from pod creation to bound-and-running, seconds."),
+    PROVISIONER_USAGE: (
+        "gauge", ("provisioner", "resource_type"),
+        "Resource usage accounted against each provisioner's limits."),
+    PROVISIONER_LIMIT: (
+        "gauge", ("provisioner", "resource_type"),
+        "Configured provisioner resource limits."),
+    BATCH_SIZE: (
+        "histogram", (),
+        "Pending pods per provisioning batch window."),
+    SOLVER_BACKEND_DURATION: (
+        "histogram", ("backend",),
+        "Per-backend (tpu / native / oracle) solve duration, seconds."),
+}
+
 
 def decorate(provider, reg: Optional[Registry] = None):
     """Wrap every public method of a CloudProvider in a duration histogram

@@ -10,8 +10,12 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-BIG = jnp.float32(3.4e38)
+# host-side constant on purpose: a module-level jnp scalar would initialize
+# the device backend at import time, which makes oracle-only code paths (and
+# the CLI) depend on a live/reachable accelerator
+BIG = np.float32(3.4e38)
 
 
 def gather_pm_bits(pm_g: jnp.ndarray, vw: jnp.ndarray, vb: jnp.ndarray) -> jnp.ndarray:

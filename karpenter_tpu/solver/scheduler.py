@@ -159,12 +159,18 @@ class BatchScheduler:
         unavailable, allow_new_nodes, max_new_nodes,
     ) -> SolveResult:
         if self.backend == "oracle":
-            return oracle_solve(
-                pods, provisioners, instance_types,
-                existing_nodes=existing_nodes, daemonsets=daemonsets,
-                unavailable=unavailable, allow_new_nodes=allow_new_nodes,
-                max_new_nodes=max_new_nodes,
-            )
+            t0 = time.perf_counter()
+            try:
+                return oracle_solve(
+                    pods, provisioners, instance_types,
+                    existing_nodes=existing_nodes, daemonsets=daemonsets,
+                    unavailable=unavailable, allow_new_nodes=allow_new_nodes,
+                    max_new_nodes=max_new_nodes,
+                )
+            finally:
+                self.registry.histogram(SOLVER_BACKEND_DURATION).observe(
+                    time.perf_counter() - t0, {"backend": "oracle"}
+                )
         return self._solve_tpu(
             pods, provisioners, instance_types, existing_nodes, daemonsets,
             unavailable, allow_new_nodes, max_new_nodes,

@@ -53,7 +53,8 @@ from ..models.tensorize import NO_SELECTOR, SolveTensors
 from ..ops.masks import BIG, gather_pm_bits, lex_argmin, prefix_allocate, water_fill
 from .types import SimNode, SolveResult
 
-BIGN = jnp.float32(1e9)  # "unbounded" node/pod counts
+# host-side on purpose (see ops/masks.py BIG): no device init at import time
+BIGN = np.float32(1e9)  # "unbounded" node/pod counts
 
 
 # ---------------------------------------------------------------------------

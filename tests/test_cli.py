@@ -1,0 +1,117 @@
+"""CLI surface (`karpenter-tpu` / karpenter_tpu/cli.py)."""
+
+import json
+
+import pytest
+
+from karpenter_tpu.cli import main
+
+
+def test_solve_generated(capsys):
+    rc = main(["solve", "--small", "--pods", "12", "--backend", "oracle",
+               "--compact"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["scheduled"] == 12
+    assert out["infeasible"] == 0
+    assert out["new_nodes"] >= 1
+
+
+def test_solve_scenario_file(tmp_path, capsys):
+    doc = {
+        "pods": [{"name": f"w{i}", "requests": {"cpu": 2.0}} for i in range(4)],
+        "provisioners": [{"name": "default"}],
+    }
+    f = tmp_path / "scenario.json"
+    f.write_text(json.dumps(doc))
+    rc = main(["solve", "--small", "--scenario", str(f), "--backend", "oracle",
+               "--assignments", "--compact"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert set(out["assignments"]) == {"w0", "w1", "w2", "w3"}
+
+
+def test_solve_infeasible_exit_code(tmp_path, capsys):
+    doc = {"pods": [{"name": "giant", "requests": {"cpu": 10000.0}}]}
+    f = tmp_path / "s.json"
+    f.write_text(json.dumps(doc))
+    rc = main(["solve", "--small", "--scenario", str(f), "--backend", "oracle",
+               "--compact"])
+    assert rc == 3
+
+
+def test_metrics_doc_up_to_date(tmp_path, capsys):
+    """docs/METRICS.md must match the inventory (regenerate via
+    `karpenter-tpu metrics-doc` after metric changes)."""
+    rc = main(["metrics-doc", "--check", "--out", "docs/METRICS.md"])
+    assert rc == 0
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    assert "karpenter-tpu" in capsys.readouterr().out
+
+
+def test_inventory_metrics_are_emitted(small_catalog):
+    """Every metric documented in metrics.INVENTORY must actually be emitted
+    by a full provision -> interrupt -> consolidate controller pass (the
+    generated docs must not advertise dead series)."""
+    from karpenter_tpu.cloud.fake import FakeCloudProvider
+    from karpenter_tpu.controllers.deprovisioning import (
+        MIN_NODE_LIFETIME, DeprovisioningController,
+    )
+    from karpenter_tpu.controllers.interruption import (
+        SPOT_INTERRUPTION, InterruptionController, InterruptionMessage,
+        MessageQueue,
+    )
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.controllers.state import ClusterState
+    from karpenter_tpu.controllers.termination import TerminationController
+    from karpenter_tpu.events import Recorder
+    from karpenter_tpu.metrics import INVENTORY, Registry, decorate
+    from karpenter_tpu.models.pod import PodSpec
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.solver.scheduler import BatchScheduler
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    state = ClusterState(clock=clock)
+    reg = Registry()
+    cloud = decorate(FakeCloudProvider(small_catalog, clock=clock), reg)
+    rec = Recorder()
+    sched = BatchScheduler(backend="oracle", registry=reg)
+    prov_ctrl = ProvisioningController(state, cloud, scheduler=sched,
+                                       recorder=rec, registry=reg, clock=clock)
+    term = TerminationController(state, cloud, recorder=rec, registry=reg, clock=clock)
+    deprov = DeprovisioningController(state, cloud, term, provisioning=prov_ctrl,
+                                      scheduler=sched, recorder=rec,
+                                      registry=reg, clock=clock)
+    queue = MessageQueue()
+    ic = InterruptionController(state, term, queue, recorder=rec,
+                                registry=reg, clock=clock)
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.requirements import IN, Requirement
+
+    state.apply_provisioner(Provisioner(
+        name="default", consolidation_enabled=True, limits={"cpu": 1000.0},
+        requirements=[Requirement(L.INSTANCE_TYPE, IN, ["c5.2xlarge"])],
+    ))
+    for i in range(30):
+        state.add_pod(PodSpec(name=f"p{i}", requests={"cpu": 0.5}, owner_key="d"))
+    prov_ctrl.reconcile(); clock.advance(1.5); prov_ctrl.reconcile()
+    assert len(state.nodes) >= 2
+    ns = next(iter(state.nodes.values()))
+    queue.send(InterruptionMessage(SPOT_INTERRUPTION,
+                                   ns.machine.provider_id, clock.now()))
+    ic.reconcile()
+    prov_ctrl.reconcile(); clock.advance(1.5); prov_ctrl.reconcile()
+    # shrink the workload so consolidation finds a delete
+    for p in list(state.pods)[: len(state.pods) - 3]:
+        state.delete_pod(p)
+    clock.advance(MIN_NODE_LIFETIME + 1)
+    action = deprov.reconcile()
+    assert action is not None
+
+    emitted = (set(reg.counters) | set(reg.gauges) | set(reg.histograms))
+    missing = set(INVENTORY) - emitted
+    assert not missing, f"documented metrics never emitted: {sorted(missing)}"
