@@ -29,7 +29,11 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if not _SO.exists() and _SRC.exists():
+    stale = (
+        _SRC.exists()
+        and (not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime)
+    )
+    if stale:
         subprocess.run(
             ["g++", "-O2", "-fPIC", "-shared", "-Wall", "-std=c++17",
              "-o", str(_SO), str(_SRC)],
@@ -87,14 +91,13 @@ def feasibility_numpy(st: SolveTensors):
 
 
 def has_topology(st: SolveTensors) -> bool:
-    """Groups with zone/hostname constraints need the zoned solver paths."""
+    """Groups the native tier can't express: positive pod-affinity (modes
+    A/B/C live on the device / oracle).  Zone/hostname spread and
+    anti-affinity ARE handled natively (ffd.cpp place_constrained)."""
     import numpy as _np
 
     return bool(
-        _np.any(st.g_zone_spread >= 0)
-        or _np.any(st.g_host_spread >= 0)
-        or _np.any(st.g_zone_anti >= 0)
-        or _np.any(st.g_zone_paff >= 0)
+        _np.any(st.g_zone_paff >= 0)
         or _np.any(st.g_host_paff >= 0)
     )
 
