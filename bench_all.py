@@ -29,7 +29,7 @@ def _ffd_and_tpu(pods, provs, catalog, label):
     cpu_ms = (time.perf_counter() - t0) * 1000.0
 
     st = tensorize(pods, provs, catalog)
-    out = solve_tensors(st, track_assignments=False)
+    out = solve_tensors(st, track_assignments=False, measure=True)
     tpu = out.result
     cost_ratio = (
         tpu.new_node_cost / oracle.new_node_cost if oracle.new_node_cost > 0 else 1.0
@@ -278,8 +278,19 @@ def main():
                     help="comma-separated config numbers to run")
     args = ap.parse_args()
     picked = [int(x) for x in args.configs.split(",") if x.strip()]
+    import os
+
+    from bench import arm_watchdog, ensure_backend
+
+    arm_watchdog(float(os.environ.get("BENCH_DEADLINE_S", "3000")),
+                 metric="bench_all_sweep")
+    ensure_backend()
     for n in picked:
-        rec = CONFIGS[n]()
+        try:
+            rec = CONFIGS[n]()
+        except Exception as e:  # one bad config must not kill the sweep
+            rec = {"metric": f"c{n}", "value": None, "unit": "ms",
+                   "vs_baseline": None, "error": f"{type(e).__name__}: {e}"[:500]}
         rec = {"config": n, **rec}
         print(json.dumps(rec), flush=True)
 

@@ -8,3 +8,25 @@ compiled by JAX/XLA for TPU.
 """
 
 __version__ = "0.1.0"
+
+# Honor an explicit JAX_PLATFORMS env contract at the config layer.  The
+# deployment image's sitecustomize force-registers the axon TPU plugin even
+# when JAX_PLATFORMS=cpu is exported, so the env var alone doesn't stop
+# jax.devices() from initializing (and possibly hanging on) the TPU tunnel;
+# the config update does.  Only applied when the operator set the var.
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception as _e:  # pin didn't apply: say so, loudly — a silent
+        import warnings as _warnings  # drop re-exposes the TPU-tunnel hang
+
+        _warnings.warn(
+            f"karpenter_tpu: could not apply JAX_PLATFORMS="
+            f"{_os.environ['JAX_PLATFORMS']} at the jax config layer ({_e!r}); "
+            "accelerator plugins may still initialize",
+            RuntimeWarning,
+        )

@@ -24,6 +24,7 @@ from .base import (
     InsufficientCapacityError,
     MachineNotFoundError,
 )
+from .launchpath import select_launch_types
 
 _instance_counter = itertools.count()
 
@@ -53,6 +54,7 @@ class FakeCloudProvider(CloudProvider):
         self.ice_offerings: Set[Tuple[str, str, str]] = set()  # (type, zone, ct)
         self.create_calls: List[Machine] = []
         self.delete_calls: List[str] = []
+        self.launch_selections: List = []  # LaunchSelection per create (call capture)
         self.next_error: Optional[Exception] = None
         self.allow_creates = True
 
@@ -75,17 +77,27 @@ class FakeCloudProvider(CloudProvider):
         if not self.allow_creates:
             raise RuntimeError("creates disabled")
 
-        # resolve the cheapest offering satisfying the machine's requirements,
-        # mirroring instance.go:406-438 (spot iff allowed, else lowest price)
-        choice, iced = self._resolve(machine)
+        # full reference launch pipeline (filter -> price-sort -> 60-cap ->
+        # capacity-type choice), then fleet semantics: walk offerings of the
+        # chosen capacity type cheapest-first, skipping ICE'd pools the way
+        # CreateFleet's lowest-price strategy tries the next pool
+        # (instance.go:83-87,201-259,405-529)
+        sel = select_launch_types(machine, self.instance_types)
+        machine.launch_warnings = list(sel.warnings)
+        self.launch_selections.append(sel)
+        choice, iced = self._resolve_fleet(machine, sel)
         if choice is None:
-            if iced is not None:
-                # every matching offering is ICE'd: surface the cheapest one's
+            if iced:
+                # every matching pool is ICE'd: surface the cheapest one's
                 # coordinates (what a CreateFleet ICE error carries)
-                raise InsufficientCapacityError(iced[0].name, iced[1].zone, iced[1].capacity_type)
+                it0, o0 = iced[0]
+                raise InsufficientCapacityError(it0.name, o0.zone, o0.capacity_type)
             wanted = sorted(machine.requirements.get(L.INSTANCE_TYPE).values)
             raise InsufficientCapacityError(wanted[0] if wanted else "<any>", "<any>", "<any>")
         it, offering = choice
+        # ICE'd pools skipped on the way to success still get reported so the
+        # controller can blacklist them (instance.go:395-401)
+        machine.ice_errors = [(i.name, o.zone, o.capacity_type) for i, o in iced]
 
         pid = f"fake://{it.name}/{next(_instance_counter)}"
         machine.provider_id = pid
@@ -113,31 +125,28 @@ class FakeCloudProvider(CloudProvider):
         )
         return machine
 
-    def _resolve(self, machine: Machine):
-        """Returns (choice, cheapest_iced): cheapest launchable offering
-        satisfying the machine requirements, plus the cheapest ICE'd match
-        (for the error path when nothing is launchable)."""
-        best = None
-        best_iced = None
+    def _resolve_fleet(self, machine: Machine, sel):
+        """Fleet launch over the selected types: cheapest non-ICE'd pool of
+        the chosen capacity type wins; ICE'd pools encountered cheaper than
+        the winner are collected (price-ordered) for blacklist feedback."""
         reqs = machine.requirements
-        type_req = reqs.get(L.INSTANCE_TYPE)
         zone_req = reqs.get(L.ZONE)
-        ct_req = reqs.get(L.CAPACITY_TYPE)
-        for it in self.instance_types:
-            if not type_req.contains(it.name):
-                continue
+        pools = []
+        for it in sel.instance_types:
             for o in it.offerings:
-                if not o.available:
+                if not o.available or o.capacity_type != sel.capacity_type:
                     continue
-                if not zone_req.contains(o.zone) or not ct_req.contains(o.capacity_type):
+                if not zone_req.contains(o.zone):
                     continue
-                if (it.name, o.zone, o.capacity_type) in self.ice_offerings:
-                    if best_iced is None or o.price < best_iced[1].price:
-                        best_iced = (it, o)
-                    continue
-                if best is None or o.price < best[1].price:
-                    best = (it, o)
-        return best, best_iced
+                pools.append((it, o))
+        pools.sort(key=lambda p: (p[1].price, p[0].name, p[1].zone))
+        iced = []
+        for it, o in pools:
+            if (it.name, o.zone, o.capacity_type) in self.ice_offerings:
+                iced.append((it, o))
+                continue
+            return (it, o), iced
+        return None, iced
 
     def delete(self, machine: Machine) -> None:
         self.delete_calls.append(machine.provider_id)

@@ -119,6 +119,15 @@ class ProvisioningController:
                 ))
                 # pods stay pending; next reconcile re-solves around the ICE
                 continue
+            # ICE'd pools the fleet skipped on the way to success still feed
+            # the blacklist (instance.go:395-401); flexibility warnings
+            # surface as events (checkODFallback, instance.go:261-281)
+            for t, z, ct in machine.ice_errors:
+                self.unavailable.mark_unavailable(t, z, ct)
+            for w in machine.launch_warnings:
+                self.recorder.publish(Event(
+                    "Machine", machine.name, "OnDemandFlexibility", w, "Warning",
+                ))
             self.registry.counter(NODES_CREATED).inc(
                 {"provisioner": machine.provisioner}
             )

@@ -40,6 +40,10 @@ class Machine:
     launched_at: Optional[float] = None
     registered: bool = False
     initialized: bool = False
+    # launch diagnostics (set by the cloud layer): ICE'd offerings skipped on
+    # the way to a successful fleet launch, and flexibility warnings
+    ice_errors: List[tuple] = field(default_factory=list)  # (type, zone, ct)
+    launch_warnings: List[str] = field(default_factory=list)
 
     def __post_init__(self) -> None:
         if not self.name:

@@ -294,7 +294,12 @@ def tensorize(
                 g_host_paff[gi] = sid
         for tsc in rep.topology_spread:
             if not tsc.hard:
-                continue  # ScheduleAnyway is advisory; v1 ignores soft spread
+                # ScheduleAnyway reaches the solver only pre-hardened: the
+                # scheduler folds soft spreads into the relaxation ladder
+                # (scheduler._harden_preferences), so by the time tensors are
+                # built every honored spread is DoNotSchedule; leftovers here
+                # are preferences already relaxed away
+                continue
             sid = slots.intern(tsc.label_selector, tsc.topology_key, "spread")
             if tsc.topology_key == L.ZONE:
                 g_zone_spread[gi] = sid

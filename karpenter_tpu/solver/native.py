@@ -19,28 +19,58 @@ from ..models import labels as L
 from ..models.tensorize import SolveTensors
 from .types import SimNode, SolveResult
 
-_SO = Path(__file__).with_name("_native.so")
 _SRC = Path(__file__).resolve().parents[2] / "native" / "ffd.cpp"
 
 _lib = None
+
+#: kt_ffd_solve arity: 9 dims + 23 input arrays + 7 output arrays.  Declared
+#: so a source/binding mismatch fails loudly (ctypes arity check) instead of
+#: corrupting the stack.
+_N_DIMS = 9
+_N_ARRAYS = 30
+
+
+def _so_path() -> Path:
+    """Build artifact keyed on the source content hash: a fresh checkout (or
+    an edited ffd.cpp) always compiles its own binary; stale binaries from
+    other source revisions are never loaded (mtimes are unreliable on fresh
+    clones — every file gets checkout time)."""
+    import hashlib
+
+    h = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:12]
+    return Path(__file__).with_name(f"_native_{h}.so")
 
 
 def _load():
     global _lib
     if _lib is not None:
         return _lib
-    stale = (
-        _SRC.exists()
-        and (not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime)
-    )
-    if stale:
-        subprocess.run(
-            ["g++", "-O2", "-fPIC", "-shared", "-Wall", "-std=c++17",
-             "-o", str(_SO), str(_SRC)],
-            check=True,
-        )
-    lib = ctypes.CDLL(str(_SO))
+    so = _so_path()
+    if not so.exists():
+        # compile to a private temp path, then atomically publish: concurrent
+        # processes (operator + bench, parallel pytest) must never CDLL a
+        # half-written ELF
+        import os
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(so.parent))
+        os.close(fd)
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-fPIC", "-shared", "-Wall", "-std=c++17",
+                 "-o", tmp, str(_SRC)],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, so)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    lib = ctypes.CDLL(str(so))
     lib.kt_ffd_solve.restype = ctypes.c_int
+    lib.kt_ffd_solve.argtypes = (
+        [ctypes.c_int] * _N_DIMS + [ctypes.c_void_p] * _N_ARRAYS
+    )
     lib.kt_version.restype = ctypes.c_char_p
     _lib = lib
     return lib
@@ -93,7 +123,9 @@ def feasibility_numpy(st: SolveTensors):
 def has_topology(st: SolveTensors) -> bool:
     """Groups the native tier can't express: positive pod-affinity (modes
     A/B/C live on the device / oracle).  Zone/hostname spread and
-    anti-affinity ARE handled natively (ffd.cpp place_constrained)."""
+    anti-affinity ARE handled natively (ffd.cpp place_constrained) — the
+    binding marshals ex_zone/ex_selcnt/zc0 so the constrained path sees real
+    existing-cluster topology state."""
     import numpy as _np
 
     return bool(
@@ -117,23 +149,42 @@ def solve_tensors_native(
     lib = _load()
     t0 = time.perf_counter()
     G, C, D, R = st.G, max(1, st.C), st.D, st.R
+    S = st.S
+    Z = max(1, st.n_zones)
+    P = st.prov_limits.shape[0]
     NE = len(existing_nodes)
-    NR = max(1, (max_nodes if max_nodes is not None else NE + int(st.counts.sum())))
+    NR = max(1, NE, (max_nodes if max_nodes is not None else NE + int(st.counts.sum())))
 
     F, dom_ok = feasibility_numpy(st)
     F = np.ascontiguousarray(F, dtype=np.uint8)
     dom_ok = np.ascontiguousarray(dom_ok, dtype=np.uint8)
 
+    # ---- existing-node state (same semantics as TpuSolver.prepare) ------
+    zone_index = {z: i for i, z in enumerate(st.zone_names)}
+    prov_index = {n: i for i, n in enumerate(st.prov_names)}
     ex_res = np.zeros((max(1, NE), R), dtype=np.float32)
+    ex_zone = np.zeros(max(1, NE), dtype=np.int32)
+    ex_selcnt = np.zeros((max(1, NE), S), dtype=np.int32)
     ex_ok = np.zeros((G, max(1, NE)), dtype=np.uint8)
+    zc0 = np.zeros((S, Z), dtype=np.int32)
+    prov_used0 = np.zeros((P, R), dtype=np.float32)
     for ni, node in enumerate(existing_nodes):
         ex_res[ni] = st.vocab.resources_to_row(node.remaining()).astype(np.float32)
+        ex_zone[ni] = zone_index.get(node.zone, 0)
+        pi = prov_index.get(node.provisioner)
+        if pi is not None:
+            prov_used0[pi] += st.vocab.resources_to_row(node.allocatable).astype(np.float32)
         for gi, g in enumerate(st.groups):
             rep = g.pods[0]
             ex_ok[gi, ni] = (
                 not any(t.blocks(rep.tolerations) for t in node.taints)
                 and g.requirements.compatible(node.labels) is None
             )
+    for si, (sel, _topo, _kind) in enumerate(st.selector_defs):
+        for ni, node in enumerate(existing_nodes):
+            n_match = sum(1 for p in node.pods if sel.matches(p.labels))
+            ex_selcnt[ni, si] = n_match
+            zc0[si, zone_index.get(node.zone, 0)] += n_match
 
     price = np.where(np.isinf(st.cand_price), np.float32(3.0e38), st.cand_price)
     price = np.ascontiguousarray(price, dtype=np.float32)
@@ -141,6 +192,16 @@ def solve_tensors_native(
     req = np.ascontiguousarray(st.requests, dtype=np.float32)
     counts = np.ascontiguousarray(st.counts, dtype=np.int32)
     alloc = np.ascontiguousarray(st.cand_alloc, dtype=np.float32)
+    g_zone_spread = np.ascontiguousarray(st.g_zone_spread, dtype=np.int32)
+    g_zone_skew = np.ascontiguousarray(st.g_zone_skew, dtype=np.int32)
+    g_host_spread = np.ascontiguousarray(st.g_host_spread, dtype=np.int32)
+    g_host_cap = np.ascontiguousarray(st.g_host_cap, dtype=np.int32)
+    g_zone_anti = np.ascontiguousarray(st.g_zone_anti, dtype=np.int32)
+    sel_match = np.ascontiguousarray(st.g_sel_match, dtype=np.uint8)
+    dom_zone = np.ascontiguousarray(st.dom_zone, dtype=np.int32)
+    cand_prov = np.ascontiguousarray(st.cand_prov, dtype=np.int32)
+    cand_cap = np.ascontiguousarray(st.cand_cap, dtype=np.float32)
+    prov_limits = np.ascontiguousarray(st.prov_limits, dtype=np.float32)
 
     slot_res = np.zeros((NR, R), dtype=np.float32)
     slot_cand = np.zeros(NR, dtype=np.int32)
@@ -152,12 +213,14 @@ def solve_tensors_native(
 
     c = lambda a: a.ctypes.data_as(ctypes.c_void_p)
     lib.kt_ffd_solve(
-        G, C, D, R, NE, NR,
+        G, C, D, R, NE, NR, S, Z, P,
         c(req), c(counts), c(F), c(dom_ok), c(alloc), c(price), c(avail),
-        c(ex_res), c(ex_ok),
+        c(ex_res), c(ex_ok), c(ex_zone), c(ex_selcnt),
+        c(g_zone_spread), c(g_zone_skew), c(g_host_spread), c(g_host_cap),
+        c(g_zone_anti), c(sel_match), c(dom_zone), c(zc0),
+        c(cand_prov), c(cand_cap), c(prov_limits), c(prov_used0),
         c(slot_res), c(slot_cand), c(slot_dom), c(slot_price), c(takes),
-        n_used.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        c(infeasible),
+        c(n_used), c(infeasible),
     )
 
     # ---- extraction (same shape as TpuSolver._extract) -----------------

@@ -29,18 +29,46 @@ from .types import SimNode, SolveResult
 NATIVE_BATCH_LIMIT = 256
 
 
+def _soft_spreads(pod: PodSpec):
+    return [t for t in pod.topology_spread if not t.hard]
+
+
+def _n_preferences(pod: PodSpec) -> int:
+    """Relaxable preferences: preferred node-affinity terms + ScheduleAnyway
+    topology spreads (both sit on the same relaxation ladder, like core's
+    Preferences — scheduling.md:205-233 + :303-346 ScheduleAnyway)."""
+    return len(pod.preferred_affinity_terms) + len(_soft_spreads(pod))
+
+
 def _harden_preferences(pod: PodSpec, keep: Optional[int] = None) -> PodSpec:
-    """Fold the first ``keep`` preferred affinity terms (all when None) into
-    the required set.  Returns the pod unchanged when none are kept."""
-    kept = pod.preferred_affinity_terms[: len(pod.preferred_affinity_terms) if keep is None else keep]
-    if not kept:
+    """Fold the first ``keep`` preferences (all when None) into the hard
+    constraint set: preferred affinity terms join the required set,
+    ScheduleAnyway spreads become DoNotSchedule.  The ladder drops soft
+    spreads first (they sort after affinity terms), then affinity terms
+    last-first.  Returns the pod unchanged when it has no preferences."""
+    from ..models.pod import TopologySpreadConstraint
+
+    prefs_aff = pod.preferred_affinity_terms
+    soft = _soft_spreads(pod)
+    total = len(prefs_aff) + len(soft)
+    if total == 0:
         return pod
+    k = total if keep is None else max(0, keep)
+    kept_aff = prefs_aff[: min(k, len(prefs_aff))]
+    kept_soft = soft[: max(0, k - len(prefs_aff))]
+
     out = copy.copy(pod)
-    out.required_affinity_terms = [
-        list(term) + [r for pt in kept for r in pt]
-        for term in (pod.required_affinity_terms or [[]])
-    ]
+    if kept_aff:
+        out.required_affinity_terms = [
+            list(term) + [r for pt in kept_aff for r in pt]
+            for term in (pod.required_affinity_terms or [[]])
+        ]
     out.preferred_affinity_terms = []
+    out.topology_spread = [t for t in pod.topology_spread if t.hard] + [
+        TopologySpreadConstraint(t.max_skew, t.topology_key, "DoNotSchedule",
+                                 t.label_selector)
+        for t in kept_soft
+    ]
     out.__dict__.pop("_group_key", None)  # hardened copy needs its own key
     return out
 
@@ -89,11 +117,12 @@ class BatchScheduler:
         allow_new_nodes: bool = True,
         max_new_nodes: Optional[int] = None,
     ) -> SolveResult:
-        """Solve with preference relaxation: pods carrying preferred affinity
-        terms are first solved with all preferences hardened; any that come
-        back infeasible retry dropping one preferred term at a time, last
-        first (the reference's scheduler relaxes preferences one failure at a
-        time — scheduling.md:205-233).  Pods with OR'd required-affinity terms
+        """Solve with preference relaxation: pods carrying preferences
+        (preferred affinity terms, ScheduleAnyway topology spreads) are first
+        solved with all preferences hardened; any that come back infeasible
+        retry dropping one preference at a time, last first (the reference's
+        scheduler relaxes preferences one failure at a time —
+        scheduling.md:205-233).  Pods with OR'd required-affinity terms
         that stay infeasible under term[0] retry under each alternate term —
         with the full preference ladder re-applied per term, so a pod landing
         on term[1] still honors its satisfiable preferences."""
@@ -139,10 +168,10 @@ class BatchScheduler:
             instance_types, existing_nodes, daemonsets, unavailable,
             allow_new_nodes, max_new_nodes,
         )
-        max_pref = max((len(p.preferred_affinity_terms) for p in pods), default=0)
+        max_pref = max((_n_preferences(p) for p in pods), default=0)
         for keep in range(max_pref - 1, -1, -1):
             retry = [p for p in pods if p.name in result.infeasible
-                     and len(p.preferred_affinity_terms) > keep]
+                     and _n_preferences(p) > keep]
             if not retry:
                 continue
             _merge(result, self._solve_once(

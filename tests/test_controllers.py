@@ -211,6 +211,29 @@ class TestTpuBackendE2E:
         assert all(p in state.bindings for p in state.pods)
 
 
+class TestAutoBackendE2E:
+    def test_auto_scheduler_routes_small_batch_native(self, small_catalog):
+        """The operator's default configuration: backend="auto" routes a
+        small unconstrained batch through the native C++ tier end-to-end."""
+        from karpenter_tpu.solver import native
+
+        if not native.available():
+            pytest.skip("native lib unavailable")
+        clock = FakeClock()
+        state = ClusterState(clock=clock)
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+        ctrl = ProvisioningController(
+            state, cloud, scheduler=BatchScheduler(backend="auto"), clock=clock,
+        )
+        state.apply_provisioner(Provisioner(name="default"))
+        for i in range(20):
+            state.add_pod(PodSpec(name=f"p{i}", requests={"cpu": 1.0}, owner_key="d"))
+        result = pump(ctrl, clock)
+        assert result is not None
+        assert len(state.pending_pods()) == 0
+        assert all(p in state.bindings for p in state.pods)
+
+
 class TestFakeCloud:
     def test_create_resolves_cheapest(self, small_catalog):
         cloud = FakeCloudProvider(small_catalog)

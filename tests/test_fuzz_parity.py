@@ -124,6 +124,34 @@ def test_fuzz_cost_and_feasibility_parity(seed, small_catalog):
         )
 
 
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_native_parity(seed, small_catalog):
+    """Native C++ tier vs oracle over the same scenario sweep.  Positive
+    pod-affinity scenarios are skipped — the scheduler's has_topology gate
+    routes those to the device/oracle, never to the native tier."""
+    from karpenter_tpu.solver import native
+
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    pods, provs, unavailable = random_scenario(seed, small_catalog)
+    st = tensorize(pods, provs, small_catalog, unavailable=unavailable)
+    if native.has_topology(st):
+        pytest.skip("positive pod-affinity routes away from the native tier")
+    oracle = reference.solve(pods, provs, small_catalog, unavailable=unavailable)
+    got = native.solve_tensors_native(st)
+
+    assert got.n_scheduled == oracle.n_scheduled, (
+        f"seed {seed}: scheduled native={got.n_scheduled} oracle={oracle.n_scheduled} "
+        f"(native infeasible={len(got.infeasible)}, oracle={len(oracle.infeasible)})"
+    )
+    if oracle.new_node_cost > 0:
+        ratio = got.new_node_cost / oracle.new_node_cost
+        assert ratio <= PARITY + 1e-9, (
+            f"seed {seed}: cost ratio {ratio:.4f}\n"
+            f"native: {got.summary()}\noracle: {oracle.summary()}"
+        )
+
+
 def test_fuzz_determinism(small_catalog):
     """Same tensors solved twice must produce the identical packing."""
     pods, provs, unavailable = random_scenario(3, small_catalog)

@@ -1,0 +1,159 @@
+"""Launch-path selection fidelity vs instance.go:83-87,261-281,405-529."""
+
+import pytest
+
+from karpenter_tpu.cloud.fake import FakeCloudProvider
+from karpenter_tpu.cloud.launchpath import (
+    FLEXIBILITY_THRESHOLD,
+    MAX_INSTANCE_TYPES,
+    filter_exotic,
+    filter_unwanted_spot,
+    is_mixed_capacity_launch,
+    order_by_price,
+    select_launch_types,
+)
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.machine import Machine
+from karpenter_tpu.models.requirements import IN, Requirement, Requirements
+
+
+def flexible_machine(**req_kw) -> Machine:
+    """A machine with open requirements, the shape the reference's Create
+    receives (our solver pins instead; flexibility is the API-parity path)."""
+    reqs = Requirements()
+    for key, values in req_kw.items():
+        reqs.add(Requirement(key, IN, values))
+    return Machine(requirements=reqs)
+
+
+class TestSelection:
+    def test_sixty_type_truncation(self, full_catalog):
+        """MaxInstanceTypes=60: cloudprovider.go:64-67 applied instance.go:85-87.
+        Capacity type pinned so the unwanted-spot filter (which legitimately
+        shrinks unconstrained mixed launches) stays out of the way."""
+        assert len(full_catalog) > MAX_INSTANCE_TYPES
+        m = flexible_machine(**{L.CAPACITY_TYPE: [L.CAPACITY_TYPE_ON_DEMAND]})
+        sel = select_launch_types(m, full_catalog)
+        assert len(sel.instance_types) == MAX_INSTANCE_TYPES
+
+    def test_price_sorted_before_truncation(self, full_catalog):
+        """The 60 kept must be the 60 cheapest (instance.go:421-438)."""
+        m = flexible_machine(**{L.CAPACITY_TYPE: [L.CAPACITY_TYPE_ON_DEMAND]})
+        sel = select_launch_types(m, full_catalog)
+        kept = sel.instance_types
+
+        def cheapest(it):
+            return min((o.price for o in it.offerings
+                        if o.available and o.capacity_type == L.CAPACITY_TYPE_ON_DEMAND),
+                       default=float("inf"))
+
+        prices = [cheapest(it) for it in kept]
+        assert prices == sorted(prices)
+        # nothing cheaper was dropped
+        dropped = [it for it in filter_exotic(full_catalog)
+                   if it not in kept and it.capacity.get(L.RESOURCE_GPU, 0.0) == 0]
+        if dropped:
+            assert min(cheapest(it) for it in dropped) >= prices[-1]
+
+    def test_exotic_filtered_when_generic_suffice(self, full_catalog):
+        sel = select_launch_types(flexible_machine(), full_catalog)
+        assert all(
+            it.capacity.get(L.RESOURCE_GPU, 0.0) == 0 for it in sel.instance_types
+        )
+
+    def test_exotic_kept_when_nothing_else(self, full_catalog):
+        gpu_types = [it for it in full_catalog if it.capacity.get(L.RESOURCE_GPU, 0.0) > 0]
+        assert gpu_types
+        got = filter_exotic(gpu_types)
+        assert got == gpu_types  # no generic subset: original returned
+
+    def test_unwanted_spot_filtered_on_mixed_launch(self, full_catalog):
+        """Spot types pricier than the cheapest workable on-demand type are
+        dropped (instance.go:481-503)."""
+        m = flexible_machine()
+        types = filter_exotic([
+            it for it in full_catalog
+            if m.requirements.get(L.INSTANCE_TYPE).contains(it.name)
+        ])
+        assert is_mixed_capacity_launch(m.requirements, types)
+        kept = filter_unwanted_spot(types, m.requirements)
+        cheapest_od = min(
+            o.price for it in types for o in it.offerings
+            if o.available and o.capacity_type == L.CAPACITY_TYPE_ON_DEMAND
+        )
+        for it in kept:
+            assert min(o.price for o in it.offerings if o.available) <= cheapest_od
+
+    def test_capacity_type_spot_when_flexible(self, small_catalog):
+        sel = select_launch_types(flexible_machine(), small_catalog)
+        assert sel.capacity_type == L.CAPACITY_TYPE_SPOT
+
+    def test_od_flexibility_warning_under_threshold(self, small_catalog):
+        """<5 types + flexible-to-spot but landing on-demand => warning
+        (instance.go:52,261-281)."""
+        # pin to 2 types whose spot offerings we exclude via zone... simpler:
+        # requirements allow both cts but only OD offerings exist in the
+        # selected zone? our catalog has spot everywhere, so pin types and
+        # mark ct-flexible while restricting to a type set with spot — the
+        # warning path needs OD chosen, so restrict capacity-type reachability
+        # by excluding spot zones is not possible here; instead verify the
+        # no-warning and the warning-by-count paths directly:
+        names = sorted(it.name for it in small_catalog)[:2]
+        m = flexible_machine(**{L.INSTANCE_TYPE: names})
+        sel = select_launch_types(m, small_catalog)
+        # spot reachable -> spot chosen -> no warning even at 2 types
+        assert sel.capacity_type == L.CAPACITY_TYPE_SPOT
+        assert sel.warnings == []
+
+        # force the OD path with spot still *allowed* in requirements but not
+        # offered: strip spot offerings from copies of two types
+        import copy
+
+        thin = []
+        for it in small_catalog[:2]:
+            c = copy.deepcopy(it)
+            c.offerings = [o for o in c.offerings
+                           if o.capacity_type == L.CAPACITY_TYPE_ON_DEMAND]
+            thin.append(c)
+        sel2 = select_launch_types(flexible_machine(), thin)
+        assert sel2.capacity_type == L.CAPACITY_TYPE_ON_DEMAND
+        assert len(sel2.instance_types) < FLEXIBILITY_THRESHOLD
+        assert len(sel2.warnings) == 1
+
+    def test_resource_fit_prefilter(self, small_catalog):
+        m = flexible_machine()
+        m.resource_requests = {"cpu": 10.0}
+        sel = select_launch_types(m, small_catalog)
+        assert all(it.allocatable.get("cpu", 0.0) >= 10.0 for it in sel.instance_types)
+
+
+class TestFleetSemantics:
+    def test_ice_pool_skipped_and_reported(self, small_catalog):
+        """CreateFleet lowest-price: an ICE'd cheapest pool falls through to
+        the next pool, and the skipped pool is surfaced for blacklisting."""
+        cloud = FakeCloudProvider(small_catalog)
+        m0 = flexible_machine()
+        probe = cloud.create(m0)  # discover the cheapest pool
+        cloud.inject_ice(probe.instance_type, probe.zone, probe.capacity_type)
+
+        m = flexible_machine()
+        got = cloud.create(m)
+        assert (probe.instance_type, probe.zone, probe.capacity_type) != \
+            (got.instance_type, got.zone, got.capacity_type)
+        assert (probe.instance_type, probe.zone, probe.capacity_type) in got.ice_errors
+
+    def test_all_pools_iced_raises(self, small_catalog):
+        from karpenter_tpu.cloud.base import InsufficientCapacityError
+
+        one = [small_catalog[0]]
+        cloud = FakeCloudProvider(one)
+        for o in one[0].offerings:
+            cloud.inject_ice(one[0].name, o.zone, o.capacity_type)
+        with pytest.raises(InsufficientCapacityError):
+            cloud.create(flexible_machine())
+
+    def test_selection_captured_per_create(self, small_catalog):
+        cloud = FakeCloudProvider(small_catalog)
+        cloud.create(flexible_machine())
+        assert len(cloud.launch_selections) == 1
+        assert len(cloud.launch_selections[0].instance_types) <= MAX_INSTANCE_TYPES

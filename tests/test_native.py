@@ -82,6 +82,59 @@ class TestNativeParity:
         got = self._check(pods, [default_prov()], small_catalog)
         assert "giant" in got.infeasible
 
+    def test_zone_spread(self, small_catalog):
+        sel = LabelSelector.of({"app": "web"})
+        pods = [PodSpec(name=f"p{i}", labels={"app": "web"}, requests={"cpu": 1.0},
+                        topology_spread=[TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)],
+                        owner_key="web")
+                for i in range(12)]
+        got = self._check(pods, [default_prov()], small_catalog)
+        per_zone = {}
+        for n in got.nodes:
+            per_zone[n.zone] = per_zone.get(n.zone, 0) + len(n.pods)
+        assert max(per_zone.values()) - min(per_zone.values()) <= 1
+
+    def test_hostname_anti_affinity(self, small_catalog):
+        from karpenter_tpu.models.pod import PodAffinityTerm
+
+        sel = LabelSelector.of({"app": "solo"})
+        pods = [PodSpec(name=f"p{i}", labels={"app": "solo"}, requests={"cpu": 0.5},
+                        affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME, anti=True)],
+                        owner_key="solo")
+                for i in range(6)]
+        got = self._check(pods, [default_prov()], small_catalog)
+        assert len(got.nodes) == 6  # one matcher per node
+        assert all(len(n.pods) == 1 for n in got.nodes)
+
+    def test_existing_topology_state(self, small_catalog):
+        """ex_selcnt/zc0 marshaling: spread counters must see pods already
+        bound on existing nodes, so new placements balance against them."""
+        sel = LabelSelector.of({"app": "web"})
+        it = next(t for t in small_catalog if t.name == "m5.4xlarge")
+
+        def node(zone):
+            return SimNode(
+                instance_type="m5.4xlarge", provisioner="default", zone=zone,
+                capacity_type="on-demand", price=0.768, allocatable=dict(it.allocatable),
+                labels={**it.labels(), L.ZONE: zone, L.CAPACITY_TYPE: "on-demand",
+                        L.PROVISIONER_NAME: "default"},
+                existing=True,
+            )
+
+        n1 = node("zone-1a")
+        # two spread-matching pods already sit in zone-1a
+        for i in range(2):
+            n1.pods.append(PodSpec(name=f"old{i}", labels={"app": "web"},
+                                   requests={"cpu": 1.0}, owner_key="web"))
+        spread = [TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)]
+        pods = [PodSpec(name=f"new{i}", labels={"app": "web"}, requests={"cpu": 1.0},
+                        topology_spread=list(spread), owner_key="web")
+                for i in range(2)]
+        got = self._check(pods, [default_prov()], small_catalog, existing=[n1])
+        # skew=1 with 2 already in zone-1a: both new pods must land elsewhere
+        new_zones = [n.zone for n in got.nodes]
+        assert all(z != "zone-1a" for z in new_zones)
+
 
 class TestRouting:
     def test_auto_routes_small_to_native(self, small_catalog):
@@ -90,11 +143,24 @@ class TestRouting:
         st = tensorize(pods, [default_prov()], small_catalog)
         assert sched._route_native(st, 10)
 
-    def test_auto_routes_topology_to_device(self, small_catalog):
+    def test_auto_routes_spread_to_native(self, small_catalog):
+        """Zone spread is handled by ffd.cpp place_constrained, so small
+        spread batches stay on the low-latency tier."""
         sched = BatchScheduler(backend="auto")
         sel = LabelSelector.of({"app": "x"})
         pods = [PodSpec(name=f"p{i}", labels={"app": "x"}, requests={"cpu": 1.0},
                         topology_spread=[TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)])
+                for i in range(10)]
+        st = tensorize(pods, [default_prov()], small_catalog)
+        assert sched._route_native(st, 10)
+
+    def test_auto_routes_positive_affinity_to_device(self, small_catalog):
+        from karpenter_tpu.models.pod import PodAffinityTerm
+
+        sched = BatchScheduler(backend="auto")
+        sel = LabelSelector.of({"app": "x"})
+        pods = [PodSpec(name=f"p{i}", labels={"app": "x"}, requests={"cpu": 1.0},
+                        affinity_terms=[PodAffinityTerm(sel, L.ZONE, anti=False)])
                 for i in range(10)]
         st = tensorize(pods, [default_prov()], small_catalog)
         assert not sched._route_native(st, 10)

@@ -1,0 +1,101 @@
+"""ScheduleAnyway topology spread: hardened first, relaxed on infeasibility.
+
+Reference semantics: scheduling.md:303-346 (soft spread still influences
+placement) on core's preference-relaxation ladder (one preference dropped per
+failed attempt).  Parity requirement (VERDICT r1 #6): a soft-spread workload
+distributes across zones on BOTH backends.
+"""
+
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import LabelSelector, PodSpec, TopologySpreadConstraint
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.solver.scheduler import BatchScheduler, _harden_preferences, _n_preferences
+
+
+def soft_spread_pods(n, key=L.ZONE, skew=1):
+    sel = LabelSelector.of({"app": "web"})
+    return [
+        PodSpec(name=f"p{i}", labels={"app": "web"}, requests={"cpu": 1.0},
+                topology_spread=[TopologySpreadConstraint(skew, key, "ScheduleAnyway", sel)],
+                owner_key="web")
+        for i in range(n)
+    ]
+
+
+class TestHardening:
+    def test_soft_spread_counts_as_preference(self):
+        p = soft_spread_pods(1)[0]
+        assert _n_preferences(p) == 1
+
+    def test_hardened_copy_flips_to_do_not_schedule(self):
+        p = soft_spread_pods(1)[0]
+        h = _harden_preferences(p)
+        assert len(h.topology_spread) == 1
+        assert h.topology_spread[0].hard
+        assert h.topology_spread[0].max_skew == 1
+        # original untouched
+        assert not p.topology_spread[0].hard
+
+    def test_keep_zero_drops_soft_spread(self):
+        p = soft_spread_pods(1)[0]
+        h = _harden_preferences(p, keep=0)
+        assert h.topology_spread == []
+
+
+class TestSoftSpreadPlacement:
+    @pytest.mark.parametrize("backend", ["oracle", "tpu"])
+    def test_distributes_across_zones(self, small_catalog, backend):
+        """Satisfiable soft zone spread must actually spread (not collapse
+        into the cheapest single zone), on both backends."""
+        sched = BatchScheduler(backend=backend)
+        pods = soft_spread_pods(9)
+        res = sched.solve(pods, [Provisioner(name="default").with_defaults()],
+                          small_catalog)
+        assert res.infeasible == {}
+        zone_counts = {}
+        node_zone = {n.name: n.zone for n in res.nodes}
+        for p in pods:
+            z = node_zone[res.assignments[p.name]]
+            zone_counts[z] = zone_counts.get(z, 0) + 1
+        assert len(zone_counts) == 3
+        assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+    @pytest.mark.parametrize("backend", ["oracle", "tpu"])
+    def test_relaxes_when_unsatisfiable(self, small_catalog, backend):
+        """Hostname soft spread (one pod per node) under a provisioner cpu
+        limit that can't fund one node per pod: hard semantics would leave a
+        pod pending; ScheduleAnyway must relax it onto an existing node.
+        Relaxation is per-still-infeasible-pod (the ladder retries only what
+        failed, like core), so satisfied pods keep their spread nodes."""
+        sel = LabelSelector.of({"app": "solo"})
+        pods = [
+            PodSpec(name=f"p{i}", labels={"app": "solo"}, requests={"cpu": 1.0},
+                    topology_spread=[TopologySpreadConstraint(
+                        1, L.HOSTNAME, "ScheduleAnyway", sel)],
+                    owner_key="solo")
+            for i in range(3)
+        ]
+        prov = Provisioner(name="default", limits={"cpu": 8.0}).with_defaults()
+        sched = BatchScheduler(backend=backend)
+        res = sched.solve(pods, [prov], small_catalog)
+        assert res.infeasible == {}  # nobody left pending
+        # the limit held: at most 8 cpu of capacity launched
+        assert sum(n.allocatable.get("cpu", 0.0) for n in res.nodes) <= 8.0
+        # and the relaxed pod doubled up instead of getting a third node
+        assert len(res.nodes) < 3
+
+    def test_hard_spread_still_hard(self, small_catalog):
+        """DoNotSchedule must NOT be relaxed by the ladder."""
+        sel = LabelSelector.of({"app": "solo"})
+        pods = [
+            PodSpec(name=f"p{i}", labels={"app": "solo"}, requests={"cpu": 1.0},
+                    topology_spread=[TopologySpreadConstraint(
+                        1, L.HOSTNAME, "DoNotSchedule", sel)],
+                    owner_key="solo")
+            for i in range(3)
+        ]
+        prov = Provisioner(name="default", limits={"cpu": 8.0}).with_defaults()
+        res = BatchScheduler(backend="oracle").solve(pods, [prov], small_catalog)
+        assert len(res.infeasible) > 0
