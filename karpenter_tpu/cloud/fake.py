@@ -25,6 +25,12 @@ from .base import (
     MachineNotFoundError,
 )
 from .launchpath import select_launch_types
+from .templates import (
+    Image,
+    NodeTemplate,
+    images_for_instance_type,
+    resolve_images,
+)
 
 _instance_counter = itertools.count()
 
@@ -51,12 +57,21 @@ class FakeCloudProvider(CloudProvider):
         self.clock = clock or Clock()
         self.eventual_consistency_calls = eventual_consistency_calls
         self.instances: Dict[str, FakeInstance] = {}
+        # image catalog + node templates back the real drift check
+        # (cloudprovider.go:258-287): creates stamp machine.image_id from the
+        # template's currently-resolved images; publishing a newer image later
+        # makes existing machines drift.
+        self.templates: Dict[str, NodeTemplate] = {"default": NodeTemplate()}
+        self.images: List[Image] = []
         self.ice_offerings: Set[Tuple[str, str, str]] = set()  # (type, zone, ct)
         self.create_calls: List[Machine] = []
         self.delete_calls: List[str] = []
         self.launch_selections: List = []  # LaunchSelection per create (call capture)
         self.next_error: Optional[Exception] = None
         self.allow_creates = True
+        # seconds until a launched node registers + passes readiness; >0
+        # engages the deprovisioning wait-ready machine for replacements
+        self.node_ready_delay: float = 0.0
 
     # ---- test injection ------------------------------------------------
     def inject_ice(self, instance_type: str, zone: str, capacity_type: str) -> None:
@@ -67,6 +82,11 @@ class FakeCloudProvider(CloudProvider):
 
     def mark_drifted(self, provider_id: str) -> None:
         self.instances[provider_id].drifted = True
+
+    def publish_image(self, image: Image) -> None:
+        """Add an image to the catalog (the SSM-alias-update analog: a newer
+        image per (family, arch, accel) supersedes the old in resolution)."""
+        self.images.append(image)
 
     # ---- CloudProvider -------------------------------------------------
     def create(self, machine: Machine) -> Machine:
@@ -101,6 +121,7 @@ class FakeCloudProvider(CloudProvider):
 
         pid = f"fake://{it.name}/{next(_instance_counter)}"
         machine.provider_id = pid
+        machine.image_id = self._image_for(machine.node_template, it)
         machine.instance_type = it.name
         machine.zone = offering.zone
         machine.capacity_type = offering.capacity_type
@@ -178,9 +199,37 @@ class FakeCloudProvider(CloudProvider):
     def get_instance_types(self, provisioner: Optional[Provisioner] = None) -> List[InstanceType]:
         return list(self.instance_types)
 
+    def _image_for(self, template_name: str, it: InstanceType) -> str:
+        tmpl = self.templates.get(template_name)
+        if tmpl is None:
+            return ""
+        images = resolve_images(tmpl, self.images)
+        mapped = images_for_instance_type(images, it)
+        return mapped[0].image_id if mapped else ""
+
     def is_machine_drifted(self, machine: Machine) -> bool:
+        """Real image drift (cloudprovider.go:233-251 + isAMIDrifted
+        :258-287): the instance's image must be among the images the node
+        template *currently* resolves for its instance type.  The injected
+        `drifted` flag remains as a test escape hatch."""
         inst = self.instances.get(machine.provider_id)
-        return bool(inst and inst.drifted)
+        if inst is None:
+            return False
+        if inst.drifted:
+            return True
+        if not machine.image_id or not machine.instance_type:
+            return False  # drift not detectable without a recorded image
+        tmpl = self.templates.get(machine.node_template)
+        if tmpl is None:
+            return False
+        it = next(
+            (t for t in self.instance_types if t.name == machine.instance_type), None
+        )
+        if it is None:
+            return False
+        images = resolve_images(tmpl, self.images)
+        mapped = {i.image_id for i in images_for_instance_type(images, it)}
+        return machine.image_id not in mapped
 
     def name(self) -> str:
         return "fake"

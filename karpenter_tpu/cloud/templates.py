@@ -39,6 +39,7 @@ class Image:
     arch: str
     accelerated: bool = False
     created_at: float = 0.0
+    family: str = "standard"
 
 
 class ImageFamily:
@@ -68,9 +69,9 @@ class StandardFamily(ImageFamily):
 
     def default_images(self) -> List[Image]:
         return [
-            Image("img-standard-amd64", L.ARCH_AMD64, created_at=2.0),
-            Image("img-standard-arm64", L.ARCH_ARM64, created_at=2.0),
-            Image("img-standard-gpu", L.ARCH_AMD64, accelerated=True, created_at=2.0),
+            Image("img-standard-amd64", L.ARCH_AMD64, created_at=2.0, family="standard"),
+            Image("img-standard-arm64", L.ARCH_ARM64, created_at=2.0, family="standard"),
+            Image("img-standard-gpu", L.ARCH_AMD64, accelerated=True, created_at=2.0, family="standard"),
         ]
 
     def bootstrap_script(self, cluster_name, labels, taints, kubelet_flags, custom_userdata="") -> str:
@@ -104,8 +105,8 @@ class TomlFamily(ImageFamily):
 
     def default_images(self) -> List[Image]:
         return [
-            Image("img-toml-amd64", L.ARCH_AMD64, created_at=1.0),
-            Image("img-toml-arm64", L.ARCH_ARM64, created_at=1.0),
+            Image("img-toml-amd64", L.ARCH_AMD64, created_at=1.0, family="toml"),
+            Image("img-toml-arm64", L.ARCH_ARM64, created_at=1.0, family="toml"),
         ]
 
     def bootstrap_script(self, cluster_name, labels, taints, kubelet_flags, custom_userdata="") -> str:
@@ -198,29 +199,48 @@ def resolve_images(
     template: NodeTemplate,
     available_images: Sequence[Image] = (),
 ) -> List[Image]:
-    """Selector-based discovery (ami.go:158-230) or family defaults
-    (ami.go:135-149), newest-first (ami.go:232-241)."""
+    """Selector-based discovery (ami.go:158-230) or family-alias defaults
+    (ami.go:135-149), newest-first (ami.go:232-241).
+
+    The alias path has SSM semantics: it returns only the *current* image per
+    (arch, accelerated) variant — when a newer image is published into the
+    pool, older ones drop out of the resolved set, which is exactly what the
+    drift check keys off (cloudprovider.go:258-287)."""
     family = get_family(template.image_family)
     if template.image_selector:
         ids = {v for k, v in template.image_selector.items() if k == "id"}
         pool = list(available_images) or family.default_images()
         picked = [i for i in pool if not ids or i.image_id in ids]
     else:
-        picked = family.default_images()
+        pool = [i for i in available_images if i.family == family.name]
+        if not pool:
+            pool = family.default_images()
+        newest: Dict[Tuple[str, bool], Image] = {}
+        for img in pool:
+            key = (img.arch, img.accelerated)
+            cur = newest.get(key)
+            if cur is None or img.created_at > cur.created_at:
+                newest[key] = img
+        picked = list(newest.values())
     return sorted(picked, key=lambda i: (-i.created_at, i.image_id))
 
 
-def image_for_instance_type(images: Sequence[Image], it: InstanceType) -> Optional[Image]:
-    """Pick the image matching the type's arch/accelerator (ami.go:99-133)."""
+def images_for_instance_type(images: Sequence[Image], it: InstanceType) -> List[Image]:
+    """All resolved images mapping to this type's arch/accelerator variant
+    (ami.go:99-133 MapInstanceTypes analog).  The drift check tests membership
+    of the instance's image in this set (cloudprovider.go:258-287)."""
     arch = it.labels().get(L.ARCH, L.ARCH_AMD64)
     accelerated = L.RESOURCE_GPU in it.capacity
-    for img in images:
-        if img.arch == arch and img.accelerated == accelerated:
-            return img
-    for img in images:  # fall back on arch match alone
-        if img.arch == arch:
-            return img
-    return None
+    exact = [i for i in images if i.arch == arch and i.accelerated == accelerated]
+    if exact:
+        return exact
+    return [i for i in images if i.arch == arch]  # fall back on arch alone
+
+
+def image_for_instance_type(images: Sequence[Image], it: InstanceType) -> Optional[Image]:
+    """Pick the (newest) image matching the type's arch/accelerator."""
+    mapped = images_for_instance_type(images, it)
+    return mapped[0] if mapped else None
 
 
 # ---------------------------------------------------------------------------

@@ -38,6 +38,9 @@ from .termination import TerminationController
 
 MIN_NODE_LIFETIME = 5 * 60.0          # designs/consolidation.md:67
 DEFAULT_BATCH_IDLE_AFTER_NO_ACTION = 15.0
+#: how long a consolidation replacement may take to become ready before the
+#: action is abandoned and the replacement reaped (designs/deprovisioning.md:32-33)
+REPLACEMENT_READY_TIMEOUT = 9.5 * 60.0
 #: above this candidate count, run the one-device-call delete screen
 #: (solver/consolidation.py) before any sequential what-ifs
 SCREEN_THRESHOLD = 32
@@ -54,6 +57,19 @@ class Action:
     mechanism: str                    # "emptiness" | "expiration" | "drift" | "consolidation"
     nodes: List[str]
     replacement: Optional[SimNode] = None
+    savings: float = 0.0
+
+
+@dataclass
+class PendingReplacement:
+    """A committed replace action waiting for its replacement node to become
+    ready before the old nodes are terminated (designs/consolidation.md:15,
+    designs/deprovisioning.md:32-33).  While one is in flight no other
+    deprovisioning action starts."""
+
+    replacement: str                  # replacement node name
+    old_nodes: List[str]
+    deadline: float                   # abandon the action past this
     savings: float = 0.0
 
 
@@ -83,11 +99,17 @@ class DeprovisioningController:
         self._last_seqnum = -1
         self._last_action_at = 0.0
         self._last_eval_at = -1e18
+        self._pending: Optional[PendingReplacement] = None
 
     # ---- tick ------------------------------------------------------------
     def reconcile(self) -> Optional[Action]:
         t0 = time.perf_counter()
         try:
+            # A committed replace action waiting on readiness blocks all
+            # other deprovisioning until it completes or times out.
+            if self._pending is not None:
+                self._finish_pending()
+                return None
             # Time-based mechanisms (expiration/drift/emptiness) run every
             # tick — they fire on clock advance, which never bumps seqnum.
             action = (
@@ -399,11 +421,61 @@ class DeprovisioningController:
                 )
                 node.labels[L.HOSTNAME] = node.name
                 ns = self.state.add_node(node, machine=machine)
+                ready_delay = getattr(self.cloud, "node_ready_delay", 0.0)
+                if ready_delay > 0:
+                    # wait-ready: old nodes survive until the replacement
+                    # registers and initializes (or the ~9.5-min deadline
+                    # passes); the nomination shields the replacement from
+                    # consolidation while it is still empty.
+                    deadline = self.clock.now() + REPLACEMENT_READY_TIMEOUT
+                    self.state.nominate(node.name, ttl=REPLACEMENT_READY_TIMEOUT)
+                    self._pending = PendingReplacement(
+                        node.name, list(action.nodes), deadline, action.savings
+                    )
+                    self.recorder.publish(Event(
+                        "Node", node.name, "WaitingOnReadiness",
+                        f"replacement for {','.join(action.nodes)} launched; "
+                        f"waiting up to {REPLACEMENT_READY_TIMEOUT:.0f}s for readiness",
+                    ))
+                    return
                 ns.initialized = True
-        for name in action.nodes:
+        self._terminate(action.nodes, action.mechanism, action.kind, action.savings)
+
+    def _terminate(self, nodes: Sequence[str], mechanism: str, kind: str,
+                   savings: float) -> None:
+        for name in nodes:
             self.recorder.publish(Event(
                 "Node", name, "DeprovisioningTriggered",
-                f"{action.mechanism}: {action.kind} (saves ${action.savings:.3f}/hr)",
+                f"{mechanism}: {kind} (saves ${savings:.3f}/hr)",
             ))
             self.termination.begin(name)
         self.termination.reconcile()
+
+    def _finish_pending(self) -> None:
+        """Advance the wait-ready state machine: terminate the old nodes once
+        the replacement initializes; abandon (and reap the replacement) if the
+        readiness deadline passes first."""
+        p = self._pending
+        assert p is not None
+        now = self.clock.now()
+        ns = self.state.nodes.get(p.replacement)
+        if ns is None:
+            # replacement vanished (interrupted/GC'd): abandon, keep old nodes
+            self._pending = None
+            return
+        ready_delay = getattr(self.cloud, "node_ready_delay", 0.0)
+        if not ns.initialized and now - ns.node.created_at >= ready_delay:
+            ns.initialized = True  # registered + passed readiness (sim kubelet)
+        if ns.initialized:
+            self._pending = None
+            self._terminate(p.old_nodes, "consolidation", "replace", p.savings)
+            self._last_action_at = now
+            return
+        if now >= p.deadline:
+            self._pending = None
+            self.recorder.publish(Event(
+                "Node", p.replacement, "ReplacementTimedOut",
+                "replacement did not become ready in time; abandoning "
+                "consolidation and reaping the replacement", "Warning",
+            ))
+            self._terminate([p.replacement], "consolidation", "abandon", 0.0)

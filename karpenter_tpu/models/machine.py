@@ -38,6 +38,7 @@ class Machine:
     capacity: ResourceList = field(default_factory=dict)
     allocatable: ResourceList = field(default_factory=dict)
     launched_at: Optional[float] = None
+    image_id: str = ""                  # instance's launch image (drift input)
     registered: bool = False
     initialized: bool = False
     # launch diagnostics (set by the cloud layer): ICE'd offerings skipped on

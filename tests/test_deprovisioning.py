@@ -156,6 +156,62 @@ class TestConsolidationReplace:
         assert len(state.nodes) == 1
 
 
+class TestReplacementWaitReady:
+    """Replace actions launch the replacement, then wait for readiness before
+    terminating the old node (designs/deprovisioning.md:32-33)."""
+
+    def _trigger_replace(self, small_catalog, ready_delay):
+        clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(
+            small_catalog,
+            Provisioner(name="default", consolidation_enabled=True, requirements=[C2X]),
+        )
+        schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 0.5})])
+        old_node = state.bindings["p"]
+        state.apply_provisioner(Provisioner(name="default", consolidation_enabled=True))
+        cloud.node_ready_delay = ready_delay
+        clock.advance(MIN_NODE_LIFETIME + 1)
+        action = deprov.reconcile()
+        assert action is not None and action.kind == "replace"
+        return clock, state, cloud, deprov, recorder, old_node
+
+    def test_old_node_survives_until_replacement_ready(self, small_catalog):
+        clock, state, cloud, deprov, recorder, old_node = self._trigger_replace(
+            small_catalog, ready_delay=30.0
+        )
+        # replacement launched, old node still serving
+        assert old_node in state.nodes
+        assert len(state.nodes) == 2
+        repl = next(n for n in state.nodes if n != old_node)
+        assert not state.nodes[repl].initialized
+        # nomination shields the empty replacement from consolidation
+        assert state.nodes[repl].nominated_until > clock.now()
+
+        # not ready yet: nothing happens, and no new action starts
+        clock.advance(10)
+        assert deprov.reconcile() is None
+        assert old_node in state.nodes
+
+        # readiness reached: old node terminated, pod reschedules
+        clock.advance(25)
+        deprov.reconcile()
+        assert old_node not in state.nodes
+        assert state.nodes[repl].initialized
+
+    def test_timeout_abandons_and_reaps_replacement(self, small_catalog):
+        clock, state, cloud, deprov, recorder, old_node = self._trigger_replace(
+            small_catalog, ready_delay=1e12  # never becomes ready
+        )
+        repl = next(n for n in state.nodes if n != old_node)
+        from karpenter_tpu.controllers.deprovisioning import REPLACEMENT_READY_TIMEOUT
+
+        clock.advance(REPLACEMENT_READY_TIMEOUT + 1)
+        deprov.reconcile()
+        # the doomed replacement is reaped; the old node keeps serving
+        assert repl not in state.nodes
+        assert old_node in state.nodes
+        assert any(e.reason == "ReplacementTimedOut" for e in recorder.events)
+
+
 class TestMultiNode:
     def test_multi_node_delete(self, small_catalog):
         clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(
@@ -269,5 +325,49 @@ class TestExpirationAndDrift:
         schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 0.5})])
         node = state.bindings["p"]
         cloud.mark_drifted(state.nodes[node].machine.provider_id)
+        clock.advance(10)
+        assert deprov.reconcile() is None
+
+    def test_image_drift_detected_when_newer_image_published(self, small_catalog):
+        """Real drift (cloudprovider.go:258-287): machines launch with the
+        currently-resolved image; publishing a newer image per alias makes the
+        old image unresolved -> drifted -> replace."""
+        from karpenter_tpu.cloud.templates import Image
+
+        clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(
+            small_catalog, drift_enabled=True
+        )
+        schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 0.5})])
+        node = state.bindings["p"]
+        machine = state.nodes[node].machine
+        assert machine.image_id == "img-standard-amd64"
+        assert not cloud.is_machine_drifted(machine)
+
+        cloud.publish_image(
+            Image("img-standard-amd64-v2", L.ARCH_AMD64, created_at=99.0, family="standard")
+        )
+        assert cloud.is_machine_drifted(machine)
+        clock.advance(10)
+        action = deprov.reconcile()
+        assert action is not None and action.mechanism == "drift"
+        assert node not in state.nodes
+
+    def test_selector_images_do_not_drift_while_still_matching(self, small_catalog):
+        """Selector-pinned images (ami.go:158-230) keep matching even when
+        other images appear, so no drift is reported."""
+        from karpenter_tpu.cloud.templates import Image, NodeTemplate
+
+        clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(
+            small_catalog, drift_enabled=True
+        )
+        cloud.templates["default"] = NodeTemplate(
+            image_selector={"id": "img-pinned"}
+        )
+        cloud.publish_image(Image("img-pinned", L.ARCH_AMD64, created_at=1.0))
+        schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 0.5})])
+        machine = state.nodes[state.bindings["p"]].machine
+        assert machine.image_id == "img-pinned"
+        cloud.publish_image(Image("img-other", L.ARCH_AMD64, created_at=99.0))
+        assert not cloud.is_machine_drifted(machine)
         clock.advance(10)
         assert deprov.reconcile() is None
