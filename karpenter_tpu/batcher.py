@@ -13,6 +13,9 @@ Two batching layers mirror the reference:
 
 from __future__ import annotations
 
+import threading
+import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Generic, Hashable, List, Optional, TypeVar
 
@@ -69,6 +72,67 @@ class Window(Generic[T]):
 class _Bucket(Generic[T, U]):
     requests: List[T] = field(default_factory=list)
     results: List[U] = field(default_factory=list)
+
+
+class _Batch:
+    __slots__ = ("reqs", "event", "results")
+
+    def __init__(self) -> None:
+        self.reqs: List[object] = []
+        self.event = threading.Event()
+        self.results = None  # List[("ok", value) | ("err", exception)]
+
+
+class ThreadCoalescer:
+    """Coalescer for *concurrent* callers (batcher.go:130-151 semantics with
+    goroutines mapped to threads): the first requester of a bucket becomes
+    the leader, sleeps the idle window while peers join, then executes once
+    and publishes per-request outcomes.  Used at the cloud boundary by
+    ``cloud.batched.BatchedCloud``; the synchronous ``Coalescer`` above
+    covers single-threaded accumulate-then-flush callers."""
+
+    def __init__(
+        self,
+        execute: Callable[[List[object]], List[tuple]],
+        idle_seconds: float = 0.002,
+    ) -> None:
+        self.execute = execute
+        self.idle = idle_seconds
+        self._lock = threading.Lock()
+        self._buckets: Dict[Hashable, _Batch] = {}
+        self.batch_count = 0                       # backend round trips
+        self.batch_sizes = deque(maxlen=128)       # recent batch sizes
+
+    def call(self, key: Hashable, req: object):
+        with self._lock:
+            batch = self._buckets.get(key)
+            leader = batch is None
+            if leader:
+                batch = _Batch()
+                self._buckets[key] = batch
+            idx = len(batch.reqs)
+            batch.reqs.append(req)
+        if leader:
+            if self.idle > 0:
+                time.sleep(self.idle)
+            with self._lock:
+                # late joiners after this point start a fresh bucket
+                self._buckets.pop(key, None)
+                reqs = list(batch.reqs)
+            try:
+                outcomes = self.execute(reqs)
+            except Exception as err:  # backend-wide failure fans out to all
+                outcomes = [("err", err)] * len(reqs)
+            batch.results = outcomes
+            self.batch_count += 1
+            self.batch_sizes.append(len(reqs))
+            batch.event.set()
+        else:
+            batch.event.wait()
+        kind, val = batch.results[idx]
+        if kind == "err":
+            raise val
+        return val
 
 
 class Coalescer(Generic[T, U]):

@@ -169,7 +169,11 @@ class NodeTemplate:
     user_data: str = ""
     instance_profile: str = ""
     block_devices: List[BlockDevice] = field(default_factory=list)
+    # pre-built launch template override; excludes the fields it replaces
+    # (provider_validation.go:64-84)
+    launch_template_name: Optional[str] = None
     metadata_http_tokens: str = "required"
+    metadata_http_endpoint: str = "enabled"
     metadata_hop_limit: int = 2
     tags: Dict[str, str] = field(default_factory=dict)
     detailed_monitoring: bool = False
@@ -179,15 +183,11 @@ class NodeTemplate:
     status_images: List[Image] = field(default_factory=list)
 
     def validate(self) -> List[str]:
-        errs = []
-        if self.image_family == "custom" and not self.image_selector:
-            errs.append("custom image family requires an image selector")
-        if self.metadata_http_tokens not in ("required", "optional"):
-            errs.append(f"bad metadata_http_tokens {self.metadata_http_tokens!r}")
-        for bd in self.block_devices:
-            if bd.size_gib <= 0:
-                errs.append(f"block device {bd.device_name}: size must be positive")
-        return errs
+        """Full spec validation; single source of truth lives in
+        webhooks.validate_node_template_spec."""
+        from ..webhooks import validate_node_template_spec
+
+        return validate_node_template_spec(self)
 
 
 # ---------------------------------------------------------------------------
@@ -208,7 +208,12 @@ def resolve_images(
     drift check keys off (cloudprovider.go:258-287)."""
     family = get_family(template.image_family)
     if template.image_selector:
-        ids = {v for k, v in template.image_selector.items() if k == "id"}
+        ids = {
+            one.strip()
+            for k, v in template.image_selector.items()
+            if k in ("id", "ids")
+            for one in str(v).split(",")
+        }
         pool = list(available_images) or family.default_images()
         picked = [i for i in pool if not ids or i.image_id in ids]
     else:

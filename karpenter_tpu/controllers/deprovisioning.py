@@ -16,9 +16,12 @@ unchanged).
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
 
 from ..cloud.base import CloudProvider
 from ..events import Event, Recorder
@@ -38,6 +41,10 @@ from .termination import TerminationController
 
 MIN_NODE_LIFETIME = 5 * 60.0          # designs/consolidation.md:67
 DEFAULT_BATCH_IDLE_AFTER_NO_ACTION = 15.0
+#: per-action validation wait: a proposed action is held this long, then
+#: re-validated against fresh cluster state before executing
+#: (designs/deprovisioning.md "DeprovisioningTTL of 15 seconds")
+DEPROVISIONING_TTL = 15.0
 #: how long a consolidation replacement may take to become ready before the
 #: action is abandoned and the replacement reaped (designs/deprovisioning.md:32-33)
 REPLACEMENT_READY_TIMEOUT = 9.5 * 60.0
@@ -85,6 +92,7 @@ class DeprovisioningController:
         registry: Optional[Registry] = None,
         clock: Optional[Clock] = None,
         drift_enabled: bool = False,            # feature gate (settings.md:76-78)
+        deprovisioning_ttl: float = DEPROVISIONING_TTL,
     ) -> None:
         self.state = state
         self.cloud = cloud
@@ -95,11 +103,15 @@ class DeprovisioningController:
         self.registry = registry or default_registry
         self.clock = clock or state.clock
         self.drift_enabled = drift_enabled
+        self.deprovisioning_ttl = deprovisioning_ttl
         self.unavailable = getattr(provisioning, "unavailable", None)
         self._last_seqnum = -1
         self._last_action_at = 0.0
         self._last_eval_at = -1e18
         self._pending: Optional[PendingReplacement] = None
+        self._proposed: Optional[Tuple[Action, float]] = None  # (action, validate_at)
+        self._last_subset_drop = 0
+        self._last_confirm_drop = 0
 
     # ---- tick ------------------------------------------------------------
     def reconcile(self) -> Optional[Action]:
@@ -110,6 +122,20 @@ class DeprovisioningController:
             if self._pending is not None:
                 self._finish_pending()
                 return None
+            # A proposed action sits for the deprovisioning TTL, then is
+            # re-validated against fresh state before executing
+            # (designs/deprovisioning.md "DeprovisioningTTL of 15 seconds").
+            if self._proposed is not None:
+                proposed, validate_at = self._proposed
+                if self.clock.now() < validate_at:
+                    return None
+                self._proposed = None
+                fresh = self._revalidate(proposed)
+                if fresh is None:
+                    return None  # conditions changed; start over next tick
+                self._execute(fresh)
+                self._last_action_at = self.clock.now()
+                return fresh
             # Time-based mechanisms (expiration/drift/emptiness) run every
             # tick — they fire on clock advance, which never bumps seqnum.
             action = (
@@ -122,14 +148,39 @@ class DeprovisioningController:
                 if action is None:
                     self._last_seqnum = self.state.seqnum
                     self._last_eval_at = self.clock.now()
-            if action is not None:
-                self._execute(action)
-                self._last_action_at = self.clock.now()
+            if action is None:
+                return None
+            if self.deprovisioning_ttl > 0:
+                self._proposed = (action, self.clock.now() + self.deprovisioning_ttl)
+                return None
+            self._execute(action)
+            self._last_action_at = self.clock.now()
             return action
         finally:
             self.registry.histogram(DEPROVISIONING_DURATION).observe(
                 time.perf_counter() - t0
             )
+
+    def _revalidate(self, proposed: Action) -> Optional[Action]:
+        """Re-run the proposing mechanism and accept only if it still yields
+        the same action (kind + node set); the fresh action is executed so a
+        replacement spec reflects current prices/availability."""
+        if proposed.mechanism == "expiration":
+            fresh = self._expiration()
+        elif proposed.mechanism == "drift":
+            fresh = self._drift() if self.drift_enabled else None
+        elif proposed.mechanism == "emptiness":
+            fresh = self._emptiness()
+        else:
+            fresh = self._consolidation()
+        if (
+            fresh is not None
+            and fresh.mechanism == proposed.mechanism
+            and fresh.kind == proposed.kind
+            and set(fresh.nodes) == set(proposed.nodes)
+        ):
+            return fresh
+        return None
 
     def _should_evaluate_consolidation(self) -> bool:
         """Back off while the cluster is unchanged (consolidation.md:64) but
@@ -289,15 +340,21 @@ class DeprovisioningController:
         groups, per-zone groups."""
         subsets: List[List[int]] = []
         seen = set()
+        dropped = 0
 
         def add(ix):
+            nonlocal dropped
             ix = sorted(set(ix))
             if len(ix) < 2:
                 return
             key = tuple(ix)
-            if key not in seen and len(subsets) < MAX_SUBSETS:
-                seen.add(key)
-                subsets.append(ix)
+            if key in seen:
+                return
+            if len(subsets) >= MAX_SUBSETS:
+                dropped += 1
+                return
+            seen.add(key)
+            subsets.append(ix)
 
         size = 2
         while size <= len(cand_idx):
@@ -315,6 +372,14 @@ class DeprovisioningController:
         for group in list(by_type.values()) + list(by_zone.values()):
             add(group[:8])
             add(group[:4])
+        if dropped and dropped != self._last_subset_drop:
+            # change-gated (pretty.ChangeMonitor analog): a large cluster
+            # silently degrading to the prefix heuristic should be visible
+            logger.info(
+                "consolidation screen capped: %d structured subsets dropped "
+                "(MAX_SUBSETS=%d, candidates=%d)", dropped, MAX_SUBSETS, len(cand_idx)
+            )
+        self._last_subset_drop = dropped
         return subsets
 
     #: exact-confirm at most this many screened subset hits per pass (the
@@ -332,6 +397,14 @@ class DeprovisioningController:
             for k, subset in enumerate(subsets) if deletable[k]
         ]
         hits.sort(key=lambda t: (-t[0], t[1]))
+        overflow = max(0, len(hits) - self.MAX_SUBSET_CONFIRMS)
+        if overflow and overflow != self._last_confirm_drop:
+            logger.info(
+                "consolidation confirms capped: %d screened subset hits not "
+                "exact-confirmed this pass (MAX_SUBSET_CONFIRMS=%d)",
+                overflow, self.MAX_SUBSET_CONFIRMS,
+            )
+        self._last_confirm_drop = overflow
         for _, subset in hits[: self.MAX_SUBSET_CONFIRMS]:
             targets = [ns_of[i] for i in subset if i in ns_of]
             if len(targets) != len(subset):
@@ -479,3 +552,8 @@ class DeprovisioningController:
                 "consolidation and reaping the replacement", "Warning",
             ))
             self._terminate([p.replacement], "consolidation", "abandon", 0.0)
+            # arm the backoff (like the ICE path in _execute) so the same
+            # doomed replace isn't immediately re-proposed; read the seqnum
+            # AFTER the reap, which itself bumps it
+            self._last_seqnum = self.state.seqnum
+            self._last_eval_at = now

@@ -28,9 +28,9 @@ class NodeTemplateController:
         self._last_sync = -1e18
 
     def apply(self, template: NodeTemplate) -> None:
-        errs = template.validate()
-        if errs:
-            raise ValueError(f"invalid node template {template.name}: {errs}")
+        from ..webhooks import admit_node_template
+
+        admit_node_template(template)  # raises AdmissionError
         self.templates[template.name] = template
         self._reconcile_one(template)
 

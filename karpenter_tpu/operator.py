@@ -81,7 +81,15 @@ class Operator:
         self.metrics_port = metrics_port
 
         self.state = ClusterState(clock=self.clock)
-        self.cloud = decorate(cloud, self.registry)
+        # request coalescing under the metrics decorator, like the
+        # reference's pkg/batcher sits inside the provider under
+        # core's metrics.Decorate (cmd/controller/main.go:46).
+        # idle_seconds=0: the operator tick is single-threaded, so waiting
+        # for peers would only add dead latency; coalescing engages for
+        # concurrent callers (e.g. the gRPC solver service threads).
+        from .cloud.batched import BatchedCloud
+
+        self.cloud = decorate(BatchedCloud(cloud, idle_seconds=0.0), self.registry)
         self.unavailable = UnavailableOfferings(clock=self.clock)
         self.scheduler = BatchScheduler(backend=scheduler_backend, registry=self.registry)
         self.pricing = PricingProvider(cloud.get_instance_types(), clock=self.clock)
@@ -224,7 +232,13 @@ def _demo(args) -> None:
     for i in range(0, int(args.pods * 0.7)):
         op.state.delete_pod(f"pod-{i}")
     clock.advance(6 * 60)
-    for _ in range(8):
+    # enough sim time for propose -> 15s validation TTL -> execute cycles
+    for _ in range(10):
+        op.tick()
+        clock.advance(4.0)
+    for _ in range(8):  # settle: rebind pods evicted by the last action
+        if not op.state.pending_pods():
+            break
         op.tick()
         clock.advance(2.0)
     cost2 = sum(ns.node.price for ns in op.state.nodes.values())

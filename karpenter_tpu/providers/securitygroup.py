@@ -31,7 +31,7 @@ class SecurityGroupProvider:
         for g in self.groups:
             ok = True
             for k, v in selector.items():
-                if k == "id":
+                if k in ("id", "ids"):
                     if g.group_id not in {s.strip() for s in v.split(",")}:
                         ok = False
                         break

@@ -22,8 +22,11 @@ class Subnet:
 
 def _matches(tags: Mapping[str, str], selector: Mapping[str, str]) -> bool:
     for k, v in selector.items():
-        if k == "id":
-            if tags.get("id") != v and v != tags.get("subnet-id", ""):
+        if k in ("id", "ids"):
+            # comma-separated membership, like the reference's aws-ids
+            # selector (subnet.go:211-233, SplitCommaSeparatedString)
+            wanted = {s.strip() for s in v.split(",")}
+            if tags.get("id") not in wanted and tags.get("subnet-id", "") not in wanted:
                 return False
         elif v == "*":
             if k not in tags:

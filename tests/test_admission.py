@@ -1,0 +1,189 @@
+"""Admission validation matrix.
+
+Ports the invalid-object tables from the reference's validation suites
+(pkg/apis/v1alpha1/provider_validation.go + awsnodetemplate_validation.go
+cases exercised in pkg/apis/v1alpha1/suite_test.go, and the v1alpha5
+provisioner webhook rules)."""
+
+import pytest
+
+from karpenter_tpu.cloud.templates import BlockDevice, NodeTemplate
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import Taint
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.webhooks import (
+    AdmissionError,
+    admit_node_template,
+    admit_provisioner,
+)
+
+SEL = {"discovery": "cluster"}
+
+
+def _template(**kw):
+    base = dict(
+        name="t", subnet_selector=dict(SEL), security_group_selector=dict(SEL)
+    )
+    base.update(kw)
+    return NodeTemplate(**base)
+
+
+class TestNodeTemplateValid:
+    def test_minimal_valid(self):
+        admit_node_template(_template())
+
+    def test_id_selectors_valid(self):
+        admit_node_template(_template(
+            subnet_selector={"ids": "subnet-12345, subnet-67890"},
+            security_group_selector={"ids": "sg-12345"},
+            image_selector={"id": "img-standard-amd64"},
+        ))
+
+    def test_launch_template_override_valid(self):
+        admit_node_template(NodeTemplate(
+            name="t", subnet_selector=dict(SEL), launch_template_name="my-lt"
+        ))
+
+
+INVALID_TEMPLATES = [
+    # (case, template kwargs / builder, expected error fragment)
+    ("missing subnet selector",
+     dict(subnet_selector={}), "subnet_selector is required"),
+    ("missing security group selector",
+     dict(security_group_selector={}), "security_group_selector is required"),
+    ("empty selector value",
+     dict(subnet_selector={"env": ""}), "non-empty key and value"),
+    ("empty selector key",
+     dict(security_group_selector={"": "x"}), "non-empty key and value"),
+    ("bad subnet id shape",
+     dict(subnet_selector={"ids": "subnet-12345,bogus"}), "not a valid subnet id"),
+    ("bad security group id shape",
+     dict(security_group_selector={"ids": "sg_123"}), "not a valid security-group id"),
+    ("bad image id shape",
+     dict(image_selector={"id": "ami-123"}), "not a valid image id"),
+    ("empty tag key",
+     dict(tags={"": "v"}), "empty tag keys"),
+    ("bad http tokens",
+     dict(metadata_http_tokens="maybe"), "metadata_http_tokens"),
+    ("bad http endpoint",
+     dict(metadata_http_endpoint="sometimes"), "metadata_http_endpoint"),
+    ("hop limit too small",
+     dict(metadata_hop_limit=0), "metadata_hop_limit"),
+    ("hop limit too large",
+     dict(metadata_hop_limit=65), "metadata_hop_limit"),
+    ("unknown image family",
+     dict(image_family="windows"), "image_family"),
+    ("custom family without selector",
+     dict(image_family="custom"), "requires an image selector"),
+    ("block device without name",
+     dict(block_devices=[BlockDevice(device_name="")]), "device_name is required"),
+    ("block device bad volume type",
+     dict(block_devices=[BlockDevice(volume_type="floppy")]), "volume_type"),
+    ("block device too small",
+     dict(block_devices=[BlockDevice(size_gib=0.5)]), "size"),
+    ("block device too large",
+     dict(block_devices=[BlockDevice(size_gib=65.0 * 1024)]), "size"),
+    ("launch template + security groups",
+     dict(launch_template_name="lt"), "mutually exclusive"),
+    ("launch template + user data",
+     dict(launch_template_name="lt", security_group_selector={},
+          user_data="#!/bin/sh"), "mutually exclusive"),
+    ("launch template + image selector",
+     dict(launch_template_name="lt", security_group_selector={},
+          image_selector={"id": "img-a"}), "mutually exclusive"),
+    ("launch template + block devices",
+     dict(launch_template_name="lt", security_group_selector={},
+          block_devices=[BlockDevice()]), "mutually exclusive"),
+    ("launch template + instance profile",
+     dict(launch_template_name="lt", security_group_selector={},
+          instance_profile="prof"), "mutually exclusive"),
+]
+
+
+@pytest.mark.parametrize(
+    "case,kw,fragment", INVALID_TEMPLATES, ids=[c for c, _, _ in INVALID_TEMPLATES]
+)
+def test_invalid_node_templates(case, kw, fragment):
+    with pytest.raises(AdmissionError) as exc:
+        admit_node_template(_template(**kw))
+    assert fragment in str(exc.value)
+
+
+class TestAdmittedShapesResolve:
+    """Every selector shape admission accepts must be resolvable by the
+    providers — no 'valid' template may silently resolve to nothing."""
+
+    def test_ids_selectors_resolve(self):
+        from karpenter_tpu.cloud.templates import Image, resolve_images
+        from karpenter_tpu.providers.securitygroup import SecurityGroup, SecurityGroupProvider
+        from karpenter_tpu.providers.subnet import Subnet, SubnetProvider
+
+        t = _template(
+            subnet_selector={"ids": "subnet-12345, subnet-67890"},
+            security_group_selector={"ids": "sg-12345"},
+            image_selector={"id": "img-aaa,img-bbb"},
+        )
+        admit_node_template(t)
+        subnets = SubnetProvider([
+            Subnet("subnet-12345", "zone-1a", 10),
+            Subnet("subnet-67890", "zone-1b", 10),
+            Subnet("subnet-other", "zone-1c", 10),
+        ])
+        assert {s.subnet_id for s in subnets.list(t.subnet_selector)} == {
+            "subnet-12345", "subnet-67890"
+        }
+        sgs = SecurityGroupProvider([
+            SecurityGroup("sg-12345"), SecurityGroup("sg-other")
+        ])
+        assert [g.group_id for g in sgs.list(t.security_group_selector)] == ["sg-12345"]
+        pool = [Image("img-aaa", L.ARCH_AMD64), Image("img-bbb", L.ARCH_ARM64),
+                Image("img-ccc", L.ARCH_AMD64)]
+        assert {i.image_id for i in resolve_images(t, pool)} == {"img-aaa", "img-bbb"}
+
+
+class TestProvisionerValid:
+    def test_minimal_valid(self):
+        admit_provisioner(Provisioner(name="p"))
+
+    def test_defaults_applied(self):
+        out = admit_provisioner(Provisioner(name="p"))
+        keys = {r.key for r in out.requirements}
+        assert L.OS in keys and L.ARCH in keys and L.CAPACITY_TYPE in keys
+
+
+INVALID_PROVISIONERS = [
+    ("consolidation + empty ttl",
+     dict(consolidation_enabled=True, ttl_seconds_after_empty=30.0),
+     "mutually exclusive"),
+    ("negative empty ttl",
+     dict(ttl_seconds_after_empty=-1.0), "non-negative"),
+    ("non-positive expiry ttl",
+     dict(ttl_seconds_until_expired=0.0), "must be positive"),
+    ("negative limit",
+     dict(limits={"cpu": -4.0}), "must be non-negative"),
+    ("duplicate taints",
+     dict(taints=[Taint("a", L.EFFECT_NO_SCHEDULE, "x"),
+                  Taint("a", L.EFFECT_NO_SCHEDULE, "y")]),
+     "duplicate taint"),
+    ("empty taint key",
+     dict(taints=[Taint("", L.EFFECT_NO_SCHEDULE, "x")]), "empty key"),
+    ("bad taint effect",
+     dict(taints=[Taint("a", "Sometimes", "x")]), "bad effect"),
+    ("restricted label domain",
+     dict(labels={"karpenter.sh/custom": "h"}), "restricted domain"),
+    ("bad label value",
+     dict(labels={"app": "-leading-dash"}), "not a valid label value"),
+    ("bad label key",
+     dict(labels={"UPPER/bad key": "v"}), "not a qualified name"),
+    ("weight out of range",
+     dict(weight=101), "outside [0,100]"),
+]
+
+
+@pytest.mark.parametrize(
+    "case,kw,fragment", INVALID_PROVISIONERS, ids=[c for c, _, _ in INVALID_PROVISIONERS]
+)
+def test_invalid_provisioners(case, kw, fragment):
+    with pytest.raises(AdmissionError) as exc:
+        admit_provisioner(Provisioner(name="p", **kw))
+    assert fragment in str(exc.value)

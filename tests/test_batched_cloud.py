@@ -1,0 +1,110 @@
+"""BatchedCloud: request coalescing at the provider boundary (pkg/batcher
+analog — createfleet.go fan-out, describeinstances.go merge,
+terminateinstances.go merge)."""
+
+import threading
+
+import pytest
+
+from karpenter_tpu.cloud.base import MachineNotFoundError
+from karpenter_tpu.cloud.batched import BatchedCloud
+from karpenter_tpu.cloud.fake import FakeCloudProvider
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.machine import Machine
+from karpenter_tpu.models.requirements import IN, Requirement, Requirements
+
+
+def _machine():
+    reqs = Requirements()
+    reqs.add(Requirement(L.INSTANCE_TYPE, IN, ["m5.large"]))
+    return Machine(provisioner="default", requirements=reqs)
+
+
+def _run_concurrent(fns):
+    """Run callables on threads, releasing them together so they land in the
+    same coalescing window; returns per-thread (result | exception)."""
+    barrier = threading.Barrier(len(fns))
+    out = [None] * len(fns)
+
+    def runner(i, fn):
+        barrier.wait()
+        try:
+            out[i] = ("ok", fn())
+        except Exception as err:
+            out[i] = ("err", err)
+
+    threads = [threading.Thread(target=runner, args=(i, f)) for i, f in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return out
+
+
+@pytest.fixture
+def batched(small_catalog):
+    return BatchedCloud(FakeCloudProvider(small_catalog), idle_seconds=0.05)
+
+
+class TestCreateFleetFanOut:
+    def test_identical_specs_share_one_fleet_call(self, batched):
+        results = _run_concurrent([lambda: batched.create(_machine()) for _ in range(6)])
+        assert all(k == "ok" for k, _ in results)
+        # one backend round trip for the whole bucket...
+        assert batched.creates.batch_count == 1
+        assert list(batched.creates.batch_sizes) == [6]
+        # ...but each requester got its own instance
+        pids = {m.provider_id for _, m in results}
+        assert len(pids) == 6
+
+    def test_distinct_specs_use_distinct_buckets(self, batched):
+        def other():
+            reqs = Requirements()
+            reqs.add(Requirement(L.INSTANCE_TYPE, IN, ["c5.large"]))
+            return Machine(provisioner="default", requirements=reqs)
+
+        _run_concurrent([lambda: batched.create(_machine()),
+                         lambda: batched.create(other())])
+        assert batched.creates.batch_count == 2
+
+
+class TestDescribeMerge:
+    def test_concurrent_gets_merge_into_one_describe(self, batched):
+        pids = [batched.create(_machine()).provider_id for _ in range(4)]
+        batched.describes.batch_count = 0
+        results = _run_concurrent([lambda p=p: batched.get(p) for p in pids])
+        assert all(k == "ok" for k, _ in results)
+        assert {m.provider_id for _, m in results} == set(pids)
+        assert batched.describes.batch_count == 1
+        assert batched.describes.batch_sizes[-1] == 4
+
+    def test_not_found_maps_per_caller(self, batched):
+        pid = batched.create(_machine()).provider_id
+        results = _run_concurrent([
+            lambda: batched.get(pid),
+            lambda: batched.get("fake://nope/999"),
+        ])
+        by_kind = sorted(k for k, _ in results)
+        assert by_kind == ["err", "ok"]
+        err = next(v for k, v in results if k == "err")
+        assert isinstance(err, MachineNotFoundError)
+
+
+class TestTerminateMerge:
+    def test_concurrent_deletes_merge(self, batched):
+        machines = [batched.create(_machine()) for _ in range(5)]
+        results = _run_concurrent([lambda m=m: batched.delete(m) for m in machines])
+        assert all(k == "ok" for k, _ in results)
+        assert batched.terminates.batch_count == 1
+        assert list(batched.terminates.batch_sizes) == [5]
+        for m in machines:
+            with pytest.raises(MachineNotFoundError):
+                batched.inner.get(m.provider_id)
+
+
+class TestTransparency:
+    def test_provider_attrs_pass_through(self, batched):
+        batched.inject_ice("m5.large", "zone-a", "on-demand")
+        assert ("m5.large", "zone-a", "on-demand") in batched.inner.ice_offerings
+        assert batched.node_ready_delay == 0.0
+        assert batched.name() == "fake"

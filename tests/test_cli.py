@@ -109,7 +109,9 @@ def test_inventory_metrics_are_emitted(small_catalog):
     for p in list(state.pods)[: len(state.pods) - 3]:
         state.delete_pod(p)
     clock.advance(MIN_NODE_LIFETIME + 1)
-    action = deprov.reconcile()
+    assert deprov.reconcile() is None  # proposes; 15s validation TTL armed
+    clock.advance(16)
+    action = deprov.reconcile()        # re-validated and executed
     assert action is not None
 
     emitted = (set(reg.counters) | set(reg.gauges) | set(reg.histograms))

@@ -147,7 +147,8 @@ class TestControllerIntegration:
         prov_ctrl = ProvisioningController(state, cloud, scheduler=sched, registry=reg, clock=clock)
         term = TerminationController(state, cloud, registry=reg, clock=clock)
         deprov = DeprovisioningController(state, cloud, term, provisioning=prov_ctrl,
-                                          scheduler=sched, registry=reg, clock=clock)
+                                          scheduler=sched, registry=reg, clock=clock,
+                                          deprovisioning_ttl=0.0)
         state.apply_provisioner(Provisioner(
             name="default", consolidation_enabled=True,
             requirements=[Requirement(L.INSTANCE_TYPE, IN, ["c5.2xlarge"])],

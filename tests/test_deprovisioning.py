@@ -35,6 +35,8 @@ def make_env(small_catalog, provisioner=None, drift_enabled=False):
     deprov = DeprovisioningController(
         state, cloud, term, provisioning=prov_ctrl, scheduler=sched,
         recorder=recorder, registry=registry, clock=clock, drift_enabled=drift_enabled,
+        deprovisioning_ttl=0.0,  # unit tests exercise mechanisms directly;
+                                 # TestDeprovisioningTTL covers the 15s wait
     )
     state.apply_provisioner(provisioner or Provisioner(name="default", consolidation_enabled=True))
     return clock, state, cloud, prov_ctrl, term, deprov, recorder
@@ -210,6 +212,63 @@ class TestReplacementWaitReady:
         assert repl not in state.nodes
         assert old_node in state.nodes
         assert any(e.reason == "ReplacementTimedOut" for e in recorder.events)
+
+
+class TestDeprovisioningTTL:
+    """Proposed actions wait DEPROVISIONING_TTL, get re-validated against
+    fresh state, then execute (designs/deprovisioning.md 'DeprovisioningTTL
+    of 15 seconds')."""
+
+    def _env(self, small_catalog):
+        clock = FakeClock()
+        state = ClusterState(clock=clock)
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+        recorder = Recorder()
+        registry = Registry()
+        sched = BatchScheduler(backend="oracle", registry=registry)
+        prov_ctrl = ProvisioningController(
+            state, cloud, scheduler=sched, recorder=recorder, registry=registry, clock=clock
+        )
+        term = TerminationController(state, cloud, recorder=recorder, registry=registry, clock=clock)
+        deprov = DeprovisioningController(
+            state, cloud, term, provisioning=prov_ctrl, scheduler=sched,
+            recorder=recorder, registry=registry, clock=clock,
+        )  # default 15s TTL
+        state.apply_provisioner(Provisioner(name="default", consolidation_enabled=True))
+        return clock, state, cloud, prov_ctrl, deprov
+
+    def test_action_deferred_then_executed(self, small_catalog):
+        clock, state, cloud, prov_ctrl, deprov = self._env(small_catalog)
+        schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 1.0})])
+        node = state.bindings["p"]
+        state.delete_pod("p")
+        clock.advance(MIN_NODE_LIFETIME + 1)
+        # first reconcile proposes but does not act
+        assert deprov.reconcile() is None
+        assert node in state.nodes
+        # still inside the TTL: nothing happens
+        clock.advance(5)
+        assert deprov.reconcile() is None
+        assert node in state.nodes
+        # TTL passed: re-validated and executed
+        clock.advance(11)
+        action = deprov.reconcile()
+        assert action is not None and action.kind == "delete"
+        assert node not in state.nodes
+
+    def test_invalidated_proposal_dropped(self, small_catalog):
+        clock, state, cloud, prov_ctrl, deprov = self._env(small_catalog)
+        schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 1.0})])
+        node = state.bindings["p"]
+        state.delete_pod("p")
+        clock.advance(MIN_NODE_LIFETIME + 1)
+        assert deprov.reconcile() is None  # proposal armed
+        # conditions change inside the TTL: a pod lands on the node again
+        state.add_pod(PodSpec(name="q", requests={"cpu": 1.0}))
+        state.bind("q", node)
+        clock.advance(16)
+        assert deprov.reconcile() is None  # re-validation fails; no action
+        assert node in state.nodes
 
 
 class TestMultiNode:

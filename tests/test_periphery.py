@@ -213,9 +213,13 @@ class TestTemplates:
         assert '"a" = "b"' in out and '"t" = "v:NoSchedule"' in out
 
     def test_custom_family_requires_selector(self):
-        bad = NodeTemplate(name="x", image_family="custom")
-        assert bad.validate()
-        ok = NodeTemplate(name="x", image_family="custom", image_selector={"id": "img-1"})
+        sel = {"discovery": "cluster"}
+        bad = NodeTemplate(name="x", image_family="custom",
+                           subnet_selector=sel, security_group_selector=sel)
+        assert any("image selector" in e for e in bad.validate())
+        ok = NodeTemplate(name="x", image_family="custom",
+                          subnet_selector=sel, security_group_selector=sel,
+                          image_selector={"id": "img-1"})
         assert ok.validate() == []
 
     def test_launch_template_cache(self):
