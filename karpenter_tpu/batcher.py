@@ -124,8 +124,9 @@ class ThreadCoalescer:
             except Exception as err:  # backend-wide failure fans out to all
                 outcomes = [("err", err)] * len(reqs)
             batch.results = outcomes
-            self.batch_count += 1
-            self.batch_sizes.append(len(reqs))
+            with self._lock:  # concurrent leaders of other buckets also count
+                self.batch_count += 1
+                self.batch_sizes.append(len(reqs))
             batch.event.set()
         else:
             batch.event.wait()

@@ -44,8 +44,17 @@ class BatchedCloud(CloudProvider):
 
     # ---- batch executors: one backend round trip each -------------------
     def _do_creates(self, machines: List[Machine]) -> List[_Outcome]:
+        bulk = getattr(self.inner, "create_fleet", None)
+        if bulk is not None:
+            # one fleet round trip; per-slot Machine or error fans out
+            return [
+                ("err", slot) if isinstance(slot, Exception) else ("ok", slot)
+                for slot in bulk(machines)
+            ]
+        # provider without a bulk hook: coalescing only dedups the window,
+        # each create is still its own round trip
         out: List[_Outcome] = []
-        for m in machines:  # one fleet request; N instances fan out
+        for m in machines:
             try:
                 out.append(("ok", self.inner.create(m)))
             except Exception as err:

@@ -63,6 +63,10 @@ class FakeCloudProvider(CloudProvider):
         # makes existing machines drift.
         self.templates: Dict[str, NodeTemplate] = {"default": NodeTemplate()}
         self.images: List[Image] = []
+        # named pre-built launch templates (launch_template_name override):
+        # LT name -> image id it launches with
+        self.launch_templates: Dict[str, str] = {}
+        self.fleet_calls = 0  # one per create_fleet round trip
         self.ice_offerings: Set[Tuple[str, str, str]] = set()  # (type, zone, ct)
         self.create_calls: List[Machine] = []
         self.delete_calls: List[str] = []
@@ -87,6 +91,11 @@ class FakeCloudProvider(CloudProvider):
         """Add an image to the catalog (the SSM-alias-update analog: a newer
         image per (family, arch, accel) supersedes the old in resolution)."""
         self.images.append(image)
+
+    def register_launch_template(self, name: str, image_id: str) -> None:
+        """Register a pre-built launch template for launch_template_name
+        overrides (the user-managed LT the reference launches verbatim)."""
+        self.launch_templates[name] = image_id
 
     # ---- CloudProvider -------------------------------------------------
     def create(self, machine: Machine) -> Machine:
@@ -199,10 +208,28 @@ class FakeCloudProvider(CloudProvider):
     def get_instance_types(self, provisioner: Optional[Provisioner] = None) -> List[InstanceType]:
         return list(self.instance_types)
 
+    def create_fleet(self, machines: Sequence[Machine]) -> List[object]:
+        """Bulk create: ONE fleet round trip launches every machine
+        (CreateFleet with summed capacity, createfleet.go fan-out).  Returns
+        one slot per machine — the launched Machine, or the per-pool error —
+        so callers see partial fulfilment exactly like a real fleet."""
+        self.fleet_calls += 1
+        out: List[object] = []
+        for m in machines:
+            try:
+                out.append(self.create(m))
+            except Exception as err:
+                out.append(err)
+        return out
+
     def _image_for(self, template_name: str, it: InstanceType) -> str:
         tmpl = self.templates.get(template_name)
         if tmpl is None:
             return ""
+        if tmpl.launch_template_name is not None:
+            # user-managed LT launched verbatim: the image is whatever the
+            # named template carries (instance.go launch-template override)
+            return self.launch_templates.get(tmpl.launch_template_name, "")
         images = resolve_images(tmpl, self.images)
         mapped = images_for_instance_type(images, it)
         return mapped[0].image_id if mapped else ""
@@ -222,6 +249,11 @@ class FakeCloudProvider(CloudProvider):
         tmpl = self.templates.get(machine.node_template)
         if tmpl is None:
             return False
+        if tmpl.launch_template_name is not None:
+            # LT override: drift when the user repointed the named template
+            # at a different image
+            current = self.launch_templates.get(tmpl.launch_template_name, "")
+            return bool(current) and machine.image_id != current
         it = next(
             (t for t in self.instance_types if t.name == machine.instance_type), None
         )

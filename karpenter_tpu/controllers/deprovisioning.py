@@ -173,13 +173,17 @@ class DeprovisioningController:
             fresh = self._emptiness()
         else:
             fresh = self._consolidation()
-        if (
-            fresh is not None
-            and fresh.mechanism == proposed.mechanism
-            and fresh.kind == proposed.kind
-            and set(fresh.nodes) == set(proposed.nodes)
-        ):
+        if fresh is None or fresh.mechanism != proposed.mechanism or fresh.kind != proposed.kind:
+            return None
+        if set(fresh.nodes) == set(proposed.nodes):
             return fresh
+        # Deletes stay valid when the eligible set GREW during the wait
+        # (e.g. more nodes crossed their empty-TTL): execute the proposed
+        # subset rather than dropping and restarting the TTL clock forever
+        # under steady churn.  Replacements were computed for an exact node
+        # set, so any change drops them.
+        if proposed.kind == "delete" and set(proposed.nodes) <= set(fresh.nodes):
+            return proposed
         return None
 
     def _should_evaluate_consolidation(self) -> bool:
@@ -258,9 +262,34 @@ class DeprovisioningController:
             cost *= remaining / total
         return cost
 
+    def _pod_could_use(self, pod: PodSpec, node) -> bool:
+        """Could this pending pod land on this node?  (taints, resources,
+        requirement compatibility — the cheap host-side screen)."""
+        if any(t.blocks(pod.tolerations) for t in node.taints):
+            return False
+        if not node.fits(pod.requests):
+            return False
+        terms = pod.scheduling_requirements()
+        return any(reqs.compatible(node.labels) is None for reqs in terms)
+
     def _consolidation(self) -> Optional[Action]:
-        if self.state.pending_pods():
-            return None  # stabilization: wait for the cluster to settle
+        pending = self.state.pending_pods()
+        if pending:
+            # Stabilization: wait for the cluster to settle before any
+            # simulation-based action.  But empty nodes that NO pending pod
+            # could land on are still reclaimable — otherwise an adversary
+            # that keeps a pod perpetually unschedulable (chaos suite,
+            # test/suites/chaos/suite_test.go:66-112) freezes consolidation
+            # while provisioning keeps adding nodes: unbounded growth.
+            empties = [
+                ns for _, ns in self._candidates()
+                if not ns.node.pods
+                and not any(self._pod_could_use(p, ns.node) for p in pending)
+            ]
+            if empties:
+                return Action("delete", "consolidation",
+                              sorted(ns.node.name for ns in empties))
+            return None
         cands = self._candidates()
         if not cands:
             return None

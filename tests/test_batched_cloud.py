@@ -53,6 +53,7 @@ class TestCreateFleetFanOut:
         # one backend round trip for the whole bucket...
         assert batched.creates.batch_count == 1
         assert list(batched.creates.batch_sizes) == [6]
+        assert batched.inner.fleet_calls == 1  # truly ONE fleet API call
         # ...but each requester got its own instance
         pids = {m.provider_id for _, m in results}
         assert len(pids) == 6

@@ -1,0 +1,163 @@
+"""Adversarial operator tests — the chaos-suite analog.
+
+Ports the runaway scale-up guard (test/suites/chaos/suite_test.go:66-112,
+162-209: a taint-injecting adversary against the controller loop with a
+node-count monitor asserting bounded growth) and the utilization packing E2E
+(test/suites/utilization/suite_test.go:55-73: 100 x 1.5-CPU pods pack one
+per small node) against the fake cloud + controller loop."""
+
+import pytest
+
+from karpenter_tpu.cloud.fake import FakeCloudProvider
+from karpenter_tpu.controllers.deprovisioning import (
+    MIN_NODE_LIFETIME,
+    DeprovisioningController,
+)
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.state import ClusterState
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.events import Recorder
+from karpenter_tpu.metrics import Registry
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import PodSpec, Taint
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.requirements import IN, Requirement
+from karpenter_tpu.solver.scheduler import BatchScheduler
+from karpenter_tpu.utils.clock import FakeClock
+
+CHAOS_TAINT = Taint("chaos", L.EFFECT_NO_SCHEDULE, "true")
+
+
+def make_env(small_catalog, provisioner):
+    clock = FakeClock()
+    state = ClusterState(clock=clock)
+    cloud = FakeCloudProvider(small_catalog, clock=clock)
+    recorder = Recorder()
+    registry = Registry()
+    sched = BatchScheduler(backend="oracle", registry=registry)
+    prov_ctrl = ProvisioningController(
+        state, cloud, scheduler=sched, recorder=recorder, registry=registry, clock=clock
+    )
+    term = TerminationController(state, cloud, recorder=recorder, registry=registry, clock=clock)
+    deprov = DeprovisioningController(
+        state, cloud, term, provisioning=prov_ctrl, scheduler=sched,
+        recorder=recorder, registry=registry, clock=clock, deprovisioning_ttl=0.0,
+    )
+    state.apply_provisioner(provisioner)
+    return clock, state, cloud, prov_ctrl, deprov
+
+
+class TaintAdder:
+    """The adversary (startTaintAdder): taints every node right after it
+    appears and evicts its pods, so the workload never sticks and keeps
+    looking unschedulable."""
+
+    def __init__(self, state: ClusterState) -> None:
+        self.state = state
+        self.tainted = set()
+
+    def run(self) -> None:
+        for name, ns in list(self.state.nodes.items()):
+            if name in self.tainted:
+                continue
+            self.tainted.add(name)
+            ns.node.taints = list(ns.node.taints) + [CHAOS_TAINT]
+            ns.nominated_until = 0.0  # drop in-flight nomination protection
+            for p in list(ns.node.pods):
+                self.state.bindings.pop(p.name, None)  # evicted -> pending
+            ns.node.pods = []
+
+
+class TestRunawayScaleUp:
+    def _churn(self, clock, state, prov_ctrl, deprov, adversary, cycles, step):
+        peak = 0
+        for _ in range(cycles):
+            prov_ctrl.reconcile()
+            clock.advance(1.5)          # let the batch window fire
+            prov_ctrl.reconcile()
+            adversary.run()
+            deprov.reconcile()
+            clock.advance(step)
+            peak = max(peak, len(state.nodes))
+        return peak
+
+    def test_bounded_with_consolidation(self, small_catalog):
+        """Consolidation keeps reaping the tainted-empty nodes, so the
+        adversary cannot drive unbounded growth (chaos suite case 1)."""
+        clock, state, cloud, prov_ctrl, deprov = make_env(
+            small_catalog,
+            Provisioner(name="default", consolidation_enabled=True),
+        )
+        state.add_pod(PodSpec(name="app", requests={"cpu": 1.0}, owner_key="d"))
+        adversary = TaintAdder(state)
+        # nodes accumulate for MIN_NODE_LIFETIME, then deletes keep pace:
+        # with a 30s churn step the standing population is bounded by
+        # ~lifetime/step + slack
+        bound = int(MIN_NODE_LIFETIME / 30.0) + 5
+        peak = self._churn(clock, state, prov_ctrl, deprov, adversary,
+                           cycles=40, step=30.0)
+        assert peak < bound, f"runaway scale-up: peak {peak} nodes >= {bound}"
+        # cleanup keeps working at steady state, not just at the end
+        assert len(state.nodes) < bound
+
+    def test_bounded_with_ttl_after_empty(self, small_catalog):
+        """ttlSecondsAfterEmpty variant (chaos suite case 2): emptiness
+        deletes tainted nodes without the consolidation lifetime gate."""
+        clock, state, cloud, prov_ctrl, deprov = make_env(
+            small_catalog,
+            Provisioner(name="default", consolidation_enabled=False,
+                        ttl_seconds_after_empty=60.0),
+        )
+        state.add_pod(PodSpec(name="app", requests={"cpu": 1.0}, owner_key="d"))
+        adversary = TaintAdder(state)
+        peak = self._churn(clock, state, prov_ctrl, deprov, adversary,
+                           cycles=40, step=30.0)
+        # TTL 60s / 30s step -> ~2-3 standing tainted nodes + the fresh one
+        assert peak <= 6, f"runaway scale-up: peak {peak} nodes"
+
+    def test_provisioner_limits_hold_under_churn(self, small_catalog):
+        """Provisioner limits bound total capacity even while the adversary
+        is churning (designs/limits.md)."""
+        clock, state, cloud, prov_ctrl, deprov = make_env(
+            small_catalog,
+            Provisioner(name="default", consolidation_enabled=True,
+                        limits={"cpu": 8.0},
+                        requirements=[Requirement(L.INSTANCE_TYPE, IN, ["c5.large"])]),
+        )
+        for i in range(4):
+            state.add_pod(PodSpec(name=f"app-{i}", requests={"cpu": 1.0}, owner_key="d"))
+        adversary = TaintAdder(state)
+        for _ in range(25):
+            prov_ctrl.reconcile()
+            clock.advance(1.5)
+            prov_ctrl.reconcile()
+            total_cpu = sum(
+                ns.node.allocatable.get("cpu", 0.0) for ns in state.nodes.values()
+            )
+            assert total_cpu <= 8.0 + 1e-6, f"limit breached: {total_cpu} cpu"
+            adversary.run()
+            deprov.reconcile()
+            clock.advance(30.0)
+
+
+class TestUtilizationPacking:
+    def test_exact_one_pod_per_small_node(self, small_catalog):
+        """100 x 1.5-CPU pods on a type with 1.83 allocatable CPU pack
+        exactly one per node -> exactly 100 nodes
+        (test/suites/utilization/suite_test.go:55-73)."""
+        clock, state, cloud, prov_ctrl, deprov = make_env(
+            small_catalog,
+            Provisioner(name="default",
+                        requirements=[Requirement(L.INSTANCE_TYPE, IN, ["c5.large"])]),
+        )
+        for i in range(100):
+            state.add_pod(PodSpec(name=f"p{i}", requests={"cpu": 1.5}, owner_key="d"))
+        prov_ctrl.reconcile()
+        clock.advance(1.5)
+        prov_ctrl.reconcile()
+        assert not state.pending_pods()
+        assert len(state.nodes) == 100
+        assert all(
+            ns.node.instance_type == "c5.large" and len(ns.node.pods) == 1
+            for ns in state.nodes.values()
+        )

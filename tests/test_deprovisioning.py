@@ -256,6 +256,25 @@ class TestDeprovisioningTTL:
         assert action is not None and action.kind == "delete"
         assert node not in state.nodes
 
+    def test_grown_delete_set_does_not_starve_proposal(self, small_catalog):
+        """If MORE nodes become delete-eligible during the TTL wait, the
+        proposed subset still executes instead of restarting the clock."""
+        clock, state, cloud, prov_ctrl, deprov = self._env(small_catalog)
+        schedule(state, prov_ctrl, clock, [
+            PodSpec(name="p1", requests={"cpu": 1.0}),
+            PodSpec(name="p2", requests={"cpu": 7.0}),  # forces a 2nd node
+        ])
+        n1, n2 = state.bindings["p1"], state.bindings["p2"]
+        state.delete_pod("p1")
+        clock.advance(MIN_NODE_LIFETIME + 1)
+        assert deprov.reconcile() is None  # proposes delete of n1's node
+        # during the wait the second node empties too -> eligible set grows
+        state.delete_pod("p2")
+        clock.advance(16)
+        action = deprov.reconcile()
+        assert action is not None and action.kind == "delete"
+        assert set(action.nodes) <= {n1, n2} and len(action.nodes) >= 1
+
     def test_invalidated_proposal_dropped(self, small_catalog):
         clock, state, cloud, prov_ctrl, deprov = self._env(small_catalog)
         schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 1.0})])
@@ -410,6 +429,26 @@ class TestExpirationAndDrift:
         action = deprov.reconcile()
         assert action is not None and action.mechanism == "drift"
         assert node not in state.nodes
+
+    def test_launch_template_override_drift(self, small_catalog):
+        """launch_template_name templates launch with the named LT's image;
+        repointing the LT at a new image drifts existing machines."""
+        from karpenter_tpu.cloud.templates import NodeTemplate
+
+        clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(
+            small_catalog, drift_enabled=True
+        )
+        cloud.templates["default"] = NodeTemplate(
+            name="default", subnet_selector={"discovery": "c"},
+            launch_template_name="my-lt",
+        )
+        cloud.register_launch_template("my-lt", "img-custom-v1")
+        schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 0.5})])
+        machine = state.nodes[state.bindings["p"]].machine
+        assert machine.image_id == "img-custom-v1"
+        assert not cloud.is_machine_drifted(machine)
+        cloud.register_launch_template("my-lt", "img-custom-v2")
+        assert cloud.is_machine_drifted(machine)
 
     def test_selector_images_do_not_drift_while_still_matching(self, small_catalog):
         """Selector-pinned images (ami.go:158-230) keep matching even when
