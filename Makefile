@@ -19,9 +19,10 @@ karpenter_tpu/service/solver_pb2.py: karpenter_tpu/service/solver.proto
 test:
 	$(PYTHON) -m pytest tests/ -x -q
 
-# randomized order + repetition, the reference's battletest analog
+# the reference's battletest analog (Makefile:69-76: -race + randomized
+# order + random delays): widened seeded churn/race sweep, then the suite
 battletest:
-	$(PYTHON) -m pytest tests/ -q -p no:randomly 2>/dev/null || \
+	KT_BATTLE_SEEDS=24 $(PYTHON) -m pytest tests/test_battle.py tests/test_fuzz_parity.py -q
 	$(PYTHON) -m pytest tests/ -q
 
 bench:

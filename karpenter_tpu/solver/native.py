@@ -227,7 +227,11 @@ def solve_tensors_native(
     nused = int(n_used[0])
     nodes: List[SimNode] = []
     slot_to_node: Dict[int, SimNode] = {}
-    for ni, node in enumerate(existing_nodes):
+    # snapshots: placements must not leak into the caller's node objects;
+    # the placed snapshots are returned (existing_nodes) so retry waves can
+    # chain on them without double-booking capacity
+    snap_existing = [n.snapshot() for n in existing_nodes]
+    for ni, node in enumerate(snap_existing):
         slot_to_node[ni] = node
     n_ct = max(1, len(st.ct_names))
     for s in range(NE, nused):
@@ -269,6 +273,6 @@ def solve_tensors_native(
         nodes=nodes,
         assignments=assignments,
         infeasible=infeasible_map,
-        existing_nodes=list(existing_nodes),
+        existing_nodes=snap_existing,
         solve_ms=(time.perf_counter() - t0) * 1000.0,
     )

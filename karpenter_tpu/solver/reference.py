@@ -434,8 +434,10 @@ def solve(
     the consolidation what-if reuses this with ``allow_new_nodes``/
     ``max_new_nodes`` — §3.3)."""
     t0 = time.perf_counter()
+    # snapshots: simulated placements must not leak into the caller's nodes
+    existing = [n.snapshot() for n in existing_nodes]
     s = _Solver(
-        pods, provisioners, instance_types, list(existing_nodes), list(daemonsets),
+        pods, provisioners, instance_types, existing, list(daemonsets),
         unavailable or set(), allow_new_nodes, max_new_nodes,
     )
     s.run()
@@ -443,6 +445,6 @@ def solve(
         nodes=s.new_nodes,
         assignments=s.assignments,
         infeasible=s.infeasible,
-        existing_nodes=list(existing_nodes),
+        existing_nodes=existing,
         solve_ms=(time.perf_counter() - t0) * 1000.0,
     )

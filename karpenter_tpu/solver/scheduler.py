@@ -75,13 +75,22 @@ def _harden_preferences(pod: PodSpec, keep: Optional[int] = None) -> PodSpec:
 
 def _merge(result: SolveResult, sub: SolveResult) -> None:
     """Fold a retry wave's outcome into ``result`` (shared by the preference
-    ladder and the OR-term ladder so their merge semantics cannot diverge)."""
+    ladder and the OR-term ladder so their merge semantics cannot diverge).
+
+    ``sub`` solved against ``result.existing_nodes + result.nodes`` — the
+    PLACED snapshots of the prior wave — and returned its own placed copies
+    in ``sub.existing_nodes``.  Those copies replace the prior references so
+    the next wave sees every placement so far (capacity bookkeeping chains
+    across waves without mutating the caller's node objects)."""
     for name in list(result.infeasible):
         if name in sub.assignments:
             del result.infeasible[name]
     result.infeasible.update(sub.infeasible)
     result.assignments.update(sub.assignments)
-    result.nodes.extend(sub.nodes)
+    ne = len(result.existing_nodes)
+    placed = list(sub.existing_nodes)
+    result.existing_nodes = placed[:ne]
+    result.nodes = placed[ne:] + list(sub.nodes)
     result.solve_ms += sub.solve_ms
 
 
@@ -150,7 +159,7 @@ class BatchScheduler:
                     break
                 _merge(result, self._solve_wave(
                     alts, provisioners, instance_types,
-                    list(existing_nodes) + result.nodes, daemonsets,
+                    list(result.existing_nodes) + result.nodes, daemonsets,
                     unavailable, allow_new_nodes,
                     _budget_left(result, max_new_nodes),
                 ))
@@ -177,7 +186,7 @@ class BatchScheduler:
             _merge(result, self._solve_once(
                 [_harden_preferences(p, keep) for p in retry],
                 provisioners, instance_types,
-                list(existing_nodes) + result.nodes, daemonsets,
+                list(result.existing_nodes) + result.nodes, daemonsets,
                 unavailable, allow_new_nodes,
                 _budget_left(result, max_new_nodes),
             ))
@@ -240,19 +249,32 @@ class BatchScheduler:
                          and _refers(tpu_pods, cpu_pods)
                          and not _refers(cpu_pods, tpu_pods))
 
+        # placed-snapshot chaining: each stage solves against the previous
+        # stage's PLACED existing snapshots (+ placed prior new nodes), and
+        # the placed copies replace the prior references afterwards — see
+        # _merge for the cross-wave bookkeeping rationale
+        cur_existing: List[SimNode] = list(existing_nodes)
         nodes: List[SimNode] = []
         assignments: Dict[str, str] = {}
         infeasible: Dict[str, str] = {}
         solve_ms = 0.0
 
+        def chain(res: SolveResult) -> None:
+            """Adopt a stage's placed snapshots of (cur_existing + nodes)."""
+            nonlocal cur_existing, nodes
+            ne = len(cur_existing)
+            placed = list(res.existing_nodes)
+            cur_existing = placed[:ne]
+            nodes = placed[ne:] + list(res.nodes)
+
         if cpu_first:
             res0 = oracle_solve(
                 cpu_pods, provisioners, instance_types,
-                existing_nodes=list(existing_nodes), daemonsets=daemonsets,
+                existing_nodes=cur_existing, daemonsets=daemonsets,
                 unavailable=unavailable, allow_new_nodes=allow_new_nodes,
                 max_new_nodes=max_new_nodes,
             )
-            nodes.extend(res0.nodes)
+            chain(res0)
             assignments.update(res0.assignments)
             infeasible.update(res0.infeasible)
             solve_ms += res0.solve_ms
@@ -271,14 +293,14 @@ class BatchScheduler:
                 from . import native as native_mod
 
                 res = native_mod.solve_tensors_native(
-                    st, existing_nodes=list(existing_nodes) + nodes,
-                    max_nodes=len(existing_nodes) + len(nodes) + new_budget,
+                    st, existing_nodes=list(cur_existing) + nodes,
+                    max_nodes=len(cur_existing) + len(nodes) + new_budget,
                 )
                 backend_used = "native"
             else:
                 out = self._tpu.solve(
-                    st, existing_nodes=list(existing_nodes) + nodes,
-                    max_nodes=len(existing_nodes) + len(nodes) + new_budget,
+                    st, existing_nodes=list(cur_existing) + nodes,
+                    max_nodes=len(cur_existing) + len(nodes) + new_budget,
                     mesh=self.mesh,
                 )
                 res = out.result
@@ -296,7 +318,7 @@ class BatchScheduler:
                 for p in list(res.assignments):
                     if p in infeasible:
                         del res.assignments[p]
-            nodes.extend(res.nodes)
+            chain(res)
             assignments.update(res.assignments)
             infeasible.update(res.infeasible)
             solve_ms += res.solve_ms
@@ -305,7 +327,7 @@ class BatchScheduler:
             t0 = time.perf_counter()
             res2 = oracle_solve(
                 cpu_pods, provisioners, instance_types,
-                existing_nodes=list(existing_nodes) + nodes,
+                existing_nodes=list(cur_existing) + nodes,
                 daemonsets=daemonsets, unavailable=unavailable,
                 allow_new_nodes=allow_new_nodes,
                 max_new_nodes=None if max_new_nodes is None else max(0, max_new_nodes - len(nodes)),
@@ -313,7 +335,7 @@ class BatchScheduler:
             self.registry.histogram(SOLVER_BACKEND_DURATION).observe(
                 time.perf_counter() - t0, {"backend": "oracle"}
             )
-            nodes.extend(res2.nodes)
+            chain(res2)
             assignments.update(res2.assignments)
             infeasible.update(res2.infeasible)
             solve_ms += res2.solve_ms
@@ -322,6 +344,6 @@ class BatchScheduler:
             nodes=nodes,
             assignments=assignments,
             infeasible=infeasible,
-            existing_nodes=list(existing_nodes),
+            existing_nodes=cur_existing,
             solve_ms=solve_ms,
         )

@@ -772,7 +772,11 @@ class TpuSolver:
             new_nodes.append(node)
             slot_to_node[si] = node
 
-        for ni, node in enumerate(existing_nodes):
+        # snapshots: placements must not leak into the caller's node objects;
+        # the placed snapshots are returned (existing_nodes) so retry waves
+        # can chain on them without double-booking capacity
+        snap_existing = [n.snapshot() for n in existing_nodes]
+        for ni, node in enumerate(snap_existing):
             slot_to_node[ni] = node
 
         assignments: Dict[str, str] = {}
@@ -805,7 +809,7 @@ class TpuSolver:
             nodes=new_nodes,
             assignments=assignments,
             infeasible=infeasible_map,
-            existing_nodes=list(existing_nodes),
+            existing_nodes=snap_existing,
             solve_ms=solve_ms,
         )
         return TpuSolveOutput(

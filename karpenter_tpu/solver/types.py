@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
@@ -55,6 +56,18 @@ class SimNode:
         req = dict(requests)
         req.setdefault(L.RESOURCE_PODS, 1.0)
         return fits(req, self.remaining())
+
+    def snapshot(self) -> "SimNode":
+        """Simulation copy: solvers place pods by mutating ``pods``, and a
+        what-if solve (consolidation) must never leak placements into the
+        caller's live node objects."""
+        return dataclasses.replace(
+            self,
+            pods=list(self.pods),
+            labels=dict(self.labels),
+            taints=list(self.taints),
+            allocatable=dict(self.allocatable),
+        )
 
 
 @dataclass

@@ -1,0 +1,199 @@
+"""Battle tests — the `make battletest` analog of the reference's
+race/stress hardening (Makefile:69-76: `-race`, randomized spec order,
+random test delays).
+
+Python has no `-race`, so the two race surfaces get direct thread hammering
+(ThreadCoalescer, the gRPC-style solver service is covered in
+test_service.py), and the controller loop gets seeded randomized event
+churn with invariants checked after every step — the random-interleaving
+analog of randomized spec order."""
+
+import os
+import random
+import threading
+
+import pytest
+
+#: `make battletest` widens the seed sweep (KT_BATTLE_SEEDS=24)
+N_SEEDS = int(os.environ.get("KT_BATTLE_SEEDS", "6"))
+
+from karpenter_tpu.batcher import ThreadCoalescer
+from karpenter_tpu.cloud.fake import FakeCloudProvider
+from karpenter_tpu.cloud.templates import Image
+from karpenter_tpu.controllers.deprovisioning import DeprovisioningController
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.state import ClusterState
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.events import Recorder
+from karpenter_tpu.metrics import Registry
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import PodSpec
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.solver.scheduler import BatchScheduler
+from karpenter_tpu.utils.clock import FakeClock
+
+CPU_LIMIT = 64.0
+
+
+def check_invariants(state: ClusterState, cloud: FakeCloudProvider) -> None:
+    # every binding points at a live pod and a live node, and the node's pod
+    # list agrees
+    for pod_name, node_name in state.bindings.items():
+        assert pod_name in state.pods, f"binding for deleted pod {pod_name}"
+        assert node_name in state.nodes, f"binding to deleted node {node_name}"
+        ns = state.nodes[node_name]
+        assert any(p.name == pod_name for p in ns.node.pods), (
+            f"{pod_name} bound to {node_name} but absent from its pod list"
+        )
+    # node pod lists never reference unbound/deleted pods
+    for name, ns in state.nodes.items():
+        for p in ns.node.pods:
+            if p.is_daemon:
+                continue
+            assert state.bindings.get(p.name) == name, (
+                f"{p.name} on {name} without a matching binding"
+            )
+    # provisioner limits hold
+    total_cpu = sum(
+        ns.node.allocatable.get("cpu", 0.0) for ns in state.nodes.values()
+    )
+    assert total_cpu <= CPU_LIMIT + 1e-6, f"cpu limit breached: {total_cpu}"
+    # every node's machine is live in the cloud unless mid-termination
+    for name, ns in state.nodes.items():
+        if ns.machine is None or ns.marked_for_deletion:
+            continue
+        inst = cloud.instances.get(ns.machine.provider_id)
+        assert inst is not None and not inst.terminated, (
+            f"{name} backed by terminated/unknown instance"
+        )
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_randomized_controller_churn(seed, small_catalog):
+    rng = random.Random(seed)
+    clock = FakeClock()
+    state = ClusterState(clock=clock)
+    cloud = FakeCloudProvider(small_catalog, clock=clock)
+    recorder = Recorder()
+    registry = Registry()
+    sched = BatchScheduler(backend="oracle", registry=registry)
+    prov_ctrl = ProvisioningController(
+        state, cloud, scheduler=sched, recorder=recorder, registry=registry, clock=clock
+    )
+    term = TerminationController(state, cloud, recorder=recorder, registry=registry, clock=clock)
+    deprov = DeprovisioningController(
+        state, cloud, term, provisioning=prov_ctrl, scheduler=sched,
+        recorder=recorder, registry=registry, clock=clock, drift_enabled=True,
+    )
+    state.apply_provisioner(Provisioner(
+        name="default", consolidation_enabled=True, limits={"cpu": CPU_LIMIT},
+    ))
+
+    pod_seq = 0
+    live_pods = []
+
+    def add_pods():
+        nonlocal pod_seq
+        for _ in range(rng.randint(1, 8)):
+            p = PodSpec(
+                name=f"p{pod_seq}",
+                requests={"cpu": rng.choice([0.25, 0.5, 1.0, 2.0])},
+                owner_key=f"d{rng.randint(0, 3)}",
+            )
+            pod_seq += 1
+            live_pods.append(p.name)
+            state.add_pod(p)
+
+    def del_pods():
+        for _ in range(rng.randint(1, 6)):
+            if not live_pods:
+                return
+            name = live_pods.pop(rng.randrange(len(live_pods)))
+            state.delete_pod(name)
+
+    def inject_ice():
+        it = rng.choice(cloud.instance_types)
+        for o in it.offerings[: rng.randint(1, 3)]:
+            cloud.inject_ice(it.name, o.zone, o.capacity_type)
+
+    def clear_ice():
+        cloud.clear_ice()
+
+    def publish_image():
+        cloud.publish_image(Image(
+            f"img-standard-amd64-s{seed}-{rng.randint(0, 99999)}",
+            L.ARCH_AMD64, created_at=clock.now() + 1000.0, family="standard",
+        ))
+
+    def time_jump():
+        clock.advance(rng.choice([30.0, 120.0, 400.0]))
+
+    events = [add_pods, add_pods, del_pods, inject_ice, clear_ice,
+              publish_image, time_jump]
+    for step in range(120):
+        rng.choice(events)()
+        prov_ctrl.reconcile()
+        clock.advance(rng.uniform(0.1, 3.0))  # random delays (battletest)
+        prov_ctrl.reconcile()
+        deprov.reconcile()
+        term.reconcile()
+        check_invariants(state, cloud)
+
+    # drain to quiescence: no pods -> the cluster empties out
+    for name in list(state.pods):
+        state.delete_pod(name)
+    for _ in range(80):
+        clock.advance(30.0)
+        prov_ctrl.reconcile()
+        deprov.reconcile()
+        term.reconcile()
+        check_invariants(state, cloud)
+        if not state.nodes:
+            break
+    assert not state.nodes, f"seed {seed}: {len(state.nodes)} nodes never reaped"
+
+
+class TestCoalescerRace:
+    def test_concurrent_leaders_count_exactly(self):
+        """Many threads across many buckets: every request served exactly
+        once, per-bucket fan-out intact, counters consistent."""
+        served = []
+        lock = threading.Lock()
+
+        def execute(reqs):
+            with lock:
+                served.extend(reqs)
+            return [("ok", r * 2) for r in reqs]
+
+        co = ThreadCoalescer(execute, idle_seconds=0.001)
+        results = {}
+        res_lock = threading.Lock()
+
+        def worker(i):
+            val = co.call(f"bucket-{i % 7}", i)
+            with res_lock:
+                results[i] = val
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(200)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(served) == list(range(200))          # exactly once
+        assert all(results[i] == i * 2 for i in range(200))  # right fan-out
+        assert co.batch_count == len(co.batch_sizes)
+        assert sum(co.batch_sizes) == 200                  # no lost increments
+
+    def test_executor_exception_fans_out_and_recovers(self):
+        calls = {"n": 0}
+
+        def execute(reqs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("backend down")
+            return [("ok", r) for r in reqs]
+
+        co = ThreadCoalescer(execute, idle_seconds=0.0)
+        with pytest.raises(RuntimeError):
+            co.call("k", 1)
+        assert co.call("k", 2) == 2  # coalescer usable after failure

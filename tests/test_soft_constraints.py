@@ -86,6 +86,42 @@ class TestSoftSpreadPlacement:
         # and the relaxed pod doubled up instead of getting a third node
         assert len(res.nodes) < 3
 
+    @pytest.mark.parametrize("backend", ["oracle", "tpu"])
+    def test_retry_wave_sees_prior_placements(self, small_catalog, backend):
+        """Cross-wave capacity bookkeeping: wave 1 fills an existing node;
+        the relaxation retry for a preference-carrying pod must see that
+        placement and NOT double-book the node's capacity."""
+        from karpenter_tpu.models.requirements import IN, Requirement
+        from karpenter_tpu.solver.types import SimNode
+
+        # existing node with room for exactly one 1-cpu pod
+        node = SimNode(
+            instance_type="c5.large", provisioner="default", zone="zone-1a",
+            capacity_type=L.CAPACITY_TYPE_ON_DEMAND, price=0.085,
+            allocatable={"cpu": 1.2, "memory": 8e9, L.RESOURCE_PODS: 10.0},
+            labels={L.ZONE: "zone-1a", L.CAPACITY_TYPE: L.CAPACITY_TYPE_ON_DEMAND,
+                    L.INSTANCE_TYPE: "c5.large", L.PROVISIONER_NAME: "default"},
+            existing=True,
+        )
+        plain = PodSpec(name="plain", requests={"cpu": 1.0}, owner_key="a")
+        picky = PodSpec(
+            name="picky", requests={"cpu": 1.0}, owner_key="b",
+            # unsatisfiable preference: hardened wave fails, retry drops it
+            preferred_affinity_terms=[[Requirement("no-such-label", IN, ["x"])]],
+        )
+        prov = Provisioner(name="default").with_defaults()
+        res = BatchScheduler(backend=backend).solve(
+            [plain, picky], [prov], small_catalog, existing_nodes=[node],
+        )
+        assert res.infeasible == {}
+        # the two pods cannot share the 1.2-cpu node
+        assert {res.assignments["plain"], res.assignments["picky"]} != {node.name}
+        on_existing = [p for p in (plain, picky) if res.assignments[p.name] == node.name]
+        assert len(on_existing) <= 1
+        assert len(res.nodes) == 1  # exactly one new node for the other pod
+        # the caller's node object was never mutated by the simulation
+        assert node.pods == []
+
     def test_hard_spread_still_hard(self, small_catalog):
         """DoNotSchedule must NOT be relaxed by the ladder."""
         sel = LabelSelector.of({"app": "solo"})
