@@ -101,6 +101,7 @@ class ThreadCoalescer:
         self._lock = threading.Lock()
         self._buckets: Dict[Hashable, _Batch] = {}
         self.batch_count = 0                       # backend round trips
+        self.requests_served = 0                   # total requests across batches
         self.batch_sizes = deque(maxlen=128)       # recent batch sizes
 
     def call(self, key: Hashable, req: object):
@@ -126,6 +127,7 @@ class ThreadCoalescer:
             batch.results = outcomes
             with self._lock:  # concurrent leaders of other buckets also count
                 self.batch_count += 1
+                self.requests_served += len(reqs)
                 self.batch_sizes.append(len(reqs))
             batch.event.set()
         else:

@@ -28,11 +28,41 @@ POD_AXIS = "pods"
 TYPE_AXIS = "types"
 
 
+def _host_major(devices: Sequence) -> np.ndarray:
+    """Arrange devices as a (pods, types) array with ICI/DCN awareness.
+
+    Multi-host (devices spanning >1 process): the pods axis runs ACROSS
+    hosts and the types axis WITHIN a host, so the hot candidate-axis
+    collectives (the O(G*C*K) feasibility contraction's gathers/reductions
+    along C) ride ICI between a host's own chips, and only the
+    embarrassingly-parallel pod-group axis crosses DCN — the scaling-book
+    recipe of keeping the chatty axis on the fast fabric.
+
+    Single host: largest factor pair (a, b), a >= b, so both axes shard.
+    """
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
+    n = len(devices)
+    n_proc = len(by_proc)
+    if n_proc > 1 and n % n_proc == 0:
+        per_host = n // n_proc
+        rows = [by_proc[p][:per_host] for p in sorted(by_proc)]
+        if all(len(r) == per_host for r in rows):
+            return np.array(rows)  # (hosts=pods over DCN, chips=types on ICI)
+    b = int(np.floor(np.sqrt(n)))
+    while n % b:
+        b -= 1
+    return np.array(list(devices)).reshape(n // b, b)
+
+
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     """Build a (pods, types) mesh over the available devices.
 
     Prefers a 2D factorization (e.g. 8 -> 4x2) so both the group axis and the
-    candidate axis shard; degenerates gracefully to 1D.
+    candidate axis shard; degenerates gracefully to 1D.  On multi-host
+    topologies the pods axis maps to hosts (DCN) and the types axis to each
+    host's chips (ICI) — see ``_host_major``.
     """
     devices = jax.devices()
     if n_devices is not None and len(devices) < n_devices:
@@ -46,14 +76,7 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
             devices = cpus
     if n_devices is not None:
         devices = devices[:n_devices]
-    n = len(devices)
-    # largest factor pair (a, b) with a >= b
-    b = int(np.floor(np.sqrt(n)))
-    while n % b:
-        b -= 1
-    a = n // b
-    dev_array = np.array(devices).reshape(a, b)
-    return Mesh(dev_array, (POD_AXIS, TYPE_AXIS))
+    return Mesh(_host_major(devices), (POD_AXIS, TYPE_AXIS))
 
 
 def feasibility_shardings(mesh: Mesh):

@@ -27,6 +27,10 @@ from .types import SimNode, SolveResult
 #: "auto" routes batches below this pod count (with no topology constraints)
 #: to the native C++ tier; larger or constrained batches go to the device.
 NATIVE_BATCH_LIMIT = 256
+#: relaxation-ladder depth cap: at most this many retry waves per solve; a
+#: pod with more preferences has its top rungs collapsed (several dropped at
+#: once) instead of funding one solve per preference
+MAX_RELAXATION_WAVES = 8
 
 
 def _soft_spreads(pod: PodSpec):
@@ -73,24 +77,29 @@ def _harden_preferences(pod: PodSpec, keep: Optional[int] = None) -> PodSpec:
     return out
 
 
+def _adopt_placed(prev_existing: List[SimNode], sub: SolveResult):
+    """Split a wave's placed snapshots back into (existing, prior+new nodes).
+
+    ``sub`` solved against ``prev_existing + <prior new nodes>`` in that
+    order and returned its placed copies in ``sub.existing_nodes``; the
+    copies replace the prior references so the next wave sees every
+    placement so far — capacity bookkeeping chains across waves without
+    mutating the caller's node objects.  The ONLY place this split-index
+    logic lives; both _merge and _solve_tpu's staging use it."""
+    ne = len(prev_existing)
+    placed = list(sub.existing_nodes)
+    return placed[:ne], placed[ne:] + list(sub.nodes)
+
+
 def _merge(result: SolveResult, sub: SolveResult) -> None:
     """Fold a retry wave's outcome into ``result`` (shared by the preference
-    ladder and the OR-term ladder so their merge semantics cannot diverge).
-
-    ``sub`` solved against ``result.existing_nodes + result.nodes`` — the
-    PLACED snapshots of the prior wave — and returned its own placed copies
-    in ``sub.existing_nodes``.  Those copies replace the prior references so
-    the next wave sees every placement so far (capacity bookkeeping chains
-    across waves without mutating the caller's node objects)."""
+    ladder and the OR-term ladder so their merge semantics cannot diverge)."""
     for name in list(result.infeasible):
         if name in sub.assignments:
             del result.infeasible[name]
     result.infeasible.update(sub.infeasible)
     result.assignments.update(sub.assignments)
-    ne = len(result.existing_nodes)
-    placed = list(sub.existing_nodes)
-    result.existing_nodes = placed[:ne]
-    result.nodes = placed[ne:] + list(sub.nodes)
+    result.existing_nodes, result.nodes = _adopt_placed(result.existing_nodes, sub)
     result.solve_ms += sub.solve_ms
 
 
@@ -177,7 +186,14 @@ class BatchScheduler:
             instance_types, existing_nodes, daemonsets, unavailable,
             allow_new_nodes, max_new_nodes,
         )
-        max_pref = max((_n_preferences(p) for p in pods), default=0)
+        # cap the ladder depth like the reference caps its long axes
+        # (SURVEY §5 long-context analog: 60-type truncation, batching):
+        # a pod with absurdly many preferences drops straight to its last
+        # MAX_RELAXATION_WAVES instead of funding one solve per preference
+        max_pref = min(
+            max((_n_preferences(p) for p in pods), default=0),
+            MAX_RELAXATION_WAVES,
+        )
         for keep in range(max_pref - 1, -1, -1):
             retry = [p for p in pods if p.name in result.infeasible
                      and _n_preferences(p) > keep]
@@ -262,10 +278,7 @@ class BatchScheduler:
         def chain(res: SolveResult) -> None:
             """Adopt a stage's placed snapshots of (cur_existing + nodes)."""
             nonlocal cur_existing, nodes
-            ne = len(cur_existing)
-            placed = list(res.existing_nodes)
-            cur_existing = placed[:ne]
-            nodes = placed[ne:] + list(res.nodes)
+            cur_existing, nodes = _adopt_placed(cur_existing, res)
 
         if cpu_first:
             res0 = oracle_solve(

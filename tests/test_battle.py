@@ -181,8 +181,10 @@ class TestCoalescerRace:
             t.join()
         assert sorted(served) == list(range(200))          # exactly once
         assert all(results[i] == i * 2 for i in range(200))  # right fan-out
-        assert co.batch_count == len(co.batch_sizes)
-        assert sum(co.batch_sizes) == 200                  # no lost increments
+        # batch_sizes is a bounded recency deque; the unbounded counters are
+        # the race-detection surface
+        assert co.requests_served == 200                   # no lost increments
+        assert co.batch_count <= 200
 
     def test_executor_exception_fans_out_and_recovers(self):
         calls = {"n": 0}

@@ -31,6 +31,35 @@ class TestMesh:
         assert mesh.axis_names == (POD_AXIS, TYPE_AXIS)
         assert mesh.devices.shape == (4, 2)
 
+    def test_host_major_multi_host_layout(self):
+        """Multi-host: pods axis spans hosts (DCN), types axis stays within
+        a host (ICI) — the chatty candidate-axis collectives ride the fast
+        fabric."""
+        from karpenter_tpu.parallel.mesh import _host_major
+
+        class Dev:
+            def __init__(self, pid, i):
+                self.process_index = pid
+                self.id = i
+
+            def __repr__(self):
+                return f"d{self.process_index}.{self.id}"
+
+        devs = [Dev(pid, i) for pid in range(2) for i in range(4)]  # 2 hosts x 4 chips
+        arr = _host_major(devs)
+        assert arr.shape == (2, 4)  # pods=hosts, types=chips-per-host
+        for row in arr:
+            assert len({d.process_index for d in row}) == 1  # one host per row
+
+    def test_host_major_single_host_factorizes(self):
+        from karpenter_tpu.parallel.mesh import _host_major
+
+        class Dev:
+            process_index = 0
+
+        arr = _host_major([Dev() for _ in range(8)])
+        assert arr.shape == (4, 2)
+
     def test_make_mesh_two_devices(self):
         mesh = make_mesh(2)
         assert mesh.devices.size == 2

@@ -122,6 +122,32 @@ class TestSoftSpreadPlacement:
         # the caller's node object was never mutated by the simulation
         assert node.pods == []
 
+    def test_relaxation_ladder_depth_capped(self, small_catalog):
+        """A pod with more preferences than MAX_RELAXATION_WAVES still
+        schedules (top rungs collapse) without one solve per preference."""
+        from karpenter_tpu.models.requirements import IN, Requirement
+        from karpenter_tpu.solver import scheduler as sched_mod
+
+        pod = PodSpec(
+            name="p", requests={"cpu": 1.0}, owner_key="a",
+            preferred_affinity_terms=[
+                [Requirement(f"pref-{i}", IN, ["x"])] for i in range(20)
+            ],
+        )
+        prov = Provisioner(name="default").with_defaults()
+        sched = BatchScheduler(backend="oracle")
+        calls = {"n": 0}
+        orig = sched._solve_once
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return orig(*a, **kw)
+
+        sched._solve_once = counting
+        res = sched.solve([pod], [prov], small_catalog)
+        assert res.infeasible == {}
+        assert calls["n"] <= sched_mod.MAX_RELAXATION_WAVES + 1
+
     def test_hard_spread_still_hard(self, small_catalog):
         """DoNotSchedule must NOT be relaxed by the ladder."""
         sel = LabelSelector.of({"app": "solo"})
