@@ -103,6 +103,88 @@ def random_scenario(seed: int, catalog):
     return pods, provs, unavailable
 
 
+def random_existing_nodes(seed: int, catalog, provs):
+    """Existing cluster state: partially-filled nodes of random types, some
+    pre-placed filler pods consuming capacity."""
+    from karpenter_tpu.solver.types import SimNode
+
+    rng = np.random.default_rng(seed + 10_000)
+    zones = ["zone-1a", "zone-1b", "zone-1c"]
+    nodes = []
+    for i in range(int(rng.integers(1, 8))):
+        it = catalog[int(rng.integers(0, len(catalog)))]
+        zone = str(rng.choice(zones))
+        prov = provs[int(rng.integers(0, len(provs)))]
+        node = SimNode(
+            instance_type=it.name,
+            provisioner=prov.name,
+            zone=zone,
+            capacity_type=L.CAPACITY_TYPE_ON_DEMAND,
+            price=it.offerings[0].price,
+            allocatable=dict(it.allocatable),
+            labels={**it.labels(), L.ZONE: zone,
+                    L.CAPACITY_TYPE: L.CAPACITY_TYPE_ON_DEMAND,
+                    L.PROVISIONER_NAME: prov.name},
+            existing=True,
+        )
+        node.labels[L.HOSTNAME] = node.name
+        # fill 0-70% of cpu with filler pods (never past cpu OR pod-density
+        # capacity)
+        cpu_cap = node.allocatable.get("cpu", 0.0)
+        pods_cap = node.allocatable.get(L.RESOURCE_PODS, 110.0)
+        target = cpu_cap * float(rng.random() * 0.7)
+        used, j, size = 0.0, 0, 0.25
+        while used < target and used + size <= cpu_cap and j + 1 <= pods_cap:
+            node.pods.append(PodSpec(name=f"filler-{i}-{j}",
+                                     requests={"cpu": size},
+                                     owner_key=f"filler-{i}"))
+            used += size
+            j += 1
+        nodes.append(node)
+    return nodes
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_existing_node_parity_and_no_overcommit(seed, small_catalog):
+    """Solves against pre-populated cluster state: device vs oracle parity,
+    plus the placed snapshots never overcommit any node and the CALLER's
+    node objects are never mutated (the snapshot-isolation invariant)."""
+    pods, provs, unavailable = random_scenario(seed, small_catalog)
+    existing = random_existing_nodes(seed, small_catalog, provs)
+    before = {n.name: len(n.pods) for n in existing}
+
+    oracle = reference.solve(pods, provs, small_catalog,
+                             existing_nodes=existing, unavailable=unavailable)
+    st = tensorize(pods, provs, small_catalog, unavailable=unavailable)
+    tpu = solve_tensors(st, existing_nodes=existing).result
+
+    # caller's nodes untouched by BOTH backends
+    assert {n.name: len(n.pods) for n in existing} == before
+
+    assert tpu.n_scheduled == oracle.n_scheduled, (
+        f"seed {seed}: scheduled tpu={tpu.n_scheduled} oracle={oracle.n_scheduled}"
+    )
+    if oracle.new_node_cost > 0:
+        ratio = tpu.new_node_cost / oracle.new_node_cost
+        assert ratio <= PARITY + 1e-9, f"seed {seed}: cost ratio {ratio:.4f}"
+    else:
+        # oracle packed everything onto existing capacity: launching ANY new
+        # node would be a real cost regression, not a parity tolerance
+        assert tpu.new_node_cost == 0, (
+            f"seed {seed}: device launched {len(tpu.nodes)} unnecessary nodes"
+        )
+
+    # no node (existing snapshot or new) is overcommitted — used() includes
+    # the per-node pod-density (RESOURCE_PODS) term
+    for res in (oracle, tpu):
+        for node in list(res.existing_nodes) + list(res.nodes):
+            for k, v in node.used().items():
+                assert v <= node.allocatable.get(k, 0.0) + 1e-6, (
+                    f"seed {seed}: {node.name} overcommitted on {k}: "
+                    f"{v} > {node.allocatable.get(k)}"
+                )
+
+
 @pytest.mark.parametrize("seed", SEEDS)
 def test_fuzz_cost_and_feasibility_parity(seed, small_catalog):
     pods, provs, unavailable = random_scenario(seed, small_catalog)
