@@ -48,6 +48,10 @@ DEPROVISIONING_TTL = 15.0
 #: how long a consolidation replacement may take to become ready before the
 #: action is abandoned and the replacement reaped (designs/deprovisioning.md:32-33)
 REPLACEMENT_READY_TIMEOUT = 9.5 * 60.0
+#: per-node cool-off after a replace attempt fails (create error or readiness
+#: timeout); time-based mechanisms (expiration/drift) consult this so a
+#: doomed replace retries on this cadence instead of every tick
+REPLACE_RETRY_BACKOFF = 2 * 60.0
 #: above this candidate count, run the one-device-call delete screen
 #: (solver/consolidation.py) before any sequential what-ifs
 SCREEN_THRESHOLD = 32
@@ -78,6 +82,7 @@ class PendingReplacement:
     old_nodes: List[str]
     deadline: float                   # abandon the action past this
     savings: float = 0.0
+    mechanism: str = "consolidation"  # which replace mechanism committed it
 
 
 class DeprovisioningController:
@@ -110,6 +115,7 @@ class DeprovisioningController:
         self._last_eval_at = -1e18
         self._pending: Optional[PendingReplacement] = None
         self._proposed: Optional[Tuple[Action, float]] = None  # (action, validate_at)
+        self._replace_backoff: Dict[str, float] = {}  # node -> retry-after
         self._last_subset_drop = 0
         self._last_confirm_drop = 0
 
@@ -195,10 +201,15 @@ class DeprovisioningController:
         return self.clock.now() - self._last_eval_at >= DEFAULT_BATCH_IDLE_AFTER_NO_ACTION
 
     # ---- mechanisms -------------------------------------------------------
+    def _backing_off(self, node_name: str) -> bool:
+        return self.clock.now() < self._replace_backoff.get(node_name, 0.0)
+
     def _expiration(self) -> Optional[Action]:
         now = self.clock.now()
         for ns in self.state.provisioned_nodes():
             if ns.marked_for_deletion or ns.node.expires_at is None:
+                continue
+            if self._backing_off(ns.node.name):
                 continue
             if now >= ns.node.expires_at:
                 return Action("replace", "expiration", [ns.node.name])
@@ -207,6 +218,8 @@ class DeprovisioningController:
     def _drift(self) -> Optional[Action]:
         for ns in self.state.provisioned_nodes():
             if ns.marked_for_deletion or ns.machine is None:
+                continue
+            if self._backing_off(ns.node.name):
                 continue
             if self.cloud.is_machine_drifted(ns.machine):
                 return Action("replace", "drift", [ns.node.name])
@@ -447,21 +460,9 @@ class DeprovisioningController:
         """Can these nodes' pods fit on the remaining nodes + <=1 cheaper new
         node?  (the §3.3 what-if — runs on the batch solver)."""
         target_names = {ns.node.name for ns in targets}
-        pods: List[PodSpec] = [p for ns in targets for p in ns.node.pods]
-        others = [
-            n for n in self.state.schedulable_nodes() if n.name not in target_names
-        ]
-        provisioners = [p.with_defaults() for p in self.state.provisioners.values()]
-        result = self.scheduler.solve(
-            pods,
-            provisioners,
-            self.cloud.get_instance_types(),
-            existing_nodes=others,
-            daemonsets=self.state.daemonsets,
-            unavailable=self.unavailable.as_set() if self.unavailable else None,
-            allow_new_nodes=True,
-            max_new_nodes=1,
-        )
+        pods: List[PodSpec] = [p for ns in targets for p in ns.node.pods
+                               if not p.is_daemon]
+        result = self._solve_what_if(pods, target_names)
         if result.infeasible:
             return None
         current_cost = sum(ns.node.price for ns in targets)
@@ -481,15 +482,53 @@ class DeprovisioningController:
         )
 
     # ---- execution --------------------------------------------------------
+    def _solve_what_if(self, pods: List[PodSpec], exclude: set):
+        """The §3.3 what-if: schedule ``pods`` onto the cluster minus
+        ``exclude`` plus at most one new node (shared by the consolidation
+        simulate and the drift/expiration replacement planner)."""
+        others = [
+            n for n in self.state.schedulable_nodes() if n.name not in exclude
+        ]
+        provisioners = [p.with_defaults() for p in self.state.provisioners.values()]
+        return self.scheduler.solve(
+            pods, provisioners, self.cloud.get_instance_types(),
+            existing_nodes=others, daemonsets=self.state.daemonsets,
+            unavailable=self.unavailable.as_set() if self.unavailable else None,
+            allow_new_nodes=True, max_new_nodes=1,
+        )
+
+    def _plan_replacement(self, action: Action) -> Optional[SimNode]:
+        """Size a replacement for a drift/expiration replace: can the nodes'
+        pods fit on the rest of the cluster plus at most one new node?  None
+        when no new node is needed (plain terminate) or none can be planned
+        (fall back to terminate -> reprovision).  Daemon pods are excluded:
+        their daemonsets recreate them on the replacement, already accounted
+        via the solve's daemonset overhead."""
+        names = set(action.nodes)
+        targets = [self.state.nodes[n] for n in action.nodes if n in self.state.nodes]
+        pods = [p for ns in targets for p in ns.node.pods if not p.is_daemon]
+        if not pods:
+            return None
+        result = self._solve_what_if(pods, names)
+        if result.infeasible or not result.nodes:
+            return None
+        return result.nodes[0]
+
     def _execute(self, action: Action) -> None:
         self.registry.counter(DEPROVISIONING_ACTIONS).inc(
             {"action": f"{action.kind}/{action.mechanism}"}
         )
-        if action.kind == "replace" and action.mechanism == "consolidation" and action.replacement:
+        replacement = action.replacement
+        if action.kind == "replace" and replacement is None:
+            # drift/expiration replaces also launch-then-wait
+            # (designs/deprovisioning.md: the replacement path is shared by
+            # all replace mechanisms, not just consolidation)
+            replacement = self._plan_replacement(action)
+        if action.kind == "replace" and replacement is not None:
             # launch the replacement BEFORE deleting (consolidation.md:15)
             if self.provisioning is not None:
                 machine = self.provisioning._machine_for(
-                    action.replacement,
+                    replacement,
                     [p.with_defaults() for p in self.state.provisioners.values()],
                 )
                 try:
@@ -502,9 +541,14 @@ class DeprovisioningController:
                         self.unavailable.mark_unavailable(
                             err.instance_type, err.zone, err.capacity_type
                         )
-                    # arm the backoff so the same doomed action isn't hot-retried
+                    # arm both backoffs so the same doomed action isn't
+                    # hot-retried: seqnum gates consolidation, the per-node
+                    # cool-off gates the time-based mechanisms (drift/expiry)
                     self._last_seqnum = self.state.seqnum
                     self._last_eval_at = self.clock.now()
+                    retry_at = self.clock.now() + REPLACE_RETRY_BACKOFF
+                    for name in action.nodes:
+                        self._replace_backoff[name] = retry_at
                     self.recorder.publish(Event(
                         "Machine", machine.name, "ReplacementFailed", str(err), "Warning"
                     ))
@@ -532,7 +576,8 @@ class DeprovisioningController:
                     deadline = self.clock.now() + REPLACEMENT_READY_TIMEOUT
                     self.state.nominate(node.name, ttl=REPLACEMENT_READY_TIMEOUT)
                     self._pending = PendingReplacement(
-                        node.name, list(action.nodes), deadline, action.savings
+                        node.name, list(action.nodes), deadline, action.savings,
+                        mechanism=action.mechanism,
                     )
                     self.recorder.publish(Event(
                         "Node", node.name, "WaitingOnReadiness",
@@ -570,7 +615,7 @@ class DeprovisioningController:
             ns.initialized = True  # registered + passed readiness (sim kubelet)
         if ns.initialized:
             self._pending = None
-            self._terminate(p.old_nodes, "consolidation", "replace", p.savings)
+            self._terminate(p.old_nodes, p.mechanism, "replace", p.savings)
             self._last_action_at = now
             return
         if now >= p.deadline:
@@ -578,11 +623,14 @@ class DeprovisioningController:
             self.recorder.publish(Event(
                 "Node", p.replacement, "ReplacementTimedOut",
                 "replacement did not become ready in time; abandoning "
-                "consolidation and reaping the replacement", "Warning",
+                f"{p.mechanism} and reaping the replacement", "Warning",
             ))
-            self._terminate([p.replacement], "consolidation", "abandon", 0.0)
-            # arm the backoff (like the ICE path in _execute) so the same
+            self._terminate([p.replacement], p.mechanism, "abandon", 0.0)
+            # arm both backoffs (like the create-failure path) so the same
             # doomed replace isn't immediately re-proposed; read the seqnum
             # AFTER the reap, which itself bumps it
+            retry_at = now + REPLACE_RETRY_BACKOFF
+            for name in p.old_nodes:
+                self._replace_backoff[name] = retry_at
             self._last_seqnum = self.state.seqnum
             self._last_eval_at = now

@@ -199,6 +199,21 @@ class TestReplacementWaitReady:
         assert old_node not in state.nodes
         assert state.nodes[repl].initialized
 
+    def test_interrupted_replacement_abandons_action(self, small_catalog):
+        """A spot interruption that kills the replacement mid-wait abandons
+        the consolidation action; the old node keeps serving."""
+        clock, state, cloud, deprov, recorder, old_node = self._trigger_replace(
+            small_catalog, ready_delay=60.0
+        )
+        repl = next(n for n in state.nodes if n != old_node)
+        # the interruption controller's effect: the replacement node vanishes
+        state.remove_node(repl)
+        clock.advance(10)
+        assert deprov.reconcile() is None
+        assert old_node in state.nodes  # action abandoned, no termination
+        # the wait-ready state machine is cleared, not wedged
+        assert deprov._pending is None
+
     def test_timeout_abandons_and_reaps_replacement(self, small_catalog):
         clock, state, cloud, deprov, recorder, old_node = self._trigger_replace(
             small_catalog, ready_delay=1e12  # never becomes ready
@@ -449,6 +464,69 @@ class TestExpirationAndDrift:
         assert not cloud.is_machine_drifted(machine)
         cloud.register_launch_template("my-lt", "img-custom-v2")
         assert cloud.is_machine_drifted(machine)
+
+    def test_drift_replace_waits_for_replacement_readiness(self, small_catalog):
+        """Drift replaces share the launch-then-wait path: the drifted node
+        keeps serving until its pre-launched replacement initializes."""
+        from karpenter_tpu.cloud.templates import Image
+
+        clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(
+            small_catalog, drift_enabled=True
+        )
+        schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 0.5})])
+        old = state.bindings["p"]
+        cloud.node_ready_delay = 40.0
+        cloud.publish_image(
+            Image("img-standard-amd64-v2", L.ARCH_AMD64, created_at=99.0, family="standard")
+        )
+        clock.advance(10)
+        action = deprov.reconcile()
+        assert action is not None and action.mechanism == "drift"
+        # old node alive; replacement launched, not yet initialized
+        assert old in state.nodes
+        repl = next(n for n in state.nodes if n != old)
+        assert not state.nodes[repl].initialized
+        clock.advance(5)
+        assert deprov.reconcile() is None and old in state.nodes
+        # readiness: old node drains, pod reschedules onto the replacement
+        clock.advance(36)
+        deprov.reconcile()
+        assert old not in state.nodes
+        pump(prov_ctrl, clock)
+        assert state.bindings["p"] == repl
+
+    def test_failed_replace_backs_off_instead_of_hot_looping(self, small_catalog):
+        """A replace whose machine create persistently fails retries on the
+        REPLACE_RETRY_BACKOFF cadence, not every tick."""
+        from karpenter_tpu.cloud.base import InsufficientCapacityError
+        from karpenter_tpu.cloud.templates import Image
+        from karpenter_tpu.controllers.deprovisioning import REPLACE_RETRY_BACKOFF
+
+        clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(
+            small_catalog, drift_enabled=True
+        )
+        schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 0.5})])
+        old = state.bindings["p"]
+        cloud.publish_image(
+            Image("img-standard-amd64-v2", L.ARCH_AMD64, created_at=99.0, family="standard")
+        )
+        creates_before = len(cloud.create_calls)
+        cloud.next_error = InsufficientCapacityError("c5.large", "zone-1a", "on-demand")
+        clock.advance(10)
+        action = deprov.reconcile()   # create fails -> action aborted
+        assert old in state.nodes
+        first_attempt = len(cloud.create_calls)
+        assert first_attempt == creates_before + 1
+        # inside the backoff window: drift does NOT re-attempt the create
+        for _ in range(5):
+            clock.advance(10)
+            deprov.reconcile()
+        assert len(cloud.create_calls) == first_attempt
+        # after the cool-off the replace retries (and now succeeds)
+        clock.advance(REPLACE_RETRY_BACKOFF + 1)
+        deprov.reconcile()
+        assert len(cloud.create_calls) == first_attempt + 1
+        assert old not in state.nodes  # replacement launched, old drained
 
     def test_selector_images_do_not_drift_while_still_matching(self, small_catalog):
         """Selector-pinned images (ami.go:158-230) keep matching even when
