@@ -202,7 +202,13 @@ class DeprovisioningController:
 
     # ---- mechanisms -------------------------------------------------------
     def _backing_off(self, node_name: str) -> bool:
-        return self.clock.now() < self._replace_backoff.get(node_name, 0.0)
+        now = self.clock.now()
+        # purge expired entries so the dict stays bounded by concurrently
+        # cooling-off nodes, not by every node that ever failed a replace
+        for name, until in list(self._replace_backoff.items()):
+            if now >= until:
+                del self._replace_backoff[name]
+        return now < self._replace_backoff.get(node_name, 0.0)
 
     def _expiration(self) -> Optional[Action]:
         now = self.clock.now()
@@ -519,10 +525,11 @@ class DeprovisioningController:
             {"action": f"{action.kind}/{action.mechanism}"}
         )
         replacement = action.replacement
-        if action.kind == "replace" and replacement is None:
+        if action.kind == "replace" and replacement is None and self.provisioning is not None:
             # drift/expiration replaces also launch-then-wait
             # (designs/deprovisioning.md: the replacement path is shared by
-            # all replace mechanisms, not just consolidation)
+            # all replace mechanisms, not just consolidation); planning is
+            # pointless without a provisioning controller to launch through
             replacement = self._plan_replacement(action)
         if action.kind == "replace" and replacement is not None:
             # launch the replacement BEFORE deleting (consolidation.md:15)

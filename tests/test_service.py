@@ -97,6 +97,36 @@ class TestGrpc:
         # nodes carry the real pod objects back
         assert all(isinstance(p, PodSpec) and p.requests for n in result.nodes for p in n.pods)
 
+    def test_concurrent_clients(self, server, small_catalog):
+        """The sidecar serves concurrent solves correctly — the production
+        concurrency surface (reconciler replicas + consolidation what-ifs
+        hitting one solver)."""
+        import threading
+
+        prov = Provisioner(name="default").with_defaults()
+        out = [None] * 6
+
+        def solve(i):
+            pods = [PodSpec(name=f"c{i}-p{j}", requests={"cpu": 0.5 + 0.5 * (i % 3)},
+                            owner_key=f"c{i}") for j in range(10)]
+            remote = RemoteScheduler(f"127.0.0.1:{server}")
+            try:
+                out[i] = remote.solve(pods, [prov], small_catalog)
+            finally:
+                remote.client.close()
+
+        threads = [threading.Thread(target=solve, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, res in enumerate(out):
+            assert res is not None and res.infeasible == {}
+            assert res.n_scheduled == 10
+            # each client's result contains ONLY its own pods (no cross-talk)
+            names = {p.name for n in res.nodes for p in n.pods}
+            assert names == {f"c{i}-p{j}" for j in range(10)}
+
     def test_remote_respects_unavailable(self, server, small_catalog):
         pods = [PodSpec(name="p", requests={"cpu": 1.0, "memory": 2**30})]
         prov = Provisioner(name="default").with_defaults()
