@@ -128,6 +128,7 @@ class DeprovisioningController:
             if self._pending is not None:
                 self._finish_pending()
                 return None
+            self._purge_backoff()
             # A proposed action sits for the deprovisioning TTL, then is
             # re-validated against fresh state before executing
             # (designs/deprovisioning.md "DeprovisioningTTL of 15 seconds").
@@ -201,14 +202,17 @@ class DeprovisioningController:
         return self.clock.now() - self._last_eval_at >= DEFAULT_BATCH_IDLE_AFTER_NO_ACTION
 
     # ---- mechanisms -------------------------------------------------------
-    def _backing_off(self, node_name: str) -> bool:
+    def _purge_backoff(self) -> None:
+        """Drop expired cool-off entries (once per tick) so the dict stays
+        bounded by concurrently cooling-off nodes, not by every node that
+        ever failed a replace."""
         now = self.clock.now()
-        # purge expired entries so the dict stays bounded by concurrently
-        # cooling-off nodes, not by every node that ever failed a replace
         for name, until in list(self._replace_backoff.items()):
             if now >= until:
                 del self._replace_backoff[name]
-        return now < self._replace_backoff.get(node_name, 0.0)
+
+    def _backing_off(self, node_name: str) -> bool:
+        return self.clock.now() < self._replace_backoff.get(node_name, 0.0)
 
     def _expiration(self) -> Optional[Action]:
         now = self.clock.now()

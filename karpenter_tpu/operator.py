@@ -111,6 +111,7 @@ class Operator:
             self.state, self.cloud, self.termination, provisioning=self.provisioning,
             scheduler=self.scheduler, recorder=self.recorder, registry=self.registry,
             clock=self.clock, drift_enabled=s.drift_enabled,
+            deprovisioning_ttl=s.deprovisioning_ttl,
         )
         self.interruption = InterruptionController(
             self.state, self.termination, self.queue, unavailable=self.unavailable,
@@ -131,6 +132,7 @@ class Operator:
             s.batch_idle_duration, s.batch_max_duration, clock=self.clock
         )
         self.deprovisioning.drift_enabled = s.drift_enabled
+        self.deprovisioning.deprovisioning_ttl = s.deprovisioning_ttl
 
     def _hydrate(self) -> None:
         """Leadership-gated warm-state rebuild (SURVEY §5 checkpoint/resume):

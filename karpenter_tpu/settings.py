@@ -29,6 +29,9 @@ class Settings:
     batch_idle_duration: float = 1.0
     # feature gates (settings.md:76-78)
     drift_enabled: bool = False
+    # deprovisioning tunable (designs/deprovisioning.md "DeprovisioningTTL
+    # of 15 seconds ... can be tuned")
+    deprovisioning_ttl: float = 15.0
 
     def validate(self) -> List[str]:
         errs = []
@@ -38,6 +41,8 @@ class Settings:
             errs.append("batch durations must be non-negative")
         if self.batch_idle_duration > self.batch_max_duration:
             errs.append("batchIdleDuration must be <= batchMaxDuration")
+        if self.deprovisioning_ttl < 0:
+            errs.append("deprovisioningTTL must be non-negative")
         return errs
 
 

@@ -65,6 +65,10 @@ class TestOperator:
         assert op.provisioning.window.idle == 0.1
         op.settings.update(drift_enabled=True)
         assert op.deprovisioning.drift_enabled is True
+        op.settings.update(deprovisioning_ttl=30.0)
+        assert op.deprovisioning.deprovisioning_ttl == 30.0
+        with pytest.raises(ValueError):
+            op.settings.update(deprovisioning_ttl=-1.0)
 
     def test_http_metrics_and_healthz(self, small_catalog):
         clock = FakeClock()
