@@ -92,12 +92,14 @@ class Operator:
         self.cloud = decorate(BatchedCloud(cloud, idle_seconds=0.0), self.registry)
         self.unavailable = UnavailableOfferings(clock=self.clock)
         self.scheduler = BatchScheduler(backend=scheduler_backend, registry=self.registry)
-        self.pricing = PricingProvider(cloud.get_instance_types(), clock=self.clock)
+        s = self.settings.current
+        self.pricing = PricingProvider(
+            cloud.get_instance_types(), clock=self.clock,
+            isolated_vpc=s.isolated_vpc,
+        )
         self.subnets = SubnetProvider()
         self.security_groups = SecurityGroupProvider(clock=self.clock)
         self.queue = MessageQueue()
-
-        s = self.settings.current
         self.provisioning = ProvisioningController(
             self.state, self.cloud, scheduler=self.scheduler, recorder=self.recorder,
             registry=self.registry, unavailable=self.unavailable, clock=self.clock,
@@ -133,6 +135,7 @@ class Operator:
         )
         self.deprovisioning.drift_enabled = s.drift_enabled
         self.deprovisioning.deprovisioning_ttl = s.deprovisioning_ttl
+        self.pricing.isolated_vpc = s.isolated_vpc
 
     def _hydrate(self) -> None:
         """Leadership-gated warm-state rebuild (SURVEY §5 checkpoint/resume):

@@ -26,10 +26,14 @@ class PricingProvider:
         source: Optional[PriceSource] = None,
         clock: Optional[Clock] = None,
         refresh_period: float = PRICING_REFRESH_PERIOD,
+        isolated_vpc: bool = False,
     ) -> None:
         self.clock = clock or Clock()
         self.refresh_period = refresh_period
         self.source = source
+        # isolated VPCs can't reach the pricing API: stay on the static
+        # fallback and never poll (pricing.go:121-123)
+        self.isolated_vpc = isolated_vpc
         self._od: Dict[str, float] = {}
         self._spot: Dict[Tuple[str, str], float] = {}
         self._last_refresh = -1e18
@@ -63,7 +67,7 @@ class PricingProvider:
 
     # ---- refresh loop (pricing.go:84-152) -------------------------------
     def maybe_refresh(self) -> bool:
-        if self.source is None:
+        if self.source is None or self.isolated_vpc:
             return False
         now = self.clock.now()
         if now - self._last_refresh < self.refresh_period:

@@ -106,6 +106,8 @@ class TestOperator:
         assert op.deprovisioning.drift_enabled is True
         op.settings.update(deprovisioning_ttl=30.0)
         assert op.deprovisioning.deprovisioning_ttl == 30.0
+        op.settings.update(isolated_vpc=True)
+        assert op.pricing.isolated_vpc is True
         with pytest.raises(ValueError):
             op.settings.update(deprovisioning_ttl=-1.0)
 

@@ -139,6 +139,19 @@ class TestPricing:
         assert p.maybe_refresh() is True
         assert p.on_demand_price("m5.xlarge") == 2.0
 
+    def test_isolated_vpc_stays_on_static_fallback(self, small_catalog):
+        """Isolated VPCs can't reach the pricing API: never poll the source,
+        keep the embedded fallback prices (pricing.go:121-123)."""
+        clock = FakeClock()
+        static = PricingProvider(small_catalog).on_demand_price("m5.xlarge")
+        src = lambda: [("m5.xlarge", "zone-1a", "on-demand", 99.0)]
+        p = PricingProvider(small_catalog, source=src, clock=clock,
+                            refresh_period=1.0, isolated_vpc=True)
+        clock.advance(100)
+        assert p.maybe_refresh() is False
+        assert p.on_demand_price("m5.xlarge") == static
+        assert p.updates == 0
+
 
 class TestSettings:
     def test_validation(self):
