@@ -186,7 +186,10 @@ class Operator:
         """One pass over every controller (singleton-controller semantics)."""
         if not self.elector.tick():
             return
-        self.interruption.reconcile()
+        if self.settings.current.interruption_queue_name:
+            # interruption handling is enabled iff a queue is configured
+            # (settings.md; pkg/controllers/controllers.go gates the same way)
+            self.interruption.reconcile()
         self.provisioning.reconcile()
         self.deprovisioning.reconcile()
         self.termination.reconcile()

@@ -111,6 +111,27 @@ class TestOperator:
         with pytest.raises(ValueError):
             op.settings.update(deprovisioning_ttl=-1.0)
 
+    def test_interruption_gated_on_queue_name(self, op):
+        """Interruption reconciles only when a queue name is configured."""
+        from karpenter_tpu.controllers.interruption import (
+            SPOT_INTERRUPTION,
+            InterruptionMessage,
+        )
+
+        op.state.add_pod(PodSpec(name="p", requests={"cpu": 0.5}))
+        for _ in range(3):
+            op.tick()
+            op.clock.advance(1.5)
+        node = op.state.bindings["p"]
+        pid = op.state.nodes[node].machine.provider_id
+        op.queue.send(InterruptionMessage(SPOT_INTERRUPTION, pid, op.clock.now()))
+        op.tick()
+        assert node in op.state.nodes           # no queue name -> ignored
+        assert len(op.queue) == 1               # message not consumed
+        op.settings.update(interruption_queue_name="q")
+        op.tick()
+        assert node not in op.state.nodes       # drained + deleted
+
     def test_http_metrics_and_healthz(self, small_catalog):
         clock = FakeClock()
         cloud = FakeCloudProvider(small_catalog, clock=clock)
