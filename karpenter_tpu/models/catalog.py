@@ -94,6 +94,24 @@ class CatalogSpec:
     vm_memory_overhead_percent: float = 0.075
     spot_discount: float = 0.62  # mean spot discount vs on-demand
     spot_jitter: float = 0.15
+    # settings-driven capacity shape (settings.go:40-65; instancetype.go):
+    # ENI-limited density off -> flat 110-pod default; pod-ENI on -> expose
+    # the branch-interface resource.  Field names mirror Settings exactly.
+    enable_eni_limited_pod_density: bool = True
+    enable_pod_eni: bool = False
+
+    @classmethod
+    def from_settings(cls, s) -> "CatalogSpec":
+        """Build a spec from the global Settings (the wiring an instance-type
+        provider uses at catalog-construction time)."""
+        return cls(
+            vm_memory_overhead_percent=s.vm_memory_overhead_percent,
+            enable_eni_limited_pod_density=s.enable_eni_limited_pod_density,
+            enable_pod_eni=s.enable_pod_eni,
+        )
+
+
+DEFAULT_MAX_PODS = 110.0  # kubelet default when ENI-limited density is off
 
 
 def _mk_type(
@@ -111,13 +129,21 @@ def _mk_type(
     local_nvme_gb: int = 0,
 ) -> InstanceType:
     mem_bytes = vm_memory_overhead(mem_gib * GIB, spec.vm_memory_overhead_percent)
-    pods = _eni_limited_pods(vcpus)
+    pods = (
+        float(_eni_limited_pods(vcpus))
+        if spec.enable_eni_limited_pod_density
+        else DEFAULT_MAX_PODS
+    )
     capacity = {
         L.RESOURCE_CPU: float(vcpus),
         L.RESOURCE_MEMORY: mem_bytes,
         L.RESOURCE_EPHEMERAL_STORAGE: 20.0 * GIB if not local_nvme_gb else local_nvme_gb * GIB,
-        L.RESOURCE_PODS: float(pods),
+        L.RESOURCE_PODS: pods,
     }
+    if spec.enable_pod_eni:
+        # branch network interfaces for pod-ENI workloads (instancetype.go
+        # :133-232 pod-eni resource); scale with the ENI tier
+        capacity[L.RESOURCE_POD_ENI] = float(_eni_limited_pods(vcpus) // 3)
     if gpus:
         capacity[L.RESOURCE_GPU] = float(gpus)
 
@@ -137,7 +163,7 @@ def _mk_type(
         Requirement(L.CAPACITY_TYPE, IN, [L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND]),
         Requirement(L.INSTANCE_CPU, IN, [str(vcpus)]),
         Requirement(L.INSTANCE_MEMORY, IN, [str(int(mem_gib * 1024))]),  # MiB like the reference
-        Requirement(L.INSTANCE_PODS, IN, [str(pods)]),
+        Requirement(L.INSTANCE_PODS, IN, [str(int(pods))]),
         Requirement(L.INSTANCE_CATEGORY, IN, [category]),
         Requirement(L.INSTANCE_FAMILY, IN, [family]),
         Requirement(L.INSTANCE_GENERATION, IN, [str(generation)]),

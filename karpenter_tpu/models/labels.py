@@ -57,6 +57,7 @@ RESOURCE_MEMORY = "memory"
 RESOURCE_EPHEMERAL_STORAGE = "ephemeral-storage"
 RESOURCE_PODS = "pods"
 RESOURCE_GPU = "nvidia.com/gpu"
+RESOURCE_POD_ENI = "vpc.amazonaws.com/pod-eni"
 
 # Taint effects
 EFFECT_NO_SCHEDULE = "NoSchedule"

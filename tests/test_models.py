@@ -41,6 +41,26 @@ class TestCatalog:
         names = {t.name for t in small_catalog}
         assert "m5.xlarge" in names and "t3a.small" in names
 
+    def test_settings_shape_pod_density(self):
+        """eni_limited_pod_density off -> flat 110-pod default; pod-ENI on ->
+        branch-interface resource exposed (settings.go:40-65 semantics)."""
+        from karpenter_tpu.models.catalog import CatalogSpec, generate_catalog
+
+        dense = generate_catalog(
+            CatalogSpec(enable_eni_limited_pod_density=False), full=False
+        )
+        assert all(it.capacity[L.RESOURCE_PODS] == 110.0 for it in dense)
+        default = generate_catalog(full=False)
+        assert any(it.capacity[L.RESOURCE_PODS] != 110.0 for it in default)
+        assert all(L.RESOURCE_POD_ENI not in it.capacity for it in default)
+        eni = generate_catalog(CatalogSpec(enable_pod_eni=True), full=False)
+        assert all(it.capacity.get(L.RESOURCE_POD_ENI, 0) > 0 for it in eni)
+        # Settings -> CatalogSpec wiring carries the flags across layers
+        from karpenter_tpu.settings import Settings
+
+        spec = CatalogSpec.from_settings(Settings(enable_pod_eni=True))
+        assert spec.enable_pod_eni and spec.enable_eni_limited_pod_density
+
     def test_full_catalog_scale(self, full_catalog):
         assert len(full_catalog) > 400
 
