@@ -20,9 +20,9 @@ test:
 	$(PYTHON) -m pytest tests/ -x -q
 
 # the reference's battletest analog (Makefile:69-76: -race + randomized
-# order + random delays): widened seeded churn/race sweep, then the suite
+# order + random delays): widened seeded churn/fuzz/race sweep, then the suite
 battletest:
-	KT_BATTLE_SEEDS=24 $(PYTHON) -m pytest tests/test_battle.py tests/test_fuzz_parity.py -q
+	KT_BATTLE_SEEDS=24 KT_FUZZ_SEEDS=40 $(PYTHON) -m pytest tests/test_battle.py tests/test_fuzz_parity.py -q
 	$(PYTHON) -m pytest tests/ -q
 
 bench:

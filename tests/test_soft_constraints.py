@@ -64,11 +64,13 @@ class TestSoftSpreadPlacement:
 
     @pytest.mark.parametrize("backend", ["oracle", "tpu"])
     def test_relaxes_when_unsatisfiable(self, small_catalog, backend):
-        """Hostname soft spread (one pod per node) under a provisioner cpu
-        limit that can't fund one node per pod: hard semantics would leave a
-        pod pending; ScheduleAnyway must relax it onto an existing node.
-        Relaxation is per-still-infeasible-pod (the ladder retries only what
-        failed, like core), so satisfied pods keep their spread nodes."""
+        """Hostname soft spread (one pod per node) when new nodes are
+        blocked entirely: hard semantics would leave pods pending;
+        ScheduleAnyway must relax them onto the existing node's free
+        capacity.  (New capacity blocked via an exhausted cpu limit makes
+        the outcome scoring-independent on every backend.)"""
+        from karpenter_tpu.solver.types import SimNode
+
         sel = LabelSelector.of({"app": "solo"})
         pods = [
             PodSpec(name=f"p{i}", labels={"app": "solo"}, requests={"cpu": 1.0},
@@ -77,14 +79,23 @@ class TestSoftSpreadPlacement:
                     owner_key="solo")
             for i in range(3)
         ]
-        prov = Provisioner(name="default", limits={"cpu": 8.0}).with_defaults()
+        node = SimNode(
+            instance_type="c5.xlarge", provisioner="default", zone="zone-1a",
+            capacity_type="on-demand", price=0.17,
+            allocatable={"cpu": 3.82, "memory": 8e9, L.RESOURCE_PODS: 20.0},
+            labels={L.ZONE: "zone-1a", L.CAPACITY_TYPE: "on-demand",
+                    L.INSTANCE_TYPE: "c5.xlarge",
+                    L.PROVISIONER_NAME: "default"},
+            existing=True,
+        )
+        # limit already consumed by the existing node: no new capacity
+        prov = Provisioner(name="default", limits={"cpu": 3.82}).with_defaults()
         sched = BatchScheduler(backend=backend)
-        res = sched.solve(pods, [prov], small_catalog)
-        assert res.infeasible == {}  # nobody left pending
-        # the limit held: at most 8 cpu of capacity launched
-        assert sum(n.allocatable.get("cpu", 0.0) for n in res.nodes) <= 8.0
-        # and the relaxed pod doubled up instead of getting a third node
-        assert len(res.nodes) < 3
+        res = sched.solve(pods, [prov], small_catalog, existing_nodes=[node])
+        assert res.infeasible == {}     # nobody left pending
+        assert res.nodes == []          # no new capacity launched
+        # all three doubled up on the one node (spread relaxed)
+        assert all(res.assignments[p.name] == node.name for p in pods)
 
     @pytest.mark.parametrize("backend", ["oracle", "tpu"])
     def test_retry_wave_sees_prior_placements(self, small_catalog, backend):
@@ -147,6 +158,27 @@ class TestSoftSpreadPlacement:
         res = sched.solve([pod], [prov], small_catalog)
         assert res.infeasible == {}
         assert calls["n"] <= sched_mod.MAX_RELAXATION_WAVES + 1
+
+    @pytest.mark.parametrize("backend", ["oracle", "tpu"])
+    def test_relaxes_with_partial_new_capacity(self, small_catalog, backend):
+        """Partial-capacity variant: the limit funds SOME per-pod spread
+        nodes but not all; satisfied pods keep their spread nodes and only
+        the still-infeasible pod doubles up (0.5-cpu pods make the doubling
+        feasible on a c5.large's slack for any scoring policy)."""
+        sel = LabelSelector.of({"app": "solo"})
+        pods = [
+            PodSpec(name=f"p{i}", labels={"app": "solo"}, requests={"cpu": 0.5},
+                    topology_spread=[TopologySpreadConstraint(
+                        1, L.HOSTNAME, "ScheduleAnyway", sel)],
+                    owner_key="solo")
+            for i in range(3)
+        ]
+        # two c5.large fit (3.66 <= 4), a third does not (5.49 > 4)
+        prov = Provisioner(name="default", limits={"cpu": 4.0}).with_defaults()
+        res = BatchScheduler(backend=backend).solve(pods, [prov], small_catalog)
+        assert res.infeasible == {}
+        assert sum(n.allocatable.get("cpu", 0.0) for n in res.nodes) <= 4.0
+        assert 1 <= len(res.nodes) < 3  # new nodes created, but not per-pod
 
     def test_hard_spread_still_hard(self, small_catalog):
         """DoNotSchedule must NOT be relaxed by the ladder."""
