@@ -132,7 +132,7 @@ def _make_step(
 ):
     """Build the per-group scan step closure over constant tensors."""
     counts = consts["counts"]          # [G]
-    counts_suffix = consts["counts_suffix"]  # [G] pods in later groups
+    suffix_res = consts["suffix_res"]  # [G, R] later-group resource demand
     requests = consts["requests"]      # [G, R]
     F = consts["F"]                    # [G, C]
     dom_ok = consts["dom_ok"]          # [G, D]
@@ -297,12 +297,20 @@ def _make_step(
             # (hostname caps included); slack beyond that is only worth
             # paying for when LATER groups exist to backfill it.  The oracle
             # scores resource-only ppn because its sequential interleave
-            # always has backfill in flight; here the suffix demand makes
-            # that optimism explicit — a hostname-capped group solved last
-            # buys right-sized nodes instead of betting on backfill that
-            # never comes (fuzz seeds 14/20), while capped groups with
-            # later demand still buy big co-location nodes (bench c3).
-            fill = jnp.minimum(ppn, take_pn + counts_suffix[g])
+            # always has backfill in flight; here the later-group RESOURCE
+            # demand (converted to this-group pod equivalents) makes that
+            # optimism explicit — a hostname-capped group solved last buys
+            # right-sized nodes instead of betting on backfill that never
+            # comes (fuzz seeds 14/20), while capped groups with real later
+            # demand still buy big co-location nodes (bench c3).
+            backfill_eq = jnp.min(jnp.where(
+                req_g > 0, suffix_res[g] / jnp.maximum(req_g, 1e-9), BIGN
+            ))
+            # the backfill pool is shared across every node this group will
+            # create (~rem/take_pn of them): per-node slack is only worth
+            # what the pool can actually deliver to ONE node
+            per_node_backfill = backfill_eq * take_pn / jnp.maximum(rem, 1.0)
+            fill = jnp.minimum(ppn, take_pn + per_node_backfill)
             denom = jnp.maximum(jnp.minimum(fill, jnp.maximum(rem, 1.0)), 1.0)
             score = jnp.where(ok_cd, cand_price / denom[:, None], BIG)
             pk = jnp.where(ok_cd, cand_price, BIG)
@@ -624,12 +632,15 @@ class TpuSolver:
             return np.pad(arr, widths, constant_values=value)
 
         np_counts = _pad(st.counts, pad_g, 0, 0)
-        # pods in LATER groups (suffix sum): the backfill demand available
-        # to fill slack on nodes bought for the current group
-        np_suffix = np.concatenate(
-            [np.cumsum(np_counts[::-1])[::-1][1:], [0]]
-        ).astype(np.float32)
+        # RESOURCE demand of LATER groups (suffix sum of count*request):
+        # the backfill available to fill slack on nodes bought for the
+        # current group, in resource units — 50 tiny pods cannot justify a
+        # big node the way 50 same-sized pods can
         np_requests = _pad(st.requests, pad_g, 0, 0)
+        demand = (np_counts[:, None] * np_requests).astype(np.float32)   # [G, R]
+        np_suffix_res = np.concatenate(
+            [np.cumsum(demand[::-1], axis=0)[::-1][1:], np.zeros((1, demand.shape[1]))]
+        ).astype(np.float32)                                             # [G, R]
         np_pm = _pad(st.pm, pad_g, 0, 0)
         np_gzs = _pad(st.g_zone_spread, pad_g, 0, -1)
         np_gzk = _pad(st.g_zone_skew, pad_g, 0, 1)
@@ -685,7 +696,7 @@ class TpuSolver:
 
         consts = dict(
             counts=jnp.asarray(np_counts),
-            counts_suffix=jnp.asarray(np_suffix),
+            suffix_res=jnp.asarray(np_suffix_res),
             requests=jnp.asarray(np_requests),
             g_zone_spread=jnp.asarray(np_gzs),
             g_zone_skew=jnp.asarray(np_gzk),
@@ -717,7 +728,8 @@ class TpuSolver:
             sc = NamedSharding(mesh, P(TYPE_AXIS))     # candidate axis
             sr = NamedSharding(mesh, P())              # replicated
             place = {
-                "counts": sg, "requests": sg, "g_zone_spread": sg, "g_zone_skew": sg,
+                "counts": sg, "requests": sg, "suffix_res": sg,
+                "g_zone_spread": sg, "g_zone_skew": sg,
                 "g_host_spread": sg, "g_host_cap": sg, "g_zone_anti": sg,
                 "g_zone_paff": sg, "g_host_paff": sg,
                 "g_sel_match": sr, "cand_alloc": sc, "cand_cap": sc,
