@@ -352,9 +352,10 @@ def _make_step(
             # zones.  Three closed-form passes:
             #   1. tentative fill with unlimited new capacity -> how many
             #      NEW pods each zone would need beyond its open rows;
-            #   2. water-fill the limit-fundable new-pod budget (best
-            #      whole-node count over candidates; partial nodes consume
-            #      full capacity against the limit) across those needs;
+            #   2. water-fill the limit-fundable new-pod budget (sum over
+            #      provisioner pools of each pool's best whole-node count;
+            #      partial nodes consume full capacity against the limit)
+            #      across those needs;
             #   3. final fill with rows+funded caps, then the maxSkew recap
             #      (lvl_min over ALL eligible zones, capacity-stuck ones
             #      included) — overflow stays unplaced, it does NOT pile
@@ -367,9 +368,14 @@ def _make_step(
                 axis=1,
             )                                                           # [C]
             c_ok = jnp.any(new_ok_nolim, axis=1)
-            fundable_new = jnp.max(
-                jnp.where(c_ok, jnp.clip(head_c, 0.0, BIGN) * take_pn, 0.0)
-            )
+            per_c = jnp.where(c_ok, jnp.clip(head_c, 0.0, BIGN) * take_pn, 0.0)
+            # provisioner limits are independent pools: the fundable total is
+            # the SUM over provisioners of each pool's best candidate, not a
+            # single global best
+            per_p = jnp.zeros(prov_limits.shape[0], dtype=per_c.dtype).at[
+                cand_prov
+            ].max(per_c)
+            fundable_new = jnp.minimum(jnp.sum(per_p), BIGN)
             alloc0 = water_fill(zc_sp, cap_z, cnt, el).astype(jnp.float32)
             rows_z = jnp.where(el, rowcap_z, 0.0)
             need_new = jnp.maximum(alloc0 - jnp.minimum(rows_z, alloc0), 0.0)
