@@ -83,6 +83,13 @@ class _Batch:
         self.results = None  # List[("ok", value) | ("err", exception)]
 
 
+class CoalescerTimeout(RuntimeError):
+    """A follower waited past ``follower_timeout`` for its batch leader to
+    publish results — the leader thread likely died between registering the
+    bucket and setting the event.  The request outcome is UNKNOWN: if the
+    leader was merely stalled, the batched call may still execute."""
+
+
 class ThreadCoalescer:
     """Coalescer for *concurrent* callers (batcher.go:130-151 semantics with
     goroutines mapped to threads): the first requester of a bucket becomes
@@ -91,13 +98,20 @@ class ThreadCoalescer:
     ``cloud.batched.BatchedCloud``; the synchronous ``Coalescer`` above
     covers single-threaded accumulate-then-flush callers."""
 
+    #: generous bound on how long a follower will wait for its leader; the
+    #: backend call itself is bounded well under this, so expiry means the
+    #: leader died (async exception / interpreter shutdown), not a slow call
+    FOLLOWER_TIMEOUT = 120.0
+
     def __init__(
         self,
         execute: Callable[[List[object]], List[tuple]],
         idle_seconds: float = 0.002,
+        follower_timeout: float = FOLLOWER_TIMEOUT,
     ) -> None:
         self.execute = execute
         self.idle = idle_seconds
+        self.follower_timeout = follower_timeout
         self._lock = threading.Lock()
         self._buckets: Dict[Hashable, _Batch] = {}
         self.batch_count = 0                       # backend round trips
@@ -131,7 +145,21 @@ class ThreadCoalescer:
                 self.batch_sizes.append(len(reqs))
             batch.event.set()
         else:
-            batch.event.wait()
+            # measured beyond the leader's idle-window sleep, so a live leader
+            # still collecting joiners can never be mistaken for a dead one
+            if not batch.event.wait(self.idle + self.follower_timeout):
+                with self._lock:
+                    # unregister the dead batch (if still current) so the next
+                    # caller can become a fresh leader instead of every future
+                    # call for this key stalling on the same corpse
+                    if self._buckets.get(key) is batch:
+                        del self._buckets[key]
+                raise CoalescerTimeout(
+                    f"batch leader for bucket {key!r} did not publish results "
+                    f"within {self.idle + self.follower_timeout:.0f}s; request "
+                    "outcome unknown (it may still execute if the leader was "
+                    "only stalled)"
+                )
         kind, val = batch.results[idx]
         if kind == "err":
             raise val

@@ -140,7 +140,8 @@ class DeprovisioningController:
                 fresh = self._revalidate(proposed)
                 if fresh is None:
                     return None  # conditions changed; start over next tick
-                self._execute(fresh)
+                if not self._execute(fresh):
+                    return None  # aborted (infeasible plan / failed create)
                 self._last_action_at = self.clock.now()
                 return fresh
             # Time-based mechanisms (expiration/drift/emptiness) run every
@@ -160,7 +161,8 @@ class DeprovisioningController:
             if self.deprovisioning_ttl > 0:
                 self._proposed = (action, self.clock.now() + self.deprovisioning_ttl)
                 return None
-            self._execute(action)
+            if not self._execute(action):
+                return None  # aborted (infeasible plan / failed create)
             self._last_action_at = self.clock.now()
             return action
         finally:
@@ -306,7 +308,7 @@ class DeprovisioningController:
             # while provisioning keeps adding nodes: unbounded growth.
             empties = [
                 ns for _, ns in self._candidates()
-                if not ns.node.pods
+                if ns.workload_empty()
                 and not any(self._pod_could_use(p, ns.node) for p in pending)
             ]
             if empties:
@@ -317,8 +319,9 @@ class DeprovisioningController:
         if not cands:
             return None
 
-        # 1) empty-node deletes (deprovisioning.md:70-75)
-        empties = [ns.node.name for _, ns in cands if not ns.node.pods]
+        # 1) empty-node deletes (deprovisioning.md:70-75); daemon-only nodes
+        #    count as empty (NodeState.workload_empty)
+        empties = [ns.node.name for _, ns in cands if ns.workload_empty()]
         if empties:
             return Action("delete", "consolidation", empties)
 
@@ -507,34 +510,60 @@ class DeprovisioningController:
             allow_new_nodes=True, max_new_nodes=1,
         )
 
-    def _plan_replacement(self, action: Action) -> Optional[SimNode]:
+    def _plan_replacement(self, action: Action) -> Tuple[str, Optional[SimNode]]:
         """Size a replacement for a drift/expiration replace: can the nodes'
-        pods fit on the rest of the cluster plus at most one new node?  None
-        when no new node is needed (plain terminate) or none can be planned
-        (fall back to terminate -> reprovision).  Daemon pods are excluded:
-        their daemonsets recreate them on the replacement, already accounted
-        via the solve's daemonset overhead."""
+        pods fit on the rest of the cluster plus at most one new node?
+        Returns ("none-needed", None) when the pods fit on the remaining
+        cluster (plain terminate preserves availability), ("planned", node)
+        with the replacement to launch first, or ("infeasible", None) when the
+        pods cannot be rescheduled even with a new node — in which case the
+        action must be aborted, NOT executed, to preserve the
+        launch-before-delete invariant (consolidation.md:15).  Daemon pods are
+        excluded: their daemonsets recreate them on the replacement, already
+        accounted via the solve's daemonset overhead."""
         names = set(action.nodes)
         targets = [self.state.nodes[n] for n in action.nodes if n in self.state.nodes]
         pods = [p for ns in targets for p in ns.node.pods if not p.is_daemon]
         if not pods:
-            return None
+            return "none-needed", None
         result = self._solve_what_if(pods, names)
-        if result.infeasible or not result.nodes:
-            return None
-        return result.nodes[0]
+        if result.infeasible:
+            return "infeasible", None
+        if not result.nodes:
+            return "none-needed", None
+        return "planned", result.nodes[0]
 
-    def _execute(self, action: Action) -> None:
+    def _count_action(self, action: Action) -> None:
         self.registry.counter(DEPROVISIONING_ACTIONS).inc(
             {"action": f"{action.kind}/{action.mechanism}"}
         )
+
+    def _execute(self, action: Action) -> bool:
+        """Carry out the action.  Returns True when it actually took effect
+        (replacement launched and/or nodes terminated); False when aborted
+        (infeasible replacement plan, failed create) — aborted actions do not
+        count toward the actions metric and are not reported as executed."""
         replacement = action.replacement
         if action.kind == "replace" and replacement is None and self.provisioning is not None:
             # drift/expiration replaces also launch-then-wait
             # (designs/deprovisioning.md: the replacement path is shared by
             # all replace mechanisms, not just consolidation); planning is
             # pointless without a provisioning controller to launch through
-            replacement = self._plan_replacement(action)
+            plan, replacement = self._plan_replacement(action)
+            if plan == "infeasible":
+                # the pods cannot be rescheduled even with a new node: abort
+                # rather than evicting into nowhere (the reference skips
+                # candidates whose pods cannot be rescheduled), and arm the
+                # per-node cool-off so drift/expiry doesn't hot-retry
+                retry_at = self.clock.now() + REPLACE_RETRY_BACKOFF
+                for name in action.nodes:
+                    self._replace_backoff[name] = retry_at
+                self.recorder.publish(Event(
+                    "Node", action.nodes[0], "ReplacementInfeasible",
+                    f"{action.mechanism}: pods cannot be rescheduled onto the "
+                    "remaining cluster plus one new node; deferring", "Warning",
+                ))
+                return False
         if action.kind == "replace" and replacement is not None:
             # launch the replacement BEFORE deleting (consolidation.md:15)
             if self.provisioning is not None:
@@ -563,7 +592,7 @@ class DeprovisioningController:
                     self.recorder.publish(Event(
                         "Machine", machine.name, "ReplacementFailed", str(err), "Warning"
                     ))
-                    return
+                    return False
                 node = SimNode(
                     instance_type=machine.instance_type,
                     provisioner=machine.provisioner,
@@ -595,9 +624,12 @@ class DeprovisioningController:
                         f"replacement for {','.join(action.nodes)} launched; "
                         f"waiting up to {REPLACEMENT_READY_TIMEOUT:.0f}s for readiness",
                     ))
-                    return
+                    self._count_action(action)  # committed: replacement launched
+                    return True
                 ns.initialized = True
+        self._count_action(action)
         self._terminate(action.nodes, action.mechanism, action.kind, action.savings)
+        return True
 
     def _terminate(self, nodes: Sequence[str], mechanism: str, kind: str,
                    savings: float) -> None:

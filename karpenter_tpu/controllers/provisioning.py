@@ -60,7 +60,7 @@ class ProvisioningController:
     def reconcile(self) -> Optional[SolveResult]:
         """One tick: enqueue pending pods; when the batch window fires, solve
         and launch.  Returns the SolveResult when a solve happened."""
-        for pod in self.state.pending_pods():
+        for pod in self.state.pending_pods():  # daemon pods excluded by state
             if pod.name not in self._queued:
                 self.window.add(pod)
                 self._queued.add(pod.name)

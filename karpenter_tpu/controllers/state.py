@@ -31,6 +31,12 @@ class NodeState:
     nominated_until: float = 0.0  # in-flight pods expected to land here
     empty_since: Optional[float] = None
 
+    def workload_empty(self) -> bool:
+        """No non-daemon pods: the single emptiness predicate shared by
+        empty_nodes() and the deprovisioning empties paths (daemonset pods
+        never make a node non-empty)."""
+        return not any(not p.is_daemon for p in self.node.pods)
+
 
 class ClusterState:
     def __init__(self, clock: Optional[Clock] = None) -> None:
@@ -81,13 +87,18 @@ class ClusterState:
         return ns
 
     def remove_node(self, name: str) -> List[PodSpec]:
-        """Remove a node; its pods become pending again (rescheduled)."""
+        """Remove a node; its workload pods become pending again
+        (rescheduled).  Daemon pods are deleted outright — the daemonset
+        controller only runs them on nodes that exist."""
         ns = self.nodes.pop(name, None)
         if ns is None:
             return []
-        orphans = list(ns.node.pods)
-        for p in orphans:
+        orphans = [p for p in ns.node.pods if not p.is_daemon]
+        for p in ns.node.pods:
             self.bindings.pop(p.name, None)
+            if p.is_daemon:
+                self.pods.pop(p.name, None)
+                self.pod_added_at.pop(p.name, None)
         ns.node.pods = []
         self._changed()
         return orphans
@@ -110,7 +121,14 @@ class ClusterState:
 
     # ---- queries -------------------------------------------------------
     def pending_pods(self) -> List[PodSpec]:
-        return [p for name, p in self.pods.items() if name not in self.bindings]
+        """Unbound pods that provisioning could help.  Daemon pods are
+        excluded everywhere: the daemonset controller only places them on
+        nodes that already exist, so they are never provisionable pending
+        work and must not freeze consolidation's stabilization wait."""
+        return [
+            p for name, p in self.pods.items()
+            if name not in self.bindings and not p.is_daemon
+        ]
 
     def schedulable_nodes(self) -> List[SimNode]:
         """Nodes the scheduler may pack onto (not cordoned / being deleted)."""
@@ -135,12 +153,12 @@ class ClusterState:
         now = self.clock.now() if now is None else now
         out = []
         for ns in self.provisioned_nodes():
-            non_daemon = [p for p in ns.node.pods if not p.is_daemon]
-            if not non_daemon and not ns.marked_for_deletion:
-                if ns.empty_since is None:
-                    ns.empty_since = now
-                out.append(ns)
-            elif non_daemon:
+            if ns.workload_empty():
+                if not ns.marked_for_deletion:
+                    if ns.empty_since is None:
+                        ns.empty_since = now
+                    out.append(ns)
+            else:
                 ns.empty_since = None
         return out
 

@@ -74,6 +74,12 @@ class TerminationController:
         for pod in list(ns.node.pods):
             if not self._evictable(pod):
                 continue
+            if pod.is_daemon:
+                # daemon pods die with the node (the daemonset controller
+                # recreates them only on nodes that exist) — they never
+                # become pending
+                self.state.delete_pod(pod.name)
+                continue
             # eviction: unbind; the owning controller recreates it -> pending
             self.state.bindings.pop(pod.name, None)
             ns.node.pods.remove(pod)

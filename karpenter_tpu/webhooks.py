@@ -87,7 +87,10 @@ def validate_provisioner_spec(prov: Provisioner) -> List[str]:
 
 def admit_provisioner(prov: Provisioner, *, apply_defaults: bool = True) -> Provisioner:
     out = prov.with_defaults() if apply_defaults else prov
-    errs = validate_provisioner_spec(prov)
+    # validate the defaulted object — the one that will actually be admitted —
+    # so defects introduced (or cured) by defaulting are judged correctly,
+    # matching the knative default-then-validate order
+    errs = validate_provisioner_spec(out)
     if errs:
         raise AdmissionError("Provisioner", prov.name, errs)
     return out

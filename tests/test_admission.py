@@ -150,6 +150,31 @@ class TestProvisionerValid:
         keys = {r.key for r in out.requirements}
         assert L.OS in keys and L.ARCH in keys and L.CAPACITY_TYPE in keys
 
+    def test_validation_judges_the_defaulted_object(self):
+        """Knative default-then-validate order: validation must see the object
+        that will actually be admitted, so a defect introduced by defaulting
+        is caught (and one cured by defaulting is not)."""
+
+        class DefaultsIntroduceDefect(Provisioner):
+            def with_defaults(self):
+                out = super().with_defaults()
+                out.labels = {"app": "-leading-dash"}  # invalid, post-default
+                return out
+
+        with pytest.raises(AdmissionError) as exc:
+            admit_provisioner(DefaultsIntroduceDefect(name="p"))
+        assert "not a valid label value" in str(exc.value)
+
+        class DefaultsCureDefect(Provisioner):
+            def with_defaults(self):
+                out = super().with_defaults()
+                out.labels = {}  # the raw defect is normalized away
+                return out
+
+        admit_provisioner(DefaultsCureDefect(
+            name="p", labels={"app": "-leading-dash"}
+        ))  # must not raise
+
 
 INVALID_PROVISIONERS = [
     ("consolidation + empty ttl",

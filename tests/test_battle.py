@@ -199,3 +199,20 @@ class TestCoalescerRace:
         with pytest.raises(RuntimeError):
             co.call("k", 1)
         assert co.call("k", 2) == 2  # coalescer usable after failure
+
+    def test_follower_times_out_on_dead_leader(self):
+        """A follower whose leader died between registering the bucket and
+        publishing results must surface a distinguishable error instead of
+        blocking at the cloud boundary forever."""
+        from karpenter_tpu.batcher import CoalescerTimeout, _Batch
+
+        co = ThreadCoalescer(lambda reqs: [("ok", r) for r in reqs],
+                             idle_seconds=0.0, follower_timeout=0.05)
+        # simulate a dead leader: bucket registered, event never set
+        dead = _Batch()
+        dead.reqs.append("leader-req")
+        co._buckets["k"] = dead
+        with pytest.raises(CoalescerTimeout):
+            co.call("k", "follower-req")
+        # the dead batch was unregistered: the bucket is usable again
+        assert co.call("k", "fresh") == "fresh"

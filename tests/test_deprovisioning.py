@@ -82,6 +82,35 @@ class TestEmptiness:
         assert action.mechanism == "consolidation" and action.kind == "delete"
         assert node_name not in state.nodes
 
+    def test_daemon_only_node_reclaimed_under_pending_pods(self, small_catalog):
+        """The anti-starvation empties path must count daemon-only nodes as
+        empty (matching state.empty_nodes()): clusters running daemonsets —
+        the common case — still get the unbounded-growth guard while a pod is
+        perpetually pending."""
+        clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(small_catalog)
+        schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 1.0})])
+        node_name = state.bindings["p"]
+        # a daemon pod lands on the node; the workload pod then goes away
+        state.add_pod(PodSpec(name="ds-p", requests={"cpu": 0.1}, is_daemon=True))
+        state.bind("ds-p", node_name)
+        state.delete_pod("p")
+        # a pending pod that can never use this node keeps the cluster in the
+        # stabilization path
+        state.add_pod(PodSpec(name="stuck", requests={"cpu": 1.0},
+                              node_selector={L.INSTANCE_TYPE: "no-such-type"}))
+        clock.advance(MIN_NODE_LIFETIME + 1)
+        action = deprov.reconcile()
+        assert action is not None and action.kind == "delete"
+        assert node_name not in state.nodes
+        # the daemon pod died with its node — it must not linger as a pending
+        # pod or trigger provisioning (create/delete churn loop)
+        assert "ds-p" not in state.pods
+        nodes_before = len(state.nodes)
+        creates_before = len(cloud.create_calls)
+        pump(prov_ctrl, clock)
+        assert len(cloud.create_calls) == creates_before
+        assert len(state.nodes) == nodes_before
+
     def test_young_nodes_not_consolidated(self, small_catalog):
         clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(small_catalog)
         schedule(state, prov_ctrl, clock, [PodSpec(name="p", requests={"cpu": 1.0})])
@@ -527,6 +556,46 @@ class TestExpirationAndDrift:
         deprov.reconcile()
         assert len(cloud.create_calls) == first_attempt + 1
         assert old not in state.nodes  # replacement launched, old drained
+
+    def test_infeasible_replace_defers_instead_of_evicting(self, small_catalog):
+        """When the replacement what-if is INFEASIBLE — the node's pods cannot
+        be rescheduled onto the remaining cluster plus one new node — the
+        replace must abort and arm the per-node backoff, NOT fall through to
+        terminate (launch-before-delete invariant, consolidation.md:15)."""
+        from karpenter_tpu.controllers.deprovisioning import REPLACE_RETRY_BACKOFF
+
+        prov = Provisioner(
+            name="default", ttl_seconds_until_expired=3600.0, requirements=[C2X]
+        )
+        clock, state, cloud, prov_ctrl, term, deprov, recorder = make_env(small_catalog, prov)
+        schedule(state, prov_ctrl, clock, [
+            PodSpec(name="p", requests={"cpu": 1.0},
+                    node_selector={L.INSTANCE_TYPE: "c5.2xlarge"}),
+        ])
+        node = state.bindings["p"]
+        # narrow the pool so no replacement can ever host the pinned pod
+        state.apply_provisioner(Provisioner(
+            name="default", ttl_seconds_until_expired=3600.0,
+            requirements=[Requirement(L.INSTANCE_TYPE, IN, ["m5.large"])],
+        ))
+        deletes_before = len(cloud.delete_calls)
+        clock.advance(3601)
+        deprov.reconcile()
+        # node survives, pod stays bound, nothing launched or terminated
+        assert node in state.nodes
+        assert state.bindings["p"] == node
+        assert len(cloud.delete_calls) == deletes_before
+        assert not cloud.create_calls[1:]  # only the original provisioning create
+        assert any(e.reason == "ReplacementInfeasible" for e in recorder.events)
+        # backoff: the doomed replace isn't re-planned every tick
+        for _ in range(3):
+            clock.advance(10)
+            deprov.reconcile()
+        assert node in state.nodes
+        # after the cool-off it is re-examined (still infeasible, still alive)
+        clock.advance(REPLACE_RETRY_BACKOFF + 1)
+        deprov.reconcile()
+        assert node in state.nodes and state.bindings["p"] == node
 
     def test_selector_images_do_not_drift_while_still_matching(self, small_catalog):
         """Selector-pinned images (ami.go:158-230) keep matching even when
