@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 
@@ -33,10 +34,24 @@ def _maybe_profile(port: int) -> None:
         print(f"jax profiler listening on :{port}", file=sys.stderr)
 
 
+def _maybe_jit_cache(cache_dir: str) -> None:
+    """Enable JAX's persistent (on-disk) compilation cache: a restarted
+    operator re-loads previously compiled solver programs instead of paying
+    the XLA compile again — together with compile-behind this removes the
+    cold-start stall entirely for shapes any prior process compiled."""
+    if cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        print(f"persistent jit cache at {cache_dir}", file=sys.stderr)
+
+
 def cmd_demo(args) -> int:
     from .operator import main as op_main
 
     _maybe_profile(args.profile_port)
+    _maybe_jit_cache(args.jit_cache_dir)
     argv = ["--demo", "--pods", str(args.pods), "--backend", args.backend]
     if args.small:
         argv.append("--small")
@@ -46,6 +61,12 @@ def cmd_demo(args) -> int:
 
 
 def cmd_solve(args) -> int:
+    # one-shot process: a background compile would outlive its usefulness and
+    # (non-daemon) delay exit by the full XLA compile — serve cold shapes
+    # from the warm tier without compiling.  A persistent jit cache dir
+    # re-enables cross-run compile reuse via demo/serve processes.
+    _maybe_jit_cache(args.jit_cache_dir)
+
     from .models.catalog import generate_catalog
     from .models.pod import PodSpec
     from .models.provisioner import Provisioner
@@ -66,7 +87,9 @@ def cmd_solve(args) -> int:
         pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}, owner_key="cli")
                 for i in range(args.pods)]
         provs = [Provisioner(name="default").with_defaults()]
-    res = BatchScheduler(backend=args.backend).solve(pods, provs, catalog)
+    res = BatchScheduler(
+        backend=args.backend, compile_behind=False,
+    ).solve(pods, provs, catalog)
     out = {
         "scheduled": res.n_scheduled,
         "infeasible": len(res.infeasible),
@@ -91,6 +114,7 @@ def cmd_serve(args) -> int:
     from .service.server import main as serve_main
 
     _maybe_profile(args.profile_port)
+    _maybe_jit_cache(args.jit_cache_dir)
     return serve_main(["--port", str(args.port), "--backend", args.backend])
 
 
@@ -152,6 +176,8 @@ def main(argv=None) -> int:
     d.add_argument("--backend", default="auto", choices=["auto", "tpu", "oracle"])
     d.add_argument("--metrics-port", type=int, default=0)
     d.add_argument("--profile-port", type=int, default=0)
+    d.add_argument("--jit-cache-dir", default=os.environ.get("KT_JIT_CACHE_DIR", ""),
+                   help="persistent XLA compile cache directory")
     d.set_defaults(fn=cmd_demo)
 
     s = sub.add_parser("solve", help="one-shot batch solve")
@@ -161,12 +187,16 @@ def main(argv=None) -> int:
     s.add_argument("--backend", default="auto", choices=["auto", "tpu", "native", "oracle"])
     s.add_argument("--assignments", action="store_true", help="include per-pod assignments")
     s.add_argument("--compact", action="store_true")
+    s.add_argument("--jit-cache-dir", default=os.environ.get("KT_JIT_CACHE_DIR", ""),
+                   help="persistent XLA compile cache directory")
     s.set_defaults(fn=cmd_solve)
 
     v = sub.add_parser("serve", help="gRPC solver sidecar")
     v.add_argument("--port", type=int, default=50151)
     v.add_argument("--backend", default="auto", choices=["auto", "tpu", "oracle"])
     v.add_argument("--profile-port", type=int, default=0)
+    v.add_argument("--jit-cache-dir", default=os.environ.get("KT_JIT_CACHE_DIR", ""),
+                   help="persistent XLA compile cache directory")
     v.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("bench", help="run BASELINE benchmark configs")

@@ -118,6 +118,9 @@ PROVISIONER_USAGE = "karpenter_provisioner_usage"
 PROVISIONER_LIMIT = "karpenter_provisioner_limit"
 BATCH_SIZE = "karpenter_provisioner_batch_size"
 SOLVER_BACKEND_DURATION = "karpenter_solver_backend_duration_seconds"
+SOLVER_COMPILE_IN_PROGRESS = "karpenter_solver_compile_in_progress"
+SOLVER_COMPILE_DURATION = "karpenter_solver_compile_duration_seconds"
+SOLVER_COLD_FALLBACKS = "karpenter_solver_cold_start_fallbacks_total"
 
 #: metric inventory: name -> (type, labels, help).  docs/METRICS.md is
 #: generated from this table (``karpenter-tpu metrics-doc``), mirroring the
@@ -162,6 +165,17 @@ INVENTORY = {
     SOLVER_BACKEND_DURATION: (
         "histogram", ("backend",),
         "Per-backend (tpu / native / oracle) solve duration, seconds."),
+    SOLVER_COMPILE_IN_PROGRESS: (
+        "gauge", (),
+        "Background XLA compiles currently in flight (compile-behind + "
+        "warmup); callers are served by the warm tier meanwhile."),
+    SOLVER_COMPILE_DURATION: (
+        "histogram", (),
+        "Background XLA compile duration per shape signature, seconds."),
+    SOLVER_COLD_FALLBACKS: (
+        "counter", ("backend",),
+        "Solves served by the native/oracle warm tier because the device "
+        "program for their shape was not compiled yet."),
 }
 
 

@@ -166,15 +166,28 @@ class PodSpec:
         return key
 
     def _compute_group_key(self) -> tuple:
+        # hot at scale (called once per pod in tensorize.group_pods; 50k-pod
+        # batches make this the dominant tensorize cost): avoid genexpr/sort
+        # machinery for the tiny-dict common case
+        labels = self.labels
+        requests = self.requests
+        selector = self.node_selector
+        ra = self.required_affinity_terms
+        pa = self.preferred_affinity_terms
+        req_items = [(k, round(v, 9)) for k, v in requests.items()]
+        if len(req_items) > 1:
+            req_items.sort()
         return (
             self.namespace,
-            tuple(sorted(self.labels.items())),
-            tuple(sorted((k, round(v, 9)) for k, v in self.requests.items())),
-            tuple(sorted(self.node_selector.items())),
-            tuple(tuple(t) for t in map(tuple, self.required_affinity_terms)),
-            tuple(tuple(t) for t in map(tuple, self.preferred_affinity_terms)),
-            tuple(self.tolerations),
-            tuple(self.topology_spread),
-            tuple(self.affinity_terms),
+            (tuple(labels.items()) if len(labels) <= 1
+             else tuple(sorted(labels.items()))) if labels else (),
+            tuple(req_items),
+            (tuple(selector.items()) if len(selector) <= 1
+             else tuple(sorted(selector.items()))) if selector else (),
+            tuple(map(tuple, ra)) if ra else (),
+            tuple(map(tuple, pa)) if pa else (),
+            tuple(self.tolerations) if self.tolerations else (),
+            tuple(self.topology_spread) if self.topology_spread else (),
+            tuple(self.affinity_terms) if self.affinity_terms else (),
             self.priority,
         )

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -136,12 +137,32 @@ class Operator:
         self.deprovisioning.drift_enabled = s.drift_enabled
         self.deprovisioning.deprovisioning_ttl = s.deprovisioning_ttl
         self.pricing.isolated_vpc = s.isolated_vpc
+        if self.elector.elected:
+            # settings can reshape the catalog (pod density, pod-ENI) and
+            # thus the solver tensor shapes: re-warm the compile ladder
+            self._warm_solver()
 
     def _hydrate(self) -> None:
         """Leadership-gated warm-state rebuild (SURVEY §5 checkpoint/resume):
-        re-adopt orphaned instances, refresh prices."""
+        re-adopt orphaned instances, refresh prices, and start the solver
+        shape warmup so the first real batches never stall on an XLA
+        compile (compile-behind covers shapes outside the warmed ladder)."""
         self.link.reconcile()
         self.pricing.maybe_refresh()
+        self._warm_solver()
+
+    def _warm_solver(self) -> None:
+        provs = [p.with_defaults() for p in self.state.provisioners.values()]
+        try:
+            self.scheduler.warm_startup(
+                provs or [Provisioner(name="default").with_defaults()],
+                self.cloud.get_instance_types(),
+                daemonsets=self.state.daemonsets,
+            )
+        except Exception:  # warmup is best-effort; solves fall back warm
+            logging.getLogger(__name__).warning(
+                "solver warmup failed; compile-behind will cover", exc_info=True
+            )
 
     # ---- health / metrics -----------------------------------------------
     def healthz(self) -> bool:

@@ -30,14 +30,20 @@ _N_DIMS = 9
 _N_ARRAYS = 30
 
 
+_CXX_FLAGS = ("-O3", "-fPIC", "-shared", "-Wall", "-std=c++17")
+
+
 def _so_path() -> Path:
-    """Build artifact keyed on the source content hash: a fresh checkout (or
-    an edited ffd.cpp) always compiles its own binary; stale binaries from
-    other source revisions are never loaded (mtimes are unreliable on fresh
-    clones — every file gets checkout time)."""
+    """Build artifact keyed on the source content hash (and compile flags):
+    a fresh checkout (or an edited ffd.cpp, or a flags change) always
+    compiles its own binary; stale binaries from other source revisions are
+    never loaded (mtimes are unreliable on fresh clones — every file gets
+    checkout time)."""
     import hashlib
 
-    h = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:12]
+    h = hashlib.sha256(
+        _SRC.read_bytes() + " ".join(_CXX_FLAGS).encode()
+    ).hexdigest()[:12]
     return Path(__file__).with_name(f"_native_{h}.so")
 
 
@@ -57,8 +63,7 @@ def _load():
         os.close(fd)
         try:
             subprocess.run(
-                ["g++", "-O2", "-fPIC", "-shared", "-Wall", "-std=c++17",
-                 "-o", tmp, str(_SRC)],
+                ["g++", *_CXX_FLAGS, "-o", tmp, str(_SRC)],
                 check=True,
                 capture_output=True,
             )

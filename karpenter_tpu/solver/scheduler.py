@@ -10,18 +10,31 @@ node set, so one SolveResult comes back either way.
 from __future__ import annotations
 
 import copy
+import os
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from ..metrics import SCHEDULING_DURATION, SOLVER_BACKEND_DURATION, Registry, registry as default_registry
+import logging
+
+from ..metrics import (
+    SCHEDULING_DURATION,
+    SOLVER_BACKEND_DURATION,
+    SOLVER_COLD_FALLBACKS,
+    SOLVER_COMPILE_DURATION,
+    SOLVER_COMPILE_IN_PROGRESS,
+    Registry,
+    registry as default_registry,
+)
 from ..models import labels as L
 from ..models.instancetype import InstanceType
-from ..models.pod import PodSpec
+from ..models.pod import LabelSelector, PodSpec
 from ..models.provisioner import Provisioner
 from ..models.tensorize import device_inexpressible, tensorize
 from .reference import solve as oracle_solve
 from .tpu import TpuSolver
 from .types import SimNode, SolveResult
+
+logger = logging.getLogger(__name__)
 
 
 #: "auto" routes batches below this pod count (with no topology constraints)
@@ -33,6 +46,14 @@ NATIVE_BATCH_LIMIT = 256
 MAX_RELAXATION_WAVES = 8
 
 
+def _compile_behind_enabled() -> bool:
+    """Measurement escape hatch: KT_COMPILE_BEHIND=0 serves cold shapes from
+    the warm tier WITHOUT starting the background compile — used by the
+    cold-start benchmark subprocess, which exits right after one solve and
+    must not wait out a 40 s XLA compile at interpreter shutdown."""
+    return os.environ.get("KT_COMPILE_BEHIND", "1") != "0"
+
+
 def _soft_spreads(pod: PodSpec):
     return [t for t in pod.topology_spread if not t.hard]
 
@@ -41,7 +62,11 @@ def _n_preferences(pod: PodSpec) -> int:
     """Relaxable preferences: preferred node-affinity terms + ScheduleAnyway
     topology spreads (both sit on the same relaxation ladder, like core's
     Preferences — scheduling.md:205-233 + :303-346 ScheduleAnyway)."""
-    return len(pod.preferred_affinity_terms) + len(_soft_spreads(pod))
+    n = len(pod.preferred_affinity_terms)
+    for t in pod.topology_spread:
+        if not t.hard:
+            n += 1
+    return n
 
 
 def _harden_preferences(pod: PodSpec, keep: Optional[int] = None) -> PodSpec:
@@ -50,13 +75,16 @@ def _harden_preferences(pod: PodSpec, keep: Optional[int] = None) -> PodSpec:
     ScheduleAnyway spreads become DoNotSchedule.  The ladder drops soft
     spreads first (they sort after affinity terms), then affinity terms
     last-first.  Returns the pod unchanged when it has no preferences."""
+    if not pod.preferred_affinity_terms and (
+        not pod.topology_spread or all(t.hard for t in pod.topology_spread)
+    ):
+        return pod  # no preferences (the hot path at scale)
+
     from ..models.pod import TopologySpreadConstraint
 
     prefs_aff = pod.preferred_affinity_terms
     soft = _soft_spreads(pod)
     total = len(prefs_aff) + len(soft)
-    if total == 0:
-        return pod
     k = total if keep is None else max(0, keep)
     kept_aff = prefs_aff[: min(k, len(prefs_aff))]
     kept_soft = soft[: max(0, k - len(prefs_aff))]
@@ -115,13 +143,18 @@ class BatchScheduler:
         registry: Optional[Registry] = None,
         mesh=None,
         native_batch_limit: int = NATIVE_BATCH_LIMIT,
+        compile_behind: Optional[bool] = None,  # None: KT_COMPILE_BEHIND env
     ) -> None:
         assert backend in ("auto", "tpu", "native", "oracle")
         self.backend = backend
         self.registry = registry or default_registry
         self.mesh = mesh
         self.native_batch_limit = native_batch_limit
+        self.compile_behind = (
+            _compile_behind_enabled() if compile_behind is None else compile_behind
+        )
         self._tpu = TpuSolver()
+        self._cold_logged: Set[tuple] = set()  # change-gated stall logging
 
     def solve(
         self,
@@ -230,6 +263,131 @@ class BatchScheduler:
             unavailable, allow_new_nodes, max_new_nodes,
         )
 
+    #: startup-warmup shape profiles: (groups, total_pods, with_zone_spread).
+    #: These mirror the steady-state controller batches (a provisioning wave
+    #: of mixed pods, with and without topology spread) so the first real
+    #: batches hit a compiled program; shapes outside the warmed ladder are
+    #: covered by compile-behind (_device_ready), never by a caller stall.
+    WARM_PROFILES = ((16, 400, False), (16, 400, True))
+
+    def warm_startup(
+        self,
+        provisioners,
+        instance_types,
+        daemonsets: Sequence[PodSpec] = (),
+        profiles=None,
+    ) -> int:
+        """Kick off background compiles for the startup shape ladder against
+        the live catalog/provisioners.  Returns the number of compiles
+        started.  Cheap to call repeatedly (signatures dedupe), so the
+        operator re-invokes it on settings changes that reshape the catalog."""
+        if self.backend not in ("auto", "tpu") or not self.compile_behind:
+            return 0
+        from ..models.pod import TopologySpreadConstraint
+
+        started = 0
+        for groups, total, spread in (profiles or self.WARM_PROFILES):
+            pods = []
+            per = max(1, total // groups)
+            for gi in range(groups):
+                sel = LabelSelector.of({"warmup-group": f"g{gi}"})
+                constraints = (
+                    [TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)]
+                    if spread else []
+                )
+                for i in range(per):
+                    pods.append(PodSpec(
+                        name=f"warmup-g{gi}-{i}",
+                        labels={"warmup-group": f"g{gi}"},
+                        requests={"cpu": 0.25 * (1 + gi % 8),
+                                  "memory": float(2 ** (30 + gi % 3))},
+                        topology_spread=list(constraints),
+                        owner_key=f"warmup-g{gi}",
+                    ))
+            st = tensorize(pods, provisioners, instance_types,
+                           daemonsets=daemonsets)
+            if self._tpu.warm_async(st, mesh=self.mesh, on_done=self._warm_done):
+                started += 1
+        if started:
+            self.registry.gauge(SOLVER_COMPILE_IN_PROGRESS).set(
+                self._tpu.compiles_in_flight()
+            )
+            logger.info("startup warmup: %d solver shape compiles started "
+                        "in the background", started)
+        return started
+
+    # ---- compile-behind (cold-start) ----------------------------------
+    def _warm_done(self, sig, seconds: float, err) -> None:
+        self.registry.gauge(SOLVER_COMPILE_IN_PROGRESS).set(
+            self._tpu.compiles_in_flight()
+        )
+        self.registry.histogram(SOLVER_COMPILE_DURATION).observe(seconds)
+        if err is not None:
+            logger.warning("background solver compile failed after %.1fs: %r",
+                           seconds, err)
+        else:
+            logger.info("solver shape compiled in background (%.1fs); "
+                        "subsequent solves of this shape run on-device", seconds)
+
+    def _device_ready(self, st, existing_nodes, max_slots) -> bool:
+        """True when the device program for this solve's shape is already
+        compiled.  (The background compile for a cold shape is kicked off by
+        _start_warm AFTER the fallback solve returns, so the compile thread
+        never contends with the caller's own solve.)"""
+        sig = self._tpu.signature(
+            st, existing_nodes=existing_nodes, max_nodes=max_slots,
+            mesh=self.mesh,
+        )
+        return self._tpu.ready(sig)
+
+    def _start_warm(self, st, existing_nodes, max_slots) -> None:
+        """Kick the background compile for a shape that just went cold,
+        with snapshot inputs so the live node objects aren't shared with
+        the worker thread.  Logged once per shape."""
+        if not self.compile_behind:
+            return
+        started = self._tpu.warm_async(
+            st, existing_nodes=[n.snapshot() for n in existing_nodes],
+            max_nodes=max_slots, mesh=self.mesh, on_done=self._warm_done,
+        )
+        if started:
+            self.registry.gauge(SOLVER_COMPILE_IN_PROGRESS).set(
+                self._tpu.compiles_in_flight()
+            )
+        sig = self._tpu.signature(
+            st, existing_nodes=existing_nodes, max_nodes=max_slots,
+            mesh=self.mesh,
+        )
+        if sig not in self._cold_logged:
+            self._cold_logged.add(sig)
+            logger.info(
+                "device program for this solve shape was not compiled yet; "
+                "served from the warm tier (compile running in background: "
+                "%s)", started or self._tpu.compiling(sig),
+            )
+
+    def _cold_solve(
+        self, st, tpu_pods, provisioners, instance_types, all_existing,
+        daemonsets, unavailable, allow_new_nodes, max_slots, max_new_nodes,
+    ):
+        """Serve a solve whose device program is still compiling: the native
+        C++ tier when it can express the batch (ms-scale, zero warmup — the
+        Go-FFD-like cold-start answer), else the CPU oracle."""
+        from . import native as native_mod
+
+        if native_mod.available() and not native_mod.has_topology(st):
+            res = native_mod.solve_tensors_native(
+                st, existing_nodes=all_existing, max_nodes=max_slots,
+            )
+            return res, "native"
+        res = oracle_solve(
+            tpu_pods, provisioners, instance_types,
+            existing_nodes=all_existing, daemonsets=daemonsets,
+            unavailable=unavailable, allow_new_nodes=allow_new_nodes,
+            max_new_nodes=max_new_nodes,
+        )
+        return res, "oracle"
+
     def _route_native(self, st, n_pods: int) -> bool:
         """auto-policy: native C++ tier for small unconstrained batches
         (per-dispatch device overhead dominates there); the batch solver for
@@ -302,18 +460,34 @@ class BatchScheduler:
             )
             t0 = time.perf_counter()
             new_budget = len(tpu_pods) if max_new_nodes is None else max_new_nodes
+            all_existing = list(cur_existing) + nodes
+            max_slots = len(all_existing) + new_budget
             if self._route_native(st, len(tpu_pods)):
                 from . import native as native_mod
 
                 res = native_mod.solve_tensors_native(
-                    st, existing_nodes=list(cur_existing) + nodes,
-                    max_nodes=len(cur_existing) + len(nodes) + new_budget,
+                    st, existing_nodes=all_existing, max_nodes=max_slots,
                 )
                 backend_used = "native"
+            elif self.backend == "auto" and not self._device_ready(
+                st, all_existing, max_slots
+            ):
+                # compile-behind: the device program for this shape is not
+                # compiled yet; serve this solve from the warm tier so the
+                # caller never eats the XLA stall, then _start_warm (below,
+                # after the fallback returns) kicks the background compile
+                res, backend_used = self._cold_solve(
+                    st, tpu_pods, provisioners, instance_types, all_existing,
+                    daemonsets, unavailable, allow_new_nodes, max_slots,
+                    max_new_nodes,
+                )
+                self.registry.counter(SOLVER_COLD_FALLBACKS).inc(
+                    {"backend": backend_used}
+                )
+                self._start_warm(st, all_existing, max_slots)
             else:
                 out = self._tpu.solve(
-                    st, existing_nodes=list(cur_existing) + nodes,
-                    max_nodes=len(cur_existing) + len(nodes) + new_budget,
+                    st, existing_nodes=all_existing, max_nodes=max_slots,
                     mesh=self.mesh,
                 )
                 res = out.result

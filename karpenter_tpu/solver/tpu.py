@@ -57,6 +57,64 @@ from .types import SimNode, SolveResult
 BIGN = np.float32(1e9)  # "unbounded" node/pod counts
 
 
+def _rung(n: int, quantum: int, linear_max: int, ratio: float = 1.5,
+          axis_div: int = 1) -> int:
+    """Bucket ``n`` up to a small, stable rung ladder: linear multiples of
+    ``quantum`` up to ``linear_max``, then a geometric x``ratio`` ladder
+    (each rung rounded to the quantum).  Linear quanta keep padding waste
+    near zero for the common small shapes; the geometric tail bounds the
+    TOTAL number of distinct rungs (≈ log-many), so a growing cluster stops
+    triggering a fresh XLA compile every ``quantum`` of growth — the compile
+    ladder becomes warmable.  ``axis_div`` keeps the rung divisible for mesh
+    sharding."""
+    q = max(quantum, axis_div)
+    q = ((q + axis_div - 1) // axis_div) * axis_div
+
+    def up(m: int) -> int:
+        out = ((m + q - 1) // q) * q
+        return max(out, axis_div)
+
+    if n <= linear_max:
+        return up(n)
+    rung = up(linear_max)
+    while rung < n:
+        rung = up(int(rung * ratio))
+    return rung
+
+
+def _mesh_divs(mesh) -> Tuple[int, int]:
+    if mesh is None:
+        return 1, 1
+    from ..parallel.mesh import POD_AXIS, TYPE_AXIS
+
+    return mesh.shape[POD_AXIS], mesh.shape[TYPE_AXIS]
+
+
+def solve_dims(st: SolveTensors, *, NE: int, node_budget: int,
+               a: int = 1, b: int = 1, track: bool = True) -> dict:
+    """The padded tensor dimensions (and thus the XLA compile signature) for
+    a solve of ``st`` against ``NE`` existing nodes with ``node_budget`` max
+    node slots.  The SINGLE source of the bucketing math: ``prepare`` pads to
+    these dims and ``TpuSolver.signature`` keys compile-readiness on them, so
+    the two can never drift."""
+    G_pad = _rung(st.G, 16, 128, axis_div=a)
+    C_pad = _rung(max(1, st.C), 64, 512, axis_div=b)
+    NR = _rung(max(1, node_budget), 512, 2048, axis_div=a)
+    NE_pad = _rung(max(1, NE), 16, 64)
+    S_pad = _rung(st.S, 8, 32) if st.S else 0
+    P_pad = _rung(max(1, len(st.prov_names)), 4, 8)
+    K, W = st.pm.shape[1], st.pm.shape[2]
+    return dict(
+        G=G_pad, C=C_pad, NR=NR, NE_pad=NE_pad, S=S_pad, P=P_pad,
+        D=st.D, R=st.R, Z=max(1, st.n_zones), K=K, W=W,
+        track=bool(track), a=a, b=b,
+    )
+
+
+def _dims_key(dims: dict) -> tuple:
+    return tuple(sorted(dims.items()))
+
+
 # ---------------------------------------------------------------------------
 # feasibility precompute
 # ---------------------------------------------------------------------------
@@ -581,11 +639,119 @@ class TpuSolveOutput:
     compile_ms: float
 
 
+def _node_budget(st: SolveTensors, NE: int, max_nodes: Optional[int]) -> int:
+    if max_nodes is None:
+        max_nodes = NE + int(st.counts.sum())  # worst case: one pod per node
+    return max(1, max_nodes)
+
+
 class TpuSolver:
-    """Builds and caches the jitted solve for a tensor shape signature."""
+    """Builds and caches the jitted solve for a tensor shape signature.
+
+    Compile-readiness is tracked per signature (the padded-dims key from
+    ``solve_dims``): ``ready()`` tells the scheduler whether a solve of this
+    shape will hit the jit cache or stall ~tens of seconds in XLA, and
+    ``warm_async()`` compiles a signature on a background thread — the
+    scheduler's compile-behind fallback and the operator's startup warmup
+    both ride it.  The reference bar is the Go FFD's zero-warmup ms-scale
+    first solve (designs/bin-packing.md:28-43): callers must never eat a
+    cold compile."""
+
+    #: at most this many concurrent background compiles; extras are dropped
+    #: (the next solve of that shape re-triggers the warm)
+    MAX_CONCURRENT_WARMS = 2
 
     def __init__(self) -> None:
-        self._cache: Dict[tuple, object] = {}
+        import threading
+
+        self._lock = threading.Lock()
+        self._ready: set = set()
+        self._compiling: set = set()
+
+    # ---- compile-readiness ----------------------------------------------
+    def signature(
+        self,
+        st: SolveTensors,
+        *,
+        existing_nodes: Sequence[SimNode] = (),
+        max_nodes: Optional[int] = None,
+        track_assignments: bool = True,
+        mesh=None,
+    ) -> tuple:
+        NE = len(existing_nodes)
+        a, b = _mesh_divs(mesh)
+        dims = solve_dims(
+            st, NE=NE, node_budget=_node_budget(st, NE, max_nodes),
+            a=a, b=b, track=track_assignments,
+        )
+        return _dims_key(dims)
+
+    def ready(self, sig: tuple) -> bool:
+        with self._lock:
+            return sig in self._ready
+
+    def compiling(self, sig: tuple) -> bool:
+        with self._lock:
+            return sig in self._compiling
+
+    def compiles_in_flight(self) -> int:
+        with self._lock:
+            return len(self._compiling)
+
+    def _mark_ready(self, sig: tuple) -> None:
+        with self._lock:
+            self._ready.add(sig)
+            self._compiling.discard(sig)
+
+    def warm_async(
+        self,
+        st: SolveTensors,
+        *,
+        existing_nodes: Sequence[SimNode] = (),
+        max_nodes: Optional[int] = None,
+        track_assignments: bool = True,
+        mesh=None,
+        on_done=None,
+    ) -> bool:
+        """Compile this solve's signature on a daemon thread (running the
+        full solve and discarding the result — compile dominates).  Returns
+        True when a warm was started, False when the signature is already
+        ready/compiling or the concurrent-warm bound is hit.  ``on_done(sig,
+        seconds, error)`` fires from the worker thread when the warm ends."""
+        import threading
+
+        sig = self.signature(
+            st, existing_nodes=existing_nodes, max_nodes=max_nodes,
+            track_assignments=track_assignments, mesh=mesh,
+        )
+        with self._lock:
+            if sig in self._ready or sig in self._compiling:
+                return False
+            if len(self._compiling) >= self.MAX_CONCURRENT_WARMS:
+                return False
+            self._compiling.add(sig)
+
+        def work():
+            t0 = time.perf_counter()
+            err = None
+            try:
+                self.solve(
+                    st, existing_nodes=existing_nodes, max_nodes=max_nodes,
+                    track_assignments=track_assignments, mesh=mesh,
+                )
+            except Exception as e:  # pragma: no cover - surfaced via on_done
+                err = e
+                with self._lock:
+                    self._compiling.discard(sig)
+            if on_done is not None:
+                on_done(sig, time.perf_counter() - t0, err)
+
+        # NON-daemon: a daemon thread hard-killed at interpreter exit while
+        # inside an XLA compile aborts the whole process (std::terminate);
+        # a non-daemon thread instead delays exit until the compile lands,
+        # which is the safe behavior for operator shutdown and CLI runs
+        threading.Thread(target=work, name="tpu-solver-warm").start()
+        return True
 
     def prepare(
         self,
@@ -603,32 +769,21 @@ class TpuSolver:
         K, W = st.pm.shape[1], st.pm.shape[2]
         NE = len(existing_nodes)
 
-        total_pods = int(st.counts.sum())
-        if max_nodes is None:
-            max_nodes = NE + total_pods  # worst case: one pod per node
-        node_budget = max(1, max_nodes)
-        NR = node_budget
+        node_budget = _node_budget(st, NE, max_nodes)
 
         # ---- shape bucketing + mesh padding ------------------------------
-        # The scan compiles per (G, C, NR, ...) signature; bucketing the axes
+        # The scan compiles per (G, C, NR, ...) signature; rung-bucketing the
+        # axes (linear quanta for small shapes, geometric beyond — see _rung)
         # makes repeated controller solves hit the persistent jit cache
-        # instead of paying a fresh XLA compile per batch shape.
-        a = b = 1
-        if mesh is not None:
-            from ..parallel.mesh import POD_AXIS, TYPE_AXIS
-
-            a = mesh.shape[POD_AXIS]
-            b = mesh.shape[TYPE_AXIS]
-
-        def _bucket(n: int, quantum: int, axis_div: int) -> int:
-            q = max(quantum, axis_div)
-            q = ((q + axis_div - 1) // axis_div) * axis_div
-            out = ((n + q - 1) // q) * q
-            return max(out, axis_div)
-
-        pad_g = _bucket(G, 16, a) - G
-        pad_c = _bucket(C, 64, b) - C
-        NR = _bucket(NR, 512, a)
+        # instead of paying a fresh XLA compile per batch shape, and keeps
+        # the total rung ladder small enough to precompile (warm_async).
+        a, b = _mesh_divs(mesh)
+        dims = solve_dims(st, NE=NE, node_budget=node_budget, a=a, b=b,
+                          track=track_assignments)
+        pad_g = dims["G"] - G
+        pad_c = dims["C"] - C
+        pad_s = dims["S"] - S
+        NR = dims["NR"]
 
         def _pad(arr, n, axis, value):
             if n == 0:
@@ -655,7 +810,7 @@ class TpuSolver:
         np_gza = _pad(st.g_zone_anti, pad_g, 0, -1)
         np_gzp = _pad(st.g_zone_paff, pad_g, 0, -1)
         np_ghp = _pad(st.g_host_paff, pad_g, 0, -1)
-        np_gsm = _pad(st.g_sel_match, pad_g, 1, False)
+        np_gsm = _pad(_pad(st.g_sel_match, pad_g, 1, False), pad_s, 0, False)
         np_gp_ok = _pad(st.gp_ok, pad_g, 0, False)
         np_cvw = _pad(st.cand_vw, pad_c, 0, 0)
         np_cvb = _pad(st.cand_vb, pad_c, 0, 0)
@@ -665,9 +820,11 @@ class TpuSolver:
         np_cprice = _pad(st.cand_price, pad_c, 0, np.float32(3.0e38))
         np_cavail = _pad(st.cand_avail, pad_c, 0, False)
         G = G + pad_g
+        S = S + pad_s
 
         # ---- existing-node tensors (host-side compat precompute) -------
-        NE_pad = ((max(1, NE) + 15) // 16) * 16  # bucketed: stable jit shapes
+        NE_pad = dims["NE_pad"]  # rung-bucketed: stable jit shapes
+        P_pad = dims["P"]
         ex_res = np.zeros((NR, R), dtype=np.float32)
         ex_zone = np.zeros(NR, dtype=np.int32)
         ex_sel = np.zeros((NR, S), dtype=np.int32)
@@ -676,7 +833,7 @@ class TpuSolver:
         zone_index = {z: i for i, z in enumerate(st.zone_names)}
         zc0 = np.zeros((S, Z), dtype=np.int32)
         tot0 = np.zeros(S, dtype=np.int32)
-        prov_used0 = np.zeros((max(1, len(st.prov_names)), R), dtype=np.float32)
+        prov_used0 = np.zeros((P_pad, R), dtype=np.float32)
         prov_index = {n: i for i, n in enumerate(st.prov_names)}
 
         for ni, node in enumerate(existing_nodes):
@@ -717,7 +874,11 @@ class TpuSolver:
             cand_prov=jnp.asarray(np_cprov),
             cand_price=jnp.asarray(np.where(np.isinf(np_cprice), np.float32(3.0e38), np_cprice).astype(np.float32)),
             cand_avail=jnp.asarray(np_cavail),
-            prov_limits=jnp.asarray(np.where(np.isinf(st.prov_limits), np.float32(3.0e38), st.prov_limits)),
+            prov_limits=jnp.asarray(_pad(
+                np.where(np.isinf(st.prov_limits), np.float32(3.0e38),
+                         st.prov_limits).astype(np.float32),
+                P_pad - st.prov_limits.shape[0], 0, np.float32(3.0e38),
+            )),
             dom_zone=jnp.asarray(st.dom_zone),
             ex_ok=jnp.asarray(ex_ok),
             node_budget=jnp.int32(node_budget),
@@ -802,6 +963,10 @@ class TpuSolver:
         np.asarray(carry[7])  # D2H fence; see timing note below
         compile_ms = (time.perf_counter() - t0) * 1000.0
         solve_ms = compile_ms
+        self._mark_ready(self.signature(
+            st, existing_nodes=existing_nodes, max_nodes=max_nodes,
+            track_assignments=track_assignments, mesh=mesh,
+        ))
 
         if measure:
             # Timing run, results discarded.  Two quirks of the tunneled

@@ -114,6 +114,19 @@ def test_inventory_metrics_are_emitted(small_catalog):
     action = deprov.reconcile()        # re-validated and executed
     assert action is not None
 
+    # compile-behind metrics: a cold device shape served by the warm tier
+    import time as _time
+
+    auto_sched = BatchScheduler(backend="auto", registry=reg, native_batch_limit=4)
+    auto_sched.solve(
+        [PodSpec(name=f"cold{i}", requests={"cpu": 1.0}) for i in range(8)],
+        [Provisioner(name="default").with_defaults()],
+        small_catalog,
+    )
+    t0 = _time.time()
+    while auto_sched._tpu.compiles_in_flight() > 0 and _time.time() - t0 < 120:
+        _time.sleep(0.05)
+
     emitted = (set(reg.counters) | set(reg.gauges) | set(reg.histograms))
     missing = set(INVENTORY) - emitted
     assert not missing, f"documented metrics never emitted: {sorted(missing)}"

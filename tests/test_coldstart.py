@@ -1,0 +1,110 @@
+"""Compile-behind: cold device shapes are served by the warm tier while the
+XLA program compiles in the background.
+
+The reference bar is the Go FFD's zero-warmup ms-scale first solve
+(designs/bin-packing.md:28-43): a reconcile loop must never stall on an XLA
+compile.  The scheduler's auto policy therefore routes a solve whose shape
+signature is not compiled yet to the native C++ tier (or the CPU oracle when
+the batch has device-only constraints), kicks the compile off on a background
+thread, and moves that shape on-device once the compile lands.
+"""
+
+import time
+
+from karpenter_tpu.metrics import (
+    SOLVER_BACKEND_DURATION,
+    SOLVER_COLD_FALLBACKS,
+    SOLVER_COMPILE_DURATION,
+    SOLVER_COMPILE_IN_PROGRESS,
+    Registry,
+)
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import LabelSelector, PodAffinityTerm, PodSpec
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.solver.scheduler import BatchScheduler
+
+
+def _wait_warm(sched: BatchScheduler, timeout: float = 180.0) -> None:
+    t0 = time.time()
+    while sched._tpu.compiles_in_flight() > 0:
+        if time.time() - t0 > timeout:
+            raise AssertionError("background compile did not finish in time")
+        time.sleep(0.05)
+
+
+class TestCompileBehind:
+    def test_cold_shape_served_by_native_then_on_device(self, small_catalog):
+        reg = Registry()
+        sched = BatchScheduler(backend="auto", registry=reg, native_batch_limit=8)
+        prov = Provisioner(name="default").with_defaults()
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(32)]
+
+        r1 = sched.solve(pods, [prov], small_catalog)
+        assert not r1.infeasible
+        # the caller was served by the warm tier; no device execution happened
+        assert reg.counter(SOLVER_COLD_FALLBACKS).get({"backend": "native"}) == 1
+        assert reg.histogram(SOLVER_BACKEND_DURATION).count({"backend": "tpu"}) == 0
+
+        _wait_warm(sched)
+        assert reg.histogram(SOLVER_COMPILE_DURATION).count() == 1
+        assert reg.gauge(SOLVER_COMPILE_IN_PROGRESS).get() == 0
+
+        # same shape again: now solved on-device, no new fallback
+        pods2 = [PodSpec(name=f"q{i}", requests={"cpu": 1.0}) for i in range(32)]
+        r2 = sched.solve(pods2, [prov], small_catalog)
+        assert not r2.infeasible
+        assert reg.histogram(SOLVER_BACKEND_DURATION).count({"backend": "tpu"}) == 1
+        assert reg.counter(SOLVER_COLD_FALLBACKS).get({"backend": "native"}) == 1
+
+    def test_cold_device_only_batch_falls_back_to_oracle(self, small_catalog):
+        """Positive pod-affinity is inexpressible in the native tier
+        (native.has_topology), so its cold fallback is the CPU oracle."""
+        reg = Registry()
+        sched = BatchScheduler(backend="auto", registry=reg, native_batch_limit=8)
+        prov = Provisioner(name="default").with_defaults()
+        sel = LabelSelector.of({"app": "x"})
+        pods = [
+            PodSpec(name=f"p{i}", labels={"app": "x"}, requests={"cpu": 1.0},
+                    affinity_terms=[PodAffinityTerm(sel, L.ZONE, anti=False)])
+            for i in range(16)
+        ]
+        r = sched.solve(pods, [prov], small_catalog)
+        assert not r.infeasible
+        assert reg.counter(SOLVER_COLD_FALLBACKS).get({"backend": "oracle"}) == 1
+        # placements must all share one zone (the affinity contract held)
+        zones = {n.zone for n in r.nodes}
+        assert len(zones) == 1
+        _wait_warm(sched)
+
+    def test_operator_warms_solver_on_election(self, small_catalog, monkeypatch):
+        """Election-gated startup warmup (the LT-hydration analog,
+        launchtemplate.go:77-88): the operator precompiles the solver shape
+        ladder in the background before the reconcile loop needs it."""
+        from karpenter_tpu.cloud.fake import FakeCloudProvider
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.utils.clock import FakeClock
+
+        monkeypatch.setattr(BatchScheduler, "WARM_PROFILES", ((4, 8, False),))
+        clock = FakeClock()
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+        op = Operator(cloud, clock=clock, scheduler_backend="auto",
+                      registry=Registry())
+        op.state.apply_provisioner(Provisioner(name="default"))
+        op.tick()  # elects -> hydrate -> warm_startup
+        _wait_warm(op.scheduler)
+        assert op.scheduler._tpu._ready  # at least one shape compiled
+        assert op.registry.histogram(SOLVER_COMPILE_DURATION).count() >= 1
+        assert op.registry.gauge(SOLVER_COMPILE_IN_PROGRESS).get() == 0
+
+    def test_explicit_tpu_backend_compiles_synchronously(self, small_catalog):
+        """backend="tpu" (benchmarks, parity tests) keeps the synchronous
+        compile-and-run behavior — no fallback, deterministic device path."""
+        reg = Registry()
+        sched = BatchScheduler(backend="tpu", registry=reg)
+        prov = Provisioner(name="default").with_defaults()
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(8)]
+        r = sched.solve(pods, [prov], small_catalog)
+        assert not r.infeasible
+        assert reg.counter(SOLVER_COLD_FALLBACKS).get({"backend": "native"}) == 0
+        assert reg.counter(SOLVER_COLD_FALLBACKS).get({"backend": "oracle"}) == 0
+        assert reg.histogram(SOLVER_BACKEND_DURATION).count({"backend": "tpu"}) == 1
