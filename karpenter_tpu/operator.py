@@ -158,6 +158,8 @@ class Operator:
                 provs or [Provisioner(name="default").with_defaults()],
                 self.cloud.get_instance_types(),
                 daemonsets=self.state.daemonsets,
+                existing_nodes=[n.snapshot()
+                                for n in self.state.schedulable_nodes()],
             )
         except Exception:  # warmup is best-effort; solves fall back warm
             logging.getLogger(__name__).warning(

@@ -62,11 +62,7 @@ def _n_preferences(pod: PodSpec) -> int:
     """Relaxable preferences: preferred node-affinity terms + ScheduleAnyway
     topology spreads (both sit on the same relaxation ladder, like core's
     Preferences — scheduling.md:205-233 + :303-346 ScheduleAnyway)."""
-    n = len(pod.preferred_affinity_terms)
-    for t in pod.topology_spread:
-        if not t.hard:
-            n += 1
-    return n
+    return len(pod.preferred_affinity_terms) + len(_soft_spreads(pod))
 
 
 def _harden_preferences(pod: PodSpec, keep: Optional[int] = None) -> PodSpec:
@@ -265,22 +261,28 @@ class BatchScheduler:
 
     #: startup-warmup shape profiles: (groups, total_pods, with_zone_spread).
     #: These mirror the steady-state controller batches (a provisioning wave
-    #: of mixed pods, with and without topology spread) so the first real
-    #: batches hit a compiled program; shapes outside the warmed ladder are
-    #: covered by compile-behind (_device_ready), never by a caller stall.
-    WARM_PROFILES = ((16, 400, False), (16, 400, True))
+    #: of mixed pods with topology spread — spread vs no-spread collapses to
+    #: the same compile signature, so one profile covers both) so the first
+    #: real batches hit a compiled program; shapes outside the warmed ladder
+    #: are covered by compile-behind (_device_ready), never by a caller stall.
+    WARM_PROFILES = ((16, 400, True),)
 
     def warm_startup(
         self,
         provisioners,
         instance_types,
         daemonsets: Sequence[PodSpec] = (),
+        existing_nodes: Sequence[SimNode] = (),
         profiles=None,
     ) -> int:
         """Kick off background compiles for the startup shape ladder against
-        the live catalog/provisioners.  Returns the number of compiles
-        started.  Cheap to call repeatedly (signatures dedupe), so the
-        operator re-invokes it on settings changes that reshape the catalog."""
+        the live catalog/provisioners — and, crucially, against the live
+        CLUSTER SIZE: ``existing_nodes`` (snapshots) set the NE/NR rungs, so
+        an operator restarting over a 500-node cluster warms the shapes its
+        provisioning and consolidation solves will actually hit, not the
+        empty-cluster ones.  Returns the number of compiles accepted.  Cheap
+        to call repeatedly (signatures dedupe), so the operator re-invokes
+        it on settings changes that reshape the catalog."""
         if self.backend not in ("auto", "tpu") or not self.compile_behind:
             return 0
         from ..models.pod import TopologySpreadConstraint
@@ -306,13 +308,25 @@ class BatchScheduler:
                     ))
             st = tensorize(pods, provisioners, instance_types,
                            daemonsets=daemonsets)
-            if self._tpu.warm_async(st, mesh=self.mesh, on_done=self._warm_done):
+            # provisioning shape: batch solved against the current cluster
+            if self._tpu.warm_async(st, existing_nodes=existing_nodes,
+                                    mesh=self.mesh, on_done=self._warm_done):
                 started += 1
+            if existing_nodes:
+                # consolidation what-if shape: a small repack against the
+                # cluster with at most one new node (deprovisioning.py
+                # _solve_what_if passes max_new_nodes=1)
+                if self._tpu.warm_async(
+                    st, existing_nodes=existing_nodes,
+                    max_nodes=len(existing_nodes) + 1,
+                    mesh=self.mesh, on_done=self._warm_done,
+                ):
+                    started += 1
         if started:
             self.registry.gauge(SOLVER_COMPILE_IN_PROGRESS).set(
                 self._tpu.compiles_in_flight()
             )
-            logger.info("startup warmup: %d solver shape compiles started "
+            logger.info("startup warmup: %d solver shape compiles accepted "
                         "in the background", started)
         return started
 
@@ -321,11 +335,14 @@ class BatchScheduler:
         self.registry.gauge(SOLVER_COMPILE_IN_PROGRESS).set(
             self._tpu.compiles_in_flight()
         )
-        self.registry.histogram(SOLVER_COMPILE_DURATION).observe(seconds)
         if err is not None:
+            # failed compiles stay out of the duration histogram — it
+            # documents actual compile cost; TpuSolver arms a per-shape
+            # retry backoff so this shape isn't hot-recompiled
             logger.warning("background solver compile failed after %.1fs: %r",
                            seconds, err)
         else:
+            self.registry.histogram(SOLVER_COMPILE_DURATION).observe(seconds)
             logger.info("solver shape compiled in background (%.1fs); "
                         "subsequent solves of this shape run on-device", seconds)
 

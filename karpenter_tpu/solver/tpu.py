@@ -555,11 +555,21 @@ def _make_step(
                 return two_stage(state, jnp.sum(rem_z), jnp.ones(D, dtype=bool))
 
             def create_zoned(state):
-                left = jnp.sum(rem_z)
-                for z in range(Z):  # Z static and small
-                    state = two_stage(state, rem_z[z], zone_of_dom == z,
-                                      score_rem=left)
+                # scan (not a Python loop) over zones: the two_stage creation
+                # body is traced ONCE instead of Z times, cutting the XLA
+                # program size — and thus compile time — roughly by the zone
+                # count for the creation section (the dominant traced code)
+                def zbody(carry, z):
+                    st_z, left = carry
+                    st_z = two_stage(st_z, rem_z[z], zone_of_dom == z,
+                                     score_rem=left)
                     left = jnp.maximum(left - rem_z[z], 0.0)
+                    return (st_z, left), jnp.int32(0)
+
+                (state, _), _ = jax.lax.scan(
+                    zbody, (state, jnp.sum(rem_z)),
+                    jnp.arange(Z, dtype=jnp.int32),
+                )
                 return state
 
             state = jax.lax.cond(zoned, create_zoned, create_simple, state)
@@ -657,9 +667,14 @@ class TpuSolver:
     first solve (designs/bin-packing.md:28-43): callers must never eat a
     cold compile."""
 
-    #: at most this many concurrent background compiles; extras are dropped
-    #: (the next solve of that shape re-triggers the warm)
+    #: at most this many concurrent background compiles; extras queue (FIFO,
+    #: bounded) and start as slots free up
     MAX_CONCURRENT_WARMS = 2
+    MAX_QUEUED_WARMS = 8
+    #: a shape whose background compile failed is not retried for this long
+    #: (prevents a deterministically-failing compile from burning a full
+    #: compile of CPU on every solve of that shape)
+    WARM_FAILURE_BACKOFF = 300.0
 
     def __init__(self) -> None:
         import threading
@@ -667,6 +682,8 @@ class TpuSolver:
         self._lock = threading.Lock()
         self._ready: set = set()
         self._compiling: set = set()
+        self._queued: list = []  # [(sig, kwargs)]
+        self._failed_until: Dict[tuple, float] = {}
 
     # ---- compile-readiness ----------------------------------------------
     def signature(
@@ -698,6 +715,11 @@ class TpuSolver:
         with self._lock:
             return len(self._compiling)
 
+    def warm_idle(self) -> bool:
+        """No background compile running or queued."""
+        with self._lock:
+            return not self._compiling and not self._queued
+
     def _mark_ready(self, sig: tuple) -> None:
         with self._lock:
             self._ready.add(sig)
@@ -713,45 +735,70 @@ class TpuSolver:
         mesh=None,
         on_done=None,
     ) -> bool:
-        """Compile this solve's signature on a daemon thread (running the
-        full solve and discarding the result — compile dominates).  Returns
-        True when a warm was started, False when the signature is already
-        ready/compiling or the concurrent-warm bound is hit.  ``on_done(sig,
-        seconds, error)`` fires from the worker thread when the warm ends."""
-        import threading
-
+        """Compile this solve's signature on a background thread (running
+        the full solve and discarding the result — compile dominates).
+        Returns True when the warm was accepted (started or queued), False
+        when the signature is already ready/compiling/queued, is in its
+        failure backoff, or the queue is full.  ``on_done(sig, seconds,
+        error)`` fires from the worker thread when the warm ends."""
         sig = self.signature(
             st, existing_nodes=existing_nodes, max_nodes=max_nodes,
             track_assignments=track_assignments, mesh=mesh,
         )
+        kwargs = dict(
+            st=st, existing_nodes=existing_nodes, max_nodes=max_nodes,
+            track_assignments=track_assignments, mesh=mesh, on_done=on_done,
+        )
         with self._lock:
             if sig in self._ready or sig in self._compiling:
                 return False
-            if len(self._compiling) >= self.MAX_CONCURRENT_WARMS:
+            if any(s == sig for s, _ in self._queued):
                 return False
+            if time.time() < self._failed_until.get(sig, 0.0):
+                return False  # recent compile failure: back off
+            if len(self._compiling) >= self.MAX_CONCURRENT_WARMS:
+                if len(self._queued) >= self.MAX_QUEUED_WARMS:
+                    return False
+                self._queued.append((sig, kwargs))
+                return True
             self._compiling.add(sig)
+        self._spawn_warm(sig, kwargs)
+        return True
+
+    def _spawn_warm(self, sig: tuple, kwargs: dict) -> None:
+        import threading
+
+        on_done = kwargs.pop("on_done")
 
         def work():
             t0 = time.perf_counter()
             err = None
             try:
-                self.solve(
-                    st, existing_nodes=existing_nodes, max_nodes=max_nodes,
-                    track_assignments=track_assignments, mesh=mesh,
-                )
+                self.solve(**kwargs)
             except Exception as e:  # pragma: no cover - surfaced via on_done
                 err = e
                 with self._lock:
                     self._compiling.discard(sig)
+                    self._failed_until[sig] = time.time() + self.WARM_FAILURE_BACKOFF
             if on_done is not None:
                 on_done(sig, time.perf_counter() - t0, err)
+            # drain: start the next queued warm that is still cold, if any
+            while True:
+                with self._lock:
+                    if not self._queued or len(self._compiling) >= self.MAX_CONCURRENT_WARMS:
+                        return
+                    next_sig, next_kwargs = self._queued.pop(0)
+                    if next_sig in self._ready:
+                        continue  # compiled by a direct solve meanwhile
+                    self._compiling.add(next_sig)
+                self._spawn_warm(next_sig, next_kwargs)
+                return
 
         # NON-daemon: a daemon thread hard-killed at interpreter exit while
         # inside an XLA compile aborts the whole process (std::terminate);
         # a non-daemon thread instead delays exit until the compile lands,
         # which is the safe behavior for operator shutdown and CLI runs
         threading.Thread(target=work, name="tpu-solver-warm").start()
-        return True
 
     def prepare(
         self,

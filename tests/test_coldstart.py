@@ -26,7 +26,7 @@ from karpenter_tpu.solver.scheduler import BatchScheduler
 
 def _wait_warm(sched: BatchScheduler, timeout: float = 180.0) -> None:
     t0 = time.time()
-    while sched._tpu.compiles_in_flight() > 0:
+    while not sched._tpu.warm_idle():
         if time.time() - t0 > timeout:
             raise AssertionError("background compile did not finish in time")
         time.sleep(0.05)
@@ -95,6 +95,70 @@ class TestCompileBehind:
         assert op.scheduler._tpu._ready  # at least one shape compiled
         assert op.registry.histogram(SOLVER_COMPILE_DURATION).count() >= 1
         assert op.registry.gauge(SOLVER_COMPILE_IN_PROGRESS).get() == 0
+
+    def test_warm_queue_drains_beyond_concurrency_cap(self, small_catalog, monkeypatch):
+        from karpenter_tpu.solver.tpu import TpuSolver
+
+        monkeypatch.setattr(TpuSolver, "MAX_CONCURRENT_WARMS", 1)
+        reg = Registry()
+        sched = BatchScheduler(backend="auto", registry=reg)
+        prov = Provisioner(name="default").with_defaults()
+        accepted = sched.warm_startup([prov], small_catalog,
+                                      profiles=((2, 4, False), (40, 80, False)))
+        assert accepted == 2  # distinct G rungs: one runs, one queues
+        _wait_warm(sched)
+        assert len(sched._tpu._ready) == 2
+
+    def test_failed_compile_backs_off(self, small_catalog, monkeypatch):
+        """A shape whose compile fails is not hot-recompiled on every solve
+        of that shape, and failures stay out of the duration histogram."""
+        reg = Registry()
+        sched = BatchScheduler(backend="auto", registry=reg)
+
+        def boom(*a, **k):
+            raise RuntimeError("simulated XLA compile failure")
+
+        monkeypatch.setattr(sched._tpu, "solve", boom)
+        prov = Provisioner(name="default").with_defaults()
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(300)]
+        r = sched.solve(pods, [prov], small_catalog)  # cold -> native fallback
+        assert not r.infeasible
+        _wait_warm(sched)
+        assert reg.histogram(SOLVER_COMPILE_DURATION).count() == 0
+        # within the backoff window no new warm is accepted for this shape
+        from karpenter_tpu.models.tensorize import tensorize
+
+        st = tensorize(pods, [prov], small_catalog)
+        assert not sched._tpu.warm_async(st)
+        assert sched._tpu._failed_until  # backoff armed
+
+    def test_warm_startup_uses_cluster_size(self, small_catalog):
+        """The warmed signatures must reflect the live cluster's NE/NR rungs
+        — an operator restarting over a populated cluster warms the shapes
+        its solves will actually hit (VERDICT r3 review finding)."""
+        from karpenter_tpu.solver.tpu import SimNode
+
+        reg = Registry()
+        sched = BatchScheduler(backend="auto", registry=reg)
+        prov = Provisioner(name="default").with_defaults()
+        existing = [
+            SimNode(instance_type="c5.2xlarge", provisioner="default",
+                    zone="zone-1a", capacity_type="on-demand", price=0.34,
+                    allocatable={"cpu": 8.0, "pods": 58.0}, existing=True)
+            for _ in range(120)
+        ]
+        accepted = sched.warm_startup(
+            [prov], small_catalog, existing_nodes=existing,
+            profiles=((2, 400, False),),
+        )
+        # provisioning shape (NR covers existing+batch) and consolidation
+        # shape (NR covers existing+1) land on distinct NR rungs
+        assert accepted == 2
+        _wait_warm(sched)
+        ne_pads = {dict(sig)["NE_pad"] for sig in sched._tpu._ready}
+        from karpenter_tpu.solver.tpu import _rung
+
+        assert _rung(120, 16, 64) in ne_pads  # cluster-sized rung, not 16
 
     def test_explicit_tpu_backend_compiles_synchronously(self, small_catalog):
         """backend="tpu" (benchmarks, parity tests) keeps the synchronous
