@@ -260,12 +260,13 @@ class BatchScheduler:
         )
 
     #: startup-warmup shape profiles: (groups, total_pods, with_zone_spread).
-    #: These mirror the steady-state controller batches (a provisioning wave
-    #: of mixed pods with topology spread — spread vs no-spread collapses to
-    #: the same compile signature, so one profile covers both) so the first
-    #: real batches hit a compiled program; shapes outside the warmed ladder
-    #: are covered by compile-behind (_device_ready), never by a caller stall.
-    WARM_PROFILES = ((16, 400, True),)
+    #: These mirror the steady-state controller batches — a provisioning wave
+    #: of mixed pods, with and without topology spread (the selector-axis S
+    #: rung differs between the two, so they are distinct compile
+    #: signatures) — so the first real batches hit a compiled program; shapes
+    #: outside the warmed ladder are covered by compile-behind
+    #: (_device_ready), never by a caller stall.
+    WARM_PROFILES = ((16, 400, False), (16, 400, True))
 
     def warm_startup(
         self,

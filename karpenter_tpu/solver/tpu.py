@@ -684,6 +684,7 @@ class TpuSolver:
         self._compiling: set = set()
         self._queued: list = []  # [(sig, kwargs)]
         self._failed_until: Dict[tuple, float] = {}
+        self._stopped = False  # stop_warms() called: no new spawns
 
     # ---- compile-readiness ----------------------------------------------
     def signature(
@@ -720,6 +721,14 @@ class TpuSolver:
         with self._lock:
             return not self._compiling and not self._queued
 
+    def stop_warms(self) -> None:
+        """Drop all queued warms and stop the drain (operator shutdown):
+        exit then waits only for the compiles already in flight, never the
+        queue."""
+        with self._lock:
+            self._stopped = True
+            self._queued.clear()
+
     def _mark_ready(self, sig: tuple) -> None:
         with self._lock:
             self._ready.add(sig)
@@ -750,6 +759,8 @@ class TpuSolver:
             track_assignments=track_assignments, mesh=mesh, on_done=on_done,
         )
         with self._lock:
+            if self._stopped:
+                return False
             if sig in self._ready or sig in self._compiling:
                 return False
             if any(s == sig for s, _ in self._queued):
@@ -782,10 +793,18 @@ class TpuSolver:
                     self._failed_until[sig] = time.time() + self.WARM_FAILURE_BACKOFF
             if on_done is not None:
                 on_done(sig, time.perf_counter() - t0, err)
-            # drain: start the next queued warm that is still cold, if any
+            # drain: start the next queued warm that is still cold — unless
+            # the process is exiting (threading._shutdown is joining us: the
+            # main thread is gone) or stop_warms() ran; exit must wait only
+            # for compiles already in flight, never the whole queue
+            import threading as _threading
+
             while True:
                 with self._lock:
-                    if not self._queued or len(self._compiling) >= self.MAX_CONCURRENT_WARMS:
+                    if (self._stopped
+                            or not _threading.main_thread().is_alive()
+                            or not self._queued
+                            or len(self._compiling) >= self.MAX_CONCURRENT_WARMS):
                         return
                     next_sig, next_kwargs = self._queued.pop(0)
                     if next_sig in self._ready:
@@ -935,6 +954,7 @@ class TpuSolver:
         ct_key = st.vocab.key_id[L.CAPACITY_TYPE]
 
         if mesh is not None:
+            from ..parallel.distributed import put_sharded
             from ..parallel.mesh import POD_AXIS, TYPE_AXIS
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -950,14 +970,31 @@ class TpuSolver:
                 "cand_prov": sc, "cand_price": sc, "cand_avail": sc,
                 "prov_limits": sr, "dom_zone": sr, "ex_ok": sg,
             }
-            consts = {k: jax.device_put(v, place.get(k, sr)) for k, v in consts.items()}
+            consts = {k: put_sharded(v, place.get(k, sr)) for k, v in consts.items()}
 
-        F, dom_ok = compute_feasibility(
-            jnp.asarray(np_pm), consts["requests"], jnp.asarray(np_gp_ok),
-            jnp.asarray(np_cvw), jnp.asarray(np_cvb), consts["cand_alloc"],
-            consts["cand_prov"], jnp.asarray(st.key_check),
-            jnp.asarray(st.dom_vw), jnp.asarray(st.dom_vb), zone_key, ct_key,
-        )
+        if mesh is not None and jax.process_count() > 1:
+            # multi-process: eager per-op execution on non-addressable global
+            # arrays is not allowed — run the feasibility precompute as one
+            # jitted SPMD program over explicitly placed inputs
+            from ..parallel.distributed import put_sharded
+
+            F, dom_ok = jax.jit(
+                compute_feasibility, static_argnames=("zone_key", "ct_key")
+            )(
+                put_sharded(np_pm, sg), consts["requests"],
+                put_sharded(np_gp_ok, sg), put_sharded(np_cvw, sc),
+                put_sharded(np_cvb, sc), consts["cand_alloc"],
+                consts["cand_prov"], put_sharded(st.key_check, sr),
+                put_sharded(st.dom_vw, sr), put_sharded(st.dom_vb, sr),
+                zone_key=zone_key, ct_key=ct_key,
+            )
+        else:
+            F, dom_ok = compute_feasibility(
+                jnp.asarray(np_pm), consts["requests"], jnp.asarray(np_gp_ok),
+                jnp.asarray(np_cvw), jnp.asarray(np_cvb), consts["cand_alloc"],
+                consts["cand_prov"], jnp.asarray(st.key_check),
+                jnp.asarray(st.dom_vw), jnp.asarray(st.dom_vb), zone_key, ct_key,
+            )
         consts["F"], consts["dom_ok"] = F, dom_ok
 
         init = (
@@ -975,13 +1012,14 @@ class TpuSolver:
             jnp.zeros(G, dtype=jnp.int32),                       # infeasible
         )
         if mesh is not None:
+            from ..parallel.distributed import put_sharded
             from ..parallel.mesh import POD_AXIS
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             sn = NamedSharding(mesh, P(POD_AXIS))   # node-slot axis
             sr = NamedSharding(mesh, P())
             shardings = (sn, sn, sn, sn, sn, sn, sn, sr, sr, sr, sr, sr)
-            init = tuple(jax.device_put(a, s) for a, s in zip(init, shardings))
+            init = tuple(put_sharded(a, s) for a, s in zip(init, shardings))
 
         def run(init):
             return _run_scan(consts, init, NR, Z, track_assignments)

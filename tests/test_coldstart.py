@@ -109,6 +109,23 @@ class TestCompileBehind:
         _wait_warm(sched)
         assert len(sched._tpu._ready) == 2
 
+    def test_stop_warms_drops_queue(self, small_catalog, monkeypatch):
+        """Operator shutdown must wait only for in-flight compiles, never
+        the queued ones: stop_warms clears the queue and blocks new spawns."""
+        from karpenter_tpu.solver.tpu import TpuSolver
+
+        monkeypatch.setattr(TpuSolver, "MAX_CONCURRENT_WARMS", 1)
+        reg = Registry()
+        sched = BatchScheduler(backend="auto", registry=reg)
+        prov = Provisioner(name="default").with_defaults()
+        accepted = sched.warm_startup([prov], small_catalog,
+                                      profiles=((2, 4, False), (40, 80, False)))
+        assert accepted == 2
+        sched._tpu.stop_warms()
+        _wait_warm(sched)
+        assert len(sched._tpu._ready) <= 1  # queued warm never ran
+        assert not sched._tpu._queued
+
     def test_failed_compile_backs_off(self, small_catalog, monkeypatch):
         """A shape whose compile fails is not hot-recompiled on every solve
         of that shape, and failures stay out of the duration histogram."""

@@ -85,7 +85,23 @@ class TestShardedSolve:
             == sorted((n.instance_type, n.zone, n.capacity_type) for n in solo.nodes)
 
     def test_dryrun_entrypoint(self):
-        """The driver's exact multi-chip validation path."""
+        """The driver's exact multi-chip validation path (in-process 8-device
+        mesh + the 2-process phase)."""
         import __graft_entry__ as g
 
         g.dryrun_multichip(8)
+
+
+class TestMultiProcess:
+    def test_two_process_sharded_solve(self):
+        """2 REAL processes x 2 virtual devices via jax.distributed: the
+        GSPMD-sharded solve executes across processes (Gloo collectives over
+        the coordination service — the DCN stand-in) and the host-major
+        layout is asserted against real process_indexes inside each worker
+        (parallel/distributed.py assert_host_major), not mock Dev objects."""
+        from karpenter_tpu.parallel.distributed import launch_dryrun
+
+        outs = launch_dryrun(2, 2)
+        assert len(outs) == 2
+        for o in outs:
+            assert "OK" in o and "2 processes x 2 devices" in o
