@@ -231,7 +231,7 @@ class Operator:
 
     def shutdown(self) -> None:
         self._stop.set()
-        self.scheduler._tpu.stop_warms()  # don't drain queued compiles at exit
+        self.scheduler.stop_warms()  # don't drain queued compiles at exit
         self.stop_http()
 
 

@@ -88,6 +88,72 @@ def water_fill(
     return jnp.maximum(alloc, 0.0).astype(jnp.int32)
 
 
+def skew_band_fill(
+    current: jnp.ndarray,   # [Z] pods of the selector already in each zone
+    rows: jnp.ndarray,      # [Z] FREE capacity on existing open rows (pods)
+    cap: jnp.ndarray,       # [Z] total per-zone capacity (rows + new nodes)
+    total: jnp.ndarray,     # [] pods to place
+    skew: jnp.ndarray,      # [] max final (max-min) count skew, BIG = none
+    eligible: jnp.ndarray,  # [Z]
+) -> jnp.ndarray:
+    """Skew-banded allocation that prefers FREE capacity.
+
+    ``water_fill`` levels counts — the right shape for bought capacity, but
+    it will buy a new node in one zone while free existing-row capacity sits
+    idle in another.  The sequential oracle first-fits free rows as hard as
+    the skew constraint allows; this is that policy in closed form: final
+    counts live in a band [B, B+skew] (capacity permitting), each zone's
+    count is pushed toward ``current+rows`` (its free capacity) WITHIN the
+    band, and B is found by bisection so the allocation sums to ``total``.
+    Leftover units level across remaining band headroom via ``water_fill``.
+    """
+    cur = current.astype(jnp.float32)
+    capf = jnp.where(eligible, cap.astype(jnp.float32), 0.0)
+    rowsf = jnp.minimum(jnp.where(eligible, rows.astype(jnp.float32), 0.0), capf)
+    totalf = total.astype(jnp.float32)
+    # f32 ulp at 1e9 is ~64, which would destroy integer precision in the
+    # t+skew arithmetic below; counts never approach 1e6, so clamp there
+    skewf = jnp.minimum(skew.astype(jnp.float32), jnp.float32(1e6))
+    fmax = cur + capf
+
+    # Final counts live in a band [t, t+skew] (capacity permitting): each
+    # zone's count is pushed toward cur+rows — its FREE capacity — within
+    # the band, so row-rich zones sit at the band top and row-poor zones at
+    # the bottom.  t is bisected so the allocation sums to `total`:
+    #   - t > 0: purchases raise every zone to at least t (forced leveling);
+    #   - t <= 0: rows are plentiful — the band TOP (t+skew) throttles how
+    #     much of the free capacity is used, and no zone is forced up, which
+    #     keeps the max-min skew within bounds automatically.
+    def f_of(t):
+        lower = jnp.minimum(jnp.maximum(t, cur), fmax)
+        upper = jnp.minimum(jnp.maximum(t + skewf, cur), fmax)
+        pref = jnp.clip(cur + rowsf, lower, upper)
+        return jnp.where(eligible, pref, cur)
+
+    def used(t):
+        return jnp.sum(jnp.where(eligible, f_of(t) - cur, 0.0))
+
+    lo = -(skewf + totalf + 1.0)
+    hi = jnp.max(jnp.where(eligible, cur, 0.0)) + totalf + 1.0
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = 0.5 * (lo + hi)
+        ok = used(mid) <= totalf
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, 48, body, (lo, hi))
+    alloc = jnp.minimum(
+        jnp.floor(jnp.maximum(f_of(lo) - cur, 0.0) + 1e-4), capf
+    )
+    # integer remainder levels across the band's remaining headroom
+    upper = jnp.minimum(jnp.maximum(lo + skewf, cur), fmax)
+    headroom = jnp.maximum(upper - (cur + alloc), 0.0)
+    rem = jnp.maximum(totalf - jnp.sum(alloc), 0.0)
+    alloc = alloc + water_fill(cur + alloc, headroom, rem, eligible)
+    return jnp.maximum(alloc, 0.0).astype(jnp.int32)
+
+
 def prefix_allocate(cap: jnp.ndarray, quota: jnp.ndarray) -> jnp.ndarray:
     """First-fit allocation along an ordered axis: take as much as possible
     from each slot in order until ``quota`` is exhausted.

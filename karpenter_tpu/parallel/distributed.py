@@ -142,14 +142,23 @@ def launch_dryrun(
     CPU devices) and return their stdout tails; raises on any failure.
 
     The coordinator port is picked by bind-and-release, which is racy
-    (another process can grab it before worker 0 binds), so a failed launch
-    retries with a fresh port up to ``retries`` times."""
+    (another process can grab it before worker 0 binds), so a launch that
+    failed with a bind/connect-shaped error retries with a fresh port up to
+    ``retries`` times — but only when the port was auto-picked; explicit
+    ports and deterministic worker failures (assertion errors, bad solves)
+    surface immediately."""
     last_err: Optional[Exception] = None
-    for _ in range(1 + max(0, retries)):
+    attempts = 1 + (max(0, retries) if port == 0 else 0)
+    for _ in range(attempts):
         try:
             return _launch_once(n_processes, local_devices, timeout, port)
         except RuntimeError as e:
             last_err = e
+            msg = str(e).lower()
+            if not any(s in msg for s in
+                       ("bind", "address already in use", "connect",
+                        "unavailable", "deadline", "timed out")):
+                raise
     raise last_err
 
 

@@ -44,6 +44,15 @@ NATIVE_BATCH_LIMIT = 256
 #: pod with more preferences has its top rungs collapsed (several dropped at
 #: once) instead of funding one solve per preference
 MAX_RELAXATION_WAVES = 8
+#: residue-convergence depth: still-infeasible pods re-solve against the
+#: accumulated placed state until nothing more places (or this many waves).
+#: This is the batched solver's equivalent of the sequential oracle's
+#: invalidate-and-retry: the oracle co-packs multi-group residuals onto tail
+#: nodes and cascades through limit-capped provisioners one placement at a
+#: time; each wave here gives the device solve the same second look at open
+#: rows and remaining limit headroom (karpenter.sh_provisioners.yaml:160-173
+#: limits + :305-314 weights).
+MAX_RESIDUE_WAVES = 6
 
 
 def _compile_behind_enabled() -> bool:
@@ -201,6 +210,24 @@ class BatchScheduler:
                     unavailable, allow_new_nodes,
                     _budget_left(result, max_new_nodes),
                 ))
+
+            # residue convergence (see MAX_RESIDUE_WAVES): re-offer the
+            # still-infeasible pods the state every prior wave produced —
+            # open rows on placed nodes and the limit headroom left after
+            # funded creations — until a wave places nothing new.
+            for _ in range(MAX_RESIDUE_WAVES):
+                retry = [p for p in pods if p.name in result.infeasible]
+                if not retry:
+                    break
+                sub = self._solve_wave(
+                    retry, provisioners, instance_types,
+                    list(result.existing_nodes) + result.nodes, daemonsets,
+                    unavailable, allow_new_nodes,
+                    _budget_left(result, max_new_nodes),
+                )
+                if not sub.assignments:
+                    break  # no progress: the residue is genuinely infeasible
+                _merge(result, sub)
             return result
         finally:
             self.registry.histogram(SCHEDULING_DURATION).observe(time.perf_counter() - t0)
@@ -332,6 +359,11 @@ class BatchScheduler:
         return started
 
     # ---- compile-behind (cold-start) ----------------------------------
+    def stop_warms(self) -> None:
+        """Stop background compiles (operator shutdown): queued warms are
+        dropped; exit waits only for compiles already in flight."""
+        self._tpu.stop_warms()
+
     def _warm_done(self, sig, seconds: float, err) -> None:
         self.registry.gauge(SOLVER_COMPILE_IN_PROGRESS).set(
             self._tpu.compiles_in_flight()

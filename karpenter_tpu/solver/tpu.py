@@ -29,12 +29,13 @@ within the 1.02x cost-parity budget and flagged for later rounds:
   existing matches / seed one zone-or-node / infeasible), but only one
   positive term per topology key and only zone/hostname keys; other shapes
   are marked by tensorize and routed to the oracle by the scheduler,
-- maxSkew > 1 spread is balanced (water-filled) instead of first-fit-within-
-  band,
-- provisioner-limit fallback depth is bounded: 2 (bulk, tail) creation rounds
-  per zone pass = 4 candidate picks, so a group whose pods would have to
-  cascade through >3 limit-capped provisioners leaves the residue infeasible
-  where the oracle's unbounded invalidate-and-retry would keep going.
+- maxSkew > 1 spread is allocated by the skew-band fill (free-row-preferring
+  banded leveling) instead of strict first-fit-within-band,
+- in-step provisioner-limit fallback depth is 2 (bulk, tail) creation rounds
+  per zone pass = 4 candidate picks; residue a deeper cascade would strand
+  is re-solved by the scheduler's host-side residue-convergence waves
+  (solver/scheduler.py MAX_RESIDUE_WAVES) against the accumulated state,
+  matching the oracle's unbounded invalidate-and-retry.
 """
 
 from __future__ import annotations
@@ -50,7 +51,14 @@ import numpy as np
 
 from ..models import labels as L
 from ..models.tensorize import NO_SELECTOR, SolveTensors
-from ..ops.masks import BIG, gather_pm_bits, lex_argmin, prefix_allocate, water_fill
+from ..ops.masks import (
+    BIG,
+    gather_pm_bits,
+    lex_argmin,
+    prefix_allocate,
+    skew_band_fill,
+    water_fill,
+)
 from .types import SimNode, SolveResult
 
 # host-side on purpose (see ops/masks.py BIG): no device init at import time
@@ -191,6 +199,7 @@ def _make_step(
     """Build the per-group scan step closure over constant tensors."""
     counts = consts["counts"]          # [G]
     suffix_res = consts["suffix_res"]  # [G, R] later-group resource demand
+    suffix_cnt = consts["suffix_cnt"]  # [G] later-group pod count
     requests = consts["requests"]      # [G, R]
     F = consts["F"]                    # [G, C]
     dom_ok = consts["dom_ok"]          # [G, D]
@@ -341,7 +350,7 @@ def _make_step(
                 axis=1,
             )
 
-        def pick(rem, dom_mask, prov_used_cur):
+        def pick(rem, dom_mask, prov_used_cur, tail_rem=None):
             """argmin over (C, D & dom_mask) of price / min(fill, rem),
             where fill = min(ppn, take_pn + later-group demand) — the
             backfill-aware effective pods-per-node (see comment below).
@@ -365,11 +374,59 @@ def _make_step(
                 req_g > 0, suffix_res[g] / jnp.maximum(req_g, 1e-9), BIGN
             ))
             # the backfill pool is shared across every node this group will
-            # create (~rem/take_pn of them): per-node slack is only worth
-            # what the pool can actually deliver to ONE node
-            per_node_backfill = backfill_eq * take_pn / jnp.maximum(rem, 1.0)
+            # create: per-node slack is only worth what the pool can deliver
+            # to ONE node.  The node-count estimate is rem/take_pn CLAMPED by
+            # how many nodes the provisioner limit can still fund — when the
+            # limit tail binds (one node left), the whole pool concentrates
+            # on it, and a roomier type is worth its price premium (the
+            # sequential oracle gets this for free: its tail placement sees
+            # every group's residual at once; fuzz seed 27).
+            head_nodes = jnp.min(
+                jnp.floor(
+                    (prov_limits[cand_prov] - prov_used_cur[cand_prov] + 1e-6)
+                    / jnp.maximum(cand_cap, 1e-9)
+                ),
+                axis=1,
+            )                                                        # [C]
+            n_nodes_est = jnp.clip(
+                jnp.minimum(rem / jnp.maximum(take_pn, 1.0),
+                            jnp.clip(head_nodes, 0.0, BIGN)),
+                1.0, BIGN,
+            )
+            per_node_backfill = backfill_eq / n_nodes_est
             fill = jnp.minimum(ppn, take_pn + per_node_backfill)
             denom = jnp.maximum(jnp.minimum(fill, jnp.maximum(rem, 1.0)), 1.0)
+            if tail_rem is not None:
+                # TAIL purchases are the oracle's last-pods-standing buys:
+                # cap the utilization estimate additionally by the zone's
+                # own tail count plus only the NET backfill — later-group
+                # demand minus what the free capacity on open rows absorbs
+                # first (later groups first-fit free rows, so gross suffix
+                # demand over-credits a tail node — fuzz seed 14's 8x node
+                # for a 2-pod tail; but when rows are full or a limit
+                # squeezes later demand onto this very node, the credit is
+                # real — fuzz seed 27's 2-cpu tail).  Rows absorb in units
+                # of the average later-pod request vector (resource-coupled:
+                # free memory with no free cpu absorbs nothing).
+                avg_req = suffix_res[g] / jnp.maximum(suffix_cnt[g], 1.0)
+                per_row = jnp.min(jnp.where(
+                    avg_req[None, :] > 0,
+                    jnp.maximum(res, 0.0) / jnp.maximum(avg_req[None, :], 1e-9),
+                    BIGN,
+                ), axis=1)                                              # [NR]
+                rows_absorb = jnp.sum(jnp.where(active, per_row, 0.0))
+                net_frac = jnp.clip(
+                    (suffix_cnt[g] - rows_absorb)
+                    / jnp.maximum(suffix_cnt[g], 1.0),
+                    0.0, 1.0,
+                )
+                pnb_net = per_node_backfill * net_frac
+                denom = jnp.maximum(
+                    jnp.minimum(
+                        denom, jnp.maximum(tail_rem, 1.0) + pnb_net
+                    ),
+                    1.0,
+                )
             score = jnp.where(ok_cd, cand_price / denom[:, None], BIG)
             pk = jnp.where(ok_cd, cand_price, BIG)
             flat = lex_argmin(score, pk, ci_key, di_key)
@@ -434,15 +491,26 @@ def _make_step(
                 cand_prov
             ].max(per_c)
             fundable_new = jnp.minimum(jnp.sum(per_p), BIGN)
-            alloc0 = water_fill(zc_sp, cap_z, cnt, el).astype(jnp.float32)
+            # all three allocation passes prefer FREE existing-row capacity
+            # within the skew band (skew_band_fill): plain leveling buys a
+            # new node in one zone while free capacity idles in another —
+            # the sequential oracle's first-fit never does (fuzz seed 14)
             rows_z = jnp.where(el, rowcap_z, 0.0)
+            skew_eff = jnp.where(
+                zsp >= 0, g_zone_skew[g].astype(jnp.float32), BIGN
+            )
+            alloc0 = skew_band_fill(
+                zc_sp, rows_z, cap_z, cnt, skew_eff, el
+            ).astype(jnp.float32)
             need_new = jnp.maximum(alloc0 - jnp.minimum(rows_z, alloc0), 0.0)
             funded_new = water_fill(
                 jnp.zeros(Z, dtype=jnp.float32), need_new, fundable_new,
                 el & (need_new > 0),
             ).astype(jnp.float32)
             cap_f = jnp.where(el, jnp.minimum(rows_z + funded_new, cap_z), 0.0)
-            alloc1 = water_fill(zc_sp, cap_f, cnt, el).astype(jnp.float32)
+            alloc1 = skew_band_fill(
+                zc_sp, jnp.minimum(rows_z, cap_f), cap_f, cnt, skew_eff, el
+            ).astype(jnp.float32)
             lvl_min = jnp.min(jnp.where(el, zc_sp + alloc1, BIGN))
             skew_cap = jnp.where(
                 zsp >= 0,
@@ -450,7 +518,9 @@ def _make_step(
                 BIGN,
             )
             cap_z2 = jnp.minimum(cap_f, jnp.maximum(skew_cap, 0.0))
-            alloc_z = water_fill(zc_sp, cap_z2, cnt, el).astype(jnp.float32)  # [Z]
+            alloc_z = skew_band_fill(
+                zc_sp, jnp.minimum(rows_z, cap_z2), cap_z2, cnt, skew_eff, el
+            ).astype(jnp.float32)  # [Z]
             # per-zone prefix allocation over slots in creation order
             zone1h = (row_zone[:, None] == jnp.arange(Z)[None, :])           # [NR, Z]
             capz_slots = jnp.where(zone1h, cap[:, None], 0.0)
@@ -528,7 +598,7 @@ def _make_step(
             state, took_b = write_block(state, n_bulk, ppn_b, ppn_b, bc, bd)
             rem_t = jnp.maximum(rem - took_b, 0.0)
             score_t = jnp.maximum(score_rem - took_b, rem_t)
-            ct_, dt_, ok_t = pick(score_t, dom_mask, state[6])
+            ct_, dt_, ok_t = pick(score_t, dom_mask, state[6], tail_rem=rem_t)
             ppn_t = jnp.maximum(take_pn[ct_], 1.0)
             n_tail_f = jnp.where(ok_t & (rem_t > 0), jnp.ceil(rem_t / ppn_t), 0.0)
             n_tail = jnp.minimum(n_tail_f, limit_headroom(state[6], ct_)).astype(jnp.int32)
@@ -868,6 +938,9 @@ class TpuSolver:
         np_suffix_res = np.concatenate(
             [np.cumsum(demand[::-1], axis=0)[::-1][1:], np.zeros((1, demand.shape[1]))]
         ).astype(np.float32)                                             # [G, R]
+        np_suffix_cnt = np.concatenate(
+            [np.cumsum(np_counts[::-1])[::-1][1:], np.zeros(1)]
+        ).astype(np.float32)                                             # [G]
         np_pm = _pad(st.pm, pad_g, 0, 0)
         np_gzs = _pad(st.g_zone_spread, pad_g, 0, -1)
         np_gzk = _pad(st.g_zone_skew, pad_g, 0, 1)
@@ -926,6 +999,7 @@ class TpuSolver:
         consts = dict(
             counts=jnp.asarray(np_counts),
             suffix_res=jnp.asarray(np_suffix_res),
+            suffix_cnt=jnp.asarray(np_suffix_cnt),
             requests=jnp.asarray(np_requests),
             g_zone_spread=jnp.asarray(np_gzs),
             g_zone_skew=jnp.asarray(np_gzk),
@@ -963,6 +1037,7 @@ class TpuSolver:
             sr = NamedSharding(mesh, P())              # replicated
             place = {
                 "counts": sg, "requests": sg, "suffix_res": sg,
+                "suffix_cnt": sg,
                 "g_zone_spread": sg, "g_zone_skew": sg,
                 "g_host_spread": sg, "g_host_cap": sg, "g_zone_anti": sg,
                 "g_zone_paff": sg, "g_host_paff": sg,
