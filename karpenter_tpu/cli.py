@@ -57,6 +57,8 @@ def cmd_demo(args) -> int:
         argv.append("--small")
     if args.metrics_port:
         argv += ["--metrics-port", str(args.metrics_port)]
+    if args.config:
+        argv += ["--config", args.config]
     return op_main(argv)
 
 
@@ -178,6 +180,8 @@ def main(argv=None) -> int:
     d.add_argument("--profile-port", type=int, default=0)
     d.add_argument("--jit-cache-dir", default=os.environ.get("KT_JIT_CACHE_DIR", ""),
                    help="persistent XLA compile cache directory")
+    d.add_argument("--config", default="",
+                   help="YAML manifest file/dir loaded through admission")
     d.set_defaults(fn=cmd_demo)
 
     s = sub.add_parser("solve", help="one-shot batch solve")

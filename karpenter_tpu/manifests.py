@@ -1,0 +1,287 @@
+"""Declarative config: YAML manifests -> admission -> API objects.
+
+The reference is configured almost entirely through YAML — CRD instances
+(`pkg/apis/crds/karpenter.sh_provisioners.yaml:37-315`,
+`charts/karpenter-crd/`) and the `karpenter-global-settings` ConfigMap.
+This module is the framework's ingestion path for the same three kinds:
+
+- ``Provisioner``      (karpenter.sh/v1alpha5-shaped spec)
+- ``NodeTemplate``     (the AWSNodeTemplate analog, provider spec)
+- ``ConfigMap``        (karpenter-global-settings data)
+
+Every parsed object passes through the admission layer (``webhooks.py``)
+before it reaches cluster state — invalid documents are rejected with the
+structured admission errors, exactly like the reference's validating
+webhooks (`pkg/webhooks/webhooks.go:33-63`).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import yaml
+
+from dataclasses import replace
+
+from .cloud.templates import BlockDevice, NodeTemplate
+from .models import labels as L  # noqa: F401  (manifest docs reference labels)
+from .models.pod import Taint
+from .models.provisioner import Provisioner
+from .models.requirements import Requirement
+from .settings import Settings
+from .utils.quantity import parse_quantity
+from .webhooks import (
+    AdmissionError,
+    admit_node_template,
+    admit_provisioner,
+    admit_settings,
+)
+
+_DURATION_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(ms|s|m|h)?\s*$")
+_DURATION_SCALE = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0, None: 1.0}
+
+
+def parse_duration(value) -> float:
+    """'10s' / '500ms' / '9.5m' / bare numbers -> seconds."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _DURATION_RE.match(str(value))
+    if not m:
+        raise ValueError(f"invalid duration: {value!r}")
+    return float(m.group(1)) * _DURATION_SCALE[m.group(2)]
+
+
+def _parse_bool(value) -> bool:
+    if isinstance(value, bool):
+        return value
+    return str(value).strip().lower() in ("1", "true", "yes", "on")
+
+
+# ---------------------------------------------------------------------------
+# provisioner (karpenter.sh_provisioners.yaml spec shape)
+# ---------------------------------------------------------------------------
+
+
+def parse_provisioner(doc: dict) -> Provisioner:
+    meta = doc.get("metadata", {}) or {}
+    spec = doc.get("spec", {}) or {}
+    reqs = [
+        Requirement(r["key"], r["operator"], list(r.get("values", [])))
+        for r in spec.get("requirements", []) or []
+    ]
+    taints = [
+        Taint(t.get("key", ""), t.get("effect", ""), t.get("value", ""))
+        for t in spec.get("taints", []) or []
+    ]
+    startup = [
+        Taint(t.get("key", ""), t.get("effect", ""), t.get("value", ""))
+        for t in spec.get("startupTaints", []) or []
+    ]
+    limits = {
+        k: parse_quantity(v)
+        for k, v in ((spec.get("limits", {}) or {}).get("resources", {}) or {}).items()
+    }
+    consolidation = spec.get("consolidation", {}) or {}
+    provider_ref = spec.get("providerRef", {}) or {}
+    return Provisioner(
+        name=meta.get("name", "default"),
+        requirements=reqs,
+        taints=taints,
+        startup_taints=startup,
+        labels=dict(spec.get("labels", {}) or {}),
+        limits=limits,
+        weight=int(spec.get("weight", 0) or 0),
+        consolidation_enabled=_parse_bool(consolidation.get("enabled", False)),
+        ttl_seconds_after_empty=(
+            float(spec["ttlSecondsAfterEmpty"])
+            if spec.get("ttlSecondsAfterEmpty") is not None else None
+        ),
+        ttl_seconds_until_expired=(
+            float(spec["ttlSecondsUntilExpired"])
+            if spec.get("ttlSecondsUntilExpired") is not None else None
+        ),
+        node_template=provider_ref.get("name", "default"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# node template (AWSNodeTemplate analog spec shape)
+# ---------------------------------------------------------------------------
+
+
+def parse_node_template(doc: dict) -> NodeTemplate:
+    meta = doc.get("metadata", {}) or {}
+    spec = doc.get("spec", {}) or {}
+    md = spec.get("metadataOptions", {}) or {}
+    devices = [
+        BlockDevice(
+            device_name=d.get("deviceName", "/dev/xvda"),
+            size_gib=(
+                parse_quantity(d["sizeGiB"]) if "sizeGiB" in d
+                else parse_quantity(d.get("volumeSize", "20Gi")) / 1024.0**3
+            ),
+            volume_type=d.get("volumeType", "gp3"),
+            encrypted=_parse_bool(d.get("encrypted", True)),
+        )
+        for d in spec.get("blockDevices", []) or []
+    ]
+    return NodeTemplate(
+        name=meta.get("name", "default"),
+        image_family=spec.get("imageFamily", "standard"),
+        image_selector=dict(spec.get("imageSelector", {}) or {}),
+        subnet_selector=dict(spec.get("subnetSelector", {}) or {}),
+        security_group_selector=dict(spec.get("securityGroupSelector", {}) or {}),
+        user_data=spec.get("userData", "") or "",
+        instance_profile=spec.get("instanceProfile", "") or "",
+        block_devices=devices,
+        launch_template_name=spec.get("launchTemplateName"),
+        metadata_http_tokens=md.get("httpTokens", "required"),
+        metadata_http_endpoint=md.get("httpEndpoint", "enabled"),
+        metadata_hop_limit=int(md.get("httpPutResponseHopLimit", 2)),
+        tags=dict(spec.get("tags", {}) or {}),
+        detailed_monitoring=_parse_bool(spec.get("detailedMonitoring", False)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# global-settings ConfigMap (settings.go:40-65 data keys)
+# ---------------------------------------------------------------------------
+
+#: data key -> (Settings field, parser)
+_SETTINGS_KEYS = {
+    "clusterName": ("cluster_name", str),
+    "clusterEndpoint": ("cluster_endpoint", str),
+    "defaultInstanceProfile": ("default_instance_profile", str),
+    "vmMemoryOverheadPercent": ("vm_memory_overhead_percent", float),
+    "enablePodENI": ("enable_pod_eni", _parse_bool),
+    "enableENILimitedPodDensity": ("enable_eni_limited_pod_density", _parse_bool),
+    "isolatedVPC": ("isolated_vpc", _parse_bool),
+    "nodeNameConvention": ("node_name_convention", str),
+    "interruptionQueueName": ("interruption_queue_name", str),
+    "batchMaxDuration": ("batch_max_duration", parse_duration),
+    "batchIdleDuration": ("batch_idle_duration", parse_duration),
+    "featureGates.driftEnabled": ("drift_enabled", _parse_bool),
+    "deprovisioningTTL": ("deprovisioning_ttl", parse_duration),
+}
+
+
+def parse_settings(doc: dict) -> Dict[str, object]:
+    """ConfigMap data -> Settings field overrides (unknown keys rejected so
+    config typos fail loudly instead of silently doing nothing)."""
+    data = doc.get("data", {}) or {}
+    out: Dict[str, object] = {}
+    unknown = []
+    for k, v in data.items():
+        if k == "tags" or k.startswith("tags."):
+            tags = out.setdefault("tags", {})
+            if k == "tags":
+                tags.update(yaml.safe_load(v) or {})
+            else:
+                tags[k.split(".", 1)[1]] = str(v)
+            continue
+        ent = _SETTINGS_KEYS.get(k)
+        if ent is None:
+            unknown.append(k)
+            continue
+        field_name, parser = ent
+        out[field_name] = parser(v)
+    if unknown:
+        raise AdmissionError(
+            "ConfigMap", doc.get("metadata", {}).get("name", "settings"),
+            [f"unknown settings key {k!r}" for k in sorted(unknown)],
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# loading + admission
+# ---------------------------------------------------------------------------
+
+
+def load_documents(path) -> List[dict]:
+    """All YAML documents under ``path`` (a file, or a directory scanned for
+    *.yaml/*.yml in sorted order; multi-document files supported)."""
+    p = Path(path)
+    files = (
+        sorted(list(p.glob("*.yaml")) + list(p.glob("*.yml")))
+        if p.is_dir() else [p]
+    )
+    docs: List[dict] = []
+    for f in files:
+        for doc in yaml.safe_load_all(f.read_text()):
+            if doc:
+                docs.append(doc)
+    return docs
+
+
+def admit_documents(
+    docs: Iterable[dict],
+) -> Tuple[List[Provisioner], List[NodeTemplate], Dict[str, object]]:
+    """Parse + ADMIT every recognized document; raises AdmissionError on the
+    first invalid one.  Unrecognized kinds are skipped (a manifest dir may
+    carry Deployments/RBAC alongside the karpenter objects)."""
+    provisioners: List[Provisioner] = []
+    templates: List[NodeTemplate] = []
+    settings: Dict[str, object] = {}
+    for doc in docs:
+        kind = str(doc.get("kind", ""))
+        name = str((doc.get("metadata", {}) or {}).get("name", "?"))
+        try:
+            if kind == "Provisioner":
+                prov = parse_provisioner(doc)
+                admit_provisioner(prov)  # default-then-validate; raises
+                # store the RAW spec (state.apply_provisioner's convention;
+                # controllers call with_defaults() at use time)
+                provisioners.append(prov)
+            elif kind in ("NodeTemplate", "AWSNodeTemplate"):
+                templates.append(admit_node_template(parse_node_template(doc)))
+            elif (kind == "ConfigMap" and name == "karpenter-global-settings"):
+                settings.update(parse_settings(doc))
+        except AdmissionError:
+            raise
+        except (ValueError, KeyError, TypeError, AttributeError) as err:
+            # malformed-but-parseable specs deny with structure, they do not
+            # crash the ingestion path (bad quantities, missing requirement
+            # keys, non-numeric TTLs, ...)
+            raise AdmissionError(kind or "?", name, [f"malformed spec: {err!r}"])
+    if settings:
+        # per-field validity judged at admission time against the defaults;
+        # apply_objects re-validates against the live settings before mutating
+        admit_settings(replace(Settings(), **settings))
+    return provisioners, templates, settings
+
+
+def apply_objects(
+    provisioners: List[Provisioner],
+    templates: List[NodeTemplate],
+    overrides: Dict[str, object],
+    *,
+    state=None,
+    cloud=None,
+    settings_store=None,
+) -> None:
+    """Apply admitted objects to a running operator — the SINGLE apply
+    sequence shared by apply_path and the HTTP /admission/apply endpoint.
+    Validates the settings against the LIVE store first, so an invalid
+    combination denies before any provisioner/template is committed."""
+    if settings_store is not None and overrides:
+        admit_settings(replace(settings_store.current, **overrides))
+    if state is not None:
+        for prov in provisioners:
+            state.apply_provisioner(prov)
+    if cloud is not None and hasattr(cloud, "templates"):
+        for t in templates:
+            cloud.templates[t.name] = t
+    if settings_store is not None and overrides:
+        settings_store.update(**overrides)
+
+
+def apply_path(path, *, state=None, cloud=None, settings_store=None):
+    """Load manifests from ``path`` and apply the admitted objects to a
+    running operator's state/cloud/settings.  Returns the admitted tuple."""
+    provisioners, templates, overrides = admit_documents(load_documents(path))
+    apply_objects(provisioners, templates, overrides,
+                  state=state, cloud=cloud, settings_store=settings_store)
+    return provisioners, templates, overrides

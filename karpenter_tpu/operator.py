@@ -3,9 +3,12 @@
 The cmd/controller/main.go + core operator.NewOperator analog (SURVEY.md
 §3.1): builds the cloud provider, wraps it in the metrics decorator, registers
 every controller, exposes /metrics and /healthz over HTTP, and drives the
-reconcile loops.  Leader election is modeled as a pluggable gate (a real
-deployment plugs a lease-based elector; the sim elects immediately), and
-leadership gates cache hydration exactly like launchtemplate.go:77-88.
+reconcile loops.  Leader election is LEASE-based (the coordination.k8s.io
+Lease analog — reference settings.md:23, LEADER_ELECT): replicas contend on
+a pluggable LeaseStore, the holder renews every tick, a standby acquires
+when the lease expires, and leadership gates cache hydration exactly like
+launchtemplate.go:77-88 — hydration re-runs on every (re-)election, which is
+the resume-from-cloud-state posture (SURVEY §5 checkpoint/resume).
 
 Run a self-contained simulation:  ``python -m karpenter_tpu.operator --demo``
 """
@@ -45,11 +48,80 @@ from .solver.scheduler import BatchScheduler
 from .utils.clock import Clock
 
 
-class LeaderElector:
-    """Pluggable leadership gate (operator.Elected() analog)."""
+@dataclass
+class Lease:
+    """One leadership lease record (coordination.k8s.io/Lease analog)."""
 
-    def __init__(self, elect: Callable[[], bool] = lambda: True) -> None:
+    holder: str
+    renewed_at: float
+    ttl: float
+
+    def expired(self, now: float) -> bool:
+        return now >= self.renewed_at + self.ttl
+
+
+class InMemoryLeaseStore:
+    """Pluggable lease store.  Contending Operator replicas share one store;
+    a real deployment plugs a kube-API-backed implementation with the same
+    two-method surface.  ``try_acquire`` is atomic: it renews for the current
+    holder, grants an unheld/expired lease, and refuses a live one."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._leases: dict = {}
+
+    def get(self, name: str) -> Optional[Lease]:
+        with self._lock:
+            return self._leases.get(name)
+
+    def try_acquire(self, name: str, holder: str, ttl: float, now: float) -> bool:
+        with self._lock:
+            cur = self._leases.get(name)
+            if cur is not None and cur.holder != holder and not cur.expired(now):
+                return False
+            self._leases[name] = Lease(holder, now, ttl)
+            return True
+
+    def release(self, name: str, holder: str) -> None:
+        with self._lock:
+            cur = self._leases.get(name)
+            if cur is not None and cur.holder == holder:
+                del self._leases[name]
+
+
+_elector_counter = 0
+
+
+class LeaderElector:
+    """Lease-based leadership (operator.Elected() analog, settings.md:23).
+
+    Each tick the elector tries to acquire-or-renew the lease: the holder
+    stays elected, a standby takes over once the lease TTL lapses without a
+    renewal (leader crashed / partitioned), and a deposed holder steps down.
+    ``on_elected`` callbacks fire on every False->True transition — i.e. on
+    takeover too, so hydration re-runs and the new leader resumes from cloud
+    state.  ``elect`` (optional) is an extra gate retained for tests."""
+
+    DEFAULT_TTL = 15.0
+
+    def __init__(
+        self,
+        elect: Optional[Callable[[], bool]] = None,
+        *,
+        identity: Optional[str] = None,
+        store: Optional[InMemoryLeaseStore] = None,
+        lease_name: str = "karpenter-tpu-leader",
+        lease_ttl: float = DEFAULT_TTL,
+        clock: Optional[Clock] = None,
+    ) -> None:
+        global _elector_counter
+        _elector_counter += 1
         self._elect = elect
+        self.identity = identity or f"operator-{_elector_counter}"
+        self.store = store or InMemoryLeaseStore()
+        self.lease_name = lease_name
+        self.lease_ttl = lease_ttl
+        self.clock = clock or Clock()
         self.elected = False
         self._on_elected: List[Callable[[], None]] = []
 
@@ -57,11 +129,28 @@ class LeaderElector:
         self._on_elected.append(fn)
 
     def tick(self) -> bool:
-        if not self.elected and self._elect():
+        if self._elect is not None and not self._elect():
+            # gate closed: step down AND release the lease so a healthy
+            # standby takes over immediately instead of waiting out the TTL
+            self.resign()
+            return False
+        won = self.store.try_acquire(
+            self.lease_name, self.identity, self.lease_ttl, self.clock.now()
+        )
+        if won and not self.elected:
             self.elected = True
             for fn in self._on_elected:
                 fn()
+        elif not won:
+            self.elected = False  # deposed: stop reconciling immediately
         return self.elected
+
+    def resign(self) -> None:
+        """Release the lease (clean shutdown / gate-down) so a standby takes
+        over without waiting out the TTL.  Safe to call when not holding —
+        the store only deletes a lease naming this identity."""
+        self.store.release(self.lease_name, self.identity)
+        self.elected = False
 
 
 class Operator:
@@ -73,12 +162,16 @@ class Operator:
         registry: Optional[Registry] = None,
         scheduler_backend: str = "auto",
         metrics_port: int = 0,  # 0 disables the HTTP server
+        lease_store: Optional[InMemoryLeaseStore] = None,
+        identity: Optional[str] = None,
     ) -> None:
         self.clock = clock or Clock()
         self.settings = settings or SettingsStore()
         self.registry = registry or default_registry
         self.recorder = Recorder()
-        self.elector = LeaderElector()
+        self.elector = LeaderElector(
+            identity=identity, store=lease_store, clock=self.clock
+        )
         self.metrics_port = metrics_port
 
         self.state = ClusterState(clock=self.clock)
@@ -128,6 +221,8 @@ class Operator:
         self.elector.on_elected(self._hydrate)
         self._http: Optional[ThreadingHTTPServer] = None
         self._stop = threading.Event()
+        #: serializes the reconcile tick against HTTP-thread config applies
+        self._reconcile_lock = threading.RLock()
 
     # ---- wiring ---------------------------------------------------------
     def _on_settings(self, s: Settings) -> None:
@@ -166,6 +261,71 @@ class Operator:
                 "solver warmup failed; compile-behind will cover", exc_info=True
             )
 
+    # ---- declarative config / admission ---------------------------------
+    def apply_manifests(self, path) -> tuple:
+        """Load YAML manifests (file or directory) through admission into
+        the operator: Provisioners + NodeTemplates + global settings.
+        Raises AdmissionError on any invalid document."""
+        from .manifests import apply_path
+
+        # attribute access passes through the metrics decorator and the
+        # batching wrapper to the real provider (tests: provider attrs
+        # pass through), so .templates reaches the provider's dict
+        with self._reconcile_lock:
+            return apply_path(
+                path, state=self.state, cloud=self.cloud,
+                settings_store=self.settings,
+            )
+
+    def admit_http(self, raw_body: str, *, apply: bool = False):
+        """One admission review over HTTP: parse the YAML/JSON body, run it
+        through the webhook layer, return (http_status, response_dict) with
+        a structured allow/deny — the knative admission-response analog."""
+        import yaml as _yaml
+
+        from .manifests import admit_documents
+        from .webhooks import AdmissionError
+
+        try:
+            docs = [d for d in _yaml.safe_load_all(raw_body) if d]
+        except _yaml.YAMLError as err:
+            return 400, {"allowed": False,
+                         "errors": [f"unparseable document: {err}"]}
+        if not docs:
+            return 400, {"allowed": False, "errors": ["empty request body"]}
+        try:
+            provs, templates, overrides = admit_documents(docs)
+        except AdmissionError as err:
+            return 422, {"allowed": False, "kind": err.kind,
+                         "name": err.name, "errors": err.errors}
+        if not provs and not templates and not overrides:
+            kinds = sorted({str(d.get("kind", "?")) for d in docs})
+            return 400, {"allowed": False,
+                         "errors": [f"no recognized documents (kinds: {kinds})"]}
+        if apply:
+            from .manifests import apply_objects
+
+            try:
+                # under the reconcile lock: the HTTP worker thread must not
+                # mutate state dicts mid-tick (dictionary-changed-size), and
+                # a tick must never observe a half-applied config
+                with self._reconcile_lock:
+                    apply_objects(provs, templates, overrides,
+                                  state=self.state, cloud=self.cloud,
+                                  settings_store=self.settings)
+            except AdmissionError as err:
+                return 422, {"allowed": False, "kind": err.kind,
+                             "name": err.name, "errors": err.errors}
+        return 200, {
+            "allowed": True,
+            "admitted": {
+                "provisioners": [p.name for p in provs],
+                "node_templates": [t.name for t in templates],
+                "settings_keys": sorted(overrides),
+            },
+            "applied": bool(apply),
+        }
+
     # ---- health / metrics -----------------------------------------------
     def healthz(self) -> bool:
         return self.cloud.liveness() and self.pricing.liveness_ok()
@@ -194,6 +354,26 @@ class Operator:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_POST(self):
+                # admission endpoints (the knative webhook-server analog,
+                # pkg/webhooks/webhooks.go:33-63): POST a YAML/JSON manifest,
+                # get a structured allow/deny.  /admission/validate judges
+                # only; /admission/apply admits AND applies to the operator.
+                if self.path not in ("/admission/validate", "/admission/apply"):
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length).decode()
+                status, body = op.admit_http(raw, apply=self.path.endswith("/apply"))
+                payload = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
         self._http = ThreadingHTTPServer(("127.0.0.1", self.metrics_port), Handler)
         port = self._http.server_address[1]
         threading.Thread(target=self._http.serve_forever, daemon=True).start()
@@ -207,6 +387,10 @@ class Operator:
     # ---- loop -----------------------------------------------------------
     def tick(self) -> None:
         """One pass over every controller (singleton-controller semantics)."""
+        with self._reconcile_lock:
+            self._tick_locked()
+
+    def _tick_locked(self) -> None:
         if not self.elector.tick():
             return
         if self.settings.current.interruption_queue_name:
@@ -231,6 +415,7 @@ class Operator:
 
     def shutdown(self) -> None:
         self._stop.set()
+        self.elector.resign()  # standby takes over without waiting the TTL
         self.scheduler.stop_warms()  # don't drain queued compiles at exit
         self.stop_http()
 
@@ -246,7 +431,17 @@ def _demo(args) -> None:
     port = op.start_http()
     if port:
         print(f"metrics on http://127.0.0.1:{port}/metrics")
-    op.state.apply_provisioner(Provisioner(name="default", consolidation_enabled=True))
+    if getattr(args, "config", None):
+        # declarative scenario: every Provisioner/NodeTemplate/setting comes
+        # from YAML through admission — nothing constructed in code
+        provs, templates, overrides = op.apply_manifests(args.config)
+        print(f"manifests: {len(provs)} provisioner(s), "
+              f"{len(templates)} node template(s), "
+              f"{len(overrides)} setting override(s) admitted from {args.config}")
+    else:
+        op.state.apply_provisioner(
+            Provisioner(name="default", consolidation_enabled=True)
+        )
 
     print(f"scale-up: {args.pods} pods")
     for i in range(args.pods):
@@ -286,6 +481,9 @@ def main(argv=None) -> int:
     parser.add_argument("--small", action="store_true", help="20-type catalog")
     parser.add_argument("--backend", default="oracle", choices=["auto", "tpu", "oracle"])
     parser.add_argument("--metrics-port", type=int, default=0)
+    parser.add_argument("--config", default="",
+                        help="YAML manifest file/dir (Provisioners, "
+                             "NodeTemplates, settings) loaded through admission")
     args = parser.parse_args(argv)
     if args.demo:
         _demo(args)

@@ -212,3 +212,199 @@ def test_invalid_provisioners(case, kw, fragment):
     with pytest.raises(AdmissionError) as exc:
         admit_provisioner(Provisioner(name="p", **kw))
     assert fragment in str(exc.value)
+
+
+class TestYamlManifests:
+    """Declarative config: YAML manifests through admission (the reference's
+    CRD + ConfigMap ingestion, karpenter.sh_provisioners.yaml:37-315)."""
+
+    def test_example_manifests_admit_and_apply(self, small_catalog):
+        from karpenter_tpu.cloud.fake import FakeCloudProvider
+        from karpenter_tpu.manifests import apply_path
+        from karpenter_tpu.controllers.state import ClusterState
+        from karpenter_tpu.settings import SettingsStore
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        state = ClusterState(clock=clock)
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+        store = SettingsStore()
+        provs, templates, overrides = apply_path(
+            "deploy/examples", state=state, cloud=cloud, settings_store=store
+        )
+        assert {p.name for p in provs} == {"default", "spot-burst"}
+        assert state.provisioners["spot-burst"].taints[0].key == "burst"
+        assert state.provisioners["spot-burst"].ttl_seconds_after_empty == 30.0
+        assert state.provisioners["default"].limits["cpu"] == 1000.0
+        assert state.provisioners["default"].limits["memory"] == 4000 * 1024**3
+        assert cloud.templates["default"].block_devices[0].size_gib == 40.0
+        assert store.current.drift_enabled is True
+        assert store.current.batch_max_duration == 10.0
+
+    def test_invalid_yaml_provisioner_rejected(self, tmp_path):
+        from karpenter_tpu.manifests import admit_documents, load_documents
+
+        (tmp_path / "bad.yaml").write_text(
+            "kind: Provisioner\n"
+            "metadata: {name: bad}\n"
+            "spec:\n"
+            "  weight: 500\n"
+            "  consolidation: {enabled: true}\n"
+            "  ttlSecondsAfterEmpty: 30\n"
+        )
+        with pytest.raises(AdmissionError) as exc:
+            admit_documents(load_documents(tmp_path))
+        assert "outside [0,100]" in str(exc.value)
+        assert "mutually exclusive" in str(exc.value)
+
+    def test_unknown_settings_key_rejected(self):
+        from karpenter_tpu.manifests import admit_documents
+
+        doc = {"kind": "ConfigMap",
+               "metadata": {"name": "karpenter-global-settings"},
+               "data": {"batchIdleDuratoin": "1s"}}  # typo must fail loudly
+        with pytest.raises(AdmissionError) as exc:
+            admit_documents([doc])
+        assert "unknown settings key" in str(exc.value)
+
+    def test_quantity_and_duration_shapes(self):
+        from karpenter_tpu.manifests import parse_duration, parse_provisioner
+
+        assert parse_duration("500ms") == 0.5
+        assert parse_duration("9.5m") == 570.0
+        prov = parse_provisioner({
+            "kind": "Provisioner", "metadata": {"name": "q"},
+            "spec": {"limits": {"resources": {"cpu": "1500m", "memory": "2Gi"}}},
+        })
+        assert prov.limits["cpu"] == 1.5
+        assert prov.limits["memory"] == 2 * 1024**3
+
+
+class TestHttpAdmission:
+    """The webhook SERVER (pkg/webhooks/webhooks.go:33-63 analog): POST a
+    manifest to the operator's HTTP endpoint, get structured allow/deny."""
+
+    @pytest.fixture
+    def server(self, small_catalog):
+        from karpenter_tpu.cloud.fake import FakeCloudProvider
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+        op = Operator(cloud, clock=clock, scheduler_backend="oracle",
+                      registry=Registry(), metrics_port=18766)
+        port = op.start_http()
+        yield op, port
+        op.shutdown()
+
+    def _post(self, port, path, body):
+        import json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body.encode(), method="POST"
+        )
+        try:
+            resp = urllib.request.urlopen(req)
+            return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    def test_valid_provisioner_allowed_and_applied(self, server):
+        op, port = server
+        status, body = self._post(port, "/admission/apply", (
+            "kind: Provisioner\n"
+            "metadata: {name: web}\n"
+            "spec: {weight: 7, consolidation: {enabled: true}}\n"
+        ))
+        assert status == 200 and body["allowed"] is True
+        assert body["admitted"]["provisioners"] == ["web"]
+        assert "web" in op.state.provisioners
+        assert op.state.provisioners["web"].weight == 7
+
+    def test_validate_does_not_apply(self, server):
+        op, port = server
+        status, body = self._post(port, "/admission/validate", (
+            "kind: Provisioner\nmetadata: {name: dry}\nspec: {}\n"
+        ))
+        assert status == 200 and body["allowed"] is True and not body["applied"]
+        assert "dry" not in op.state.provisioners
+
+    @pytest.mark.parametrize(
+        "case,kw,fragment", INVALID_PROVISIONERS,
+        ids=[c for c, _, _ in INVALID_PROVISIONERS],
+    )
+    def test_invalid_object_table_denied_over_http(self, server, case, kw, fragment):
+        """The full invalid-provisioner table must be denied over HTTP with
+        the same structured errors the in-process admission raises."""
+        import yaml as _yaml
+
+        op, port = server
+        spec = {}
+        if "consolidation_enabled" in kw:
+            spec["consolidation"] = {"enabled": kw["consolidation_enabled"]}
+        if "ttl_seconds_after_empty" in kw:
+            spec["ttlSecondsAfterEmpty"] = kw["ttl_seconds_after_empty"]
+        if "ttl_seconds_until_expired" in kw:
+            spec["ttlSecondsUntilExpired"] = kw["ttl_seconds_until_expired"]
+        if "limits" in kw:
+            spec["limits"] = {"resources": kw["limits"]}
+        if "taints" in kw:
+            spec["taints"] = [
+                {"key": t.key, "value": t.value, "effect": t.effect}
+                for t in kw["taints"]
+            ]
+        if "labels" in kw:
+            spec["labels"] = kw["labels"]
+        if "weight" in kw:
+            spec["weight"] = kw["weight"]
+        doc = {"kind": "Provisioner", "metadata": {"name": "p"}, "spec": spec}
+        status, body = self._post(port, "/admission/validate", _yaml.safe_dump(doc))
+        assert status == 422 and body["allowed"] is False
+        assert any(fragment in e for e in body["errors"]), (case, body)
+
+    def test_malformed_spec_denied_not_crashed(self, server):
+        """Parseable-but-malformed specs (bad quantities, non-numeric TTLs)
+        must come back as structured denials, never 500s."""
+        op, port = server
+        for body in (
+            "kind: Provisioner\nmetadata: {name: m}\nspec: {weight: abc}\n",
+            ("kind: Provisioner\nmetadata: {name: m}\n"
+             "spec: {limits: {resources: {cpu: zz}}}\n"),
+            ("kind: Provisioner\nmetadata: {name: m}\n"
+             "spec: {ttlSecondsAfterEmpty: soon}\n"),
+            ("kind: Provisioner\nmetadata: {name: m}\n"
+             "spec: {requirements: [{operator: In}]}\n"),
+        ):
+            status, resp = self._post(port, "/admission/validate", body)
+            assert status == 422 and resp["allowed"] is False, (body, resp)
+            assert resp["errors"]
+
+    def test_invalid_settings_apply_is_atomic(self, server):
+        """A doc set whose settings are invalid against the LIVE store must
+        deny WITHOUT committing its provisioners (no partial apply)."""
+        op, port = server
+        status, resp = self._post(port, "/admission/apply", (
+            "kind: Provisioner\nmetadata: {name: partial}\nspec: {}\n"
+            "---\n"
+            "kind: ConfigMap\n"
+            "metadata: {name: karpenter-global-settings}\n"
+            "data: {vmMemoryOverheadPercent: \"5.0\"}\n"
+        ))
+        assert status == 422 and resp["allowed"] is False
+        assert "partial" not in op.state.provisioners  # nothing committed
+
+    def test_unparseable_body_400(self, server):
+        op, port = server
+        status, body = self._post(port, "/admission/validate", "{unclosed: [")
+        assert status == 400 and body["allowed"] is False
+
+    def test_unrecognized_kinds_400(self, server):
+        op, port = server
+        status, body = self._post(port, "/admission/validate",
+                                  "kind: Deployment\nmetadata: {name: x}\n")
+        assert status == 400 and body["allowed"] is False
+        assert "no recognized documents" in body["errors"][0]

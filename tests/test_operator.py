@@ -11,7 +11,7 @@ from karpenter_tpu.models import labels as L
 from karpenter_tpu.models.pod import PodSpec
 from karpenter_tpu.models.provisioner import Provisioner
 from karpenter_tpu.models.requirements import IN, Requirement, Requirements
-from karpenter_tpu.operator import LeaderElector, Operator
+from karpenter_tpu.operator import InMemoryLeaseStore, LeaderElector, Operator
 from karpenter_tpu.settings import SettingsStore
 from karpenter_tpu.utils.clock import FakeClock
 
@@ -150,3 +150,105 @@ class TestOperator:
                 urllib.request.urlopen(f"http://127.0.0.1:{port}/nope")
         finally:
             op.shutdown()
+
+
+class TestLeaderElection:
+    """Lease-based leader election (settings.md:23 LEADER_ELECT): two
+    operator replicas contend on a shared lease store; only the holder
+    reconciles; the standby takes over when the lease expires."""
+
+    def _pair(self, small_catalog):
+        clock = FakeClock()
+        store = InMemoryLeaseStore()
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+
+        def mk(ident):
+            op = Operator(cloud, clock=clock, scheduler_backend="oracle",
+                          registry=Registry(), lease_store=store, identity=ident)
+            op.state.apply_provisioner(Provisioner(name="default"))
+            return op
+
+        return clock, store, cloud, mk("op-1"), mk("op-2")
+
+    def test_holder_renews_and_standby_never_steals(self, small_catalog):
+        clock, store, cloud, op1, op2 = self._pair(small_catalog)
+        op1.tick()
+        assert op1.elector.elected
+        for _ in range(10):
+            clock.advance(5.0)  # < TTL between renewals
+            op1.tick()
+            op2.tick()
+            assert op1.elector.elected
+            assert not op2.elector.elected
+        lease = store.get("karpenter-tpu-leader")
+        assert lease.holder == "op-1"
+
+    def test_standby_does_not_reconcile(self, small_catalog):
+        clock, store, cloud, op1, op2 = self._pair(small_catalog)
+        op1.tick()
+        op2.state.add_pod(PodSpec(name="p", requests={"cpu": 0.5}))
+        for _ in range(3):
+            op2.tick()
+            clock.advance(1.5)
+            op1.tick()  # keep the lease renewed
+        # the standby enqueued nothing and launched nothing
+        assert not cloud.create_calls
+        assert "p" not in op2.state.bindings
+
+    def test_failover_mid_reconcile_resumes_within_ttl(self, small_catalog):
+        """Kill the leader mid-reconcile: the standby acquires on lease
+        expiry, hydration re-runs (election-gated), and it resumes from
+        cloud state — adopting the dead leader's instances, launching
+        nothing new, and finishing the in-flight work exactly once."""
+        clock, store, cloud, op1, op2 = self._pair(small_catalog)
+
+        def durable(op):
+            for i in range(4):
+                op.state.add_pod(PodSpec(name=f"p{i}", requests={"cpu": 1.0},
+                                         owner_key="d"))
+
+        durable(op1)
+        durable(op2)
+        op1.tick()
+        clock.advance(1.5)
+        op1.tick()  # batch window fired: nodes launched
+        assert cloud.create_calls
+        launches = len(cloud.create_calls)
+        n_nodes = len(op1.state.nodes)
+        # op1 dies here (no shutdown — the lease is NOT released)
+
+        # within the TTL the standby stays standby
+        clock.advance(5.0)
+        op2.tick()
+        assert not op2.elector.elected
+
+        # past the TTL it takes over and resumes from cloud state
+        clock.advance(LeaderElector.DEFAULT_TTL + 1.0)
+        for _ in range(3):
+            op2.tick()
+            clock.advance(1.5)
+        assert op2.elector.elected
+        assert len(op2.state.nodes) == n_nodes       # adopted, not re-launched
+        assert len(cloud.create_calls) == launches   # no duplicated work
+        assert not op2.state.pending_pods()          # pods re-bound
+
+    def test_deposed_leader_steps_down(self, small_catalog):
+        clock, store, cloud, op1, op2 = self._pair(small_catalog)
+        op1.tick()
+        assert op1.elector.elected
+        # op1 stalls (GC pause / partition) past the TTL; op2 takes over
+        clock.advance(LeaderElector.DEFAULT_TTL + 1.0)
+        op2.tick()
+        assert op2.elector.elected
+        # the old leader wakes up and must step down, not split-brain
+        op1.tick()
+        assert not op1.elector.elected
+        assert store.get("karpenter-tpu-leader").holder == "op-2"
+
+    def test_clean_shutdown_hands_over_without_waiting_ttl(self, small_catalog):
+        clock, store, cloud, op1, op2 = self._pair(small_catalog)
+        op1.tick()
+        assert op1.elector.elected
+        op1.shutdown()  # resigns the lease
+        op2.tick()      # same instant: no TTL wait
+        assert op2.elector.elected
