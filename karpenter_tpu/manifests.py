@@ -202,26 +202,39 @@ def parse_settings(doc: dict) -> Dict[str, object]:
 
 def load_documents(path) -> List[dict]:
     """All YAML documents under ``path`` (a file, or a directory scanned for
-    *.yaml/*.yml in sorted order; multi-document files supported)."""
+    *.yaml/*.yml in sorted order; multi-document files supported).  Missing
+    paths and empty directories are config errors (AdmissionError), not
+    silent successes."""
     p = Path(path)
+    if not p.exists():
+        raise AdmissionError("Manifest", str(p), ["path does not exist"])
     files = (
         sorted(list(p.glob("*.yaml")) + list(p.glob("*.yml")))
         if p.is_dir() else [p]
     )
+    if not files:
+        raise AdmissionError("Manifest", str(p), ["no *.yaml/*.yml files found"])
     docs: List[dict] = []
     for f in files:
-        for doc in yaml.safe_load_all(f.read_text()):
-            if doc:
-                docs.append(doc)
+        try:
+            for doc in yaml.safe_load_all(f.read_text()):
+                if doc:
+                    docs.append(doc)
+        except (OSError, yaml.YAMLError) as err:
+            raise AdmissionError("Manifest", str(f), [f"unreadable: {err}"])
     return docs
 
 
 def admit_documents(
     docs: Iterable[dict],
+    current_settings: Optional[Settings] = None,
 ) -> Tuple[List[Provisioner], List[NodeTemplate], Dict[str, object]]:
     """Parse + ADMIT every recognized document; raises AdmissionError on the
     first invalid one.  Unrecognized kinds are skipped (a manifest dir may
-    carry Deployments/RBAC alongside the karpenter objects)."""
+    carry Deployments/RBAC alongside the karpenter objects).  Settings
+    overrides are judged against ``current_settings`` (the LIVE settings of
+    the operator the docs will apply to — a partial override is valid or
+    invalid only relative to the values it leaves in place)."""
     provisioners: List[Provisioner] = []
     templates: List[NodeTemplate] = []
     settings: Dict[str, object] = {}
@@ -247,9 +260,9 @@ def admit_documents(
             # keys, non-numeric TTLs, ...)
             raise AdmissionError(kind or "?", name, [f"malformed spec: {err!r}"])
     if settings:
-        # per-field validity judged at admission time against the defaults;
-        # apply_objects re-validates against the live settings before mutating
-        admit_settings(replace(Settings(), **settings))
+        # judged against the live baseline (apply_objects re-validates under
+        # the operator's lock right before mutating)
+        admit_settings(replace(current_settings or Settings(), **settings))
     return provisioners, templates, settings
 
 
@@ -281,7 +294,10 @@ def apply_objects(
 def apply_path(path, *, state=None, cloud=None, settings_store=None):
     """Load manifests from ``path`` and apply the admitted objects to a
     running operator's state/cloud/settings.  Returns the admitted tuple."""
-    provisioners, templates, overrides = admit_documents(load_documents(path))
+    provisioners, templates, overrides = admit_documents(
+        load_documents(path),
+        current_settings=settings_store.current if settings_store else None,
+    )
     apply_objects(provisioners, templates, overrides,
                   state=state, cloud=cloud, settings_store=settings_store)
     return provisioners, templates, overrides

@@ -89,7 +89,13 @@ class InMemoryLeaseStore:
                 del self._leases[name]
 
 
-_elector_counter = 0
+def _default_identity() -> str:
+    """Unique per elector instance ACROSS processes: two replicas sharing a
+    real (pluggable) lease store must never collide on a default identity,
+    or try_acquire would grant both (holder == holder) and split-brain."""
+    import uuid
+
+    return f"operator-{uuid.uuid4().hex[:8]}"
 
 
 class LeaderElector:
@@ -114,10 +120,8 @@ class LeaderElector:
         lease_ttl: float = DEFAULT_TTL,
         clock: Optional[Clock] = None,
     ) -> None:
-        global _elector_counter
-        _elector_counter += 1
         self._elect = elect
-        self.identity = identity or f"operator-{_elector_counter}"
+        self.identity = identity or _default_identity()
         self.store = store or InMemoryLeaseStore()
         self.lease_name = lease_name
         self.lease_ttl = lease_ttl
@@ -294,7 +298,9 @@ class Operator:
         if not docs:
             return 400, {"allowed": False, "errors": ["empty request body"]}
         try:
-            provs, templates, overrides = admit_documents(docs)
+            provs, templates, overrides = admit_documents(
+                docs, current_settings=self.settings.current
+            )
         except AdmissionError as err:
             return 422, {"allowed": False, "kind": err.kind,
                          "name": err.name, "errors": err.errors}
@@ -364,9 +370,16 @@ class Operator:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
-                length = int(self.headers.get("Content-Length", 0))
-                raw = self.rfile.read(length).decode()
-                status, body = op.admit_http(raw, apply=self.path.endswith("/apply"))
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length).decode()
+                except (ValueError, UnicodeDecodeError) as err:
+                    status, body = 400, {"allowed": False,
+                                         "errors": [f"unreadable body: {err}"]}
+                else:
+                    status, body = op.admit_http(
+                        raw, apply=self.path.endswith("/apply")
+                    )
                 payload = json.dumps(body).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -415,7 +428,11 @@ class Operator:
 
     def shutdown(self) -> None:
         self._stop.set()
-        self.elector.resign()  # standby takes over without waiting the TTL
+        # under the reconcile lock: an in-flight tick on another thread must
+        # not re-acquire the lease right after the resign (the lock orders
+        # resign after that tick; _stop stops any further ones)
+        with self._reconcile_lock:
+            self.elector.resign()  # standby takes over without waiting the TTL
         self.scheduler.stop_warms()  # don't drain queued compiles at exit
         self.stop_http()
 

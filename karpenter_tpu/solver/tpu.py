@@ -350,7 +350,7 @@ def _make_step(
                 axis=1,
             )
 
-        def pick(rem, dom_mask, prov_used_cur, tail_rem=None):
+        def pick(rem, dom_mask, prov_used_cur, tail_rem=None, size_tiebreak=True):
             """argmin over (C, D & dom_mask) of price / min(fill, rem),
             where fill = min(ppn, take_pn + later-group demand) — the
             backfill-aware effective pods-per-node (see comment below).
@@ -428,8 +428,29 @@ def _make_step(
                     1.0,
                 )
             score = jnp.where(ok_cd, cand_price / denom[:, None], BIG)
+            # tie-break at exactly equal $/pod: prefer the LARGER candidate,
+            # but only when this group's own remainder fills it completely
+            # (take_pn <= rem) — then the $ outcome is identical by
+            # construction and the cluster gets fewer, larger nodes (less
+            # kubelet/API/image-pull/ENI load at the same price).
+            # Partially-fillable candidates never win the tie: their equal
+            # score rests on backfill estimates, not on guaranteed $.  For
+            # TAIL picks the guard compares against the zone's own tail
+            # count (tail_rem), not the group-wide scoring remainder — a
+            # tail that only half-fills the bigger node must not buy it on
+            # a backfill-induced score tie.  The host-seed flow opts out
+            # entirely (size_tiebreak=False): it buys exactly ONE node
+            # either way, so a larger type is strictly more $.
+            guard_rem = rem if tail_rem is None else tail_rem
+            full_take = jnp.where(
+                take_pn[:, None] <= jnp.maximum(guard_rem, 1.0),
+                take_pn[:, None], 0.0,
+            )
+            if not size_tiebreak:
+                full_take = jnp.zeros_like(full_take)
+            size_key = jnp.where(ok_cd, -full_take, BIG)
             pk = jnp.where(ok_cd, cand_price, BIG)
-            flat = lex_argmin(score, pk, ci_key, di_key)
+            flat = lex_argmin(score, size_key, pk, ci_key * D + di_key)
             bc = (flat // D).astype(jnp.int32)
             bd = (flat % D).astype(jnp.int32)
             ok = score.reshape(-1)[flat] < BIG
@@ -663,7 +684,7 @@ def _make_step(
                                       zone_budget[z_first]),
                           0.0)
             )
-            bc, bd, okp = pick(cnt, elb[dom_zone], state[6])
+            bc, bd, okp = pick(cnt, elb[dom_zone], state[6], size_tiebreak=False)
             n_new = jnp.where(~has & okp, 1, 0).astype(jnp.int32)
             per = jnp.minimum(jnp.minimum(cnt, jnp.maximum(take_pn[bc], 1.0)),
                               jnp.maximum(zone_budget[dom_zone[bd]], 0.0))

@@ -383,6 +383,29 @@ class TestHttpAdmission:
             assert status == 422 and resp["allowed"] is False, (body, resp)
             assert resp["errors"]
 
+    def test_settings_judged_against_live_store(self, server):
+        """A partial override is valid or invalid only relative to the live
+        settings it leaves in place: with the store's batchMaxDuration raised
+        to 30s, batchIdleDuration 15s must be ALLOWED (it would be invalid
+        against the 10s default)."""
+        op, port = server
+        op.settings.update(batch_max_duration=30.0)
+        status, resp = self._post(port, "/admission/apply", (
+            "kind: ConfigMap\n"
+            "metadata: {name: karpenter-global-settings}\n"
+            "data: {batchIdleDuration: \"15s\"}\n"
+        ))
+        assert status == 200 and resp["allowed"] is True, resp
+        assert op.settings.current.batch_idle_duration == 15.0
+
+    def test_missing_config_path_is_admission_error(self, tmp_path):
+        from karpenter_tpu.manifests import load_documents
+
+        with pytest.raises(AdmissionError):
+            load_documents(tmp_path / "nope")
+        with pytest.raises(AdmissionError):  # empty dir: config error too
+            load_documents(tmp_path)
+
     def test_invalid_settings_apply_is_atomic(self, server):
         """A doc set whose settings are invalid against the LIVE store must
         deny WITHOUT committing its provisioners (no partial apply)."""
