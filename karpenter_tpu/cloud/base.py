@@ -39,6 +39,13 @@ class MachineNotFoundError(CloudProviderError):
 
 
 class CloudProvider(abc.ABC):
+    def configure_settings(self, settings) -> None:
+        """Push the hot-reloadable global settings into the provider
+        (settings.go:40-65 are consumed by the AWS layer in the reference:
+        cluster name/endpoint into bootstrap, default instance profile and
+        tags into launches, node-name convention into node naming).
+        Default: no-op for providers that don't consume them."""
+
     @abc.abstractmethod
     def create(self, machine: Machine) -> Machine:
         """Launch an instance satisfying the machine's requirements; returns

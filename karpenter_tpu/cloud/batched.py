@@ -117,6 +117,11 @@ class BatchedCloud(CloudProvider):
     def liveness(self) -> bool:
         return self.inner.liveness()
 
+    def configure_settings(self, settings) -> None:
+        # explicit forward: the base class's no-op default would otherwise
+        # shadow __getattr__ delegation and strand settings at this layer
+        self.inner.configure_settings(settings)
+
     def __getattr__(self, name: str):
         # transparent for provider-specific surface (test injection hooks,
         # node_ready_delay, instance tables) — only missing attrs land here

@@ -27,6 +27,7 @@ from .base import (
 from .launchpath import select_launch_types
 from .templates import (
     Image,
+    LaunchTemplateProvider,
     NodeTemplate,
     images_for_instance_type,
     resolve_images,
@@ -76,6 +77,35 @@ class FakeCloudProvider(CloudProvider):
         # seconds until a launched node registers + passes readiness; >0
         # engages the deprovisioning wait-ready machine for replacements
         self.node_ready_delay: float = 0.0
+        # global settings consumed at launch (configure_settings)
+        self.cluster_name = "sim"
+        self.default_tags: Dict[str, str] = {}
+        self.node_name_convention = "ip-name"
+        # real launch-template flow (create -> ensure LT -> fleet): consumes
+        # clusterEndpoint (bootstrap userdata) + defaultInstanceProfile
+        self.launch_template_provider = LaunchTemplateProvider(self.cluster_name)
+
+    def configure_settings(self, settings) -> None:
+        """settings.go:40-65 consumption: cluster name + default tags flow
+        into instance tagging, nodeNameConvention into node naming, cluster
+        endpoint + default instance profile into the launch templates."""
+        self.cluster_name = settings.cluster_name
+        self.default_tags = dict(settings.tags)
+        self.node_name_convention = settings.node_name_convention
+        ltp = self.launch_template_provider
+        ltp.cluster_name = settings.cluster_name
+        ltp.cluster_endpoint = settings.cluster_endpoint
+        ltp.default_instance_profile = settings.default_instance_profile
+
+    def _node_name(self, seq: int) -> str:
+        """Node object name per nodeNameConvention (settings.go:52):
+        'ip-name' mirrors EC2 private-DNS naming, 'resource-name' names the
+        node after the instance id."""
+        if self.node_name_convention == "resource-name":
+            return f"i-{seq:017d}"
+        # 24 bits of address space: node names key state dicts, so a long
+        # simulation must not wrap into duplicate names
+        return f"ip-10-{(seq >> 16) & 0xFF}-{(seq >> 8) & 0xFF}-{seq & 0xFF}"
 
     # ---- test injection ------------------------------------------------
     def inject_ice(self, instance_type: str, zone: str, capacity_type: str) -> None:
@@ -128,8 +158,10 @@ class FakeCloudProvider(CloudProvider):
         # controller can blacklist them (instance.go:395-401)
         machine.ice_errors = [(i.name, o.zone, o.capacity_type) for i, o in iced]
 
-        pid = f"fake://{it.name}/{next(_instance_counter)}"
+        seq = next(_instance_counter)
+        pid = f"fake://{it.name}/{seq}"
         machine.provider_id = pid
+        machine.node_name = self._node_name(seq)
         machine.image_id = self._image_for(machine.node_template, it)
         machine.instance_type = it.name
         machine.zone = offering.zone
@@ -146,12 +178,32 @@ class FakeCloudProvider(CloudProvider):
             L.INSTANCE_TYPE: it.name,
             L.PROVISIONER_NAME: machine.provisioner,
         }
+        tmpl = self.templates.get(machine.node_template)
+        if tmpl is not None and tmpl.launch_template_name is None and machine.image_id:
+            # the reference ensures a launch template before CreateFleet
+            # (launchtemplate.go EnsureAll): this is where clusterEndpoint
+            # (bootstrap userdata) and defaultInstanceProfile are consumed
+            lt = self.launch_template_provider.ensure(
+                tmpl,
+                Image(machine.image_id, machine.labels.get(L.ARCH, "")),
+                labels=machine.labels, taints=machine.taints,
+            )
+            machine.launch_template = lt.name
         self.instances[pid] = FakeInstance(
             provider_id=pid,
             machine=machine,
             created_at=self.clock.now(),
             visible_after_calls=self.eventual_consistency_calls,
-            tags={"karpenter.sh/cluster": "sim", "karpenter.sh/provisioner-name": machine.provisioner},
+            # tag layering: settings-wide defaults, then the template's own,
+            # then the karpenter ownership/attribution tags LAST — user tags
+            # must never override them (instance.go:216-218; settings tag
+            # validation also rejects the reserved prefixes)
+            tags={
+                **self.default_tags,
+                **(tmpl.tags if tmpl else {}),
+                f"kubernetes.io/cluster/{self.cluster_name}": "owned",
+                "karpenter.sh/provisioner-name": machine.provisioner,
+            },
         )
         return machine
 

@@ -57,6 +57,7 @@ class ImageFamily:
         taints: Sequence[Taint],
         kubelet_flags: Dict[str, str],
         custom_userdata: str = "",
+        cluster_endpoint: str = "",
     ) -> str:
         raise NotImplementedError
 
@@ -74,13 +75,17 @@ class StandardFamily(ImageFamily):
             Image("img-standard-gpu", L.ARCH_AMD64, accelerated=True, created_at=2.0, family="standard"),
         ]
 
-    def bootstrap_script(self, cluster_name, labels, taints, kubelet_flags, custom_userdata="") -> str:
+    def bootstrap_script(self, cluster_name, labels, taints, kubelet_flags,
+                         custom_userdata="", cluster_endpoint="") -> str:
         label_arg = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
         taint_arg = ",".join(f"{t.key}={t.value}:{t.effect}" for t in taints)
         flags = " ".join(f"--{k}={v}" for k, v in sorted(kubelet_flags.items()))
+        endpoint_arg = (
+            f" --apiserver-endpoint '{cluster_endpoint}'" if cluster_endpoint else ""
+        )
         script = (
             "#!/bin/bash\n"
-            f"/etc/node/bootstrap.sh '{cluster_name}' "
+            f"/etc/node/bootstrap.sh '{cluster_name}'{endpoint_arg} "
             f"--kubelet-extra-args '--node-labels={label_arg} "
             f"--register-with-taints={taint_arg} {flags}'\n"
         )
@@ -109,8 +114,11 @@ class TomlFamily(ImageFamily):
             Image("img-toml-arm64", L.ARCH_ARM64, created_at=1.0, family="toml"),
         ]
 
-    def bootstrap_script(self, cluster_name, labels, taints, kubelet_flags, custom_userdata="") -> str:
+    def bootstrap_script(self, cluster_name, labels, taints, kubelet_flags,
+                         custom_userdata="", cluster_endpoint="") -> str:
         lines = ["[settings.kubernetes]", f'cluster-name = "{cluster_name}"']
+        if cluster_endpoint:
+            lines.append(f'api-server = "{cluster_endpoint}"')
         if custom_userdata:
             lines.append(custom_userdata.strip())
         lines.append("[settings.kubernetes.node-labels]")
@@ -132,7 +140,8 @@ class CustomFamily(ImageFamily):
     def default_images(self) -> List[Image]:
         return []
 
-    def bootstrap_script(self, cluster_name, labels, taints, kubelet_flags, custom_userdata="") -> str:
+    def bootstrap_script(self, cluster_name, labels, taints, kubelet_flags,
+                         custom_userdata="", cluster_endpoint="") -> str:
         return custom_userdata
 
 
@@ -266,8 +275,16 @@ class LaunchTemplate:
 class LaunchTemplateProvider:
     """Hash-keyed ensure-exists cache (launchtemplate.go:54-317)."""
 
-    def __init__(self, cluster_name: str = "sim", max_templates: int = 256) -> None:
+    def __init__(
+        self,
+        cluster_name: str = "sim",
+        max_templates: int = 256,
+        cluster_endpoint: str = "",
+        default_instance_profile: str = "",
+    ) -> None:
         self.cluster_name = cluster_name
+        self.cluster_endpoint = cluster_endpoint          # settings.go:44
+        self.default_instance_profile = default_instance_profile  # settings.go:46
         self.max_templates = max_templates
         self._cache: Dict[str, LaunchTemplate] = {}
         self.created: List[str] = []
@@ -287,10 +304,14 @@ class LaunchTemplateProvider:
     ) -> LaunchTemplate:
         family = get_family(template.image_family)
         userdata = family.bootstrap_script(
-            self.cluster_name, labels, taints, kubelet_flags or {}, template.user_data
+            self.cluster_name, labels, taints, kubelet_flags or {},
+            template.user_data, cluster_endpoint=self.cluster_endpoint,
         )
+        # the template's own profile wins; the settings-wide default fills
+        # the gap (settings.go defaultInstanceProfile semantics)
+        profile = template.instance_profile or self.default_instance_profile
         key = self._hash(
-            image.image_id, userdata, template.instance_profile,
+            image.image_id, userdata, profile,
             ",".join(sorted(template.status_security_groups)),
             str(sorted(template.tags.items())),
         )
@@ -301,7 +322,7 @@ class LaunchTemplateProvider:
             name=f"karpenter.k8s.tpu/{key}",
             image_id=image.image_id,
             user_data_b64=base64.b64encode(userdata.encode()).decode(),
-            instance_profile=template.instance_profile,
+            instance_profile=profile,
             security_groups=tuple(sorted(template.status_security_groups)),
             tags=tuple(sorted(template.tags.items())),
         )

@@ -603,6 +603,7 @@ class DeprovisioningController:
                     labels=dict(machine.labels),
                     taints=list(machine.taints),
                     existing=True,
+                    name=machine.node_name,  # "" -> SimNode default counter
                     created_at=self.clock.now(),
                 )
                 node.labels[L.HOSTNAME] = node.name

@@ -101,6 +101,10 @@ class LinkController:
                 labels=dict(machine.labels),
                 taints=list(machine.taints),
                 existing=True,
+                # adoption must preserve the node's identity: the same
+                # instance re-registers under its nodeNameConvention name,
+                # not a fresh synthetic one (hostname topology would diverge)
+                name=machine.node_name,
                 created_at=machine.launched_at or self.clock.now(),
             )
             node.labels[L.HOSTNAME] = node.name

@@ -141,7 +141,10 @@ class ProvisioningController:
                 labels=dict(machine.labels),
                 taints=list(machine.taints),
                 existing=True,
-                name=node.name,  # keep solver's name so assignments map
+                # the registered node carries the cloud's name (per
+                # nodeNameConvention, settings.go:52); binds below use it,
+                # and existing-vs-new discrimination above used node.name
+                name=machine.node_name or node.name,
                 created_at=self.clock.now(),
             )
             launched.labels[L.HOSTNAME] = launched.name

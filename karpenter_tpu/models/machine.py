@@ -31,6 +31,8 @@ class Machine:
 
     # status (set by the cloud layer)
     provider_id: str = ""
+    node_name: str = ""  # node object name per nodeNameConvention (settings.go:52)
+    launch_template: str = ""  # LT the instance launched with (EnsureAll)
     instance_type: str = ""
     zone: str = ""
     capacity_type: str = ""

@@ -188,6 +188,7 @@ class Operator:
         from .cloud.batched import BatchedCloud
 
         self.cloud = decorate(BatchedCloud(cloud, idle_seconds=0.0), self.registry)
+        self.cloud.configure_settings(self.settings.current)
         self.unavailable = UnavailableOfferings(clock=self.clock)
         self.scheduler = BatchScheduler(backend=scheduler_backend, registry=self.registry)
         s = self.settings.current
@@ -230,6 +231,7 @@ class Operator:
 
     # ---- wiring ---------------------------------------------------------
     def _on_settings(self, s: Settings) -> None:
+        self.cloud.configure_settings(s)
         self.provisioning.window = Window(
             s.batch_idle_duration, s.batch_max_duration, clock=self.clock
         )

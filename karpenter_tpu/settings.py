@@ -43,6 +43,11 @@ class Settings:
             errs.append("batchIdleDuration must be <= batchMaxDuration")
         if self.deprovisioning_ttl < 0:
             errs.append("deprovisioningTTL must be non-negative")
+        for k in self.tags:
+            if k.startswith("karpenter.sh/") or k.startswith("kubernetes.io/cluster/"):
+                # reserved prefixes: global tags must not override the
+                # ownership/attribution tags the launcher stamps
+                errs.append(f"tags[{k!r}] uses a restricted tag prefix")
         return errs
 
 
