@@ -77,19 +77,28 @@ class FakeCloudProvider(CloudProvider):
         # seconds until a launched node registers + passes readiness; >0
         # engages the deprovisioning wait-ready machine for replacements
         self.node_ready_delay: float = 0.0
-        # global settings consumed at launch (configure_settings)
-        self.cluster_name = "sim"
+        # global settings consumed at launch (configure_settings); the
+        # launch-template flow (create -> ensure LT -> fleet) consumes
+        # clusterEndpoint (bootstrap userdata) + defaultInstanceProfile,
+        # and owns the single copy of cluster_name (see property below)
+        self.launch_template_provider = LaunchTemplateProvider("sim")
         self.default_tags: Dict[str, str] = {}
         self.node_name_convention = "ip-name"
-        # real launch-template flow (create -> ensure LT -> fleet): consumes
-        # clusterEndpoint (bootstrap userdata) + defaultInstanceProfile
-        self.launch_template_provider = LaunchTemplateProvider(self.cluster_name)
+
+    @property
+    def cluster_name(self) -> str:
+        # single source of truth: instance tagging and bootstrap userdata
+        # must never disagree on the cluster name
+        return self.launch_template_provider.cluster_name
+
+    @cluster_name.setter
+    def cluster_name(self, value: str) -> None:
+        self.launch_template_provider.cluster_name = value
 
     def configure_settings(self, settings) -> None:
         """settings.go:40-65 consumption: cluster name + default tags flow
         into instance tagging, nodeNameConvention into node naming, cluster
         endpoint + default instance profile into the launch templates."""
-        self.cluster_name = settings.cluster_name
         self.default_tags = dict(settings.tags)
         self.node_name_convention = settings.node_name_convention
         ltp = self.launch_template_provider
@@ -170,6 +179,21 @@ class FakeCloudProvider(CloudProvider):
         machine.capacity = dict(it.capacity)
         machine.allocatable = dict(it.allocatable)
         machine.launched_at = self.clock.now()
+        tmpl = self.templates.get(machine.node_template)
+        if tmpl is not None and tmpl.launch_template_name is None and machine.image_id:
+            # the reference ensures a launch template before CreateFleet
+            # (launchtemplate.go EnsureAll): this is where clusterEndpoint
+            # (bootstrap userdata) and defaultInstanceProfile are consumed.
+            # Keyed on the PRE-resolution labels (the provisioner's static
+            # set) — zone/type/capacity-type are fleet overrides, not
+            # userdata, so LT cardinality stays per (template, image), not
+            # per (catalog x zones x capacity-types)
+            lt = self.launch_template_provider.ensure(
+                tmpl,
+                Image(machine.image_id, it.labels().get(L.ARCH, "")),
+                labels=machine.labels, taints=machine.taints,
+            )
+            machine.launch_template = lt.name
         machine.labels = {
             **machine.labels,
             **it.labels(),
@@ -178,17 +202,6 @@ class FakeCloudProvider(CloudProvider):
             L.INSTANCE_TYPE: it.name,
             L.PROVISIONER_NAME: machine.provisioner,
         }
-        tmpl = self.templates.get(machine.node_template)
-        if tmpl is not None and tmpl.launch_template_name is None and machine.image_id:
-            # the reference ensures a launch template before CreateFleet
-            # (launchtemplate.go EnsureAll): this is where clusterEndpoint
-            # (bootstrap userdata) and defaultInstanceProfile are consumed
-            lt = self.launch_template_provider.ensure(
-                tmpl,
-                Image(machine.image_id, machine.labels.get(L.ARCH, "")),
-                labels=machine.labels, taints=machine.taints,
-            )
-            machine.launch_template = lt.name
         self.instances[pid] = FakeInstance(
             provider_id=pid,
             machine=machine,

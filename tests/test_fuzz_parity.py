@@ -35,6 +35,7 @@ from karpenter_tpu.models.provisioner import Provisioner
 from karpenter_tpu.models.requirements import IN, Requirement
 from karpenter_tpu.models.tensorize import tensorize
 from karpenter_tpu.solver import reference
+from karpenter_tpu.solver.scheduler import BatchScheduler
 from karpenter_tpu.solver.tpu import solve_tensors
 
 PARITY = 1.02
@@ -295,8 +296,13 @@ def test_fuzz_existing_node_parity_and_no_overcommit(seed, small_catalog):
 
     oracle = reference.solve(pods, provs, small_catalog,
                              existing_nodes=existing, unavailable=unavailable)
-    st = tensorize(pods, provs, small_catalog, unavailable=unavailable)
-    tpu = solve_tensors(st, existing_nodes=existing).result
+    # the product boundary (scheduling.Solve = BatchScheduler): includes the
+    # relaxation ladder, OR-term ladder, and the residue-convergence waves
+    # that close the in-step limit-cascade bound (seed 31)
+    tpu = BatchScheduler(backend="tpu").solve(
+        pods, provs, small_catalog,
+        existing_nodes=existing, unavailable=unavailable,
+    )
 
     # caller's nodes untouched by BOTH backends
     assert {n.name: len(n.pods) for n in existing} == before
@@ -328,9 +334,10 @@ def test_fuzz_existing_node_parity_and_no_overcommit(seed, small_catalog):
 def test_fuzz_cost_and_feasibility_parity(seed, small_catalog):
     pods, provs, unavailable = random_scenario(seed, small_catalog)
     oracle = reference.solve(pods, provs, small_catalog, unavailable=unavailable)
-    st = tensorize(pods, provs, small_catalog, unavailable=unavailable)
-    out = solve_tensors(st)
-    tpu = out.result
+    # product boundary (see the existing-node test's comment)
+    tpu = BatchScheduler(backend="tpu").solve(
+        pods, provs, small_catalog, unavailable=unavailable
+    )
 
     floor = oracle.n_scheduled - max(2, oracle.n_scheduled // 10)
     assert tpu.n_scheduled >= floor, (
