@@ -146,15 +146,42 @@ void observe(Ctx& x, int g, int s, int z, int n) {
   }
 }
 
-// best new-node (c, d): argmin price/min(ppn, remaining); ties by price,
-// candidate idx, domain idx (the oracle's ordering).  zone_filter < 0 = any.
+// best new-node (c, d): argmin price/min(ppn, remaining).  Ties at exactly
+// equal $/pod break toward the LARGER fully-fillable candidate (ppn <=
+// remaining: the group's own remainder fills it, so the $ outcome is
+// identical by construction and the cluster gets fewer, larger nodes —
+// mirrors solver/tpu.py's size tie-break), then lower price, then candidate
+// idx, domain idx.  zone_filter < 0 = any.
 bool best_new(const Ctx& x, int g, int remaining, int zone_filter,
               const std::vector<uint8_t>* zone_el,
               int* out_c, int* out_d, float* out_ppn, float* out_price) {
   const float* rg = x.req + (size_t)g * x.R;
-  float best_score = kBig, best_price = kBig;
+  float best_score = kBig, best_price = kBig, best_full = -1.0f;
   int best_c = -1, best_d = -1;
   float best_ppn = 0.0f;
+  // candidate-invariant pieces of the size tie-break, hoisted:
+  // hostname cap on a fresh node, and the per-zone share for spread groups.
+  // The share divides by the group's ELIGIBLE zones (its allowed domains),
+  // not by the zones allowed at this instant — after round one a skew-gated
+  // spread admits zones one at a time, and dividing by that transient 1
+  // would re-admit the oversized purchase the guard exists to prevent.
+  const int sh_g = x.g_host_spread[g];
+  const int hk_g = x.g_host_cap[g];
+  float guard_rem = (float)remaining;
+  if (x.g_zone_spread[g] >= 0) {
+    std::vector<uint8_t> zone_ok(x.Z, 0);
+    for (int d = 0; d < x.D; ++d)
+      if (x.dom_ok[(size_t)g * x.D + d]) zone_ok[x.dom_zone[d]] = 1;
+    int nz = 0;
+    for (int q = 0; q < x.Z; ++q)
+      if (zone_ok[q]) ++nz;
+    // the sequential interleave makes the true per-node fill uncertain
+    // (skew gating shifts the zone shares as counts move), so demand TWO
+    // full nodes' worth of share before betting on the bigger type —
+    // large fleet groups (share >> ppn) keep the tie-break, adversarial
+    // small spreads fall back to the oracle's price tie
+    if (nz > 1) guard_rem = (float)(remaining / nz) * 0.5f;
+  }
   for (int c = 0; c < x.C; ++c) {
     if (!x.F[(size_t)g * x.C + c]) continue;
     if (!limit_ok(x, c)) continue;
@@ -162,6 +189,13 @@ bool best_new(const Ctx& x, int g, int remaining, int zone_filter,
     if (ppn < 1.0f) continue;
     float denom = ppn < (float)remaining ? ppn : (float)remaining;
     if (denom < 1.0f) denom = 1.0f;
+    // effective take on a FRESH node includes the hostname cap (an
+    // anti-affine group takes 1 pod per node regardless of resources) —
+    // without it the size tie-break would buy big nodes it can never fill
+    float take_new = ppn;
+    if (sh_g >= 0 && hk_g > 0 && (float)hk_g < take_new)
+      take_new = (float)hk_g;
+    float full = take_new <= guard_rem ? take_new : 0.0f;
     for (int d = 0; d < x.D; ++d) {
       if (!x.avail[(size_t)c * x.D + d] || !x.dom_ok[(size_t)g * x.D + d])
         continue;
@@ -170,9 +204,13 @@ bool best_new(const Ctx& x, int g, int remaining, int zone_filter,
       if (zone_el && !(*zone_el)[z]) continue;
       float p = x.price[(size_t)c * x.D + d];
       float score = p / denom;
-      if (score < best_score || (score == best_score && p < best_price)) {
+      if (score < best_score ||
+          (score == best_score &&
+           (full > best_full ||
+            (full == best_full && p < best_price)))) {
         best_score = score;
         best_price = p;
+        best_full = full;
         best_c = c;
         best_d = d;
         best_ppn = ppn;
