@@ -34,6 +34,13 @@ class TerminationController:
         self.registry = registry or default_registry
         self.clock = clock or state.clock
         self.pdbs: List[PodDisruptionBudget] = []
+        #: nodes holding the "finalizer" — reconcile visits ONLY these (a
+        #: full-cluster scan per reconcile turned the interruption hot path
+        #: O(cluster x messages)).  begin() is the only marker, so this is
+        #: authoritative; a dict (not a set) so drain order stays insertion-
+        #: ordered and deterministic (PDB budgets go to the first-marked
+        #: node, independent of string hashing).
+        self._pending: Dict[str, None] = {}
 
     # ---- API -----------------------------------------------------------
     def begin(self, node_name: str) -> None:
@@ -43,19 +50,24 @@ class TerminationController:
             return
         ns.cordoned = True
         ns.marked_for_deletion = True
+        self._pending[node_name] = None
         self.recorder.publish(Event("Node", node_name, "TerminationStarted", "cordoned"))
 
     def reconcile(self) -> None:
         """Drain marked nodes; delete fully-drained ones."""
-        for name, ns in list(self.state.nodes.items()):
-            if not ns.marked_for_deletion:
+        for name in list(self._pending):
+            ns = self.state.nodes.get(name)
+            if ns is None or not ns.marked_for_deletion:
+                self._pending.pop(name, None)
                 continue
             self._drain(name)
             ns = self.state.nodes.get(name)
             if ns is None:
+                self._pending.pop(name, None)
                 continue
             if not ns.node.pods:
                 self._finalize(name)
+                self._pending.pop(name, None)
 
     # ---- internals -------------------------------------------------------
     def _evictable(self, pod: PodSpec) -> bool:

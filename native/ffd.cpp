@@ -154,34 +154,28 @@ void observe(Ctx& x, int g, int s, int z, int n) {
 // idx, domain idx.  zone_filter < 0 = any.
 bool best_new(const Ctx& x, int g, int remaining, int zone_filter,
               const std::vector<uint8_t>* zone_el,
-              int* out_c, int* out_d, float* out_ppn, float* out_price) {
+              int* out_c, int* out_d, float* out_ppn, float* out_price,
+              int nz_el = 1) {
   const float* rg = x.req + (size_t)g * x.R;
   float best_score = kBig, best_price = kBig, best_full = -1.0f;
   int best_c = -1, best_d = -1;
   float best_ppn = 0.0f;
   // candidate-invariant pieces of the size tie-break, hoisted:
   // hostname cap on a fresh node, and the per-zone share for spread groups.
-  // The share divides by the group's ELIGIBLE zones (its allowed domains),
-  // not by the zones allowed at this instant — after round one a skew-gated
-  // spread admits zones one at a time, and dividing by that transient 1
-  // would re-admit the oversized purchase the guard exists to prevent.
+  // nz_el is the count of the group's ELIGIBLE zones (passed by the caller,
+  // which already built the set) — not the zones allowed at this instant:
+  // after round one a skew-gated spread admits zones one at a time, and
+  // dividing by that transient 1 would re-admit the oversized purchase the
+  // guard exists to prevent.  The sequential interleave makes the true
+  // per-node fill uncertain (skew gating shifts zone shares as counts
+  // move), so demand TWO full nodes' worth of share before betting on the
+  // bigger type — large fleet groups (share >> ppn) keep the tie-break,
+  // adversarial small spreads fall back to the oracle's price tie.
   const int sh_g = x.g_host_spread[g];
   const int hk_g = x.g_host_cap[g];
   float guard_rem = (float)remaining;
-  if (x.g_zone_spread[g] >= 0) {
-    std::vector<uint8_t> zone_ok(x.Z, 0);
-    for (int d = 0; d < x.D; ++d)
-      if (x.dom_ok[(size_t)g * x.D + d]) zone_ok[x.dom_zone[d]] = 1;
-    int nz = 0;
-    for (int q = 0; q < x.Z; ++q)
-      if (zone_ok[q]) ++nz;
-    // the sequential interleave makes the true per-node fill uncertain
-    // (skew gating shifts the zone shares as counts move), so demand TWO
-    // full nodes' worth of share before betting on the bigger type —
-    // large fleet groups (share >> ppn) keep the tie-break, adversarial
-    // small spreads fall back to the oracle's price tie
-    if (nz > 1) guard_rem = (float)(remaining / nz) * 0.5f;
-  }
+  if (x.g_zone_spread[g] >= 0 && nz_el > 1)
+    guard_rem = (float)(remaining / nz_el) * 0.5f;
   for (int c = 0; c < x.C; ++c) {
     if (!x.F[(size_t)g * x.C + c]) continue;
     if (!limit_ok(x, c)) continue;
@@ -255,6 +249,9 @@ int place_constrained(Ctx& x, int g) {
   std::vector<uint8_t> el(x.Z, 0);
   for (int d = 0; d < x.D; ++d)
     if (x.dom_ok[(size_t)g * x.D + d]) el[x.dom_zone[d]] = 1;
+  int nz_el = 0;
+  for (int q = 0; q < x.Z; ++q)
+    if (el[q]) ++nz_el;
 
   while (remaining > 0) {
     // earliest open slot in an allowed zone with capacity + host headroom
@@ -283,7 +280,7 @@ int place_constrained(Ctx& x, int g) {
     if (!any) break;
     int c, d;
     float ppn, price;
-    if (!best_new(x, g, remaining, -1, &zel, &c, &d, &ppn, &price)) break;
+    if (!best_new(x, g, remaining, -1, &zel, &c, &d, &ppn, &price, nz_el)) break;
     int s = open_node(x, g, c, d, price);
     if (s < 0) return remaining;  // NR exhausted
     place(x, g, s, 1);
