@@ -274,6 +274,24 @@ def _make_step(
         # an empty node never satisfies mode-A/C hostname affinity
         new_allowed = ~host_gated & ~hdead & ~zdead
 
+        # step-entry NET-backfill fraction for tail picks (see pick()):
+        # how much of the later-group demand the FREE capacity on open rows
+        # absorbs, in units of the average later-pod request vector.  Hoisted
+        # here — it depends only on the step-entry carry (pick() closes over
+        # this `res`, not the threaded creation state), and the [NR, R]
+        # reduction is the most memory-heavy term in the scoring path.
+        avg_req = suffix_res[g] / jnp.maximum(suffix_cnt[g], 1.0)       # [R]
+        per_row_absorb = jnp.min(jnp.where(
+            avg_req[None, :] > 0,
+            jnp.maximum(res, 0.0) / jnp.maximum(avg_req[None, :], 1e-9),
+            BIGN,
+        ), axis=1)                                                      # [NR]
+        rows_absorb = jnp.sum(jnp.where(active, per_row_absorb, 0.0))
+        net_backfill_frac = jnp.clip(
+            (suffix_cnt[g] - rows_absorb) / jnp.maximum(suffix_cnt[g], 1.0),
+            0.0, 1.0,
+        )
+
         ratios = jnp.where(req_g[None, :] > 0, jnp.floor((res + 1e-6) / jnp.maximum(req_g[None, :], 1e-9)), BIGN)
         cap = jnp.min(ratios, axis=1)            # [NR]
 
@@ -408,19 +426,7 @@ def _make_step(
                 # real — fuzz seed 27's 2-cpu tail).  Rows absorb in units
                 # of the average later-pod request vector (resource-coupled:
                 # free memory with no free cpu absorbs nothing).
-                avg_req = suffix_res[g] / jnp.maximum(suffix_cnt[g], 1.0)
-                per_row = jnp.min(jnp.where(
-                    avg_req[None, :] > 0,
-                    jnp.maximum(res, 0.0) / jnp.maximum(avg_req[None, :], 1e-9),
-                    BIGN,
-                ), axis=1)                                              # [NR]
-                rows_absorb = jnp.sum(jnp.where(active, per_row, 0.0))
-                net_frac = jnp.clip(
-                    (suffix_cnt[g] - rows_absorb)
-                    / jnp.maximum(suffix_cnt[g], 1.0),
-                    0.0, 1.0,
-                )
-                pnb_net = per_node_backfill * net_frac
+                pnb_net = per_node_backfill * net_backfill_frac
                 denom = jnp.maximum(
                     jnp.minimum(
                         denom, jnp.maximum(tail_rem, 1.0) + pnb_net
