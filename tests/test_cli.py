@@ -130,3 +130,34 @@ def test_inventory_metrics_are_emitted(small_catalog):
     emitted = (set(reg.counters) | set(reg.gauges) | set(reg.histograms))
     missing = set(INVENTORY) - emitted
     assert not missing, f"documented metrics never emitted: {sorted(missing)}"
+
+
+def test_jit_cache_dir_populates(tmp_path):
+    """--jit-cache-dir enables JAX's persistent compile cache: a device-path
+    solve must write a cache entry that a restarted process can reload
+    (the cross-restart half of the cold-start story).  Run as a subprocess —
+    the flag mutates global jax config."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # JAX_PLATFORMS=cpu is honored at the jax CONFIG layer by
+    # karpenter_tpu/__init__.py (defeating the sitecustomize TPU
+    # force-registration), so the child stays host-only.  The cache-write
+    # assertion relies on the solver compile exceeding the 0.5 s
+    # min-compile-time threshold cli.py sets — solver compiles are seconds
+    # on CPU and tens of seconds on TPU, so the margin is structural.
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    out = subprocess.run(
+        [sys.executable, "-m", "karpenter_tpu.cli", "solve", "--backend", "tpu",
+         "--pods", "8", "--small", "--compact",
+         "--jit-cache-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    doc = _json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["scheduled"] == 8 and doc["infeasible"] == 0
+    assert any(tmp_path.iterdir()), "persistent compile cache is empty"
