@@ -59,6 +59,8 @@ def cmd_demo(args) -> int:
         argv += ["--metrics-port", str(args.metrics_port)]
     if args.config:
         argv += ["--config", args.config]
+    if args.solver_address:
+        argv += ["--solver-address", args.solver_address]
     return op_main(argv)
 
 
@@ -180,6 +182,11 @@ def main(argv=None) -> int:
     d.add_argument("--profile-port", type=int, default=0)
     d.add_argument("--jit-cache-dir", default=os.environ.get("KT_JIT_CACHE_DIR", ""),
                    help="persistent XLA compile cache directory")
+    d.add_argument("--solver-address",
+                   default=os.environ.get("KARPENTER_SOLVER_ADDR", ""),
+                   help="host:port of a solver sidecar (kt serve); empty "
+                        "solves in-process; defaults from "
+                        "KARPENTER_SOLVER_ADDR (deploy/operator.yaml)")
     d.add_argument("--config", default="",
                    help="YAML manifest file/dir loaded through admission")
     d.set_defaults(fn=cmd_demo)

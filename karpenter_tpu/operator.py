@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -168,6 +169,7 @@ class Operator:
         metrics_port: int = 0,  # 0 disables the HTTP server
         lease_store: Optional[InMemoryLeaseStore] = None,
         identity: Optional[str] = None,
+        solver_address: str = "",  # host:port of a solver sidecar; "" = in-process
     ) -> None:
         self.clock = clock or Clock()
         self.settings = settings or SettingsStore()
@@ -190,7 +192,21 @@ class Operator:
         self.cloud = decorate(BatchedCloud(cloud, idle_seconds=0.0), self.registry)
         self.cloud.configure_settings(self.settings.current)
         self.unavailable = UnavailableOfferings(clock=self.clock)
-        self.scheduler = BatchScheduler(backend=scheduler_backend, registry=self.registry)
+        if solver_address:
+            # split topology (deploy/operator.yaml + deploy/solver.yaml): the
+            # sidecar owns tensorization + the device mesh; this process only
+            # reconciles.  The reference consumes its remote boundary the
+            # same way (cmd/controller/main.go:44).  Falls back to a local
+            # oracle solve while the sidecar is unreachable.
+            from .service.client import RemoteScheduler
+
+            self.scheduler = RemoteScheduler(
+                solver_address,
+                backend="" if scheduler_backend == "auto" else scheduler_backend,
+                registry=self.registry,
+            )
+        else:
+            self.scheduler = BatchScheduler(backend=scheduler_backend, registry=self.registry)
         s = self.settings.current
         self.pricing = PricingProvider(
             cloud.get_instance_types(), clock=self.clock,
@@ -436,6 +452,9 @@ class Operator:
         with self._reconcile_lock:
             self.elector.resign()  # standby takes over without waiting the TTL
         self.scheduler.stop_warms()  # don't drain queued compiles at exit
+        close = getattr(self.scheduler, "close", None)
+        if close is not None:  # RemoteScheduler: release the gRPC channel
+            close()
         self.stop_http()
 
 
@@ -446,7 +465,8 @@ def _demo(args) -> None:
     clock = FakeClock()
     cloud = FakeCloudProvider(generate_catalog(full=not args.small), clock=clock)
     op = Operator(cloud, clock=clock, scheduler_backend=args.backend,
-                  metrics_port=args.metrics_port)
+                  metrics_port=args.metrics_port,
+                  solver_address=getattr(args, "solver_address", ""))
     port = op.start_http()
     if port:
         print(f"metrics on http://127.0.0.1:{port}/metrics")
@@ -500,6 +520,11 @@ def main(argv=None) -> int:
     parser.add_argument("--small", action="store_true", help="20-type catalog")
     parser.add_argument("--backend", default="oracle", choices=["auto", "tpu", "oracle"])
     parser.add_argument("--metrics-port", type=int, default=0)
+    parser.add_argument("--solver-address",
+                        default=os.environ.get("KARPENTER_SOLVER_ADDR", ""),
+                        help="host:port of a solver sidecar (service.server); "
+                             "empty solves in-process; defaults from "
+                             "KARPENTER_SOLVER_ADDR (deploy/operator.yaml)")
     parser.add_argument("--config", default="",
                         help="YAML manifest file/dir (Provisioners, "
                              "NodeTemplates, settings) loaded through admission")

@@ -2,22 +2,38 @@
 
 ``RemoteScheduler`` is a drop-in for ``solver.scheduler.BatchScheduler`` so
 controllers can point at a sidecar instead of solving in-process (the
-reconciler <-> solver split of the north star).
+reconciler <-> solver split of the north star; the reference consumes its
+remote boundary the same way — ``cloudprovider.New(awsCtx)`` at
+cmd/controller/main.go:44 is handed to every control loop).  The facade
+contract (same methods, same signatures) is asserted by
+tests/test_service.py::test_facade_contract so any drift between the two
+schedulers fails CI, not production.
 """
 
 from __future__ import annotations
 
+import logging
+import time
 from typing import Optional, Sequence, Set
 
 import grpc
 
+from ..metrics import Registry, registry as default_registry
 from ..models.instancetype import InstanceType
 from ..models.pod import PodSpec
 from ..models.provisioner import Provisioner
+from ..solver.scheduler import BatchScheduler
 from ..solver.types import SimNode, SolveResult
 from . import codec
 from . import solver_pb2 as pb
 from .server import SERVICE
+
+logger = logging.getLogger(__name__)
+
+#: counter: solves served by the local fallback because the sidecar was down
+REMOTE_FALLBACK_SOLVES = "karpenter_solver_remote_fallback_solves_total"
+#: gauge: 1 while the remote solver is considered unreachable
+REMOTE_DEGRADED = "karpenter_solver_remote_degraded"
 
 
 class SolverClient:
@@ -33,29 +49,117 @@ class SolverClient:
             request_serializer=pb.SolveRequest.SerializeToString,
             response_deserializer=pb.SolveResponse.FromString,
         )
+        self._warm = self.channel.unary_unary(
+            f"/{SERVICE}/Warm",
+            request_serializer=pb.WarmRequest.SerializeToString,
+            response_deserializer=pb.WarmResponse.FromString,
+        )
         self._health = self.channel.unary_unary(
             f"/{SERVICE}/Health",
             request_serializer=pb.HealthRequest.SerializeToString,
             response_deserializer=pb.HealthResponse.FromString,
         )
 
-    def health(self) -> pb.HealthResponse:
-        return self._health(pb.HealthRequest(), timeout=self.timeout)
+    def health(self, timeout: Optional[float] = None) -> pb.HealthResponse:
+        return self._health(pb.HealthRequest(), timeout=timeout or self.timeout)
 
     def solve_raw(self, request: pb.SolveRequest) -> pb.SolveResponse:
         return self._solve(request, timeout=self.timeout)
+
+    def warm_raw(self, request: pb.WarmRequest) -> pb.WarmResponse:
+        return self._warm(request, timeout=self.timeout)
 
     def close(self) -> None:
         self.channel.close()
 
 
 class RemoteScheduler:
-    """BatchScheduler-compatible facade over the sidecar."""
+    """BatchScheduler-compatible facade over the sidecar.
 
-    def __init__(self, target: str, backend: str = "", timeout: float = 60.0) -> None:
+    Availability semantics: when the sidecar is unreachable, ``solve`` falls
+    back to a LOCAL solve (oracle backend by default) so the control plane
+    keeps reconciling — scale-up must not stall on a solver rollout.  After a
+    failure the remote path is considered degraded; it is retried only
+    through a cheap Health probe at most once per ``reconnect_interval``
+    seconds (health-gated reconnect), so a down sidecar costs one probe per
+    interval, not one deadline-wait per solve.
+    """
+
+    #: seconds between Health probes while degraded
+    RECONNECT_INTERVAL = 5.0
+    #: deadline for the Health probe itself — must be snappy: it sits on the
+    #: reconcile path while degraded
+    PROBE_TIMEOUT = 2.0
+
+    def __init__(
+        self,
+        target: str,
+        backend: str = "",
+        timeout: float = 60.0,
+        *,
+        fallback: Optional[BatchScheduler] = None,
+        reconnect_interval: float = RECONNECT_INTERVAL,
+        registry: Optional[Registry] = None,
+    ) -> None:
         self.client = SolverClient(target, timeout=timeout)
+        self.target = target
         self.backend = backend
+        self.mesh = None  # the device mesh lives sidecar-side
+        self.registry = registry or default_registry
+        self.fallback = fallback or BatchScheduler(
+            backend="oracle", registry=self.registry
+        )
+        self.reconnect_interval = reconnect_interval
+        self._degraded_since: Optional[float] = None
+        self._last_probe = 0.0
 
+    #: RPC status codes that mean "the sidecar is not reachable right now".
+    #: Anything else (UNIMPLEMENTED from an older sidecar's missing Warm
+    #: handler, INTERNAL on one bad request, ...) must NOT poison the Solve
+    #: path: that call falls back / returns 0, the next one goes remote.
+    TRANSPORT_CODES = (grpc.StatusCode.UNAVAILABLE,
+                       grpc.StatusCode.DEADLINE_EXCEEDED)
+
+    # ---- degradation state ------------------------------------------------
+    def degraded(self) -> bool:
+        return self._degraded_since is not None
+
+    def _transport_failure(self, err: grpc.RpcError) -> bool:
+        code = err.code() if callable(getattr(err, "code", None)) else None
+        return code in self.TRANSPORT_CODES
+
+    def _mark_degraded(self, err: Exception) -> None:
+        if self._degraded_since is None:
+            logger.warning("solver sidecar %s unreachable (%s); "
+                           "falling back to local %s solves", self.target,
+                           getattr(err, "code", lambda: err)(),
+                           self.fallback.backend)
+        self._degraded_since = time.monotonic()
+        self._last_probe = self._degraded_since
+        self.registry.gauge(REMOTE_DEGRADED).set(1)
+
+    def _remote_ok(self) -> bool:
+        """True when the remote path should be attempted: healthy, or
+        degraded but due for a (successful) health probe."""
+        if self._degraded_since is None:
+            return True
+        now = time.monotonic()
+        if now - self._last_probe < self.reconnect_interval:
+            return False
+        self._last_probe = now
+        try:
+            ok = bool(self.client.health(timeout=self.PROBE_TIMEOUT).ok)
+        except grpc.RpcError:
+            return False
+        if ok:
+            logger.info("solver sidecar %s back after %.1fs; resuming remote "
+                        "solves", self.target,
+                        now - (self._degraded_since or now))
+            self._degraded_since = None
+            self.registry.gauge(REMOTE_DEGRADED).set(0)
+        return ok
+
+    # ---- BatchScheduler surface -------------------------------------------
     def solve(
         self,
         pods: Sequence[PodSpec],
@@ -68,16 +172,75 @@ class RemoteScheduler:
         allow_new_nodes: bool = True,
         max_new_nodes: Optional[int] = None,
     ) -> SolveResult:
-        req = codec.encode_request(
+        if self._remote_ok():
+            req = codec.encode_request(
+                pods, provisioners, instance_types,
+                existing_nodes=existing_nodes, daemonsets=daemonsets,
+                unavailable=unavailable, allow_new_nodes=allow_new_nodes,
+                max_new_nodes=max_new_nodes, backend=self.backend,
+            )
+            try:
+                resp = self.client.solve_raw(req)
+            except grpc.RpcError as err:
+                if self._transport_failure(err):
+                    self._mark_degraded(err)
+                else:
+                    logger.warning("remote solve failed (%s); serving this "
+                                   "solve from the local fallback",
+                                   err.code(), exc_info=True)
+            else:
+                result = codec.decode_response(resp)
+                # re-attach real PodSpecs to returned nodes (wire carries
+                # names only)
+                by_name = {p.name: p for p in pods}
+                for node in result.nodes:
+                    node.pods = [by_name.get(p.name, p) for p in node.pods]
+                return result
+        self.registry.counter(REMOTE_FALLBACK_SOLVES).inc()
+        return self.fallback.solve(
             pods, provisioners, instance_types,
             existing_nodes=existing_nodes, daemonsets=daemonsets,
             unavailable=unavailable, allow_new_nodes=allow_new_nodes,
-            max_new_nodes=max_new_nodes, backend=self.backend,
+            max_new_nodes=max_new_nodes,
         )
-        resp = self.client.solve_raw(req)
-        result = codec.decode_response(resp)
-        # re-attach real PodSpecs to returned nodes (wire carries names only)
-        by_name = {p.name: p for p in pods}
-        for node in result.nodes:
-            node.pods = [by_name.get(p.name, p) for p in node.pods]
-        return result
+
+    def warm_startup(
+        self,
+        provisioners,
+        instance_types,
+        daemonsets: Sequence[PodSpec] = (),
+        existing_nodes: Sequence[SimNode] = (),
+        profiles=None,
+    ) -> int:
+        """Forward the live cluster shape to the sidecar so IT pre-compiles
+        the ladder (compiles belong next to the chips).  Best-effort like the
+        local warmup: an unreachable sidecar degrades the remote path and
+        returns 0 — solves still work via the fallback.  ``profiles`` stays
+        sidecar-side (the wire carries the cluster, not the rungs)."""
+        if not self._remote_ok():
+            return 0
+        req = codec.encode_warm_request(
+            provisioners, instance_types, daemonsets=daemonsets,
+            existing_nodes=existing_nodes, backend=self.backend,
+        )
+        try:
+            return int(self.client.warm_raw(req).started)
+        except grpc.RpcError as err:
+            if self._transport_failure(err):
+                self._mark_degraded(err)
+            else:
+                # e.g. UNIMPLEMENTED from a pre-Warm sidecar during a rolling
+                # upgrade: warmup is best-effort, Solve still works — do not
+                # degrade the solve path over it
+                logger.debug("remote warm_startup failed (%s); skipping",
+                             err.code())
+            return 0
+
+    def stop_warms(self) -> None:
+        """Operator shutdown: stop the LOCAL fallback's background compiles.
+        The sidecar owns its own compile lifecycle (it stops warms when its
+        process stops), so nothing is sent remotely."""
+        self.fallback.stop_warms()
+
+    def close(self) -> None:
+        self.client.close()

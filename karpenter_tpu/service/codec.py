@@ -139,6 +139,21 @@ def encode_request(
     return req
 
 
+def encode_warm_request(
+    provisioners: Sequence[Provisioner],
+    instance_types: Sequence[InstanceType],
+    daemonsets: Sequence[PodSpec] = (),
+    existing_nodes: Sequence[SimNode] = (),
+    backend: str = "",
+) -> pb.WarmRequest:
+    req = pb.WarmRequest(backend=backend)
+    req.provisioners.extend(encode_provisioner(p) for p in provisioners)
+    req.instance_types.extend(encode_instance_type(t) for t in instance_types)
+    req.daemonsets.extend(encode_pod(p) for p in daemonsets)
+    req.existing_nodes.extend(encode_node(n) for n in existing_nodes)
+    return req
+
+
 def encode_response(result: SolveResult) -> pb.SolveResponse:
     out = pb.SolveResponse(solve_ms=result.solve_ms)
     for n in result.nodes:
@@ -252,6 +267,15 @@ def decode_request(req: pb.SolveRequest):
         unavailable={(u.instance_type, u.zone, u.capacity_type) for u in req.unavailable},
         allow_new_nodes=req.allow_new_nodes,
         max_new_nodes=req.max_new_nodes if req.has_max_new_nodes else None,
+    )
+
+
+def decode_warm_request(req: pb.WarmRequest):
+    return dict(
+        provisioners=[decode_provisioner(p) for p in req.provisioners],
+        instance_types=[decode_instance_type(t) for t in req.instance_types],
+        daemonsets=[decode_pod(p) for p in req.daemonsets],
+        existing_nodes=[decode_node(n) for n in req.existing_nodes],
     )
 
 

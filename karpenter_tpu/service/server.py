@@ -50,6 +50,18 @@ class SolverService:
         )
         return codec.encode_response(result)
 
+    def Warm(self, request: pb.WarmRequest, context) -> pb.WarmResponse:
+        """Forwarded warm_startup: the operator ships its live provisioners,
+        catalog, and cluster snapshots; compiles run behind on the sidecar's
+        chips (BatchScheduler.warm_startup semantics, including signature
+        dedupe, so repeated Warm calls are cheap)."""
+        kwargs = codec.decode_warm_request(request)
+        sched = self._scheduler_for(request.backend)
+        started = sched.warm_startup(
+            kwargs.pop("provisioners"), kwargs.pop("instance_types"), **kwargs
+        )
+        return pb.WarmResponse(started=started)
+
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         import jax
 
@@ -62,6 +74,7 @@ def make_server(
     service: Optional[SolverService] = None,
     port: int = 0,
     max_workers: int = 4,
+    host: str = "127.0.0.1",
 ) -> "tuple[grpc.Server, int]":
     service = service or SolverService()
     handlers = {
@@ -69,6 +82,11 @@ def make_server(
             service.Solve,
             request_deserializer=pb.SolveRequest.FromString,
             response_serializer=pb.SolveResponse.SerializeToString,
+        ),
+        "Warm": grpc.unary_unary_rpc_method_handler(
+            service.Warm,
+            request_deserializer=pb.WarmRequest.FromString,
+            response_serializer=pb.WarmResponse.SerializeToString,
         ),
         "Health": grpc.unary_unary_rpc_method_handler(
             service.Health,
@@ -84,7 +102,7 @@ def make_server(
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE, handlers),)
     )
-    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
     return server, bound
 
@@ -92,16 +110,22 @@ def make_server(
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="karpenter-tpu-solver")
     parser.add_argument("--port", type=int, default=50151)
+    # 0.0.0.0: the deployed topology dials this across pods
+    # (deploy/operator.yaml -> Service karpenter-tpu-solver); loopback would
+    # strand the operator on its local fallback forever
+    parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--backend", default="auto", choices=["auto", "tpu", "oracle"])
     args = parser.parse_args(argv)
     service = SolverService(BatchScheduler(backend=args.backend))
-    server, port = make_server(service, port=args.port)
-    print(f"solver sidecar listening on 127.0.0.1:{port} (backend={args.backend})")
+    server, port = make_server(service, port=args.port, host=args.host)
+    print(f"solver sidecar listening on {args.host}:{port} (backend={args.backend})")
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         server.stop(grace=2.0)
+        for sched in service._schedulers.values():
+            sched.stop_warms()
     return 0
 
 

@@ -127,6 +127,43 @@ class TestGrpc:
             names = {p.name for n in res.nodes for p in n.pods}
             assert names == {f"c{i}-p{j}" for j in range(10)}
 
+    def test_warm_rpc_forwards_cluster_shape(self, small_catalog):
+        """Warm ships provisioners/catalog/cluster snapshots to the sidecar
+        and returns how many compiles it accepted — the wire analog of
+        warm_startup, so the operator's compile-behind works split."""
+        calls = {}
+
+        class RecordingScheduler(BatchScheduler):
+            def warm_startup(self, provisioners, instance_types,
+                             daemonsets=(), existing_nodes=(), profiles=None):
+                calls["provisioners"] = [p.name for p in provisioners]
+                calls["n_types"] = len(instance_types)
+                calls["n_existing"] = len(existing_nodes)
+                return 3
+
+        service = SolverService(RecordingScheduler(backend="oracle"))
+        srv, port = make_server(service, port=0)
+        try:
+            from karpenter_tpu.solver.types import SimNode
+
+            remote = RemoteScheduler(f"127.0.0.1:{port}")
+            existing = [SimNode(
+                instance_type=small_catalog[0].name, provisioner="default",
+                zone="zone-1a", capacity_type="on-demand", price=1.0,
+                allocatable=dict(small_catalog[0].allocatable), existing=True,
+                name="n-0",
+            )]
+            started = remote.warm_startup(
+                [Provisioner(name="default").with_defaults()], small_catalog,
+                existing_nodes=existing,
+            )
+            assert started == 3
+            assert calls == {"provisioners": ["default"],
+                             "n_types": len(small_catalog), "n_existing": 1}
+            remote.close()
+        finally:
+            srv.stop(grace=None)
+
     def test_remote_respects_unavailable(self, server, small_catalog):
         pods = [PodSpec(name="p", requests={"cpu": 1.0, "memory": 2**30})]
         prov = Provisioner(name="default").with_defaults()
@@ -137,3 +174,111 @@ class TestGrpc:
         result = remote.solve(pods, [prov], small_catalog, unavailable=ice)
         assert result.infeasible == {}
         assert result.nodes[0].instance_type != base.nodes[0].instance_type
+
+
+class TestFacadeContract:
+    """RemoteScheduler must stay a drop-in for BatchScheduler: the operator
+    swaps one for the other on --solver-address, so any signature drift
+    between them is a production crash.  This test IS the contract."""
+
+    SURFACE = ("solve", "warm_startup", "stop_warms")
+
+    def test_signatures_match(self):
+        import inspect
+
+        for name in self.SURFACE:
+            local = inspect.signature(getattr(BatchScheduler, name))
+            remote = inspect.signature(getattr(RemoteScheduler, name))
+            assert list(local.parameters) == list(remote.parameters), (
+                f"{name}: parameter drift between BatchScheduler and "
+                f"RemoteScheduler"
+            )
+            for p in local.parameters.values():
+                q = remote.parameters[p.name]
+                assert p.kind == q.kind, f"{name}({p.name}): kind drift"
+                assert p.default == q.default, f"{name}({p.name}): default drift"
+
+    def test_shared_attributes(self, server):
+        remote = RemoteScheduler(f"127.0.0.1:{server}")
+        local = BatchScheduler(backend="oracle")
+        # the attributes the operator and controllers actually read
+        for attr in ("backend", "mesh", "registry"):
+            assert hasattr(remote, attr) and hasattr(local, attr), attr
+        remote.close()
+
+
+class TestFallback:
+    def _pods(self, n=8):
+        return [PodSpec(name=f"p{i}", requests={"cpu": 1.0}, owner_key="d")
+                for i in range(n)]
+
+    def test_solve_falls_back_when_unreachable(self, small_catalog):
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.service.client import REMOTE_FALLBACK_SOLVES
+
+        reg = Registry()
+        # nothing listens on port 1; keep the probe interval long so the
+        # second solve skips straight to the fallback without re-probing
+        remote = RemoteScheduler("127.0.0.1:1", timeout=2.0,
+                                 reconnect_interval=600.0, registry=reg)
+        prov = Provisioner(name="default").with_defaults()
+        result = remote.solve(self._pods(), [prov], small_catalog)
+        assert result.infeasible == {} and result.n_scheduled == 8
+        assert remote.degraded()
+        assert reg.counter(REMOTE_FALLBACK_SOLVES).get() == 1
+        # degraded warm_startup is a cheap no-op, not an RPC deadline wait
+        assert remote.warm_startup([prov], small_catalog) == 0
+        remote.solve(self._pods(), [prov], small_catalog)
+        assert reg.counter(REMOTE_FALLBACK_SOLVES).get() == 2
+        remote.close()
+
+    def test_health_gated_reconnect(self, server, small_catalog):
+        remote = RemoteScheduler(f"127.0.0.1:{server}", reconnect_interval=0.0)
+        prov = Provisioner(name="default").with_defaults()
+        # simulate a past outage: degraded, but the sidecar is healthy now
+        remote._mark_degraded(RuntimeError("injected outage"))
+        assert remote.degraded()
+        result = remote.solve(self._pods(), [prov], small_catalog)
+        assert result.infeasible == {} and result.n_scheduled == 8
+        assert not remote.degraded()  # probe succeeded -> remote path resumed
+        remote.close()
+
+    def test_warm_unimplemented_does_not_degrade(self, small_catalog):
+        """Rolling upgrade: a pre-Warm sidecar answers UNIMPLEMENTED to Warm.
+        Warmup is best-effort — the Solve path must stay remote."""
+        from concurrent import futures
+
+        import grpc
+
+        from karpenter_tpu.service import solver_pb2 as pb
+        from karpenter_tpu.service.server import SERVICE
+
+        service = SolverService(BatchScheduler(backend="oracle"))
+        handlers = {  # Solve + Health only: no Warm handler registered
+            "Solve": grpc.unary_unary_rpc_method_handler(
+                service.Solve,
+                request_deserializer=pb.SolveRequest.FromString,
+                response_serializer=pb.SolveResponse.SerializeToString,
+            ),
+            "Health": grpc.unary_unary_rpc_method_handler(
+                service.Health,
+                request_deserializer=pb.HealthRequest.FromString,
+                response_serializer=pb.HealthResponse.SerializeToString,
+            ),
+        }
+        srv = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        srv.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        port = srv.add_insecure_port("127.0.0.1:0")
+        srv.start()
+        try:
+            remote = RemoteScheduler(f"127.0.0.1:{port}")
+            prov = Provisioner(name="default").with_defaults()
+            assert remote.warm_startup([prov], small_catalog) == 0
+            assert not remote.degraded()  # UNIMPLEMENTED is not an outage
+            result = remote.solve(self._pods(), [prov], small_catalog)
+            assert result.infeasible == {} and result.n_scheduled == 8
+            assert not remote.degraded()
+            remote.close()
+        finally:
+            srv.stop(grace=None)
