@@ -171,14 +171,19 @@ class ProvisioningController:
                     hist.observe(max(0.0, now - t0))
 
     def _update_limit_gauges(self) -> None:
-        """Per-provisioner usage vs configured limits (metrics.md gauges)."""
+        """Per-provisioner usage vs configured limits (metrics.md gauges).
+        Usage counts raw machine CAPACITY — the same accounting every solver
+        enforces the limit with (reference.py/tpu.py/native.py), so the
+        exported headroom matches what scheduling will actually allow."""
+        raw_cap = {it.name: it.capacity for it in self.cloud.get_instance_types()}
         usage: dict = {}
         for ns in self.state.nodes.values():
             prov_name = ns.node.labels.get(L.PROVISIONER_NAME, "")
             if not prov_name:
                 continue
             per = usage.setdefault(prov_name, {})
-            for rname, v in ns.node.allocatable.items():
+            cap = raw_cap.get(ns.node.instance_type, ns.node.allocatable)
+            for rname, v in cap.items():
                 per[rname] = per.get(rname, 0.0) + v
         for prov_name, prov in self.state.provisioners.items():
             for rname, v in usage.get(prov_name, {}).items():

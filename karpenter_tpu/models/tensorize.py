@@ -154,6 +154,23 @@ class SolveTensors:
     def S(self) -> int:
         return self.g_sel_match.shape[0]
 
+    def capacity_row(self, instance_type: str, allocatable) -> np.ndarray:
+        """Raw machine-capacity row for an existing node's type — provisioner
+        limits bind on CAPACITY, not allocatable (the creation-time checks and
+        the ground-truth validator both use it); falls back to the node's own
+        allocatable for types outside the catalog.  Single accounting rule
+        shared by the device and native solvers (the oracle applies the same
+        rule over its dict representation)."""
+        cache = getattr(self, "_type_cap", None)
+        if cache is None:
+            cache = {it: self.cand_cap[ci]
+                     for ci, (_p, it) in enumerate(self.cand_names)}
+            self._type_cap = cache
+        row = cache.get(instance_type)
+        if row is None:
+            row = self.vocab.resources_to_row(allocatable)
+        return np.asarray(row, dtype=np.float32)
+
 
 def device_inexpressible(pod: PodSpec) -> bool:
     """Positive-affinity shapes the device solver can't express (v1): more

@@ -173,12 +173,15 @@ def solve_tensors_native(
     ex_ok = np.zeros((G, max(1, NE)), dtype=np.uint8)
     zc0 = np.zeros((S, Z), dtype=np.int32)
     prov_used0 = np.zeros((P, R), dtype=np.float32)
+    # limits bind on raw machine CAPACITY (st.capacity_row) — same accounting
+    # as the device solver and the oracle (fuzz seed 23)
     for ni, node in enumerate(existing_nodes):
         ex_res[ni] = st.vocab.resources_to_row(node.remaining()).astype(np.float32)
         ex_zone[ni] = zone_index.get(node.zone, 0)
         pi = prov_index.get(node.provisioner)
         if pi is not None:
-            prov_used0[pi] += st.vocab.resources_to_row(node.allocatable).astype(np.float32)
+            prov_used0[pi] += st.capacity_row(node.instance_type,
+                                              node.allocatable)
         for gi, g in enumerate(st.groups):
             rep = g.pods[0]
             ex_ok[gi, ni] = (

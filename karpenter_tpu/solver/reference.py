@@ -105,11 +105,17 @@ class _Solver:
                 if o.zone not in self.all_zones:
                     self.all_zones.append(o.zone)
 
+        # limits bind on raw machine CAPACITY (the validator and creation-time
+        # checks both use it.capacity); counting existing nodes at allocatable
+        # under-counts their usage by the reserved overhead and lets the last
+        # new node overshoot the limit (fuzz seed 23)
+        raw_cap = {it.name: it.capacity for _, _, it, _ in self.pairs}
         for n in existing_nodes:
+            cap = raw_cap.get(n.instance_type, n.allocatable)
             self.prov_usage[n.provisioner] = add(
                 self.prov_usage[n.provisioner],
-                {L.RESOURCE_CPU: n.allocatable.get(L.RESOURCE_CPU, 0.0),
-                 L.RESOURCE_MEMORY: n.allocatable.get(L.RESOURCE_MEMORY, 0.0)},
+                {L.RESOURCE_CPU: cap.get(L.RESOURCE_CPU, 0.0),
+                 L.RESOURCE_MEMORY: cap.get(L.RESOURCE_MEMORY, 0.0)},
             )
             for p in n.pods:
                 self.topo.observe(p, n.zone, n.name, self.selectors)

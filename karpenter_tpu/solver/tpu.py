@@ -198,8 +198,8 @@ def _make_step(
 ):
     """Build the per-group scan step closure over constant tensors."""
     counts = consts["counts"]          # [G]
-    suffix_res = consts["suffix_res"]  # [G, R] later-group resource demand
-    suffix_cnt = consts["suffix_cnt"]  # [G] later-group pod count
+    suffix_res = consts["suffix_res"]  # [G, Z, R] later-group demand per zone
+    suffix_cnt = consts["suffix_cnt"]  # [G, Z] later-group pod count per zone
     requests = consts["requests"]      # [G, R]
     F = consts["F"]                    # [G, C]
     dom_ok = consts["dom_ok"]          # [G, D]
@@ -274,23 +274,40 @@ def _make_step(
         # an empty node never satisfies mode-A/C hostname affinity
         new_allowed = ~host_gated & ~hdead & ~zdead
 
-        # step-entry NET-backfill fraction for tail picks (see pick()):
-        # how much of the later-group demand the FREE capacity on open rows
-        # absorbs, in units of the average later-pod request vector.  Hoisted
-        # here — it depends only on the step-entry carry (pick() closes over
-        # this `res`, not the threaded creation state), and the [NR, R]
-        # reduction is the most memory-heavy term in the scoring path.
-        avg_req = suffix_res[g] / jnp.maximum(suffix_cnt[g], 1.0)       # [R]
+        # step-entry PER-ZONE net-backfill state for pick(): how much of the
+        # later-group demand committed to each zone the FREE capacity on that
+        # zone's open rows absorbs, in units of the zone's average later-pod
+        # request vector.  Per-zone on both sides (fuzz seed 14): a huge free
+        # row in zone c must not cancel the backfill credit of zones a/b,
+        # whose committed spread-group share can only land on nodes bought
+        # THERE.  Hoisted here — it depends only on the step-entry carry
+        # (pick() closes over this `res`, not the threaded creation state),
+        # and the [NR, R] reduction is the most memory-heavy scoring term.
+        # zero-guard only (not a floor): the even zone split makes per-zone
+        # counts FRACTIONAL, and flooring a 1/3-pod count at 1 would shrink
+        # the average request (and the net fraction below) threefold
+        cnt_z_safe = jnp.where(suffix_cnt[g] > 0, suffix_cnt[g], 1.0)    # [Z]
+        avg_req_z = suffix_res[g] / cnt_z_safe[:, None]                  # [Z, R]
+        row_avg = avg_req_z[jnp.maximum(row_zone, 0)]                   # [NR, R]
         per_row_absorb = jnp.min(jnp.where(
-            avg_req[None, :] > 0,
-            jnp.maximum(res, 0.0) / jnp.maximum(avg_req[None, :], 1e-9),
+            row_avg > 0,
+            jnp.maximum(res, 0.0) / jnp.maximum(row_avg, 1e-9),
             BIGN,
         ), axis=1)                                                      # [NR]
-        rows_absorb = jnp.sum(jnp.where(active, per_row_absorb, 0.0))
-        net_backfill_frac = jnp.clip(
-            (suffix_cnt[g] - rows_absorb) / jnp.maximum(suffix_cnt[g], 1.0),
+        rows_absorb_z = jnp.zeros(Z, dtype=jnp.float32).at[
+            jnp.maximum(row_zone, 0)
+        ].add(jnp.where(active, per_row_absorb, 0.0))                   # [Z]
+        net_backfill_frac_z = jnp.clip(
+            (suffix_cnt[g] - rows_absorb_z) / cnt_z_safe,
             0.0, 1.0,
-        )
+        )                                                               # [Z]
+        # later-group demand convertible into THIS group's pod-equivalents,
+        # per zone (hoisted from pick(): depends only on g)
+        backfill_eq_z = jnp.min(jnp.where(
+            req_g[None, :] > 0,
+            suffix_res[g] / jnp.maximum(req_g[None, :], 1e-9),
+            BIGN,
+        ), axis=1)                                                      # [Z]
 
         ratios = jnp.where(req_g[None, :] > 0, jnp.floor((res + 1e-6) / jnp.maximum(req_g[None, :], 1e-9)), BIGN)
         cap = jnp.min(ratios, axis=1)            # [NR]
@@ -368,10 +385,12 @@ def _make_step(
                 axis=1,
             )
 
-        def pick(rem, dom_mask, prov_used_cur, tail_rem=None, size_tiebreak=True):
+        def pick(rem, dom_mask, prov_used_cur, tail_rem=None,
+                 size_tiebreak=True, pool_rem=None):
             """argmin over (C, D & dom_mask) of price / min(fill, rem),
-            where fill = min(ppn, take_pn + later-group demand) — the
-            backfill-aware effective pods-per-node (see comment below).
+            where fill = min(ppn, take_pn + later-group demand committed to
+            the candidate domain's ZONE) — the backfill-aware effective
+            pods-per-node (see comment below).
 
             Limit feasibility is recomputed from the *current* provisioner
             usage so once a limit binds mid-group the next pick falls back to
@@ -380,25 +399,26 @@ def _make_step(
             ok_cd = new_ok_nolim & _lim_ok_cur(prov_used_cur)[:, None] & dom_mask[None, :]
             # Effective fill for scoring: this group fills take_pn per node
             # (hostname caps included); slack beyond that is only worth
-            # paying for when LATER groups exist to backfill it.  The oracle
-            # scores resource-only ppn because its sequential interleave
-            # always has backfill in flight; here the later-group RESOURCE
-            # demand (converted to this-group pod equivalents) makes that
-            # optimism explicit — a hostname-capped group solved last buys
-            # right-sized nodes instead of betting on backfill that never
-            # comes (fuzz seeds 14/20), while capped groups with real later
-            # demand still buy big co-location nodes (bench c3).
-            backfill_eq = jnp.min(jnp.where(
-                req_g > 0, suffix_res[g] / jnp.maximum(req_g, 1e-9), BIGN
-            ))
-            # the backfill pool is shared across every node this group will
-            # create: per-node slack is only worth what the pool can deliver
-            # to ONE node.  The node-count estimate is rem/take_pn CLAMPED by
-            # how many nodes the provisioner limit can still fund — when the
-            # limit tail binds (one node left), the whole pool concentrates
-            # on it, and a roomier type is worth its price premium (the
-            # sequential oracle gets this for free: its tail placement sees
-            # every group's residual at once; fuzz seed 27).
+            # paying for when LATER groups exist to backfill it IN THIS
+            # ZONE.  The oracle scores resource-only ppn because its
+            # sequential interleave always has backfill in flight; here the
+            # later-group RESOURCE demand committed to the candidate's zone
+            # (converted to this-group pod equivalents, backfill_eq_z) makes
+            # that optimism explicit and zone-local — a hostname-capped
+            # group solved last buys right-sized nodes instead of betting on
+            # backfill that never comes (fuzz seeds 14/20), while capped
+            # groups with real later demand still buy big co-location nodes
+            # (bench c3).
+            # The zone's backfill pool is shared across every node this
+            # group will create there: per-node slack is only worth what the
+            # pool can deliver to ONE node.  The node-count estimate is
+            # pool_rem/take_pn (the creation remainder this pick serves —
+            # the zone's share under zoned creation) CLAMPED by how many
+            # nodes the provisioner limit can still fund — when the limit
+            # tail binds (one node left), the whole pool concentrates on it,
+            # and a roomier type is worth its price premium (the sequential
+            # oracle gets this for free: its tail placement sees every
+            # group's residual at once; fuzz seed 27).
             head_nodes = jnp.min(
                 jnp.floor(
                     (prov_limits[cand_prov] - prov_used_cur[cand_prov] + 1e-6)
@@ -406,51 +426,61 @@ def _make_step(
                 ),
                 axis=1,
             )                                                        # [C]
+            est_rem = rem if pool_rem is None else pool_rem
             n_nodes_est = jnp.clip(
-                jnp.minimum(rem / jnp.maximum(take_pn, 1.0),
+                jnp.minimum(est_rem / jnp.maximum(take_pn, 1.0),
                             jnp.clip(head_nodes, 0.0, BIGN)),
                 1.0, BIGN,
-            )
-            per_node_backfill = backfill_eq / n_nodes_est
-            fill = jnp.minimum(ppn, take_pn + per_node_backfill)
+            )                                                        # [C]
+            per_node_backfill = (
+                backfill_eq_z[dom_zone][None, :] / n_nodes_est[:, None]
+            )                                                        # [C, D]
+            fill = jnp.minimum(ppn[:, None], take_pn[:, None] + per_node_backfill)
             denom = jnp.maximum(jnp.minimum(fill, jnp.maximum(rem, 1.0)), 1.0)
+            pnb_net = per_node_backfill * net_backfill_frac_z[dom_zone][None, :]
             if tail_rem is not None:
                 # TAIL purchases are the oracle's last-pods-standing buys:
                 # cap the utilization estimate additionally by the zone's
-                # own tail count plus only the NET backfill — later-group
-                # demand minus what the free capacity on open rows absorbs
-                # first (later groups first-fit free rows, so gross suffix
-                # demand over-credits a tail node — fuzz seed 14's 8x node
-                # for a 2-pod tail; but when rows are full or a limit
-                # squeezes later demand onto this very node, the credit is
-                # real — fuzz seed 27's 2-cpu tail).  Rows absorb in units
-                # of the average later-pod request vector (resource-coupled:
+                # own tail count plus only the NET backfill — the zone-
+                # committed later-group demand minus what the free capacity
+                # on THAT ZONE's open rows absorbs first (later groups
+                # first-fit free rows, so gross suffix demand over-credits a
+                # tail node — fuzz seed 14's 8x node for a 2-pod tail; but
+                # when the zone's rows are full or a limit squeezes later
+                # demand onto this very node, the credit is real — fuzz
+                # seed 27's 2-cpu tail).  Rows absorb in units of their
+                # zone's average later-pod request vector (resource-coupled:
                 # free memory with no free cpu absorbs nothing).
-                pnb_net = per_node_backfill * net_backfill_frac
                 denom = jnp.maximum(
                     jnp.minimum(
                         denom, jnp.maximum(tail_rem, 1.0) + pnb_net
                     ),
                     1.0,
                 )
-            score = jnp.where(ok_cd, cand_price / denom[:, None], BIG)
+            score = jnp.where(ok_cd, cand_price / denom, BIG)
             # tie-break at exactly equal $/pod: prefer the LARGER candidate,
             # but only when this group's own remainder fills it completely
             # (take_pn <= rem) — then the $ outcome is identical by
             # construction and the cluster gets fewer, larger nodes (less
             # kubelet/API/image-pull/ENI load at the same price).
             # Partially-fillable candidates never win the tie: their equal
-            # score rests on backfill estimates, not on guaranteed $.  For
-            # TAIL picks the guard compares against the zone's own tail
-            # count (tail_rem), not the group-wide scoring remainder — a
-            # tail that only half-fills the bigger node must not buy it on
-            # a backfill-induced score tie.  The host-seed flow opts out
-            # entirely (size_tiebreak=False): it buys exactly ONE node
-            # either way, so a larger type is strictly more $.
-            guard_rem = rem if tail_rem is None else tail_rem
+            # score rests on backfill estimates, not on guaranteed $ — even
+            # the per-zone projected credit must not upsize a tie, because
+            # when a provisioner limit binds, a node bought "for backfill"
+            # spends limit headroom later zones of THIS group still need
+            # (fuzz seed 27: a 16x tail node starves zone c below its skew
+            # band).  For TAIL picks the guard compares against the zone's
+            # own tail count (tail_rem), not the group-wide scoring
+            # remainder.  The host-seed flow opts out entirely
+            # (size_tiebreak=False): it buys exactly ONE node either way,
+            # so a larger type is strictly more $.
+            guard_rem = (
+                jnp.broadcast_to(jnp.maximum(rem, 1.0), (C, D))
+                if tail_rem is None
+                else jnp.broadcast_to(jnp.maximum(tail_rem, 1.0), (C, D))
+            )
             full_take = jnp.where(
-                take_pn[:, None] <= jnp.maximum(guard_rem, 1.0),
-                take_pn[:, None], 0.0,
+                take_pn[:, None] <= guard_rem, take_pn[:, None], 0.0,
             )
             if not size_tiebreak:
                 full_take = jnp.zeros_like(full_take)
@@ -618,14 +648,15 @@ def _make_step(
             3-zone-spread group still buys node types sized for the full
             group; scoring per-zone thirds buys smaller types and ~2x the
             node count at similar cost."""
-            bc, bd, ok = pick(score_rem, dom_mask, state[6])
+            bc, bd, ok = pick(score_rem, dom_mask, state[6], pool_rem=rem)
             ppn_b = jnp.maximum(take_pn[bc], 1.0)
             n_bulk_f = jnp.where(ok, jnp.floor(rem / ppn_b), 0.0)
             n_bulk = jnp.minimum(n_bulk_f, limit_headroom(state[6], bc)).astype(jnp.int32)
             state, took_b = write_block(state, n_bulk, ppn_b, ppn_b, bc, bd)
             rem_t = jnp.maximum(rem - took_b, 0.0)
             score_t = jnp.maximum(score_rem - took_b, rem_t)
-            ct_, dt_, ok_t = pick(score_t, dom_mask, state[6], tail_rem=rem_t)
+            ct_, dt_, ok_t = pick(score_t, dom_mask, state[6], tail_rem=rem_t,
+                                  pool_rem=rem_t)
             ppn_t = jnp.maximum(take_pn[ct_], 1.0)
             n_tail_f = jnp.where(ok_t & (rem_t > 0), jnp.ceil(rem_t / ppn_t), 0.0)
             n_tail = jnp.minimum(n_tail_f, limit_headroom(state[6], ct_)).astype(jnp.int32)
@@ -655,17 +686,25 @@ def _make_step(
                 # scan (not a Python loop) over zones: the two_stage creation
                 # body is traced ONCE instead of Z times, cutting the XLA
                 # program size — and thus compile time — roughly by the zone
-                # count for the creation section (the dominant traced code)
-                def zbody(carry, z):
-                    st_z, left = carry
-                    st_z = two_stage(st_z, rem_z[z], zone_of_dom == z,
-                                     score_rem=left)
-                    left = jnp.maximum(left - rem_z[z], 0.0)
-                    return (st_z, left), jnp.int32(0)
+                # count for the creation section (the dominant traced code).
+                # Every zone's BULK type choice scores against the group's
+                # FULL new-node demand (not a zone-decremented remainder):
+                # the sequential oracle interleaves zones, so each zone's
+                # first node is created while `remaining` is still the whole
+                # group — a later-ordered zone must not buy a smaller type
+                # (worse $/pod after the reserved-overhead staircase) just
+                # because the scan visited it second (fuzz seed 14).  Tail
+                # picks stay honest via tail_rem; an oversized bulk choice
+                # self-corrects (n_bulk floors to 0 and the tail re-scores).
+                total = jnp.sum(rem_z)
 
-                (state, _), _ = jax.lax.scan(
-                    zbody, (state, jnp.sum(rem_z)),
-                    jnp.arange(Z, dtype=jnp.int32),
+                def zbody(st_z, z):
+                    st_z = two_stage(st_z, rem_z[z], zone_of_dom == z,
+                                     score_rem=total)
+                    return st_z, jnp.int32(0)
+
+                state, _ = jax.lax.scan(
+                    zbody, state, jnp.arange(Z, dtype=jnp.int32),
                 )
                 return state
 
@@ -956,18 +995,39 @@ class TpuSolver:
             return np.pad(arr, widths, constant_values=value)
 
         np_counts = _pad(st.counts, pad_g, 0, 0)
-        # RESOURCE demand of LATER groups (suffix sum of count*request):
+        # PER-ZONE projection of later-group demand (suffix sums of
+        # count*request, distributed over each group's eligible zones):
         # the backfill available to fill slack on nodes bought for the
         # current group, in resource units — 50 tiny pods cannot justify a
-        # big node the way 50 same-sized pods can
+        # big node the way 50 same-sized pods can, and a later group
+        # zone-pinned (or hard-spread) elsewhere cannot justify THIS zone's
+        # node at all.  The sequential oracle gets this for free by
+        # replaying demand zone by zone (designs/bin-packing.md:28-43);
+        # here the zone share is an even split over the group's eligible
+        # zones (node_selector folds into group requirements), which is
+        # exactly what a hard DoNotSchedule spread commits and a
+        # conservative, pool-conserving estimate for flexible groups.
         np_requests = _pad(st.requests, pad_g, 0, 0)
         demand = (np_counts[:, None] * np_requests).astype(np.float32)   # [G, R]
+        zone_share = np.zeros((G + pad_g, Z), dtype=np.float32)
+        for gi, grp in enumerate(st.groups):
+            vs = grp.requirements.get(L.ZONE)
+            ok = np.zeros(Z, dtype=bool)
+            for zi, zname in enumerate(st.zone_names):
+                ok[zi] = vs.contains(zname)
+            if not ok.any():
+                ok[:] = True
+            zone_share[gi] = ok.astype(np.float32) / float(ok.sum())
+        demand_z = demand[:, None, :] * zone_share[:, :, None]           # [G, Z, R]
+        count_z = np_counts[:, None].astype(np.float32) * zone_share     # [G, Z]
         np_suffix_res = np.concatenate(
-            [np.cumsum(demand[::-1], axis=0)[::-1][1:], np.zeros((1, demand.shape[1]))]
-        ).astype(np.float32)                                             # [G, R]
+            [np.cumsum(demand_z[::-1], axis=0)[::-1][1:],
+             np.zeros((1,) + demand_z.shape[1:])]
+        ).astype(np.float32)                                             # [G, Z, R]
         np_suffix_cnt = np.concatenate(
-            [np.cumsum(np_counts[::-1])[::-1][1:], np.zeros(1)]
-        ).astype(np.float32)                                             # [G]
+            [np.cumsum(count_z[::-1], axis=0)[::-1][1:],
+             np.zeros((1, Z))]
+        ).astype(np.float32)                                             # [G, Z]
         np_pm = _pad(st.pm, pad_g, 0, 0)
         np_gzs = _pad(st.g_zone_spread, pad_g, 0, -1)
         np_gzk = _pad(st.g_zone_skew, pad_g, 0, 1)
@@ -1002,13 +1062,16 @@ class TpuSolver:
         prov_used0 = np.zeros((P_pad, R), dtype=np.float32)
         prov_index = {n: i for i, n in enumerate(st.prov_names)}
 
+        # limits bind on raw machine CAPACITY (st.capacity_row; the
+        # independent validator agrees) — fuzz seed 23
         for ni, node in enumerate(existing_nodes):
             ex_res[ni] = st.vocab.resources_to_row(node.remaining()).astype(np.float32)
             ex_zone[ni] = zone_index.get(node.zone, 0)
             ex_price[ni] = node.price
             pi = prov_index.get(node.provisioner)
             if pi is not None:
-                prov_used0[pi] += st.vocab.resources_to_row(node.allocatable).astype(np.float32)
+                prov_used0[pi] += st.capacity_row(node.instance_type,
+                                                  node.allocatable)
             for gi, g in enumerate(st.groups):
                 rep = g.pods[0]
                 ex_ok[gi, ni] = (
