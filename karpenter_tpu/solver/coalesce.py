@@ -1,0 +1,219 @@
+"""Cost-neutral node coalescing — merge small new nodes into larger types.
+
+The scan-over-groups solver buys each group's tail residue at that group's
+step, so two groups can each buy a half-size node where the sequential
+oracle's pod-interleaved first-fit would have filled one larger node
+(BASELINE config 5: +24 mid-size nodes at equal-or-lower $).  Node count is
+real operational load — kubelet/API traffic, image pulls, ENI/IP slots,
+interruption exposure — so after extraction the solver merges same-
+(provisioner, zone, capacity-type) NEW nodes into one larger catalog type
+whenever:
+
+- the larger type's allocatable fits the combined used resources (including
+  the pod-density row), and
+- its price is <= the sum of the replaced nodes' prices (NEVER spends $ —
+  in-family pricing is linear, so 2x 4xlarge -> 1x 8xlarge is exact), and
+- the provisioner either has no finite limits or the replacement's raw
+  capacity does not exceed the replaced capacity (limits bind on capacity),
+  and
+- no group in the solve carries hostname-scoped constraints (hostname
+  anti-affinity/spread caps are per-NODE: merging two nodes that each hold
+  one matching pod would co-locate them; zone-scoped constraints are safe —
+  merging preserves the zone).
+
+Greedy smallest-first within each bucket; deterministic.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .types import SimNode
+
+#: prov_limits entries at/above this are "no limit" sentinels
+_NO_LIMIT = 3.0e37
+#: pair scan covers only this many smallest nodes per bucket (fragments
+#: cluster at the small end; bounds host time on large solves)
+FRAG_WINDOW = 64
+
+
+def label_feasibility(st) -> np.ndarray:
+    """Host-side [G, C] label/provisioner feasibility — the numpy mirror of
+    the device precompute (tpu.compute_feasibility's gather branch): group g's
+    packed requirement mask admits candidate c's label values, and the
+    group tolerates/fits the candidate's provisioner.  Merge targets must be
+    feasible for every group with pods on the merged node — the solve
+    honored F, coalescing must too (a node_selector pinned to one instance
+    type must never be merged onto another).  Cached on the tensors."""
+    cached = getattr(st, "_host_F", None)
+    if cached is not None:
+        return cached
+    pm = np.asarray(st.pm)                    # [G, K, W] uint32
+    vw = np.asarray(st.cand_vw)               # [C, K]
+    vb = np.asarray(st.cand_vb).astype(np.uint32)
+    kc = np.asarray(st.key_check)             # [K]
+    G, K, _W = pm.shape
+    C = vw.shape[0]
+    lab = np.ones((G, C), dtype=bool)
+    for k in range(K):
+        if not kc[k]:
+            continue
+        words = pm[:, k, :][:, vw[:, k]]      # [G, C]
+        lab &= ((words >> vb[None, :, k]) & 1).astype(bool)
+    gp_ok = np.asarray(st.gp_ok)
+    lab &= gp_ok[np.arange(G)[:, None], np.asarray(st.cand_prov)[None, :]]
+    st._host_F = lab
+    return lab
+
+
+def hostname_constrained(st) -> bool:
+    """Any group whose constraints are scoped to individual nodes — merging
+    nodes could violate them, so coalescing is skipped for the whole solve."""
+    return bool(
+        (np.asarray(st.g_host_spread) >= 0).any()
+        or (np.asarray(st.g_host_paff) >= 0).any()
+        or (np.asarray(st.g_host_cap) > 0).any()
+    )
+
+
+def _domain_index(st, zone: str, ct: str) -> Optional[int]:
+    try:
+        zi = st.zone_names.index(zone)
+        ci = st.ct_names.index(ct)
+    except ValueError:
+        return None
+    return zi * max(1, len(st.ct_names)) + ci
+
+
+def coalesce_new_nodes(
+    st,
+    nodes: List[SimNode],
+    used_rows: Dict[int, np.ndarray],  # id(node) -> used resource row [R]
+    node_groups: Optional[Dict[int, set]] = None,  # id(node) -> {group idx}
+) -> Tuple[List[SimNode], Dict[str, str]]:
+    """Merge mergeable new nodes; returns (new node list, renames) where
+    ``renames`` maps absorbed old node names -> their replacement's name.
+    Pods are moved onto the replacement nodes; callers fix assignments via
+    the rename map.  ``node_groups`` scopes the label-feasibility check to
+    the groups actually placed on each node; without it (untracked solves)
+    the merge target must be feasible for EVERY group in the solve."""
+    if hostname_constrained(st):
+        return nodes, {}
+    F = label_feasibility(st)                             # [G, C]
+    all_groups = frozenset(range(F.shape[0]))
+
+    # candidate rows by provisioner, cheapest-capacity order is not needed:
+    # we pick the cheapest feasible replacement by price
+    by_prov: Dict[str, List[int]] = {}
+    for ci, (prov, _it) in enumerate(st.cand_names):
+        by_prov.setdefault(prov, []).append(ci)
+    prov_index = {n: i for i, n in enumerate(st.prov_names)}
+
+    buckets: Dict[tuple, List[SimNode]] = {}
+    for n in nodes:
+        buckets.setdefault((n.provisioner, n.zone, n.capacity_type), []).append(n)
+
+    out: List[SimNode] = []
+    renames: Dict[str, str] = {}
+    for (prov, zone, ct), group in buckets.items():
+        di = _domain_index(st, zone, ct)
+        pi = prov_index.get(prov)
+        cands = by_prov.get(prov, [])
+        if di is None or pi is None or len(group) < 2 or not cands:
+            out.extend(group)
+            continue
+        limited = bool((np.asarray(st.prov_limits)[pi] < _NO_LIMIT).any())
+        # bucket-local candidate table (spot pricing is NOT linear in size —
+        # zonal discounts vary per type — so the cheapest feasible
+        # replacement can come from any family)
+        cand_ix = np.asarray([ci for ci in cands if st.cand_avail[ci, di]],
+                             dtype=np.int64)
+        if cand_ix.size == 0:
+            out.extend(group)
+            continue
+        c_alloc = np.asarray(st.cand_alloc)[cand_ix]          # [K, R]
+        c_cap = np.asarray(st.cand_cap)[cand_ix]              # [K, R]
+        c_price = np.asarray(st.cand_price)[cand_ix, di]      # [K]
+        c_F = F[:, cand_ix]                                   # [G, K]
+
+        def groups_of(n: SimNode) -> frozenset:
+            if node_groups is None:
+                return all_groups
+            return frozenset(node_groups.get(id(n), all_groups))
+
+        def best_merge(a: SimNode, b: SimNode):
+            need = used_rows[id(a)] + used_rows[id(b)]
+            budget = a.price + b.price
+            ok = (c_price <= budget + 1e-9) & (
+                (c_alloc + 1e-6 >= need).all(axis=1)
+            )
+            # the solve honored F[g, c]; the merge target must too, for
+            # every group with pods on either node (a node_selector pinned
+            # to one instance type must never be merged onto another)
+            gs = groups_of(a) | groups_of(b)
+            if gs:
+                ok &= c_F[sorted(gs)].all(axis=0)
+            if limited:
+                cap_budget = (st.capacity_row(a.instance_type, a.allocatable)
+                              + st.capacity_row(b.instance_type, b.allocatable))
+                ok &= (c_cap <= cap_budget + 1e-6).all(axis=1)
+            if not ok.any():
+                return None
+            k = int(np.where(ok, c_price, np.inf).argmin())
+            return float(c_price[k]), int(cand_ix[k]), need
+
+        # smallest-first pair scan: any pair may merge (a cpu-heavy and a
+        # mem-heavy fragment can share one node even when two same-size
+        # fragments can't), so failure of one pair doesn't end the bucket.
+        # The scan is windowed to the FRAG_WINDOW smallest nodes — fragments
+        # live at the small end, and an unwindowed pair scan over a 50k-pod
+        # solve's hundreds of nodes would cost more host time than the solve
+        group = sorted(group, key=lambda n: (float(used_rows[id(n)].sum()), n.name))
+        merged = True
+        while merged and len(group) >= 2:
+            merged = False
+            win = min(len(group), FRAG_WINDOW)
+            for i in range(win - 1):
+                for j in range(i + 1, win):
+                    hit = best_merge(group[i], group[j])
+                    if hit is None:
+                        continue
+                    price, ci, need = hit
+                    a, b = group[i], group[j]
+                    _prov, type_name = st.cand_names[ci]
+                    node = SimNode(
+                        instance_type=type_name,
+                        provisioner=prov,
+                        zone=zone,
+                        capacity_type=ct,
+                        price=price,
+                        allocatable={
+                            st.vocab.resources[r]: float(st.cand_alloc[ci, r])
+                            for r in range(st.cand_alloc.shape[1])
+                        },
+                        existing=False,
+                    )
+                    node.pods = list(a.pods) + list(b.pods)
+                    used_rows[id(node)] = need
+                    if node_groups is not None:
+                        node_groups[id(node)] = set(groups_of(a) | groups_of(b))
+                    renames[a.name] = node.name
+                    renames[b.name] = node.name
+                    # an absorbed node may itself be a prior replacement:
+                    # forward earlier renames pointing at it
+                    for old, tgt in list(renames.items()):
+                        if tgt in (a.name, b.name):
+                            renames[old] = node.name
+                    group = sorted(
+                        [n for k, n in enumerate(group) if k not in (i, j)]
+                        + [node],
+                        key=lambda n: (float(used_rows[id(n)].sum()), n.name),
+                    )
+                    merged = True
+                    break
+                if merged:
+                    break
+        out.extend(group)
+    return out, renames

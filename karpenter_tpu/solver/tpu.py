@@ -469,11 +469,13 @@ def _make_step(
             # when a provisioner limit binds, a node bought "for backfill"
             # spends limit headroom later zones of THIS group still need
             # (fuzz seed 27: a 16x tail node starves zone c below its skew
-            # band).  For TAIL picks the guard compares against the zone's
-            # own tail count (tail_rem), not the group-wide scoring
-            # remainder.  The host-seed flow opts out entirely
-            # (size_tiebreak=False): it buys exactly ONE node either way,
-            # so a larger type is strictly more $.
+            # band).  Cross-group tail fragmentation is handled after
+            # extraction by cost-neutral coalescing (solver/coalesce.py),
+            # not by upsizing picks here.  For TAIL picks the guard compares
+            # against the zone's own tail count (tail_rem), not the
+            # group-wide scoring remainder.  The host-seed flow opts out
+            # entirely (size_tiebreak=False): it buys exactly ONE node
+            # either way, so a larger type is strictly more $.
             guard_rem = (
                 jnp.broadcast_to(jnp.maximum(rem, 1.0), (C, D))
                 if tail_rem is None
@@ -1277,13 +1279,17 @@ class TpuSolver:
 
         assignments: Dict[str, str] = {}
         infeasible_map: Dict[str, str] = {}
+        node_groups: Optional[Dict[int, set]] = None
         if ys is not None:
             takes = np.asarray(ys)  # [G, NR]
+            node_groups = {}
             for gi, g in enumerate(st.groups):
                 placed_slots = np.nonzero(takes[gi])[0]
                 pod_iter = iter(g.pods)
                 for si in placed_slots:
                     node = slot_to_node.get(int(si))
+                    if node is not None:
+                        node_groups.setdefault(id(node), set()).add(gi)
                     for _ in range(int(takes[gi, si])):
                         try:
                             pod = next(pod_iter)
@@ -1300,6 +1306,29 @@ class TpuSolver:
                 k = int(infeasible[gi])
                 for pod in g.pods[len(g.pods) - k:]:
                     infeasible_map[pod.name] = "solver: no feasible placement"
+
+        # cost-neutral coalescing: merge small new nodes into larger types at
+        # <= the same price (solver/coalesce.py — the scan buys each group's
+        # tail at that group's step, so fragments accumulate across groups;
+        # node count is operational load even when the $ match)
+        if len(new_nodes) >= 2:
+            from .coalesce import coalesce_new_nodes
+
+            used_rows = {}
+            for si, node in slot_to_node.items():
+                if si >= NE:  # slots >= NE are exactly the new_nodes entries
+                    ci = int(row_cand[si])
+                    used_rows[id(node)] = (
+                        np.asarray(st.cand_alloc[ci], dtype=np.float64)
+                        - np.asarray(res[si], dtype=np.float64)
+                    )
+            new_nodes, renames = coalesce_new_nodes(
+                st, new_nodes, used_rows, node_groups=node_groups,
+            )
+            if renames:
+                for pod_name, node_name in list(assignments.items()):
+                    if node_name in renames:
+                        assignments[pod_name] = renames[node_name]
 
         result = SolveResult(
             nodes=new_nodes,
