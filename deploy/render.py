@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Render the deploy/ manifests — the values layer.
+
+The manifests carry ``${KT_NAME:-default}`` tokens (env-substitution, the
+same one-source-of-truth posture as the reference's generated chart,
+reference Makefile:19-29: values come from ONE place instead of being
+hand-edited per file).  Render with defaults, or override via environment:
+
+    python deploy/render.py                         # all manifests, stdout
+    KT_IMAGE=repo/karpenter-tpu:v4 KT_NAMESPACE=prod \
+        python deploy/render.py | kubectl apply -f -
+    python deploy/render.py --out build/            # one file per manifest
+
+Values:
+    KT_NAMESPACE          target namespace            (karpenter)
+    KT_IMAGE              container image             (karpenter-tpu:latest)
+    KT_OPERATOR_REPLICAS  operator replicas           (2; leader + standby)
+    KT_SOLVER_REPLICAS    solver sidecar replicas     (1 per TPU chip)
+    KT_SOLVER_PORT        solver gRPC port            (50151)
+    KT_SOLVER_BACKEND     solver backend              (auto)
+    KT_METRICS_PORT       operator metrics/health     (8080)
+
+Unknown ``${KT_...}`` tokens are an error (a typo'd token must not ship as
+a literal), and rendering is pure stdlib — no helm/kustomize dependency.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from pathlib import Path
+
+TOKEN = re.compile(r"\$\{(KT_[A-Z0-9_]+)(?::-([^}]*))?\}")
+
+#: render order mirrors apply order (rbac before the deployments)
+MANIFESTS = ("rbac.yaml", "configmap.yaml", "solver.yaml", "operator.yaml")
+
+
+def render_text(text: str, env=None) -> str:
+    env = os.environ if env is None else env
+
+    def sub(m: re.Match) -> str:
+        name, default = m.group(1), m.group(2)
+        val = env.get(name, default)
+        if val is None:
+            raise KeyError(f"token ${{{name}}} has no default and {name} "
+                           f"is not set")
+        return val
+
+    return TOKEN.sub(sub, text)
+
+
+def render_all(base: Path = None, env=None) -> dict:
+    base = base or Path(__file__).parent
+    return {name: render_text((base / name).read_text(), env)
+            for name in MANIFESTS}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="deploy/render.py")
+    ap.add_argument("--out", default="", help="write per-manifest files here "
+                                             "instead of stdout")
+    args = ap.parse_args(argv)
+    rendered = render_all()
+    if args.out:
+        out = Path(args.out)
+        out.mkdir(parents=True, exist_ok=True)
+        for name, text in rendered.items():
+            (out / name).write_text(text)
+            print(f"wrote {out / name}", file=sys.stderr)
+    else:
+        try:
+            print("\n---\n".join(rendered[n].strip() for n in MANIFESTS))
+        except BrokenPipeError:  # | head — not an error
+            sys.stderr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

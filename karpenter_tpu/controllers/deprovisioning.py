@@ -327,8 +327,12 @@ class DeprovisioningController:
 
         # 1b/2a) device screen: candidate singletons (large clusters) AND
         #     structured multi-subsets (prefixes, per-type, per-zone groups)
-        #     evaluated in ONE device call, then exact-confirmed — singles
-        #     first in disruption order, then the top multi hits by savings.
+        #     evaluated in ONE device call, then exact-confirmed — MULTI
+        #     subsets first (top hits by savings), then singles in
+        #     disruption order: the reference consolidates multi-node before
+        #     single-node (concepts/deprovisioning.md:64-95), and a fleet
+        #     repack that deletes one node per 15 s TTL cycle would take
+        #     hours where one confirmed prefix delete takes a cycle.
         #     Beyond the reference's prefix-only heuristic — the win SURVEY
         #     §7.6 reserves for the device ("vectorized over many candidate
         #     sets at once").
@@ -348,6 +352,14 @@ class DeprovisioningController:
             multis = self._multi_subsets(cand_idx, cands, idx_of) if run_multi else []
             screen = screen_subset_deletes(all_nodes, singles + multis, compat)
 
+            if multis:
+                attempt = self._confirm_subsets(
+                    cands, all_nodes, idx_of, multis,
+                    screen.deletable[len(singles):],
+                )
+                if attempt is not None:
+                    return attempt
+
             if run_single:
                 deletable_idx = {i for k, i in enumerate(cand_idx)
                                  if screen.deletable[k]}
@@ -357,15 +369,7 @@ class DeprovisioningController:
                     attempt = self._simulate([ns])
                     if attempt is not None and attempt.kind == "delete":
                         return attempt
-                # fall through: no screened single confirmed; try multi/replace
-
-            if multis:
-                attempt = self._confirm_subsets(
-                    cands, all_nodes, idx_of, multis,
-                    screen.deletable[len(singles):],
-                )
-                if attempt is not None:
-                    return attempt
+                # fall through: no screened single confirmed; try replace paths
 
         # 2b) multi-node: binary search the largest disruption-cost prefix
         #     that can be deleted together with <=1 replacement

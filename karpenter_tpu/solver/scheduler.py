@@ -365,8 +365,12 @@ class BatchScheduler:
         self._tpu.stop_warms()
 
     def _warm_done(self, sig, seconds: float, err) -> None:
+        # this callback runs BEFORE the warm thread clears its own in-flight
+        # entry (TpuSolver keeps it until after on_done so watchers that
+        # poll compiles_in_flight() down to 0 never miss these metrics);
+        # exclude the completing compile from the gauge
         self.registry.gauge(SOLVER_COMPILE_IN_PROGRESS).set(
-            self._tpu.compiles_in_flight()
+            max(0, self._tpu.compiles_in_flight() - 1)
         )
         if err is not None:
             # failed compiles stay out of the duration histogram — it

@@ -868,9 +868,13 @@ class TpuSolver:
             self._queued.clear()
 
     def _mark_ready(self, sig: tuple) -> None:
+        # NOTE: deliberately does NOT discard the sig from _compiling — a
+        # warm thread for this sig may still be mid-flight, and the
+        # "compiles_in_flight() == 0 implies every on_done ran" invariant
+        # (watchers poll it, then read the compile metrics) requires the
+        # warm thread itself to clear its entry AFTER its on_done callback
         with self._lock:
             self._ready.add(sig)
-            self._compiling.discard(sig)
 
     def warm_async(
         self,
@@ -927,10 +931,26 @@ class TpuSolver:
             except Exception as e:  # pragma: no cover - surfaced via on_done
                 err = e
                 with self._lock:
-                    self._compiling.discard(sig)
                     self._failed_until[sig] = time.time() + self.WARM_FAILURE_BACKOFF
-            if on_done is not None:
-                on_done(sig, time.perf_counter() - t0, err)
+            try:
+                if on_done is not None:
+                    on_done(sig, time.perf_counter() - t0, err)
+            except Exception:  # a throwing callback must not wedge the tier
+                import logging as _logging
+
+                _logging.getLogger(__name__).warning(
+                    "warm on_done callback raised", exc_info=True
+                )
+            finally:
+                # clear the in-flight entry only AFTER on_done: watchers
+                # poll compiles_in_flight() down to 0 and then read the
+                # metrics the callback records — dropping the count first
+                # is a race.  In a finally (with the callback exception
+                # swallowed above) so neither the entry leaks nor the queue
+                # drain below is skipped — either would permanently consume
+                # a MAX_CONCURRENT_WARMS slot
+                with self._lock:
+                    self._compiling.discard(sig)
             # drain: start the next queued warm that is still cold — unless
             # the process is exiting (threading._shutdown is joining us: the
             # main thread is gone) or stop_warms() ran; exit must wait only

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Profile the config-2 solve on the real TPU — where do the milliseconds go?
+
+Produces the breakdown VERDICT r3 asked for (SURVEY §5 tracing, §7.3 Pallas
+slot): host tensorize vs tunnel RTT vs pure device compute, the top device
+kernels by self time, and the XLA cost analysis (flops / bytes) of the
+compiled program.  Results feed docs/PROFILE.md.
+
+    python scripts/profile_solve.py [--pods 50000] [--trace-dir /tmp/kt-trace]
+
+Kernel extraction: the image has no tensorflow/tensorboard, so the captured
+``*.xplane.pb`` is read with a generic protobuf wire-format walker (varint +
+length-delimited framing only — no schema compile needed).  XPlane layout
+(tensorflow/core/profiler/protobuf/xplane.proto):
+
+    XSpace.planes = 1              XPlane.name = 2
+    XPlane.lines = 3               XLine.events = 4 / name = 2
+    XEvent.metadata_id = 1         XEvent.duration_ps = 3
+    XPlane.event_metadata = 4 (map<int64, XEventMetadata{id=1, name=2}>)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+
+# ---------------------------------------------------------------------------
+# generic protobuf wire-format walker
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(buf: bytes, i: int):
+    val = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over one message's bytes."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        fnum, wtype = tag >> 3, tag & 7
+        if wtype == 0:
+            val, i = _read_varint(buf, i)
+        elif wtype == 1:
+            val, i = buf[i:i + 8], i + 8
+        elif wtype == 2:
+            ln, i = _read_varint(buf, i)
+            val, i = buf[i:i + ln], i + ln
+        elif wtype == 5:
+            val, i = buf[i:i + 4], i + 4
+        else:  # groups (3/4): not used by xplane
+            raise ValueError(f"unsupported wire type {wtype}")
+        yield fnum, wtype, val
+
+
+def top_kernels(xplane_path: str, k: int = 10):
+    """[(kernel name, total self us, calls)] for the device plane(s)."""
+    raw = open(xplane_path, "rb").read()
+    totals = defaultdict(float)
+    calls = defaultdict(int)
+    for fnum, _wt, plane in fields(raw):
+        if fnum != 1:  # XSpace.planes
+            continue
+        name = b""
+        meta = {}
+        lines = []
+        for pf, _pw, pv in fields(plane):
+            if pf == 2:
+                name = pv
+            elif pf == 3:
+                lines.append(pv)
+            elif pf == 4:  # event_metadata map entry {key=1, value=2}
+                key = None
+                mname = b""
+                for mf, _mw, mv in fields(pv):
+                    if mf == 1:
+                        key = mv
+                    elif mf == 2:
+                        for ef, _ew, ev in fields(mv):
+                            if ef == 2:
+                                mname = ev
+                if key is not None:
+                    meta[key] = mname.decode(errors="replace")
+        if b"TPU" not in name and b"/device" not in name.lower():
+            continue
+        for line in lines:
+            for lf, _lw, lv in fields(line):
+                if lf != 4:  # XLine.events
+                    continue
+                mid = dur = 0
+                for ef, ew, ev in fields(lv):
+                    if ef == 1 and ew == 0:
+                        mid = ev
+                    elif ef == 3 and ew == 0:
+                        dur = ev
+                kname = meta.get(mid, f"metadata:{mid}")
+                totals[kname] += dur / 1e6  # ps -> us
+                calls[kname] += 1
+    ranked = sorted(totals.items(), key=lambda t: -t[1])[:k]
+    return [(n, round(us, 1), calls[n]) for n, us in ranked]
+
+
+# ---------------------------------------------------------------------------
+# the measured solve
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=50_000)
+    ap.add_argument("--trace-dir", default="/tmp/kt-trace")
+    ap.add_argument("--repeats", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import build_scenario
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from karpenter_tpu.models.tensorize import tensorize
+    from karpenter_tpu.solver.tpu import TpuSolver
+
+    out = {"backend": jax.default_backend(), "n_devices": len(jax.devices())}
+
+    # 1. tunnel RTT: tiny fenced D2H round trips
+    x = jnp.zeros(4)
+    np.asarray(x)  # warm the path
+    rtts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(x + 1e-9)
+        rtts.append((time.perf_counter() - t0) * 1000.0)
+    out["tunnel_rtt_ms"] = {"min": round(min(rtts), 2),
+                            "median": round(sorted(rtts)[len(rtts) // 2], 2)}
+
+    # 2. host tensorize
+    pods, provs, catalog = build_scenario()
+    if args.pods != 50_000:
+        pods = pods[:args.pods]
+    t0 = time.perf_counter()
+    st = tensorize(pods, provs, catalog)
+    out["tensorize_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+
+    # 3. compile + fenced steady-state timings
+    solver = TpuSolver()
+    run, init, _ne = solver.prepare(st, track_assignments=False)
+    t0 = time.perf_counter()
+    carry, _ys = run(init)
+    np.asarray(carry[7])
+    out["first_call_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+    times = []
+    for r in range(args.repeats):
+        init2 = (init[0] + jnp.float32((r + 1) * 1e-9),) + tuple(init[1:])
+        t0 = time.perf_counter()
+        c2, _ = run(init2)
+        np.asarray(c2[7])
+        times.append((time.perf_counter() - t0) * 1000.0)
+    out["solve_ms"] = {"min": round(min(times), 1),
+                       "median": round(sorted(times)[len(times) // 2], 1),
+                       "all": [round(t, 1) for t in times]}
+
+    # 4. XLA cost analysis of the compiled program
+    try:
+        lowered = jax.jit(lambda i: run(i)).lower(init)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        out["cost_analysis"] = {
+            "gflops": round(float(cost.get("flops", 0.0)) / 1e9, 3),
+            "gbytes_accessed": round(
+                float(cost.get("bytes accessed", 0.0)) / 1e9, 3),
+            "transcendentals": float(cost.get("transcendentals", 0.0)),
+        }
+    except Exception as err:  # cost analysis is best-effort per backend
+        out["cost_analysis"] = {"error": str(err)[:200]}
+
+    # 5. profiler trace of one solve
+    os.makedirs(args.trace_dir, exist_ok=True)
+    init3 = (init[0] + jnp.float32(7e-9),) + tuple(init[1:])
+    with jax.profiler.trace(args.trace_dir):
+        c3, _ = run(init3)
+        np.asarray(c3[7])
+    paths = sorted(glob.glob(
+        os.path.join(args.trace_dir, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime)
+    if paths:
+        try:
+            out["top_kernels"] = top_kernels(paths[-1])
+            out["trace_file"] = paths[-1]
+        except Exception as err:
+            out["top_kernels"] = [("parse-error", str(err)[:200], 0)]
+    else:
+        gz = sorted(glob.glob(os.path.join(args.trace_dir, "**", "*.json.gz"),
+                              recursive=True), key=os.path.getmtime)
+        out["trace_file"] = gz[-1] if gz else None
+
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

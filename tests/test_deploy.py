@@ -1,0 +1,138 @@
+"""Deploy manifests: render, schema-validate, and settings-drift gates.
+
+The reference generates its chart from one source of truth
+(reference Makefile:19-29 / charts/karpenter/templates/configmap.yaml);
+the analog here is the ``${KT_*:-default}`` values layer rendered by
+``deploy/render.py``.  These tests fail on: an unrenderable token, a
+structurally invalid manifest, a Service/port/address mismatch between the
+operator and solver topology, and ANY drift between ``settings.py`` /
+``manifests._SETTINGS_KEYS`` and the shipped ConfigMap."""
+
+import sys
+from pathlib import Path
+
+import pytest
+import yaml
+
+DEPLOY = Path(__file__).resolve().parent.parent / "deploy"
+sys.path.insert(0, str(DEPLOY))
+from render import MANIFESTS, render_all, render_text  # noqa: E402
+
+from karpenter_tpu.manifests import _SETTINGS_KEYS, parse_settings
+from karpenter_tpu.settings import Settings
+
+
+@pytest.fixture(scope="module")
+def rendered():
+    return {name: list(yaml.safe_load_all(text))
+            for name, text in render_all(DEPLOY).items()}
+
+
+def _docs(rendered):
+    return [d for docs in rendered.values() for d in docs if d]
+
+
+class TestRendering:
+    def test_all_manifests_render_and_parse(self, rendered):
+        assert set(rendered) == set(MANIFESTS)
+        for name, docs in rendered.items():
+            assert docs, f"{name} rendered to zero documents"
+            for d in docs:
+                assert d.get("apiVersion"), f"{name}: doc missing apiVersion"
+                assert d.get("kind"), f"{name}: doc missing kind"
+                assert d.get("metadata", {}).get("name"), f"{name}: unnamed doc"
+
+    def test_values_overrides_apply_everywhere(self):
+        env = {"KT_NAMESPACE": "prod", "KT_IMAGE": "repo/kt:v4",
+               "KT_SOLVER_PORT": "9999", "KT_METRICS_PORT": "7070",
+               "KT_OPERATOR_REPLICAS": "3", "KT_SOLVER_REPLICAS": "2",
+               "KT_SOLVER_BACKEND": "tpu"}
+        out = render_all(DEPLOY, env={**env})
+        for name, text in out.items():
+            assert "${" not in text, f"{name}: unrendered token"
+            for d in yaml.safe_load_all(text):
+                if d and "namespace" in d.get("metadata", {}):
+                    assert d["metadata"]["namespace"] == "prod", name
+        op = out["operator.yaml"]
+        assert "repo/kt:v4" in op
+        assert "karpenter-tpu-solver.prod.svc:9999" in op
+        assert "--metrics-port=7070" in op
+        sol = out["solver.yaml"]
+        assert '"--port=9999"' in sol and '"--backend=tpu"' in sol
+
+    def test_unknown_token_fails_loudly(self):
+        with pytest.raises(KeyError):
+            render_text("image: ${KT_NO_SUCH_VALUE}", env={})
+
+    def test_split_topology_is_self_consistent(self, rendered):
+        """The operator's KARPENTER_SOLVER_ADDR must dial the solver
+        Service's name, namespace, and port; probes must hit the metrics
+        port the operator serves."""
+        by_kind = {}
+        for d in _docs(rendered):
+            by_kind.setdefault(d["kind"], []).append(d)
+        solver_svc = next(s for s in by_kind["Service"]
+                          if s["metadata"]["name"] == "karpenter-tpu-solver")
+        svc_port = solver_svc["spec"]["ports"][0]["port"]
+        operator = next(d for d in by_kind["Deployment"]
+                        if d["metadata"]["name"] == "karpenter-tpu")
+        container = operator["spec"]["template"]["spec"]["containers"][0]
+        addr = next(e["value"] for e in container["env"]
+                    if e["name"] == "KARPENTER_SOLVER_ADDR")
+        expected = (f"karpenter-tpu-solver."
+                    f"{solver_svc['metadata']['namespace']}.svc:{svc_port}")
+        assert addr == expected, f"operator dials {addr}, solver serves {expected}"
+        # solver container listens on the Service's target port
+        solver = next(d for d in by_kind["Deployment"]
+                      if d["metadata"]["name"] == "karpenter-tpu-solver")
+        sc = solver["spec"]["template"]["spec"]["containers"][0]
+        assert f"--port={svc_port}" in " ".join(sc["args"])
+        assert sc["ports"][0]["containerPort"] == svc_port
+        # operator probes target the metrics port it serves
+        mp = int(next(a for a in container["args"]
+                      if a.startswith("--metrics-port=")).split("=")[1])
+        assert container["ports"][0]["containerPort"] == mp
+        assert container["livenessProbe"]["httpGet"]["port"] == mp
+
+
+class TestSettingsDrift:
+    def test_configmap_keys_match_settings_schema(self, rendered):
+        """Bidirectional drift gate: every ConfigMap data key must be a known
+        settings key (a renamed/typo'd key fails admission), and every known
+        settings key must ship in the ConfigMap (a new Settings field whose
+        deploy default was forgotten fails here)."""
+        cm = next(d for d in _docs(rendered) if d["kind"] == "ConfigMap"
+                  and d["metadata"]["name"] == "karpenter-global-settings")
+        data_keys = {k for k in cm["data"] if not k.startswith("tags")}
+        known = set(_SETTINGS_KEYS)
+        assert data_keys - known == set(), (
+            f"ConfigMap ships unknown settings keys: {sorted(data_keys - known)}"
+        )
+        assert known - data_keys == set(), (
+            f"settings keys missing from deploy/configmap.yaml: "
+            f"{sorted(known - data_keys)}"
+        )
+
+    def test_settings_fields_all_reachable_from_configmap(self):
+        """Every Settings field (except the free-form tags map) must be
+        settable through a ConfigMap key — a new field added to settings.py
+        without a _SETTINGS_KEYS entry fails here."""
+        mapped = {field for field, _p in _SETTINGS_KEYS.values()}
+        fields = set(Settings.__dataclass_fields__) - {"tags"}
+        assert fields - mapped == set(), (
+            f"Settings fields unreachable from the ConfigMap: "
+            f"{sorted(fields - mapped)}"
+        )
+
+    def test_configmap_values_parse_to_defaults(self, rendered):
+        """The shipped ConfigMap must parse cleanly AND reproduce the coded
+        Settings defaults — deploy and code agree on what 'default' means."""
+        cm = next(d for d in _docs(rendered) if d["kind"] == "ConfigMap"
+                  and d["metadata"]["name"] == "karpenter-global-settings")
+        overrides = parse_settings(cm)
+        defaults = Settings()
+        got = Settings(**overrides)
+        assert got == defaults, (
+            f"deploy defaults drifted from Settings(): "
+            f"{ {k: (getattr(got, k), getattr(defaults, k)) for k in Settings.__dataclass_fields__ if getattr(got, k) != getattr(defaults, k)} }"
+        )

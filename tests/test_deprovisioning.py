@@ -616,3 +616,20 @@ class TestExpirationAndDrift:
         assert not cloud.is_machine_drifted(machine)
         clock.advance(10)
         assert deprov.reconcile() is None
+
+
+class TestRepackConvergence:
+    def test_device_loop_matches_oracle_loop_savings(self, small_catalog):
+        """The end-to-end repack (BASELINE config 4 at test scale): driving
+        the full ladder to convergence with the device-screened loop must
+        achieve >= 0.98x the savings of the oracle-driven loop, with every
+        evicted pod rebound.  The full-scale numbers live in bench_all
+        config 4 / docs/BENCH_RESULTS.md."""
+        from bench_all import _repack_to_convergence
+
+        dev = _repack_to_convergence(small_catalog, 80, "auto", False)
+        orc = _repack_to_convergence(small_catalog, 80, "oracle", True)
+        assert dev["pending_end"] == 0 and orc["pending_end"] == 0
+        assert orc["saved"] > 0
+        assert dev["saved"] >= 0.98 * orc["saved"], (dev, orc)
+        assert dev["nodes_end"] <= 1.1 * orc["nodes_end"]

@@ -105,3 +105,33 @@ class TestMultiProcess:
         assert len(outs) == 2
         for o in outs:
             assert "OK" in o and "2 processes x 2 devices" in o
+
+
+class TestBenchScaleSharded:
+    @pytest.mark.skipif("not __import__('os').environ.get('KT_SLOW_MESH')",
+                        reason="bench-scale mesh compile is minutes on CPU; "
+                               "opt in with KT_SLOW_MESH=1 (the driver's "
+                               "dryrun_multichip runs this shape every round)")
+    def test_bench_scale_sharded_matches_unsharded(self):
+        """10k pods / full catalog over the 8-device mesh: identical
+        cost/nodes to the single-device solve at real rung sizes (NR=2048,
+        C>=512) — the padding/uneven-axis paths the 50k solve rides."""
+        import __graft_entry__ as g
+        from karpenter_tpu.solver.tpu import solve_dims
+
+        st = g._bench_scenario()
+        dims = solve_dims(st, NE=0, node_budget=2048, a=4, b=2)
+        assert dims["NR"] >= 2048 and dims["C"] >= 512, dims
+
+        solo = TpuSolver().solve(st, max_nodes=2048,
+                                 track_assignments=False).result
+        mesh = make_mesh(8)
+        sharded = TpuSolver().solve(st, max_nodes=2048, mesh=mesh,
+                                    track_assignments=False).result
+        assert sharded.infeasible == {} and solo.infeasible == {}
+        assert abs(sharded.new_node_cost - solo.new_node_cost) < 1e-4
+        assert len(sharded.nodes) == len(solo.nodes)
+        assert sorted((n.instance_type, n.zone, n.capacity_type)
+                      for n in sharded.nodes) \
+            == sorted((n.instance_type, n.zone, n.capacity_type)
+                      for n in solo.nodes)
