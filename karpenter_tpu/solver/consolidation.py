@@ -211,23 +211,86 @@ def compat_matrix(
     N = len(nodes)
     src = range(N) if sources is None else sources
     out = np.zeros((N, N), dtype=bool)
+
+    # The naive O(|sources| x N x pods) requirement-algebra walk repeats the
+    # same few questions millions of times at 5k nodes (~100 s of the 5k
+    # consolidation reconcile).  Two-level memo instead:
+    #  - a POD SIGNATURE is exactly what node-compat depends on — the pod's
+    #    effective requirement set (node_selector + required affinity term
+    #    0) plus its tolerations.  Requests/labels/owner do NOT widen it
+    #    (group_key would: unique requests -> unique keys -> no dedup).
+    #  - a DESTINATION CLASS is the node's taints plus only the label keys
+    #    any source pod's requirements actually reference — a unique
+    #    per-node hostname label must not split an otherwise uniform fleet
+    #    into N classes when nothing selects on hostname.
+    # The 5k bench fleet asks 1 question instead of 87M.
+    pod_sig: Dict[int, tuple] = {}        # id(pod) -> signature
+    sig_reqs: Dict[tuple, object] = {}    # signature -> Requirements
+    relevant_keys: set = set()
+    for i in src:
+        for p in nodes[i].pods:
+            reqs = p.scheduling_requirements()[0]
+            # the signature is built from the ValueSet fields directly —
+            # to_list()'s canonical operator form is LOSSY (it drops
+            # require_exists when a set is complement-with-values, so
+            # [Exists(k), NotIn(k,{x})] would collide with [NotIn(k,{x})]
+            # and inherit the first-seen pod's semantics)
+            key = (
+                tuple(sorted(
+                    (k, tuple(sorted(vs.values)), vs.complement,
+                     vs.greater, vs.less, vs.require_exists)
+                    for k, vs in ((k, reqs.get(k)) for k in reqs)
+                )),
+                tuple(p.tolerations),
+            )
+            pod_sig[id(p)] = key
+            if key not in sig_reqs:
+                sig_reqs[key] = reqs
+                relevant_keys.update(reqs)
+
+    dst_class = np.zeros(N, dtype=np.int64)
+    class_of: Dict[tuple, int] = {}
+    class_rep: List[SimNode] = []
+    for j, dst in enumerate(nodes):
+        ckey = (
+            tuple(sorted((k, v) for k, v in dst.labels.items()
+                         if k in relevant_keys)),
+            tuple((t.key, t.value, t.effect) for t in dst.taints),
+        )
+        c = class_of.get(ckey)
+        if c is None:
+            c = class_of[ckey] = len(class_rep)
+            class_rep.append(dst)
+        dst_class[j] = c
+    n_cls = len(class_rep)
+
+    sig_cls_ok: Dict[tuple, np.ndarray] = {}  # signature -> [n_cls] bool
+
+    def sig_ok_row(key: tuple) -> np.ndarray:
+        row = sig_cls_ok.get(key)
+        if row is None:
+            reqs = sig_reqs[key]
+            tols = key[1]  # the signature's second element IS the tolerations
+            row = np.zeros(n_cls, dtype=bool)
+            for c, dst in enumerate(class_rep):
+                row[c] = (
+                    not any(t.blocks(tols) for t in dst.taints)
+                    and reqs.compatible(dst.labels) is None
+                )
+            sig_cls_ok[key] = row
+        return row
+
     for i in src:
         node_i = nodes[i]
         if not node_i.pods:
             out[i, :] = True
             out[i, i] = False
             continue
-        for j, dst in enumerate(nodes):
-            if i == j:
-                continue
-            ok = True
-            for p in node_i.pods:
-                if any(t.blocks(p.tolerations) for t in dst.taints):
-                    ok = False
-                    break
-                reqs = p.scheduling_requirements()[0]
-                if reqs.compatible(dst.labels) is not None:
-                    ok = False
-                    break
-            out[i, j] = ok
+        ok_cls = np.ones(n_cls, dtype=bool)
+        for p in node_i.pods:
+            ok_cls &= sig_ok_row(pod_sig[id(p)])
+            if not ok_cls.any():
+                break
+        out[i] = ok_cls[dst_class]
+        out[i, i] = False
     return out

@@ -170,3 +170,82 @@ class TestControllerIntegration:
                 break
         assert len(state.nodes) < 10
         assert not state.pending_pods()
+
+
+def test_compat_matrix_class_memo_matches_naive(small_catalog):
+    """The class-memoized compat_matrix must equal the naive per-pair
+    requirement walk on a constraint-heavy fleet (taints, selectors,
+    heterogeneous labels)."""
+    import numpy as np
+
+    from karpenter_tpu.solver.consolidation import compat_matrix
+    from tests.test_fuzz_parity import random_existing_nodes, random_scenario
+
+    for seed in (2, 5, 11):
+        pods, provs, _un = random_scenario(seed, small_catalog)
+        nodes = random_existing_nodes(seed, small_catalog, provs)
+        # attach a few constraint-bearing pods so rows aren't trivially True
+        for i, node in enumerate(nodes):
+            for p in pods[i * 3:(i * 3) + 3]:
+                node.pods.append(p)
+
+        def naive(nodes, sources=None):
+            N = len(nodes)
+            src = range(N) if sources is None else sources
+            out = np.zeros((N, N), dtype=bool)
+            for i in src:
+                ni = nodes[i]
+                if not ni.pods:
+                    out[i, :] = True
+                    out[i, i] = False
+                    continue
+                for j, dst in enumerate(nodes):
+                    if i == j:
+                        continue
+                    ok = True
+                    for p in ni.pods:
+                        if any(t.blocks(p.tolerations) for t in dst.taints):
+                            ok = False
+                            break
+                        if p.scheduling_requirements()[0].compatible(dst.labels) is not None:
+                            ok = False
+                            break
+                    out[i, j] = ok
+            return out
+
+        got = compat_matrix(nodes)
+        want = naive(nodes)
+        assert (got == want).all(), f"seed {seed}: compat drift"
+        srcs = list(range(0, len(nodes), 2))
+        assert (compat_matrix(nodes, sources=srcs) == naive(nodes, srcs)).all()
+
+
+def test_compat_matrix_signature_is_lossless():
+    """Exists+NotIn must not collide with bare NotIn (to_list() drops
+    require_exists for complement-with-values sets; the signature is built
+    from the ValueSet fields instead — review finding r4)."""
+    from karpenter_tpu.models.pod import PodSpec
+    from karpenter_tpu.models.requirements import Requirement
+    from karpenter_tpu.solver.consolidation import compat_matrix
+    from karpenter_tpu.solver.types import SimNode
+
+    def node(name, labels, pods):
+        n = SimNode(instance_type="t", provisioner="p", zone="z",
+                    capacity_type="od", price=1.0, allocatable={"cpu": 4.0},
+                    labels=labels, existing=True, name=name)
+        n.pods = pods
+        return n
+
+    pa = PodSpec(name="a", required_affinity_terms=[
+        [Requirement("k", "NotIn", ["x"])]])
+    pb = PodSpec(name="b", required_affinity_terms=[
+        [Requirement("k", "Exists", []), Requirement("k", "NotIn", ["x"])]])
+    dst = node("unlabeled", {}, [])
+    # both orders: first-seen must not leak its semantics to the other
+    for order in ([dst, node("nb", {}, [pb]), node("na", {}, [pa])],
+                  [dst, node("na", {}, [pa]), node("nb", {}, [pb])]):
+        cm = compat_matrix(order)
+        idx = {n.name: i for i, n in enumerate(order)}
+        # NotIn matches an absent label; Exists does not
+        assert cm[idx["na"], 0], "NotIn pod must fit the unlabeled node"
+        assert not cm[idx["nb"], 0], "Exists pod must NOT fit the unlabeled node"
