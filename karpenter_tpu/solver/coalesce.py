@@ -87,6 +87,23 @@ def _domain_index(st, zone: str, ct: str) -> Optional[int]:
     return zi * max(1, len(st.ct_names)) + ci
 
 
+def apply_coalesce(st, nodes, used_rows, node_groups, assignments):
+    """Shared tier epilogue: run the merge pass and repoint assignments of
+    absorbed nodes at their replacements.  Both the device tier
+    (tpu._extract) and the native tier (native.solve_tensors_native) call
+    this so the cold-start answer and the warm answer stay the same
+    coalescing contract."""
+    if len(nodes) < 2:
+        return nodes
+    nodes, renames = coalesce_new_nodes(st, nodes, used_rows,
+                                        node_groups=node_groups)
+    if renames:
+        for pod_name, node_name in list(assignments.items()):
+            if node_name in renames:
+                assignments[pod_name] = renames[node_name]
+    return nodes
+
+
 def coalesce_new_nodes(
     st,
     nodes: List[SimNode],

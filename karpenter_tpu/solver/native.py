@@ -263,10 +263,13 @@ def solve_tensors_native(
 
     assignments: Dict[str, str] = {}
     infeasible_map: Dict[str, str] = {}
+    node_groups: Dict[int, set] = {}
     for gi, g in enumerate(st.groups):
         pod_iter = iter(g.pods)
         for s in np.nonzero(takes[gi])[0]:
             node = slot_to_node.get(int(s))
+            if node is not None:
+                node_groups.setdefault(id(node), set()).add(gi)
             for _ in range(int(takes[gi, s])):
                 pod = next(pod_iter, None)
                 if pod is None:
@@ -276,6 +279,22 @@ def solve_tensors_native(
                     node.pods.append(pod)
         for pod in pod_iter:
             infeasible_map[pod.name] = "native solver: no feasible placement"
+
+    # cost-neutral coalescing, same pass as the device tier (the cold-start
+    # answer should match the warm tier's node-count quality — before this
+    # the native tier served 20 nodes where the device tier served 16 on
+    # bench config 1)
+    from .coalesce import apply_coalesce
+
+    used_rows = {}
+    for s, node in slot_to_node.items():
+        if s >= NE:  # slots >= NE are exactly the new nodes
+            ci = int(slot_cand[s])
+            used_rows[id(node)] = (
+                np.asarray(st.cand_alloc[ci], dtype=np.float64)
+                - np.asarray(slot_res[s], dtype=np.float64)
+            )
+    nodes = apply_coalesce(st, nodes, used_rows, node_groups, assignments)
 
     return SolveResult(
         nodes=nodes,

@@ -1331,24 +1331,18 @@ class TpuSolver:
         # <= the same price (solver/coalesce.py — the scan buys each group's
         # tail at that group's step, so fragments accumulate across groups;
         # node count is operational load even when the $ match)
-        if len(new_nodes) >= 2:
-            from .coalesce import coalesce_new_nodes
+        from .coalesce import apply_coalesce
 
-            used_rows = {}
-            for si, node in slot_to_node.items():
-                if si >= NE:  # slots >= NE are exactly the new_nodes entries
-                    ci = int(row_cand[si])
-                    used_rows[id(node)] = (
-                        np.asarray(st.cand_alloc[ci], dtype=np.float64)
-                        - np.asarray(res[si], dtype=np.float64)
-                    )
-            new_nodes, renames = coalesce_new_nodes(
-                st, new_nodes, used_rows, node_groups=node_groups,
-            )
-            if renames:
-                for pod_name, node_name in list(assignments.items()):
-                    if node_name in renames:
-                        assignments[pod_name] = renames[node_name]
+        used_rows = {}
+        for si, node in slot_to_node.items():
+            if si >= NE:  # slots >= NE are exactly the new_nodes entries
+                ci = int(row_cand[si])
+                used_rows[id(node)] = (
+                    np.asarray(st.cand_alloc[ci], dtype=np.float64)
+                    - np.asarray(res[si], dtype=np.float64)
+                )
+        new_nodes = apply_coalesce(st, new_nodes, used_rows, node_groups,
+                                   assignments)
 
         result = SolveResult(
             nodes=new_nodes,
