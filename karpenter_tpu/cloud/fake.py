@@ -15,7 +15,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..models import labels as L
-from ..models.instancetype import InstanceType
+from ..models.instancetype import InstanceType, specialize_for_kubelet
 from ..models.machine import Machine
 from ..models.provisioner import Provisioner
 from ..utils.clock import Clock
@@ -176,8 +176,12 @@ class FakeCloudProvider(CloudProvider):
         machine.zone = offering.zone
         machine.capacity_type = offering.capacity_type
         machine.price = offering.price
-        machine.capacity = dict(it.capacity)
-        machine.allocatable = dict(it.allocatable)
+        # the machine's kubeletConfiguration changes real node capacity
+        # (instancetype.go:226-340): density + reservation overrides are
+        # applied here exactly as the solver's candidate rows assumed
+        it_eff = specialize_for_kubelet(it, machine.kubelet)
+        machine.capacity = dict(it_eff.capacity)
+        machine.allocatable = dict(it_eff.allocatable)
         machine.launched_at = self.clock.now()
         tmpl = self.templates.get(machine.node_template)
         if tmpl is not None and tmpl.launch_template_name is None and machine.image_id:
@@ -192,6 +196,9 @@ class FakeCloudProvider(CloudProvider):
                 tmpl,
                 Image(machine.image_id, it.labels().get(L.ARCH, "")),
                 labels=machine.labels, taints=machine.taints,
+                kubelet_flags=(
+                    machine.kubelet.bootstrap_flags() if machine.kubelet else None
+                ),
             )
             machine.launch_template = lt.name
         machine.labels = {

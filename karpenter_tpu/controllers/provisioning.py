@@ -212,4 +212,5 @@ class ProvisioningController:
             labels=dict(prov.labels) if prov else {},
             resource_requests=requests,
             node_template=prov.node_template if prov else "default",
+            kubelet=prov.kubelet if prov else None,
         )

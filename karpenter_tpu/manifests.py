@@ -28,7 +28,7 @@ from dataclasses import replace
 from .cloud.templates import BlockDevice, NodeTemplate
 from .models import labels as L  # noqa: F401  (manifest docs reference labels)
 from .models.pod import Taint
-from .models.provisioner import Provisioner
+from .models.provisioner import KubeletConfiguration, Provisioner
 from .models.requirements import Requirement
 from .settings import Settings
 from .utils.quantity import parse_quantity
@@ -85,6 +85,8 @@ def parse_provisioner(doc: dict) -> Provisioner:
     }
     consolidation = spec.get("consolidation", {}) or {}
     provider_ref = spec.get("providerRef", {}) or {}
+    kc_doc = spec.get("kubeletConfiguration")
+    kubelet = _parse_kubelet(kc_doc) if kc_doc else None
     return Provisioner(
         name=meta.get("name", "default"),
         requirements=reqs,
@@ -103,6 +105,38 @@ def parse_provisioner(doc: dict) -> Provisioner:
             if spec.get("ttlSecondsUntilExpired") is not None else None
         ),
         node_template=provider_ref.get("name", "default"),
+        kubelet=kubelet,
+    )
+
+
+def _parse_kubelet(doc: dict) -> KubeletConfiguration:
+    """spec.kubeletConfiguration (karpenter.sh_provisioners.yaml:56-135):
+    reserved maps are resource quantities, eviction signals stay strings
+    (percentage-or-quantity is resolved against each node's capacity at
+    instance-type specialization time), grace periods are durations."""
+    return KubeletConfiguration(
+        max_pods=int(doc["maxPods"]) if doc.get("maxPods") is not None else None,
+        pods_per_core=(
+            int(doc["podsPerCore"]) if doc.get("podsPerCore") is not None else None
+        ),
+        system_reserved={
+            k: parse_quantity(v) for k, v in (doc.get("systemReserved") or {}).items()
+        },
+        kube_reserved={
+            k: parse_quantity(v) for k, v in (doc.get("kubeReserved") or {}).items()
+        },
+        eviction_hard=dict(doc.get("evictionHard") or {}),
+        eviction_soft=dict(doc.get("evictionSoft") or {}),
+        eviction_soft_grace_period={
+            k: parse_duration(v)
+            for k, v in (doc.get("evictionSoftGracePeriod") or {}).items()
+        },
+        eviction_max_pod_grace_period=(
+            int(doc["evictionMaxPodGracePeriod"])
+            if doc.get("evictionMaxPodGracePeriod") is not None else None
+        ),
+        cluster_dns=tuple(doc.get("clusterDNS") or ()),
+        container_runtime=doc.get("containerRuntime"),
     )
 
 

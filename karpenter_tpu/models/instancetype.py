@@ -160,3 +160,94 @@ def compute_overhead(cpu_cores: float, pod_count: float) -> Overhead:
 def vm_memory_overhead(raw_memory_bytes: float, percent: float = 0.075) -> float:
     """VM-level memory not visible to the OS (settings.go:48, default 7.5%)."""
     return raw_memory_bytes * (1.0 - percent)
+
+
+# ---------------------------------------------------------------------------
+# Per-provisioner kubeletConfiguration specialization
+# ---------------------------------------------------------------------------
+
+import math as _math
+
+# node-pressure eviction signal the capacity model understands
+MEMORY_AVAILABLE = "memory.available"
+
+
+def kubelet_pod_density(default_pods: float, vcpus: float, kc) -> float:
+    """Pod capacity under a kubeletConfiguration, mirroring ``pods()`` at
+    /root/reference/pkg/cloudprovider/instancetype.go:326-340: maxPods
+    replaces the (ENI-limited or 110) default, then podsPerCore caps at
+    podsPerCore * vCPUs, whichever is smaller."""
+    count = float(kc.max_pods) if kc.max_pods is not None else float(default_pods)
+    if kc.pods_per_core:
+        count = min(float(kc.pods_per_core) * vcpus, count)
+    return count
+
+
+def eviction_override(capacity_memory_bytes: float, *signal_maps) -> Optional[float]:
+    """memory.available eviction threshold across hard/soft signal maps
+    (instancetype.go:291-324): per map, a percentage is ceil(capacity * p/100)
+    (100% disables -> 0), a quantity parses as bytes; the override is the MAX
+    across maps, and None when no map names memory.available."""
+    from ..utils.quantity import parse_quantity
+
+    best: Optional[float] = None
+    for m in signal_maps:
+        if not m:
+            continue
+        v = m.get(MEMORY_AVAILABLE)
+        if v is None:
+            continue
+        if v.endswith("%"):
+            p = float(v[:-1])
+            if p == 100.0:
+                p = 0.0
+            got = _math.ceil(capacity_memory_bytes / 100.0 * p)
+        else:
+            got = parse_quantity(v)
+        best = got if best is None else max(best, got)
+    return best
+
+
+def specialize_for_kubelet(it: InstanceType, kc) -> InstanceType:
+    """Derive the per-provisioner InstanceType a kubeletConfiguration implies.
+
+    The reference constructs instance types per-provisioner, threading kc into
+    pod density, kube/system-reserved, and the eviction threshold
+    (instancetype.go:50-357).  We specialize the shared catalog object
+    instead: pod capacity is recomputed from the catalog's density default,
+    reserved maps get lo.Assign-style per-resource overrides on top of the
+    already-computed bases (which keeps AL2's ENI-limited kube-reserved
+    memory semantics — UsesENILimitedMemoryOverhead — intact under a maxPods
+    override), and the eviction threshold takes the max memory.available
+    signal.  Returns ``it`` unchanged when kc changes nothing solver-visible.
+    """
+    if kc is None or not kc.affects_capacity():
+        return it
+    vcpus = it.capacity.get(L.RESOURCE_CPU, 0.0)
+    default_pods = it.capacity.get(L.RESOURCE_PODS, 0.0)
+    pods = kubelet_pod_density(default_pods, vcpus, kc)
+
+    capacity = dict(it.capacity)
+    capacity[L.RESOURCE_PODS] = pods
+
+    kube = dict(it.overhead.kube_reserved)
+    kube.update(kc.kube_reserved)
+    system = dict(it.overhead.system_reserved)
+    system.update(kc.system_reserved)
+    evict = dict(it.overhead.eviction_threshold)
+    override = eviction_override(
+        capacity.get(L.RESOURCE_MEMORY, 0.0), kc.eviction_hard, kc.eviction_soft
+    )
+    if override is not None:
+        evict[L.RESOURCE_MEMORY] = override
+
+    reqs = Requirements([r for r in it.requirements.to_list() if r.key != L.INSTANCE_PODS])
+    reqs.add(Requirement(L.INSTANCE_PODS, IN, [str(int(pods))]))
+    return InstanceType(
+        name=it.name,
+        requirements=reqs,
+        offerings=it.offerings,
+        capacity=capacity,
+        overhead=Overhead(kube_reserved=kube, system_reserved=system,
+                          eviction_threshold=evict),
+    )

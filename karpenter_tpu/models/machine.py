@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from .pod import PodSpec, Taint
+from .provisioner import KubeletConfiguration
 from .requirements import Requirement, Requirements
 from .resources import ResourceList
 
@@ -28,6 +29,9 @@ class Machine:
     labels: Dict[str, str] = field(default_factory=dict)
     resource_requests: ResourceList = field(default_factory=dict)  # sum of pods to place
     node_template: str = "default"
+    # provisioner's kubeletConfiguration rides along so the cloud layer can
+    # apply density/reservation overrides at launch
+    kubelet: Optional[KubeletConfiguration] = None
 
     # status (set by the cloud layer)
     provider_id: str = ""

@@ -29,7 +29,7 @@ from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 from . import labels as L
-from .instancetype import InstanceType, Offering
+from .instancetype import InstanceType, Offering, specialize_for_kubelet
 from .pod import LabelSelector, PodAffinityTerm, PodSpec, TopologySpreadConstraint
 from .provisioner import Provisioner
 from .requirements import Requirement, Requirements
@@ -221,6 +221,30 @@ def group_pods(pods: Sequence[PodSpec]) -> List[PodGroup]:
     return groups
 
 
+# kubelet-specialization memo: build_candidates runs on every solve, and a
+# kc-bearing provisioner would otherwise redo the same Requirements rebuild
+# for every catalog type each time.  Keyed on (id(it), kc.signature()); the
+# stored strong ref to `it` both validates the id (reuse-safe) and pins it
+# while cached.  Bounded LRU so long-lived processes with churning catalogs
+# don't grow without bound.
+_KC_MEMO: Dict[tuple, tuple] = {}
+_KC_MEMO_MAX = 8192
+
+
+def _specialized(it: InstanceType, kc) -> InstanceType:
+    if kc is None or not kc.affects_capacity():
+        return it
+    key = (id(it), kc.signature())
+    hit = _KC_MEMO.get(key)
+    if hit is not None and hit[0] is it:
+        return hit[1]
+    out = specialize_for_kubelet(it, kc)
+    if len(_KC_MEMO) >= _KC_MEMO_MAX:
+        _KC_MEMO.pop(next(iter(_KC_MEMO)))
+    _KC_MEMO[key] = (it, out)
+    return out
+
+
 def build_candidates(
     provisioners: Sequence[Provisioner],
     instance_types: Sequence[InstanceType],
@@ -236,11 +260,17 @@ def build_candidates(
     ordered = sorted(enumerate(provisioners), key=lambda ip: (-ip[1].weight, ip[1].name))
     for pi, prov in ordered:
         preqs = prov.scheduling_requirements()
+        kc = prov.kubelet
         for it in instance_types:
-            if preqs.intersects(it.requirements) is not None:
+            # per-provisioner kubeletConfiguration changes pod density and
+            # reservations, so the candidate carries a specialized type
+            # (reference constructs instance types per-provisioner with kc
+            # threaded through — instancetype.go:50-357)
+            it_p = _specialized(it, kc)
+            if preqs.intersects(it_p.requirements) is not None:
                 continue
-            merged = it.requirements.copy().add(preqs)
-            out.append((pi, prov, it, merged))
+            merged = it_p.requirements.copy().add(preqs)
+            out.append((pi, prov, it_p, merged))
     return out
 
 

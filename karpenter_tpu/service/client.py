@@ -6,8 +6,9 @@ reconciler <-> solver split of the north star; the reference consumes its
 remote boundary the same way — ``cloudprovider.New(awsCtx)`` at
 cmd/controller/main.go:44 is handed to every control loop).  The facade
 contract (same methods, same signatures) is asserted by
-tests/test_service.py::test_facade_contract so any drift between the two
-schedulers fails CI, not production.
+tests/test_service.py::TestFacadeContract (test_signatures_match /
+test_shared_attributes) so any drift between the two schedulers fails CI,
+not production.
 """
 
 from __future__ import annotations

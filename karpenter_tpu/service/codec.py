@@ -14,7 +14,7 @@ from ..models.pod import (
     Toleration,
     TopologySpreadConstraint,
 )
-from ..models.provisioner import Provisioner
+from ..models.provisioner import KubeletConfiguration, Provisioner
 from ..models.requirements import Requirement, Requirements
 from ..solver.types import SimNode, SolveResult
 from . import solver_pb2 as pb
@@ -98,6 +98,20 @@ def encode_provisioner(p: Provisioner) -> pb.Provisioner:
     for k, v in p.labels.items():
         out.labels[k] = v
     out.limits.extend(_quantities(p.limits))
+    if p.kubelet is not None:
+        kc = p.kubelet
+        out.kubelet.CopyFrom(pb.KubeletConfiguration(
+            has_max_pods=kc.max_pods is not None,
+            max_pods=kc.max_pods or 0,
+            has_pods_per_core=kc.pods_per_core is not None,
+            pods_per_core=kc.pods_per_core or 0,
+        ))
+        out.kubelet.system_reserved.extend(_quantities(kc.system_reserved))
+        out.kubelet.kube_reserved.extend(_quantities(kc.kube_reserved))
+        for k, v in kc.eviction_hard.items():
+            out.kubelet.eviction_hard[k] = v
+        for k, v in kc.eviction_soft.items():
+            out.kubelet.eviction_soft[k] = v
     return out
 
 
@@ -229,6 +243,17 @@ def decode_instance_type(it: pb.InstanceType) -> InstanceType:
 
 
 def decode_provisioner(p: pb.Provisioner) -> Provisioner:
+    kubelet = None
+    if p.HasField("kubelet"):
+        kc = p.kubelet
+        kubelet = KubeletConfiguration(
+            max_pods=kc.max_pods if kc.has_max_pods else None,
+            pods_per_core=kc.pods_per_core if kc.has_pods_per_core else None,
+            system_reserved=_qdict(kc.system_reserved),
+            kube_reserved=_qdict(kc.kube_reserved),
+            eviction_hard=dict(kc.eviction_hard),
+            eviction_soft=dict(kc.eviction_soft),
+        )
     return Provisioner(
         name=p.name,
         requirements=[_dreq(r) for r in p.requirements],
@@ -238,6 +263,7 @@ def decode_provisioner(p: pb.Provisioner) -> Provisioner:
         limits=_qdict(p.limits),
         weight=p.weight,
         consolidation_enabled=p.consolidation_enabled,
+        kubelet=kubelet,
     )
 
 

@@ -1,0 +1,269 @@
+"""Per-provisioner kubeletConfiguration: density/reservation parity with the
+reference formulas (instancetype.go:226-340, karpenter.sh_provisioners.yaml:
+56-135) and end-to-end flow through both solvers + launch path."""
+
+import math
+
+import pytest
+
+from karpenter_tpu.manifests import parse_provisioner
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.catalog import generate_catalog
+from karpenter_tpu.models.instancetype import (
+    GIB,
+    MIB,
+    eviction_override,
+    kubelet_pod_density,
+    specialize_for_kubelet,
+)
+from karpenter_tpu.models.pod import PodSpec
+from karpenter_tpu.models.provisioner import KubeletConfiguration, Provisioner
+from karpenter_tpu.models.tensorize import tensorize
+from karpenter_tpu.solver import native, reference
+from karpenter_tpu.solver.tpu import solve_tensors
+from karpenter_tpu.webhooks import AdmissionError, admit_provisioner
+
+
+def default_prov(**kw):
+    return Provisioner(name=kw.pop("name", "default"), **kw).with_defaults()
+
+
+def _find(catalog, name):
+    return next(it for it in catalog if it.name == name)
+
+
+class TestDensityFormula:
+    """pods() at instancetype.go:326-340."""
+
+    def test_max_pods_overrides_default(self):
+        kc = KubeletConfiguration(max_pods=10)
+        assert kubelet_pod_density(234.0, 16.0, kc) == 10.0
+
+    def test_pods_per_core_caps(self):
+        kc = KubeletConfiguration(pods_per_core=2)
+        # 2 pods/core * 4 vCPU = 8 < ENI default
+        assert kubelet_pod_density(58.0, 4.0, kc) == 8.0
+
+    def test_pods_per_core_takes_min_with_max_pods(self):
+        # reference: count = min(podsPerCore * vcpus, maxPods)
+        kc = KubeletConfiguration(max_pods=6, pods_per_core=2)
+        assert kubelet_pod_density(58.0, 4.0, kc) == 6.0
+        kc = KubeletConfiguration(max_pods=100, pods_per_core=2)
+        assert kubelet_pod_density(58.0, 4.0, kc) == 8.0
+
+    def test_no_overrides_keeps_default(self):
+        kc = KubeletConfiguration()
+        assert kubelet_pod_density(58.0, 4.0, kc) == 58.0
+        assert not kc.affects_capacity()
+
+
+class TestEvictionFormula:
+    """evictionThreshold at instancetype.go:291-324."""
+
+    def test_percentage_is_ceil_of_capacity(self):
+        cap = 8.0 * GIB
+        got = eviction_override(cap, {"memory.available": "5%"})
+        assert got == math.ceil(cap / 100.0 * 5.0)
+
+    def test_hundred_percent_disables(self):
+        got = eviction_override(8.0 * GIB, {"memory.available": "100%"})
+        assert got == 0.0
+
+    def test_quantity_parses(self):
+        got = eviction_override(8.0 * GIB, {"memory.available": "200Mi"})
+        assert got == 200.0 * MIB
+
+    def test_max_across_hard_and_soft(self):
+        got = eviction_override(
+            8.0 * GIB, {"memory.available": "100Mi"}, {"memory.available": "300Mi"})
+        assert got == 300.0 * MIB
+
+    def test_absent_signal_is_none(self):
+        assert eviction_override(8.0 * GIB, {"nodefs.available": "10%"}, {}) is None
+
+
+class TestSpecialize:
+    def test_noop_returns_same_object(self, small_catalog):
+        it = small_catalog[0]
+        assert specialize_for_kubelet(it, None) is it
+        assert specialize_for_kubelet(it, KubeletConfiguration()) is it
+
+    def test_max_pods_changes_capacity_and_requirement(self, small_catalog):
+        it = _find(small_catalog, "c5.4xlarge")
+        out = specialize_for_kubelet(it, KubeletConfiguration(max_pods=10))
+        assert out.capacity[L.RESOURCE_PODS] == 10.0
+        assert out.requirements.get(L.INSTANCE_PODS).contains("10")
+        # kube-reserved memory keeps the ENI-limited base (AL2
+        # UsesENILimitedMemoryOverhead): maxPods does NOT shrink it
+        assert out.overhead.kube_reserved[L.RESOURCE_MEMORY] == (
+            it.overhead.kube_reserved[L.RESOURCE_MEMORY])
+        # untouched resources unchanged
+        assert out.capacity[L.RESOURCE_CPU] == it.capacity[L.RESOURCE_CPU]
+
+    def test_reserved_overrides_assign_semantics(self, small_catalog):
+        it = _find(small_catalog, "c5.4xlarge")
+        kc = KubeletConfiguration(
+            system_reserved={L.RESOURCE_CPU: 0.5},
+            kube_reserved={L.RESOURCE_MEMORY: 2.0 * GIB},
+        )
+        out = specialize_for_kubelet(it, kc)
+        # overridden keys replaced, others kept (lo.Assign)
+        assert out.overhead.system_reserved[L.RESOURCE_CPU] == 0.5
+        assert out.overhead.system_reserved[L.RESOURCE_MEMORY] == (
+            it.overhead.system_reserved[L.RESOURCE_MEMORY])
+        assert out.overhead.kube_reserved[L.RESOURCE_MEMORY] == 2.0 * GIB
+        assert out.overhead.kube_reserved[L.RESOURCE_CPU] == (
+            it.overhead.kube_reserved[L.RESOURCE_CPU])
+        # allocatable reflects the new overhead
+        assert out.allocatable[L.RESOURCE_CPU] < it.allocatable[L.RESOURCE_CPU]
+
+    def test_eviction_override_flows_to_allocatable(self, small_catalog):
+        it = _find(small_catalog, "c5.4xlarge")
+        kc = KubeletConfiguration(eviction_hard={"memory.available": "5%"})
+        out = specialize_for_kubelet(it, kc)
+        want = math.ceil(it.capacity[L.RESOURCE_MEMORY] / 100.0 * 5.0)
+        assert out.overhead.eviction_threshold[L.RESOURCE_MEMORY] == want
+
+
+class TestSolverDensityCap:
+    """A maxPods=10 provisioner caps pods-per-node at 10 in every tier."""
+
+    def _pods(self, n=40):
+        # tiny pods: without the cap they'd pack ~50+ per node
+        return [PodSpec(name=f"p{i}", requests={"cpu": 0.05}) for i in range(n)]
+
+    def _max_per_node(self, result):
+        per = {}
+        for pod, node in result.assignments.items():
+            per[node] = per.get(node, 0) + 1
+        return max(per.values())
+
+    def test_oracle_caps(self, small_catalog):
+        prov = default_prov(kubelet=KubeletConfiguration(max_pods=10))
+        got = reference.solve(self._pods(), [prov], small_catalog)
+        assert got.infeasible == {}
+        assert self._max_per_node(got) <= 10
+
+    def test_device_caps(self, small_catalog):
+        prov = default_prov(kubelet=KubeletConfiguration(max_pods=10))
+        st = tensorize(self._pods(), [prov], small_catalog)
+        got = solve_tensors(st).result
+        assert got.infeasible == {}
+        assert self._max_per_node(got) <= 10
+
+    @pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+    def test_native_caps(self, small_catalog):
+        prov = default_prov(kubelet=KubeletConfiguration(max_pods=10))
+        st = tensorize(self._pods(), [prov], small_catalog)
+        got = native.solve_tensors_native(st)
+        assert got.infeasible == {}
+        assert self._max_per_node(got) <= 10
+
+    def test_per_provisioner_density_differs(self, small_catalog):
+        """Two provisioners, same catalog: candidate rows carry different
+        densities (the per-provisioner construction the reference does)."""
+        capped = default_prov(name="capped", kubelet=KubeletConfiguration(max_pods=5))
+        free = default_prov(name="free")
+        st = tensorize(self._pods(4), [capped, free], small_catalog)
+        pods_rid = st.vocab.resource_id[L.RESOURCE_PODS]
+        dens = {}
+        for ci, (pname, itname) in enumerate(st.cand_names):
+            dens.setdefault(pname, set()).add(st.cand_cap[ci][pods_rid])
+        assert dens["capped"] == {5.0}
+        assert all(v > 5.0 for v in dens["free"])
+
+
+class TestAdmissionAndManifest:
+    def test_bad_max_pods_rejected(self):
+        prov = Provisioner(name="x", kubelet=KubeletConfiguration(max_pods=0))
+        with pytest.raises(AdmissionError, match="maxPods"):
+            admit_provisioner(prov)
+
+    def test_bad_percentage_rejected(self):
+        prov = Provisioner(
+            name="x",
+            kubelet=KubeletConfiguration(eviction_hard={"memory.available": "150%"}))
+        with pytest.raises(AdmissionError, match="percentage"):
+            admit_provisioner(prov)
+
+    def test_bad_quantity_rejected(self):
+        # "512MiB" is not a k8s quantity (suffix is Mi); without admission
+        # rejection it would crash every solve inside eviction_override
+        prov = Provisioner(
+            name="x",
+            kubelet=KubeletConfiguration(eviction_hard={"memory.available": "512MiB"}))
+        with pytest.raises(AdmissionError, match="quantity"):
+            admit_provisioner(prov)
+
+    def test_soft_without_grace_period_rejected(self):
+        prov = Provisioner(
+            name="x",
+            kubelet=KubeletConfiguration(eviction_soft={"memory.available": "5%"}))
+        with pytest.raises(AdmissionError, match="GracePeriod"):
+            admit_provisioner(prov)
+
+    def test_manifest_parses_full_shape(self):
+        doc = {
+            "metadata": {"name": "dense"},
+            "spec": {
+                "kubeletConfiguration": {
+                    "maxPods": 20,
+                    "podsPerCore": 4,
+                    "systemReserved": {"cpu": "200m", "memory": "200Mi"},
+                    "kubeReserved": {"memory": "1Gi"},
+                    "evictionHard": {"memory.available": "5%"},
+                    "evictionSoft": {"memory.available": "10%"},
+                    "evictionSoftGracePeriod": {"memory.available": "2m"},
+                    "evictionMaxPodGracePeriod": 600,
+                    "clusterDNS": ["10.0.0.10"],
+                    "containerRuntime": "containerd",
+                },
+            },
+        }
+        p = parse_provisioner(doc)
+        kc = p.kubelet
+        assert kc.max_pods == 20 and kc.pods_per_core == 4
+        assert kc.system_reserved[L.RESOURCE_CPU] == 0.2
+        assert kc.kube_reserved[L.RESOURCE_MEMORY] == 1.0 * GIB
+        assert kc.eviction_soft_grace_period["memory.available"] == 120.0
+        assert kc.cluster_dns == ("10.0.0.10",)
+        # admission passes on the parsed object
+        admit_provisioner(p)
+
+    def test_codec_roundtrip(self):
+        from karpenter_tpu.service import codec
+
+        kc = KubeletConfiguration(
+            max_pods=10, pods_per_core=2,
+            system_reserved={L.RESOURCE_CPU: 0.2},
+            kube_reserved={L.RESOURCE_MEMORY: 1.0 * GIB},
+            eviction_hard={"memory.available": "5%"},
+        )
+        p = Provisioner(name="x", kubelet=kc)
+        got = codec.decode_provisioner(codec.encode_provisioner(p)).kubelet
+        assert got.signature() == kc.signature()
+        assert codec.decode_provisioner(
+            codec.encode_provisioner(Provisioner(name="y"))).kubelet is None
+
+
+class TestLaunchPath:
+    def test_machine_capacity_and_userdata(self, small_catalog):
+        """Bootstrap flags render the kc the way eksbootstrap.go does."""
+        kc = KubeletConfiguration(max_pods=12, system_reserved={L.RESOURCE_CPU: 0.5})
+        flags = kc.bootstrap_flags()
+        assert flags["max-pods"] == "12"
+        assert flags["system-reserved"] == "cpu=500m"
+
+    def test_fake_cloud_applies_kc(self, small_catalog):
+        from karpenter_tpu.cloud.fake import FakeCloudProvider
+        from karpenter_tpu.models.machine import Machine
+        from karpenter_tpu.models.requirements import IN, Requirement, Requirements
+
+        cloud = FakeCloudProvider(instance_types=small_catalog)
+        reqs = Requirements()
+        reqs.add(Requirement(L.INSTANCE_TYPE, IN, ["c5.xlarge"]))
+        m = Machine(provisioner="default", requirements=reqs,
+                    kubelet=KubeletConfiguration(max_pods=7))
+        cloud.create(m)
+        assert m.capacity[L.RESOURCE_PODS] == 7.0
+        assert m.allocatable[L.RESOURCE_PODS] <= 7.0
