@@ -34,7 +34,7 @@ from ..metrics import (
 from ..models import labels as L
 from ..models.pod import PodSpec
 from ..solver.scheduler import BatchScheduler
-from ..solver.types import SimNode
+from ..solver.types import SimNode, SolveResult
 from ..utils.clock import Clock
 from .state import ClusterState, NodeState
 from .termination import TerminationController
@@ -503,6 +503,17 @@ class DeprovisioningController:
         """The §3.3 what-if: schedule ``pods`` onto the cluster minus
         ``exclude`` plus at most one new node (shared by the consolidation
         simulate and the drift/expiration replacement planner)."""
+        # volume pins must be current before simulating a move: a wffc claim
+        # that bound since the pod was scheduled restricts where the pod may
+        # be relocated (scheduling.md:378-433).  Unresolvable claims abort
+        # the what-if — relocating such a pod could strand it off-zone.
+        vt = self.state.volume_topology
+        for p in pods:
+            if p.volume_claims and vt.inject(p):
+                return SolveResult(
+                    nodes=[], assignments={},
+                    infeasible={p.name: "volume claim unresolvable"},
+                )
         others = [
             n for n in self.state.schedulable_nodes() if n.name not in exclude
         ]

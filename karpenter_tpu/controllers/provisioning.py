@@ -81,6 +81,24 @@ class ProvisioningController:
         return self._provision(batch)
 
     def _provision(self, batch: List[PodSpec]) -> SolveResult:
+        # volume-topology injection: fold each pod's storage reach (bound PV
+        # zone / WaitForFirstConsumer allowedTopologies) into its scheduling
+        # requirements before the solve (scheduling.md:378-433).  Pods whose
+        # claims can't resolve stay pending — scheduling them storage-blind
+        # would land them off-zone.
+        ready: List[PodSpec] = []
+        for pod in batch:
+            errors = self.state.volume_topology.inject(pod)
+            if errors:
+                self.recorder.publish(Event(
+                    "Pod", pod.name, "FailedScheduling",
+                    "; ".join(errors), "Warning",
+                ))
+                continue
+            ready.append(pod)
+        batch = ready
+        if not batch:
+            return SolveResult(nodes=[], assignments={}, infeasible={})
         provisioners = [p.with_defaults() for p in self.state.provisioners.values()]
         instance_types = self.cloud.get_instance_types()
         result = self.scheduler.solve(

@@ -47,6 +47,10 @@ class ClusterState:
         self.provisioners: Dict[str, Provisioner] = {}
         self.daemonsets: List[PodSpec] = []
         self.pod_added_at: Dict[str, float] = {}  # feeds pod-startup latency
+        # storage objects backing volume-topology injection (scheduling.md:378-433)
+        from ..models.volume import VolumeTopology
+
+        self.volume_topology = VolumeTopology()
         self.seqnum = 0  # bumps on any change; consolidation backs off on no-change
 
     # ---- mutation ------------------------------------------------------
@@ -67,6 +71,46 @@ class ClusterState:
     def add_pod(self, pod: PodSpec) -> None:
         self.pods[pod.name] = pod
         self.pod_added_at.setdefault(pod.name, self.clock.now())
+        if pod.volume_claims:
+            # best-effort early pin; _provision re-injects and holds back
+            # pods whose claims still can't resolve
+            self.volume_topology.inject(pod)
+        self._changed()
+
+    def apply_storage(self, obj) -> None:
+        """Register a PVC / PV / StorageClass (volume-topology inputs); a
+        bind/claim change re-pins affected pods on the next reconcile's
+        inject pass."""
+        from ..models.volume import (
+            PersistentVolume,
+            PersistentVolumeClaim,
+            StorageClass,
+        )
+
+        vt = self.volume_topology
+        if isinstance(obj, PersistentVolumeClaim):
+            vt.apply_claim(obj)
+        elif isinstance(obj, PersistentVolume):
+            vt.apply_volume(obj)
+        elif isinstance(obj, StorageClass):
+            vt.apply_class(obj)
+        else:  # pragma: no cover - programming error
+            raise TypeError(f"not a storage object: {obj!r}")
+        self._storage_changed()
+
+    def bind_volume(self, namespace: str, claim_name: str, pv) -> None:
+        """CSI bound a volume to a claim (the WaitForFirstConsumer aftermath):
+        register it and re-pin affected pods immediately."""
+        self.volume_topology.bind(namespace, claim_name, pv)
+        self._storage_changed()
+
+    def _storage_changed(self) -> None:
+        # storage reach changed: re-pin every claim-bearing pod NOW so
+        # consolidation what-ifs and screens never simulate against stale
+        # zone requirements (a wffc claim that just bound pins its pods)
+        for pod in self.pods.values():
+            if pod.volume_claims:
+                self.volume_topology.inject(pod)
         self._changed()
 
     def delete_pod(self, name: str) -> None:

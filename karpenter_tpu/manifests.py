@@ -30,6 +30,13 @@ from .models import labels as L  # noqa: F401  (manifest docs reference labels)
 from .models.pod import Taint
 from .models.provisioner import KubeletConfiguration, Provisioner
 from .models.requirements import Requirement
+from .models.volume import (
+    VOLUME_BINDING_IMMEDIATE,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+    parse_zone_topology,
+)
 from .settings import Settings
 from .utils.quantity import parse_quantity
 from .webhooks import (
@@ -137,6 +144,60 @@ def _parse_kubelet(doc: dict) -> KubeletConfiguration:
         ),
         cluster_dns=tuple(doc.get("clusterDNS") or ()),
         container_runtime=doc.get("containerRuntime"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# storage objects (PV topology inputs — scheduling.md:378-433)
+# ---------------------------------------------------------------------------
+
+
+def parse_storage_class(doc: dict) -> StorageClass:
+    meta = doc.get("metadata", {}) or {}
+    exprs = []
+    for topo in doc.get("allowedTopologies", []) or []:
+        exprs.extend(topo.get("matchLabelExpressions", []) or [])
+    zones, errors = parse_zone_topology(exprs)
+    if errors:
+        raise AdmissionError("StorageClass", meta.get("name", "?"), errors)
+    return StorageClass(
+        name=meta.get("name", "default"),
+        provisioner=doc.get("provisioner", "ebs.csi.tpu"),
+        volume_binding_mode=doc.get("volumeBindingMode", VOLUME_BINDING_IMMEDIATE),
+        allowed_zones=zones,
+    )
+
+
+def parse_persistent_volume(doc: dict) -> PersistentVolume:
+    meta = doc.get("metadata", {}) or {}
+    spec = doc.get("spec", {}) or {}
+    exprs = []
+    required = ((spec.get("nodeAffinity", {}) or {}).get("required", {}) or {})
+    for term in required.get("nodeSelectorTerms", []) or []:
+        exprs.extend(term.get("matchExpressions", []) or [])
+    zones, errors = parse_zone_topology(exprs)
+    if errors:
+        raise AdmissionError("PersistentVolume", meta.get("name", "?"), errors)
+    storage = (spec.get("capacity", {}) or {}).get("storage", 0)
+    return PersistentVolume(
+        name=meta.get("name", "?"),
+        zones=zones,
+        storage_class=spec.get("storageClassName", ""),
+        capacity=parse_quantity(storage) if storage else 0.0,
+    )
+
+
+def parse_persistent_volume_claim(doc: dict) -> PersistentVolumeClaim:
+    meta = doc.get("metadata", {}) or {}
+    spec = doc.get("spec", {}) or {}
+    requested = (((spec.get("resources", {}) or {}).get("requests", {}) or {})
+                 .get("storage", 0))
+    return PersistentVolumeClaim(
+        name=meta.get("name", "?"),
+        namespace=meta.get("namespace", "default"),
+        storage_class=spec.get("storageClassName", ""),
+        volume_name=spec.get("volumeName", ""),
+        requested=parse_quantity(requested) if requested else 0.0,
     )
 
 
@@ -262,7 +323,7 @@ def load_documents(path) -> List[dict]:
 def admit_documents(
     docs: Iterable[dict],
     current_settings: Optional[Settings] = None,
-) -> Tuple[List[Provisioner], List[NodeTemplate], Dict[str, object]]:
+) -> Tuple[List[Provisioner], List[NodeTemplate], Dict[str, object], List[object]]:
     """Parse + ADMIT every recognized document; raises AdmissionError on the
     first invalid one.  Unrecognized kinds are skipped (a manifest dir may
     carry Deployments/RBAC alongside the karpenter objects).  Settings
@@ -272,6 +333,7 @@ def admit_documents(
     provisioners: List[Provisioner] = []
     templates: List[NodeTemplate] = []
     settings: Dict[str, object] = {}
+    storage: List[object] = []  # StorageClass | PersistentVolume | PVC
     for doc in docs:
         kind = str(doc.get("kind", ""))
         name = str((doc.get("metadata", {}) or {}).get("name", "?"))
@@ -286,6 +348,12 @@ def admit_documents(
                 templates.append(admit_node_template(parse_node_template(doc)))
             elif (kind == "ConfigMap" and name == "karpenter-global-settings"):
                 settings.update(parse_settings(doc))
+            elif kind == "StorageClass":
+                storage.append(parse_storage_class(doc))
+            elif kind == "PersistentVolume":
+                storage.append(parse_persistent_volume(doc))
+            elif kind == "PersistentVolumeClaim":
+                storage.append(parse_persistent_volume_claim(doc))
         except AdmissionError:
             raise
         except (ValueError, KeyError, TypeError, AttributeError) as err:
@@ -297,13 +365,14 @@ def admit_documents(
         # judged against the live baseline (apply_objects re-validates under
         # the operator's lock right before mutating)
         admit_settings(replace(current_settings or Settings(), **settings))
-    return provisioners, templates, settings
+    return provisioners, templates, settings, storage
 
 
 def apply_objects(
     provisioners: List[Provisioner],
     templates: List[NodeTemplate],
     overrides: Dict[str, object],
+    storage: List[object] = (),
     *,
     state=None,
     cloud=None,
@@ -318,6 +387,8 @@ def apply_objects(
     if state is not None:
         for prov in provisioners:
             state.apply_provisioner(prov)
+        for obj in storage:
+            state.apply_storage(obj)
     if cloud is not None and hasattr(cloud, "templates"):
         for t in templates:
             cloud.templates[t.name] = t
@@ -328,10 +399,10 @@ def apply_objects(
 def apply_path(path, *, state=None, cloud=None, settings_store=None):
     """Load manifests from ``path`` and apply the admitted objects to a
     running operator's state/cloud/settings.  Returns the admitted tuple."""
-    provisioners, templates, overrides = admit_documents(
+    provisioners, templates, overrides, storage = admit_documents(
         load_documents(path),
         current_settings=settings_store.current if settings_store else None,
     )
-    apply_objects(provisioners, templates, overrides,
+    apply_objects(provisioners, templates, overrides, storage,
                   state=state, cloud=cloud, settings_store=settings_store)
-    return provisioners, templates, overrides
+    return provisioners, templates, overrides, storage

@@ -114,6 +114,12 @@ class PodSpec:
     priority: int = 0
     deletion_cost: float = 1.0  # pod-deletion-cost annotation analog
     owner_key: str = ""  # deployment/replicaset identity, for dedup grouping
+    # persistent storage: PVC names this pod mounts (spec.volumes[].
+    # persistentVolumeClaim.claimName) and the zone requirements the volume
+    # topology injector derived from them (scheduling.md:378-433) — set by
+    # VolumeTopology.inject before scheduling, ANDed into every term
+    volume_claims: List[str] = field(default_factory=list)
+    volume_zone_requirements: List[Requirement] = field(default_factory=list)
     do_not_evict: bool = False
     is_daemon: bool = False  # daemonset-owned: never blocks drain/emptiness
     uid: int = field(default_factory=lambda: next(_pod_counter))
@@ -132,6 +138,8 @@ class PodSpec:
         keeps none.
         """
         base = Requirements.from_labels(self.node_selector)
+        for r in self.volume_zone_requirements:
+            base.add(r)
         for term in self.preferred_affinity_terms[: relax_preferred]:
             for r in term:
                 base.add(r)
@@ -190,4 +198,6 @@ class PodSpec:
             tuple(self.topology_spread) if self.topology_spread else (),
             tuple(self.affinity_terms) if self.affinity_terms else (),
             self.priority,
+            (tuple(self.volume_zone_requirements)
+             if self.volume_zone_requirements else ()),
         )

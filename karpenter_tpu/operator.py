@@ -316,13 +316,13 @@ class Operator:
         if not docs:
             return 400, {"allowed": False, "errors": ["empty request body"]}
         try:
-            provs, templates, overrides = admit_documents(
+            provs, templates, overrides, storage = admit_documents(
                 docs, current_settings=self.settings.current
             )
         except AdmissionError as err:
             return 422, {"allowed": False, "kind": err.kind,
                          "name": err.name, "errors": err.errors}
-        if not provs and not templates and not overrides:
+        if not provs and not templates and not overrides and not storage:
             kinds = sorted({str(d.get("kind", "?")) for d in docs})
             return 400, {"allowed": False,
                          "errors": [f"no recognized documents (kinds: {kinds})"]}
@@ -334,7 +334,7 @@ class Operator:
                 # mutate state dicts mid-tick (dictionary-changed-size), and
                 # a tick must never observe a half-applied config
                 with self._reconcile_lock:
-                    apply_objects(provs, templates, overrides,
+                    apply_objects(provs, templates, overrides, storage,
                                   state=self.state, cloud=self.cloud,
                                   settings_store=self.settings)
             except AdmissionError as err:
@@ -346,6 +346,7 @@ class Operator:
                 "provisioners": [p.name for p in provs],
                 "node_templates": [t.name for t in templates],
                 "settings_keys": sorted(overrides),
+                "storage_objects": [getattr(s, "name", "?") for s in storage],
             },
             "applied": bool(apply),
         }

@@ -70,6 +70,7 @@ def encode_pod(p: PodSpec) -> pb.Pod:
                         topology_key=t.topology_key, anti=t.anti)
         for t in p.affinity_terms
     )
+    out.volume_zone_requirements.extend(_req(r) for r in p.volume_zone_requirements)
     return out
 
 
@@ -82,7 +83,9 @@ def encode_instance_type(it: InstanceType) -> pb.InstanceType:
         for o in it.offerings
     )
     out.capacity.extend(_quantities(it.capacity))
-    out.overhead.extend(_quantities(it.overhead.total()))
+    out.overhead.extend(_quantities(it.overhead.kube_reserved))
+    out.overhead_system.extend(_quantities(it.overhead.system_reserved))
+    out.overhead_eviction.extend(_quantities(it.overhead.eviction_threshold))
     return out
 
 
@@ -227,6 +230,7 @@ def decode_pod(p: pb.Pod) -> PodSpec:
         priority=p.priority,
         deletion_cost=p.deletion_cost or 1.0,
         owner_key=p.owner,
+        volume_zone_requirements=[_dreq(r) for r in p.volume_zone_requirements],
     )
 
 
@@ -238,7 +242,11 @@ def decode_instance_type(it: pb.InstanceType) -> InstanceType:
             Offering(o.zone, o.capacity_type, o.price, o.available) for o in it.offerings
         ],
         capacity=_qdict(it.capacity),
-        overhead=Overhead(kube_reserved=_qdict(it.overhead)),
+        overhead=Overhead(
+            kube_reserved=_qdict(it.overhead),
+            system_reserved=_qdict(it.overhead_system),
+            eviction_threshold=_qdict(it.overhead_eviction),
+        ),
     )
 
 

@@ -229,7 +229,7 @@ class TestYamlManifests:
         state = ClusterState(clock=clock)
         cloud = FakeCloudProvider(small_catalog, clock=clock)
         store = SettingsStore()
-        provs, templates, overrides = apply_path(
+        provs, templates, overrides, storage = apply_path(
             "deploy/examples", state=state, cloud=cloud, settings_store=store
         )
         assert {p.name for p in provs} == {"default", "spot-burst"}

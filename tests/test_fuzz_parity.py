@@ -152,12 +152,18 @@ def validate_solution(pods, provs, res, catalog=(),
             for tsc in p.topology_spread:
                 if tsc.when_unsatisfiable != "DoNotSchedule" or tsc.topology_key != L.ZONE:
                     continue
-                key = (tsc.label_selector, tsc.max_skew, tuple(sorted(p.node_selector.items())))
+                key = (tsc.label_selector, tsc.max_skew,
+                       tuple(sorted(p.node_selector.items())),
+                       tuple(p.volume_zone_requirements))
                 groups.setdefault(key, {}).setdefault(node.zone, 0)
                 groups[key][node.zone] += 1
-    for (sel, skew, node_sel), counts in groups.items():
+    for (sel, skew, node_sel, vol_reqs), counts in groups.items():
+        # eligibility narrows by node_selector AND volume pins — skew is
+        # judged over the zones the pod could actually use (k8s semantics:
+        # nodeAffinity-filtered domains)
         eligible = [z for z in all_zones
-                    if dict(node_sel).get(L.ZONE, z) == z]
+                    if dict(node_sel).get(L.ZONE, z) == z
+                    and all(r.value_set().contains(z) for r in vol_reqs)]
         lo = min(counts.get(z, 0) for z in eligible)
         hi = max(counts.get(z, 0) for z in eligible)
         if hi - lo > skew:
@@ -240,6 +246,20 @@ def random_scenario(seed: int, catalog):
             it = catalog[int(rng.integers(0, len(catalog)))]
             o = it.offerings[int(rng.integers(0, len(it.offerings)))]
             unavailable.add((it.name, o.zone, o.capacity_type))
+
+    # -- volume topology pins (scheduling.md:378-433): some deployments
+    # mount zonal storage — a bound PV (1 zone) or a WaitForFirstConsumer
+    # class (2 zones).  Separate rng stream so pre-existing seeds keep their
+    # exact scenarios (the observed-worst ceilings stay comparable).
+    vrng = np.random.default_rng(seed + 55_000)
+    for d in range(n_dep):
+        if vrng.random() < 0.15:
+            nz = 1 if vrng.random() < 0.6 else 2
+            vz = sorted(vrng.choice(zones, size=nz, replace=False).tolist())
+            req = Requirement(L.ZONE, IN, vz)
+            for pod in pods:
+                if pod.owner_key == f"d{d}":
+                    pod.volume_zone_requirements = [req]
 
     return pods, provs, unavailable
 

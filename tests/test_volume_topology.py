@@ -1,0 +1,338 @@
+"""Persistent-volume topology: Pod -> PVC -> {PV | StorageClass} zone
+constraints honored by every tier (scheduling.md:378-433)."""
+
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import PodSpec
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.tensorize import tensorize
+from karpenter_tpu.models.volume import (
+    VOLUME_BINDING_WAIT,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+    VolumeTopology,
+    parse_zone_topology,
+)
+from karpenter_tpu.solver import native, reference
+from karpenter_tpu.solver.tpu import solve_tensors
+
+
+def default_prov(**kw):
+    return Provisioner(name=kw.pop("name", "default"), **kw).with_defaults()
+
+
+def make_vt(**kw):
+    vt = VolumeTopology()
+    vt.apply_class(StorageClass(
+        name="ebs", volume_binding_mode=VOLUME_BINDING_WAIT,
+        allowed_zones=("zone-1a", "zone-1b")))
+    vt.apply_claim(PersistentVolumeClaim(name="claim", storage_class="ebs", **kw))
+    return vt
+
+
+class TestZoneKeyParsing:
+    def test_aliases_translate(self):
+        zones, errs = parse_zone_topology([
+            {"key": "topology.ebs.csi.aws.com/zone", "values": ["zone-1a"]},
+            {"key": L.ZONE, "values": ["zone-1b", "zone-1a"]},
+        ])
+        assert zones == ("zone-1a", "zone-1b") and not errs
+
+    def test_region_key_rejected(self):
+        zones, errs = parse_zone_topology(
+            [{"key": "topology.kubernetes.io/region", "values": ["region-1"]}])
+        assert zones == () and "not supported" in errs[0]
+
+    def test_unrelated_keys_ignored(self):
+        zones, errs = parse_zone_topology(
+            [{"key": "kubernetes.io/hostname", "values": ["n1"]}])
+        assert zones == () and not errs
+
+
+class TestResolution:
+    def test_bound_claim_pins_to_pv_zone(self):
+        vt = make_vt(volume_name="pv-1")
+        vt.apply_volume(PersistentVolume(name="pv-1", zones=("zone-1c",)))
+        zones, err = vt.zones_for_claim("default", "claim")
+        assert zones == ("zone-1c",) and err is None
+
+    def test_unbound_wffc_uses_allowed_topologies(self):
+        vt = make_vt()
+        zones, err = vt.zones_for_claim("default", "claim")
+        assert zones == ("zone-1a", "zone-1b") and err is None
+
+    def test_unbound_immediate_unconstrained(self):
+        vt = VolumeTopology()
+        vt.apply_class(StorageClass(name="std"))  # Immediate
+        vt.apply_claim(PersistentVolumeClaim(name="claim", storage_class="std"))
+        assert vt.zones_for_claim("default", "claim") == (None, None)
+
+    def test_zone_free_pv_unconstrained(self):
+        # EFS-style PV with no node affinity
+        vt = make_vt(volume_name="pv-efs")
+        vt.apply_volume(PersistentVolume(name="pv-efs", zones=()))
+        assert vt.zones_for_claim("default", "claim") == (None, None)
+
+    def test_missing_claim_errors(self):
+        vt = VolumeTopology()
+        zones, err = vt.zones_for_claim("default", "nope")
+        assert zones is None and "not found" in err
+
+    def test_bound_to_missing_pv_errors(self):
+        vt = make_vt(volume_name="ghost")
+        zones, err = vt.zones_for_claim("default", "claim")
+        assert zones is None and "missing volume" in err
+
+    def test_inject_is_idempotent_and_rebinds(self):
+        vt = make_vt()
+        pod = PodSpec(name="p", requests={"cpu": 1.0}, volume_claims=["claim"])
+        assert vt.inject(pod) == []
+        first = list(pod.volume_zone_requirements)
+        assert tuple(first[0].values) == ("zone-1a", "zone-1b")
+        k1 = pod.group_key()
+        assert vt.inject(pod) == [] and pod.volume_zone_requirements == first
+        # the claim binds (CSI created the volume in zone-1a): re-inject pins
+        vt.bind("default", "claim", PersistentVolume(name="pv-1", zones=("zone-1a",)))
+        vt.inject(pod)
+        assert tuple(pod.volume_zone_requirements[0].values) == ("zone-1a",)
+        assert pod.group_key() != k1  # cache busted: constraints changed
+
+
+class TestSolverHonorsVolumes:
+    """A pod with a zonal volume never lands off-zone in any tier."""
+
+    def _pinned_pods(self, n=12, zone="zone-1c"):
+        vt = VolumeTopology()
+        vt.apply_claim(PersistentVolumeClaim(name="claim", volume_name="pv-1"))
+        vt.apply_volume(PersistentVolume(name="pv-1", zones=(zone,)))
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}, volume_claims=["claim"])
+                for i in range(n)]
+        for p in pods:
+            assert vt.inject(p) == []
+        return pods
+
+    def test_oracle_pins(self, small_catalog):
+        got = reference.solve(self._pinned_pods(), [default_prov()], small_catalog)
+        assert got.infeasible == {}
+        assert {n.zone for n in got.nodes} == {"zone-1c"}
+
+    def test_device_pins(self, small_catalog):
+        st = tensorize(self._pinned_pods(), [default_prov()], small_catalog)
+        got = solve_tensors(st).result
+        assert got.infeasible == {}
+        assert {n.zone for n in got.nodes} == {"zone-1c"}
+
+    @pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+    def test_native_pins(self, small_catalog):
+        st = tensorize(self._pinned_pods(), [default_prov()], small_catalog)
+        got = native.solve_tensors_native(st)
+        assert got.infeasible == {}
+        assert {n.zone for n in got.nodes} == {"zone-1c"}
+
+    def test_wffc_constrains_to_allowed(self, small_catalog):
+        vt = make_vt()
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}, volume_claims=["claim"])
+                for i in range(12)]
+        for p in pods:
+            vt.inject(p)
+        got = reference.solve(pods, [default_prov()], small_catalog)
+        st = tensorize(pods, [default_prov()], small_catalog)
+        dev = solve_tensors(st).result
+        for r in (got, dev):
+            assert r.infeasible == {}
+            assert {n.zone for n in r.nodes} <= {"zone-1a", "zone-1b"}
+
+    def test_conflicting_claims_infeasible(self, small_catalog):
+        vt = VolumeTopology()
+        for z, i in (("zone-1a", 1), ("zone-1b", 2)):
+            vt.apply_claim(PersistentVolumeClaim(name=f"c{i}", volume_name=f"pv-{i}"))
+            vt.apply_volume(PersistentVolume(name=f"pv-{i}", zones=(z,)))
+        pod = PodSpec(name="torn", requests={"cpu": 1.0}, volume_claims=["c1", "c2"])
+        vt.inject(pod)
+        got = reference.solve([pod], [default_prov()], small_catalog)
+        assert "torn" in got.infeasible
+        st = tensorize([pod], [default_prov()], small_catalog)
+        dev = solve_tensors(st).result
+        assert "torn" in dev.infeasible
+
+    def test_volume_pin_composes_with_spread(self, small_catalog):
+        """Zone-pinned pods coexist with zone-spread pods in one batch."""
+        from karpenter_tpu.models.pod import LabelSelector, TopologySpreadConstraint
+
+        pinned = self._pinned_pods(6)
+        spread = [
+            PodSpec(name=f"s{i}", requests={"cpu": 1.0},
+                    labels={"app": "web"}, owner_key="web",
+                    topology_spread=[TopologySpreadConstraint(
+                        1, L.ZONE, "DoNotSchedule",
+                        LabelSelector.of({"app": "web"}))])
+            for i in range(9)
+        ]
+        pods = pinned + spread
+        oracle = reference.solve(pods, [default_prov()], small_catalog)
+        st = tensorize(pods, [default_prov()], small_catalog)
+        dev = solve_tensors(st).result
+        for r in (oracle, dev):
+            assert r.infeasible == {}
+            by_node = {n.name: n for n in r.nodes}
+            for p in pinned:
+                assert by_node[r.assignments[p.name]].zone == "zone-1c"
+
+
+class TestControllerE2E:
+    """The full WaitForFirstConsumer story through the operator's loop."""
+
+    def _env(self, catalog):
+        from karpenter_tpu.cloud.fake import FakeCloudProvider
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.controllers.state import ClusterState
+        from karpenter_tpu.events import Recorder
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        state = ClusterState(clock=clock)
+        cloud = FakeCloudProvider(catalog, clock=clock)
+        reg = Registry()
+        ctrl = ProvisioningController(
+            state, cloud, scheduler=BatchScheduler(backend="oracle", registry=reg),
+            recorder=Recorder(), registry=reg, clock=clock)
+        state.apply_provisioner(Provisioner(name="default"))
+        return clock, state, cloud, ctrl
+
+    def test_wffc_provision_then_pin(self, small_catalog):
+        clock, state, cloud, ctrl = self._env(small_catalog)
+        state.apply_storage(StorageClass(
+            name="ebs", volume_binding_mode=VOLUME_BINDING_WAIT,
+            allowed_zones=("zone-1a", "zone-1b")))
+        state.apply_storage(PersistentVolumeClaim(name="data", storage_class="ebs"))
+        state.add_pod(PodSpec(name="app", requests={"cpu": 1.0},
+                              volume_claims=["data"]))
+        ctrl.reconcile(); clock.advance(1.5); ctrl.reconcile()
+        assert "app" in state.bindings
+        zone1 = state.node_of("app").zone
+        assert zone1 in ("zone-1a", "zone-1b")
+
+        # CSI creates the volume where the pod landed and binds the claim
+        state.bind_volume(
+            "default", "data", PersistentVolume(name="pv-data", zones=(zone1,)))
+        # pod replaced (same claim): must land in the SAME zone now
+        state.delete_pod("app")
+        state.add_pod(PodSpec(name="app2", requests={"cpu": 1.0},
+                              volume_claims=["data"]))
+        ctrl.reconcile(); clock.advance(1.5); ctrl.reconcile()
+        assert "app2" in state.bindings
+        assert state.node_of("app2").zone == zone1
+
+    def test_unresolvable_claim_stays_pending(self, small_catalog):
+        clock, state, cloud, ctrl = self._env(small_catalog)
+        state.add_pod(PodSpec(name="app", requests={"cpu": 1.0},
+                              volume_claims=["ghost"]))
+        ctrl.reconcile(); clock.advance(1.5); ctrl.reconcile()
+        assert "app" not in state.bindings  # pending, not scheduled blind
+        assert len(cloud.instances) == 0
+
+
+class TestManifestsAndCodec:
+    def test_yaml_ingestion(self):
+        from karpenter_tpu.manifests import admit_documents
+
+        docs = [
+            {"kind": "StorageClass", "apiVersion": "storage.k8s.io/v1",
+             "metadata": {"name": "ebs"},
+             "provisioner": "ebs.csi.aws.com",
+             "volumeBindingMode": "WaitForFirstConsumer",
+             "allowedTopologies": [{"matchLabelExpressions": [
+                 {"key": "topology.ebs.csi.aws.com/zone",
+                  "values": ["zone-1a", "zone-1b"]}]}]},
+            {
+                "kind": "PersistentVolume",
+                "metadata": {"name": "pv-1"},
+                "spec": {
+                    "storageClassName": "ebs",
+                    "capacity": {"storage": "4Gi"},
+                    "nodeAffinity": {"required": {"nodeSelectorTerms": [
+                        {"matchExpressions": [
+                            {"key": "topology.kubernetes.io/zone",
+                             "operator": "In", "values": ["zone-1a"]},
+                        ]},
+                    ]}},
+                },
+            },
+            {"kind": "PersistentVolumeClaim",
+             "metadata": {"name": "data", "namespace": "default"},
+             "spec": {"storageClassName": "ebs", "volumeName": "pv-1",
+                      "resources": {"requests": {"storage": "4Gi"}}}},
+        ]
+        provs, templates, overrides, storage = admit_documents(docs)
+        sc, pv, pvc = storage
+        assert sc.allowed_zones == ("zone-1a", "zone-1b")
+        assert sc.volume_binding_mode == "WaitForFirstConsumer"
+        assert pv.zones == ("zone-1a",) and pv.capacity == 4 * 1024**3
+        assert pvc.volume_name == "pv-1"
+
+    def test_region_storage_class_rejected(self):
+        from karpenter_tpu.manifests import admit_documents
+        from karpenter_tpu.webhooks import AdmissionError
+
+        doc = {"kind": "StorageClass", "metadata": {"name": "bad"},
+               "allowedTopologies": [{"matchLabelExpressions": [
+                   {"key": "topology.kubernetes.io/region",
+                    "values": ["region-1"]}]}]}
+        with pytest.raises(AdmissionError, match="not supported"):
+            admit_documents([doc])
+
+    def test_bind_repins_scheduled_pods(self, small_catalog):
+        """A wffc claim binding AFTER its pod scheduled narrows the pod's
+        pins immediately — consolidation what-ifs must not relocate it to the
+        other allowed zone (review finding: stale volume_zone_requirements)."""
+        from karpenter_tpu.controllers.state import ClusterState
+
+        state = ClusterState()
+        state.apply_storage(StorageClass(
+            name="ebs", volume_binding_mode=VOLUME_BINDING_WAIT,
+            allowed_zones=("zone-1a", "zone-1b")))
+        state.apply_storage(PersistentVolumeClaim(name="data", storage_class="ebs"))
+        pod = PodSpec(name="app", requests={"cpu": 1.0}, volume_claims=["data"])
+        state.add_pod(pod)  # add_pod pins eagerly
+        assert tuple(pod.volume_zone_requirements[0].values) == ("zone-1a", "zone-1b")
+        state.bind_volume(
+            "default", "data", PersistentVolume(name="pv", zones=("zone-1a",)))
+        assert tuple(pod.volume_zone_requirements[0].values) == ("zone-1a",)
+
+    def test_remote_specialization_matches_local(self, small_catalog):
+        """Server-side kubeletConfiguration specialization on a DECODED
+        instance type must equal the local computation — the wire carries
+        the three overhead components separately so per-component overrides
+        land on the right base (review finding: pre-summed overhead)."""
+        from karpenter_tpu.models.instancetype import GIB, specialize_for_kubelet
+        from karpenter_tpu.models.provisioner import KubeletConfiguration
+        from karpenter_tpu.service import codec
+
+        it = small_catalog[0]
+        kc = KubeletConfiguration(
+            kube_reserved={L.RESOURCE_MEMORY: 2.0 * GIB},
+            system_reserved={L.RESOURCE_CPU: 0.3},
+            eviction_hard={"memory.available": "5%"},
+        )
+        dec = codec.decode_instance_type(codec.encode_instance_type(it))
+        local = specialize_for_kubelet(it, kc).allocatable
+        remote = specialize_for_kubelet(dec, kc).allocatable
+        for k, v in local.items():
+            assert abs(remote.get(k, 0.0) - v) < 1e-6, (k, v, remote.get(k))
+
+    def test_codec_carries_volume_pins(self):
+        from karpenter_tpu.service import codec
+
+        vt = make_vt()
+        pod = PodSpec(name="p", requests={"cpu": 1.0}, volume_claims=["claim"])
+        vt.inject(pod)
+        out = codec.decode_pod(codec.encode_pod(pod))
+        assert [tuple(r.values) for r in out.volume_zone_requirements] == [
+            ("zone-1a", "zone-1b")]
+        reqs = out.scheduling_requirements()[0]
+        assert reqs.get(L.ZONE).contains("zone-1a")
+        assert not reqs.get(L.ZONE).contains("zone-1c")
