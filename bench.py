@@ -136,6 +136,59 @@ def measure_coldstart():
     return None, None, None, err
 
 
+def check_regression(rec, prior_dir=None):
+    """Round-over-round perf gate: compare against the newest recorded
+    BENCH_r{N}.json and flag >10% latency regressions not paid for by
+    quality (VERDICT r4 weak #1/#2: the warm solve regressed 141.8->159.8ms
+    and cold 695->1034ms silently).  Returns a dict merged into the bench
+    record: prior round name, deltas, and human-readable flags."""
+    import glob
+    import re
+
+    prior_dir = prior_dir or os.path.dirname(os.path.abspath(__file__))
+    prior = None
+    for f in sorted(glob.glob(os.path.join(prior_dir, "BENCH_r[0-9]*.json")),
+                    key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1))):
+        try:
+            with open(f) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if "value" not in data and isinstance(data.get("tail"), str):
+            # driver artifact: the bench's JSON line lives inside "tail"
+            for line in data["tail"].splitlines():
+                line = line.strip()
+                if line.startswith("{") and '"metric"' in line:
+                    try:
+                        data = json.loads(line)
+                    except ValueError:
+                        pass
+        if data.get("value"):
+            prior = (os.path.basename(f), data)
+    if prior is None:
+        return {}
+    name, p = prior
+    out = {"prior_round": name}
+    flags = []
+    quality_better = (
+        rec.get("tpu_nodes") is not None and p.get("tpu_nodes") is not None
+        and (rec["tpu_nodes"] < p["tpu_nodes"]
+             or rec.get("cost_ratio_vs_ffd", 9) < p.get("cost_ratio_vs_ffd", 9) - 1e-4)
+    )
+    for key, label in (("value", "warm"), ("cold_first_solve_ms", "cold")):
+        cur, old = rec.get(key), p.get(key)
+        if cur is None or not old:
+            continue
+        out[f"{label}_vs_prior"] = round(cur / old, 3)
+        if cur > 1.10 * old and not quality_better:
+            flags.append(
+                f"{label} {cur:.1f}ms is {cur / old:.2f}x prior {old:.1f}ms ({name}) "
+                "at no quality gain")
+    if flags:
+        out["regression_flags"] = flags
+    return out
+
+
 def run_bench():
     from karpenter_tpu.models.tensorize import tensorize
     from karpenter_tpu.solver import reference
@@ -169,7 +222,7 @@ def run_bench():
     if cold_err is not None:
         rec_cold["cold_error"] = cold_err
 
-    return {
+    rec = {
         "metric": METRIC,
         "value": round(out.solve_ms, 3),
         "unit": "ms",
@@ -183,6 +236,8 @@ def run_bench():
         "infeasible": len(out.result.infeasible),
         "backend": jax.default_backend(),
     }
+    rec.update(check_regression(rec))
+    return rec
 
 
 def main():

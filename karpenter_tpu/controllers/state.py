@@ -77,10 +77,8 @@ class ClusterState:
             self.volume_topology.inject(pod)
         self._changed()
 
-    def apply_storage(self, obj) -> None:
-        """Register a PVC / PV / StorageClass (volume-topology inputs); a
-        bind/claim change re-pins affected pods on the next reconcile's
-        inject pass."""
+    def _apply_storage_obj(self, obj) -> None:
+        """Dispatch one PVC / PV / StorageClass into the volume registry."""
         from ..models.volume import (
             PersistentVolume,
             PersistentVolumeClaim,
@@ -96,7 +94,21 @@ class ClusterState:
             vt.apply_class(obj)
         else:  # pragma: no cover - programming error
             raise TypeError(f"not a storage object: {obj!r}")
+
+    def apply_storage(self, obj) -> None:
+        """Register one PVC / PV / StorageClass and re-pin affected pods."""
+        self._apply_storage_obj(obj)
         self._storage_changed()
+
+    def apply_storage_batch(self, objs) -> None:
+        """Register many storage objects with ONE re-pin sweep (bulk manifest
+        apply would otherwise sweep all pods once per object)."""
+        any_applied = False
+        for obj in objs:
+            self._apply_storage_obj(obj)
+            any_applied = True
+        if any_applied:
+            self._storage_changed()
 
     def bind_volume(self, namespace: str, claim_name: str, pv) -> None:
         """CSI bound a volume to a claim (the WaitForFirstConsumer aftermath):

@@ -387,8 +387,7 @@ def apply_objects(
     if state is not None:
         for prov in provisioners:
             state.apply_provisioner(prov)
-        for obj in storage:
-            state.apply_storage(obj)
+        state.apply_storage_batch(storage)
     if cloud is not None and hasattr(cloud, "templates"):
         for t in templates:
             cloud.templates[t.name] = t

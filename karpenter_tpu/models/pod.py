@@ -52,12 +52,27 @@ class Taint:
         return not any(t.tolerates(self) for t in tolerations)
 
 
+def _cached_frozen_hash(self, fields) -> int:
+    """Structural hash memoized on the instance — constraint objects are
+    hashed once per pod-dedup lookup (group_pods at 50k pods makes this the
+    dominant tensorize cost), and deployment pods share selector/requirement
+    instances, so the memo amortizes across the whole group."""
+    h = self.__dict__.get("_h")
+    if h is None:
+        h = hash(fields)
+        object.__setattr__(self, "_h", h)
+    return h
+
+
 @dataclass(frozen=True)
 class LabelSelector:
     """matchLabels + matchExpressions over *pod* labels."""
 
     match_labels: Tuple[Tuple[str, str], ...] = ()
     match_expressions: Tuple[Requirement, ...] = ()
+
+    def __hash__(self) -> int:
+        return _cached_frozen_hash(self, (self.match_labels, self.match_expressions))
 
     @staticmethod
     def of(labels: Mapping[str, str] = (), expressions: Sequence[Requirement] = ()) -> "LabelSelector":
@@ -80,6 +95,11 @@ class TopologySpreadConstraint:
     when_unsatisfiable: str  # "DoNotSchedule" | "ScheduleAnyway"
     label_selector: LabelSelector = LabelSelector()
 
+    def __hash__(self) -> int:
+        return _cached_frozen_hash(self, (
+            self.max_skew, self.topology_key, self.when_unsatisfiable,
+            self.label_selector))
+
     @property
     def hard(self) -> bool:
         return self.when_unsatisfiable == "DoNotSchedule"
@@ -90,6 +110,10 @@ class PodAffinityTerm:
     label_selector: LabelSelector
     topology_key: str
     anti: bool = False  # True => anti-affinity
+
+    def __hash__(self) -> int:
+        return _cached_frozen_hash(self, (
+            self.label_selector, self.topology_key, self.anti))
 
     def matches_pod(self, pod: "PodSpec") -> bool:
         return self.label_selector.matches(dict(pod.labels))

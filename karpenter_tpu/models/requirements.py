@@ -180,6 +180,16 @@ class Requirement:
             raise ValueError(f"{self.operator} must not carry values")
         object.__setattr__(self, "values", tuple(self.values))
 
+    def __hash__(self) -> int:
+        # memoized structural hash: requirements appear inside pod group-dedup
+        # keys, hashed once per pod at tensorize time; shared instances
+        # (deployment pods) amortize the computation
+        h = self.__dict__.get("_h")
+        if h is None:
+            h = hash((self.key, self.operator, self.values))
+            object.__setattr__(self, "_h", h)
+        return h
+
     def value_set(self) -> ValueSet:
         if self.operator == IN:
             return ValueSet(frozenset(self.values), False)

@@ -200,15 +200,48 @@ def _ffd_magnitude(requests: Mapping[str, float]) -> float:
     return cpu + mem + gpu
 
 
+def _same_spec(p: PodSpec, rep: PodSpec) -> bool:
+    """Exact spec equality on the group_key fields.  Sound fast-path test:
+    exact equality implies group-key equality (the reverse needn't hold —
+    e.g. float-noise requests that only match after rounding fall through to
+    the structural-key path and still land in the right group)."""
+    return (
+        p.requests == rep.requests
+        and p.labels == rep.labels
+        and p.node_selector == rep.node_selector
+        and p.priority == rep.priority
+        and p.tolerations == rep.tolerations
+        and p.topology_spread == rep.topology_spread
+        and p.affinity_terms == rep.affinity_terms
+        and p.required_affinity_terms == rep.required_affinity_terms
+        and p.preferred_affinity_terms == rep.preferred_affinity_terms
+        and p.volume_zone_requirements == rep.volume_zone_requirements
+    )
+
+
 def group_pods(pods: Sequence[PodSpec]) -> List[PodGroup]:
     """Dedup pods into interchangeable groups, FFD-sorted (desc magnitude).
 
     Pods with multiple OR'd required-affinity terms use only their first term
     for grouping (v1 limitation: OR-terms beyond the first are not explored;
     the reference relaxes through terms similarly).
+
+    Deployment-shaped batches take an owner-key fast path: a pod whose
+    (namespace, owner) matches the previous pod of that owner compares
+    field-for-field against the group's representative instead of building +
+    hashing the full structural key (the dominant cold-tensorize cost at 50k
+    pods).  Group membership and ordering are identical to the structural
+    path — the fast path only short-circuits provably-equal specs.
     """
     by_key: Dict[tuple, PodGroup] = {}
+    owner_cache: Dict[Tuple[str, str], PodGroup] = {}
     for p in pods:
+        oc = (p.namespace, p.owner_key) if p.owner_key else None
+        if oc is not None:
+            grp = owner_cache.get(oc)
+            if grp is not None and _same_spec(p, grp.pods[0]):
+                grp.pods.append(p)
+                continue
         k = p.group_key()
         grp = by_key.get(k)
         if grp is None:
@@ -216,6 +249,8 @@ def group_pods(pods: Sequence[PodSpec]) -> List[PodGroup]:
             grp = PodGroup(key=k, pods=[], requirements=reqs, requests=dict(p.requests))
             by_key[k] = grp
         grp.pods.append(p)
+        if oc is not None:
+            owner_cache[oc] = grp
     groups = list(by_key.values())
     groups.sort(key=lambda g: (-_ffd_magnitude(g.requests), g.pods[0].name))
     return groups

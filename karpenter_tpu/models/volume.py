@@ -165,12 +165,24 @@ class VolumeTopology:
 
 def parse_zone_topology(match_label_expressions: Sequence[dict]) -> Tuple[Tuple[str, ...], List[str]]:
     """allowedTopologies / PV nodeAffinity expressions -> (zones, errors),
-    with CSI zone-key aliasing and the explicit region-key rejection."""
+    with CSI zone-key aliasing and the explicit region-key rejection.
+
+    Only ``In`` (the operator CSI drivers write, and the only shape
+    StorageClass allowedTopologies can express) is supported on zone keys;
+    any other operator is an error rather than a silent mis-pin — treating
+    ``NotIn [z]`` as a pin TO z would schedule pods exactly where their
+    volume can never attach."""
     zones: List[str] = []
     errors: List[str] = []
     for expr in match_label_expressions:
         key = expr.get("key", "")
+        op = expr.get("operator", "In")
         if key in ZONE_KEY_ALIASES:
+            if op != "In":
+                errors.append(
+                    f"unsupported operator {op!r} on zone topology key {key!r} "
+                    "(only In is supported)")
+                continue
             zones.extend(expr.get("values", []) or [])
         elif key == REGION_KEY:
             errors.append(

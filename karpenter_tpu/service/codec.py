@@ -83,7 +83,8 @@ def encode_instance_type(it: InstanceType) -> pb.InstanceType:
         for o in it.offerings
     )
     out.capacity.extend(_quantities(it.capacity))
-    out.overhead.extend(_quantities(it.overhead.kube_reserved))
+    out.overhead.extend(_quantities(it.overhead.total()))  # legacy decoders
+    out.overhead_kube.extend(_quantities(it.overhead.kube_reserved))
     out.overhead_system.extend(_quantities(it.overhead.system_reserved))
     out.overhead_eviction.extend(_quantities(it.overhead.eviction_threshold))
     return out
@@ -242,10 +243,15 @@ def decode_instance_type(it: pb.InstanceType) -> InstanceType:
             Offering(o.zone, o.capacity_type, o.price, o.available) for o in it.offerings
         ],
         capacity=_qdict(it.capacity),
-        overhead=Overhead(
-            kube_reserved=_qdict(it.overhead),
-            system_reserved=_qdict(it.overhead_system),
-            eviction_threshold=_qdict(it.overhead_eviction),
+        overhead=(
+            Overhead(
+                kube_reserved=_qdict(it.overhead_kube),
+                system_reserved=_qdict(it.overhead_system),
+                eviction_threshold=_qdict(it.overhead_eviction),
+            )
+            if len(it.overhead_kube)
+            # legacy encoder: only the pre-summed total is on the wire
+            else Overhead(kube_reserved=_qdict(it.overhead))
         ),
     )
 

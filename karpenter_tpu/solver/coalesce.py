@@ -78,6 +78,12 @@ def hostname_constrained(st) -> bool:
     )
 
 
+def _pkey(a: SimNode, b: SimNode) -> tuple:
+    """Order-free identity key for the symmetric pair-feasibility cache."""
+    ia, ib = id(a), id(b)
+    return (ia, ib) if ia < ib else (ib, ia)
+
+
 def _domain_index(st, zone: str, ct: str) -> Optional[int]:
     try:
         zi = st.zone_names.index(zone)
@@ -160,77 +166,164 @@ def coalesce_new_nodes(
                 return all_groups
             return frozenset(node_groups.get(id(n), all_groups))
 
-        def best_merge(a: SimNode, b: SimNode):
-            need = used_rows[id(a)] + used_rows[id(b)]
-            budget = a.price + b.price
-            ok = (c_price <= budget + 1e-9) & (
-                (c_alloc + 1e-6 >= need).all(axis=1)
-            )
-            # the solve honored F[g, c]; the merge target must too, for
-            # every group with pods on either node (a node_selector pinned
-            # to one instance type must never be merged onto another)
-            gs = groups_of(a) | groups_of(b)
-            if gs:
-                ok &= c_F[sorted(gs)].all(axis=0)
-            if limited:
-                cap_budget = (st.capacity_row(a.instance_type, a.allocatable)
-                              + st.capacity_row(b.instance_type, b.allocatable))
-                ok &= (c_cap <= cap_budget + 1e-6).all(axis=1)
-            if not ok.any():
-                return None
-            k = int(np.where(ok, c_price, np.inf).argmin())
-            return float(c_price[k]), int(cand_ix[k]), need
+        # per-node precomputes, cached by identity (merged nodes get entries
+        # as they're created): candidate-feasibility row (AND over the node's
+        # groups — c_F[union].all == c_F[a].all & c_F[b].all, so pair
+        # feasibility is a cheap elementwise AND) and the raw-capacity row
+        # for limit-bound buckets
+        c_F_all = c_F.all(axis=0)
+        _nF: Dict[int, np.ndarray] = {}
+        _ncap: Dict[int, np.ndarray] = {}
+
+        def node_F(n: SimNode) -> np.ndarray:
+            got = _nF.get(id(n))
+            if got is None:
+                gs = groups_of(n)
+                got = c_F_all if gs == all_groups else c_F[sorted(gs)].all(axis=0)
+                _nF[id(n)] = got
+            return got
+
+        def node_cap(n: SimNode) -> np.ndarray:
+            got = _ncap.get(id(n))
+            if got is None:
+                got = st.capacity_row(n.instance_type, n.allocatable)
+                _ncap[id(n)] = got
+            return got
 
         # smallest-first pair scan: any pair may merge (a cpu-heavy and a
         # mem-heavy fragment can share one node even when two same-size
         # fragments can't), so failure of one pair doesn't end the bucket.
         # The scan is windowed to the FRAG_WINDOW smallest nodes — fragments
         # live at the small end, and an unwindowed pair scan over a 50k-pod
-        # solve's hundreds of nodes would cost more host time than the solve
-        group = sorted(group, key=lambda n: (float(used_rows[id(n)].sum()), n.name))
-        merged = True
-        while merged and len(group) >= 2:
-            merged = False
-            win = min(len(group), FRAG_WINDOW)
-            for i in range(win - 1):
-                for j in range(i + 1, win):
-                    hit = best_merge(group[i], group[j])
-                    if hit is None:
+        # solve's hundreds of nodes would cost more host time than the solve.
+        # Pair feasibility is symmetric and unaffected by OTHER merges, so
+        # it's cached by node-identity pair and evaluated in one batched
+        # numpy pass per scan (the round-4 cold-path regression was this
+        # loop in per-pair Python).  Merge order is unchanged: first
+        # (i, then smallest j) feasible pair, cheapest candidate, resort,
+        # rescan.
+        pair_best: Dict[tuple, Optional[tuple]] = {}  # (ida,idb) -> (price,k)|None
+        partners: Dict[int, set] = {}  # node id -> ids with a feasible merge
+        _seen: set = set()           # node ids whose window pairs are cached
+        _size: Dict[int, float] = {}  # node id -> used magnitude (sort key)
+        _pinned: List[SimNode] = []  # absorbed nodes held alive: cache keys are
+        # id()s — a GC'd node's id could be reused by a later merged node
+
+        def size_of(n: SimNode) -> float:
+            got = _size.get(id(n))
+            if got is None:
+                got = float(used_rows[id(n)].sum())
+                _size[id(n)] = got
+            return got
+
+        def eval_pairs(window: List[SimNode]) -> None:
+            """Fill pair_best for every uncached pair in the window.  Only
+            pairs touching a node new to the window since the last eval can
+            be uncached (pair feasibility is unaffected by other merges), so
+            enumeration is O(new x W), not O(W^2) per scan."""
+            w = len(window)
+            new_ix = [i for i in range(w) if id(window[i]) not in _seen]
+            if not new_ix:
+                return
+            new_set = set(new_ix)
+            fresh = []
+            for i in new_ix:
+                for j in range(w):
+                    if j == i or (j in new_set and j < i):
                         continue
-                    price, ci, need = hit
-                    a, b = group[i], group[j]
-                    _prov, type_name = st.cand_names[ci]
-                    node = SimNode(
-                        instance_type=type_name,
-                        provisioner=prov,
-                        zone=zone,
-                        capacity_type=ct,
-                        price=price,
-                        allocatable={
-                            st.vocab.resources[r]: float(st.cand_alloc[ci, r])
-                            for r in range(st.cand_alloc.shape[1])
-                        },
-                        existing=False,
-                    )
-                    node.pods = list(a.pods) + list(b.pods)
-                    used_rows[id(node)] = need
-                    if node_groups is not None:
-                        node_groups[id(node)] = set(groups_of(a) | groups_of(b))
-                    renames[a.name] = node.name
-                    renames[b.name] = node.name
-                    # an absorbed node may itself be a prior replacement:
-                    # forward earlier renames pointing at it
-                    for old, tgt in list(renames.items()):
-                        if tgt in (a.name, b.name):
-                            renames[old] = node.name
-                    group = sorted(
-                        [n for k, n in enumerate(group) if k not in (i, j)]
-                        + [node],
-                        key=lambda n: (float(used_rows[id(n)].sum()), n.name),
-                    )
-                    merged = True
+                    a, b = (i, j) if i < j else (j, i)
+                    if _pkey(window[a], window[b]) not in pair_best:
+                        fresh.append((a, b))
+            _seen.update(id(window[i]) for i in new_ix)
+            if not fresh:
+                return
+            ai = np.asarray([i for i, _ in fresh])
+            bj = np.asarray([j for _, j in fresh])
+            used_w = np.stack([used_rows[id(n)] for n in window])     # [W,R]
+            price_w = np.asarray([n.price for n in window])
+            F_w = np.stack([node_F(n) for n in window])               # [W,K]
+            need = used_w[ai] + used_w[bj]                            # [P,R]
+            ok = F_w[ai] & F_w[bj]                                    # [P,K]
+            R = need.shape[1]
+            for r in range(R):
+                ok &= c_alloc[None, :, r] + 1e-6 >= need[:, r, None]
+            ok &= c_price[None, :] <= (price_w[ai] + price_w[bj])[:, None] + 1e-9
+            if limited:
+                cap_w = np.stack([node_cap(n) for n in window])
+                capb = cap_w[ai] + cap_w[bj]
+                for r in range(R):
+                    ok &= c_cap[None, :, r] <= capb[:, r, None] + 1e-6
+            any_p = ok.any(axis=1)
+            hits = np.flatnonzero(any_p)
+            ks = np.empty(len(fresh), dtype=np.int64)
+            if hits.size:
+                ks[hits] = np.where(ok[hits], c_price[None, :], np.inf).argmin(axis=1)
+            for p, (i, j) in enumerate(fresh):
+                a, b = window[i], window[j]
+                if any_p[p]:
+                    pair_best[_pkey(a, b)] = (float(c_price[ks[p]]), int(ks[p]))
+                    partners.setdefault(id(a), set()).add(id(b))
+                    partners.setdefault(id(b), set()).add(id(a))
+                else:
+                    pair_best[_pkey(a, b)] = None
+
+        group = sorted(group, key=lambda n: (size_of(n), n.name))
+        while len(group) >= 2:
+            win = min(len(group), FRAG_WINDOW)
+            window = group[:win]
+            eval_pairs(window)
+            hit = None
+            for i in range(win - 1):
+                ps = partners.get(id(window[i]))
+                if not ps:
+                    continue
+                for j in range(i + 1, win):
+                    if id(window[j]) in ps:
+                        best = pair_best[_pkey(window[i], window[j])]
+                        hit = (i, j, best[1],
+                               used_rows[id(window[i])] + used_rows[id(window[j])])
+                        break
+                if hit is not None:
                     break
-                if merged:
-                    break
+            if hit is None:
+                break
+            i, j, k, need = hit
+            a, b = group[i], group[j]
+            _pinned.extend((a, b))
+            ci = int(cand_ix[k])
+            _prov, type_name = st.cand_names[ci]
+            node = SimNode(
+                instance_type=type_name,
+                provisioner=prov,
+                zone=zone,
+                capacity_type=ct,
+                price=float(c_price[k]),
+                allocatable={
+                    st.vocab.resources[r]: float(st.cand_alloc[ci, r])
+                    for r in range(st.cand_alloc.shape[1])
+                },
+                existing=False,
+            )
+            node.pods = list(a.pods) + list(b.pods)
+            used_rows[id(node)] = need
+            _nF[id(node)] = node_F(a) & node_F(b)
+            if node_groups is not None:
+                node_groups[id(node)] = set(groups_of(a) | groups_of(b))
+            renames[a.name] = node.name
+            renames[b.name] = node.name
+            # an absorbed node may itself be a prior replacement:
+            # forward earlier renames pointing at it
+            for old, tgt in list(renames.items()):
+                if tgt in (a.name, b.name):
+                    renames[old] = node.name
+            # absorbed nodes leave the partner graph (their ids must not
+            # surface as hits in later scans)
+            for gone in (id(a), id(b)):
+                for other in partners.pop(gone, ()):  # symmetric cleanup
+                    partners.get(other, set()).discard(gone)
+            group = sorted(
+                [n for idx, n in enumerate(group) if idx not in (i, j)] + [node],
+                key=lambda n: (size_of(n), n.name),
+            )
         out.extend(group)
     return out, renames

@@ -265,19 +265,23 @@ def solve_tensors_native(
     infeasible_map: Dict[str, str] = {}
     node_groups: Dict[int, set] = {}
     for gi, g in enumerate(st.groups):
-        pod_iter = iter(g.pods)
+        gp = g.pods
+        base = 0
         for s in np.nonzero(takes[gi])[0]:
+            take = int(takes[gi, s])
+            chunk = gp[base:base + take]
+            base += len(chunk)
             node = slot_to_node.get(int(s))
             if node is not None:
                 node_groups.setdefault(id(node), set()).add(gi)
-            for _ in range(int(takes[gi, s])):
-                pod = next(pod_iter, None)
-                if pod is None:
-                    break
-                assignments[pod.name] = node.name if node else f"slot-{s}"
-                if node is not None:
-                    node.pods.append(pod)
-        for pod in pod_iter:
+                node.pods.extend(chunk)
+                nn = node.name
+                for pod in chunk:
+                    assignments[pod.name] = nn
+            else:
+                for pod in chunk:
+                    assignments[pod.name] = f"slot-{int(s)}"
+        for pod in gp[base:]:
             infeasible_map[pod.name] = "native solver: no feasible placement"
 
     # cost-neutral coalescing, same pass as the device tier (the cold-start

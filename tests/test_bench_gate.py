@@ -1,0 +1,102 @@
+"""The bench round-over-round regression gate (bench.py:check_regression)."""
+
+import importlib.util
+import json
+
+import pytest
+
+spec = importlib.util.spec_from_file_location(
+    "benchmod_gate", __file__.rsplit("/tests/", 1)[0] + "/bench.py")
+benchmod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(benchmod)
+
+
+def _write_prior(tmp_path, n, **kw):
+    rec = {"metric": "m", "value": 150.0, "unit": "ms",
+           "cold_first_solve_ms": 600.0, "tpu_nodes": 560,
+           "cost_ratio_vs_ffd": 0.99, **kw}
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(rec))
+    return rec
+
+
+def test_no_prior_rounds(tmp_path):
+    assert benchmod.check_regression({"value": 100.0}, prior_dir=str(tmp_path)) == {}
+
+
+def test_newest_round_wins(tmp_path):
+    _write_prior(tmp_path, 3, value=999.0)
+    _write_prior(tmp_path, 4, value=150.0)
+    out = benchmod.check_regression(
+        {"value": 150.0, "cold_first_solve_ms": 600.0,
+         "tpu_nodes": 560, "cost_ratio_vs_ffd": 0.99},
+        prior_dir=str(tmp_path))
+    assert out["prior_round"] == "BENCH_r04.json"
+    assert out["warm_vs_prior"] == 1.0
+    assert "regression_flags" not in out
+
+
+def test_warm_regression_flagged(tmp_path):
+    _write_prior(tmp_path, 4)
+    out = benchmod.check_regression(
+        {"value": 180.0, "cold_first_solve_ms": 600.0,
+         "tpu_nodes": 560, "cost_ratio_vs_ffd": 0.99},
+        prior_dir=str(tmp_path))
+    assert any("warm" in f for f in out["regression_flags"])
+
+
+def test_cold_regression_flagged(tmp_path):
+    _write_prior(tmp_path, 4)
+    out = benchmod.check_regression(
+        {"value": 150.0, "cold_first_solve_ms": 1000.0,
+         "tpu_nodes": 560, "cost_ratio_vs_ffd": 0.99},
+        prior_dir=str(tmp_path))
+    assert any("cold" in f for f in out["regression_flags"])
+
+
+def test_quality_gain_excuses_latency(tmp_path):
+    # slower but strictly fewer nodes: recorded, not flagged
+    _write_prior(tmp_path, 4)
+    out = benchmod.check_regression(
+        {"value": 180.0, "cold_first_solve_ms": 600.0,
+         "tpu_nodes": 500, "cost_ratio_vs_ffd": 0.99},
+        prior_dir=str(tmp_path))
+    assert out["warm_vs_prior"] == 1.2
+    assert "regression_flags" not in out
+
+
+def test_within_budget_not_flagged(tmp_path):
+    _write_prior(tmp_path, 4)
+    out = benchmod.check_regression(
+        {"value": 160.0, "cold_first_solve_ms": 650.0,
+         "tpu_nodes": 560, "cost_ratio_vs_ffd": 0.99},
+        prior_dir=str(tmp_path))
+    assert "regression_flags" not in out
+
+
+def test_driver_wrapped_artifact_parsed(tmp_path):
+    # the driver records {"n", "cmd", "rc", "tail": "...<json line>..."}
+    inner = json.dumps({"metric": "m", "value": 150.0,
+                        "cold_first_solve_ms": 600.0, "tpu_nodes": 560,
+                        "cost_ratio_vs_ffd": 0.99})
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+        {"n": 4, "cmd": "python bench.py", "rc": 0,
+         "tail": "WARNING: some log line\n" + inner + "\n"}))
+    out = benchmod.check_regression(
+        {"value": 165.1, "cold_first_solve_ms": 400.0,
+         "tpu_nodes": 560, "cost_ratio_vs_ffd": 0.99},
+        prior_dir=str(tmp_path))
+    assert out["prior_round"] == "BENCH_r04.json"
+    assert out["warm_vs_prior"] == 1.101
+    assert out["cold_vs_prior"] == 0.667
+    assert any("warm" in f for f in out["regression_flags"])
+
+
+def test_errored_prior_skipped(tmp_path):
+    _write_prior(tmp_path, 3)
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+        {"metric": "m", "value": None, "error": "watchdog"}))
+    out = benchmod.check_regression(
+        {"value": 150.0, "cold_first_solve_ms": 600.0,
+         "tpu_nodes": 560, "cost_ratio_vs_ffd": 0.99},
+        prior_dir=str(tmp_path))
+    assert out["prior_round"] == "BENCH_r03.json"

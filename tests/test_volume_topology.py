@@ -50,6 +50,13 @@ class TestZoneKeyParsing:
             [{"key": "kubernetes.io/hostname", "values": ["n1"]}])
         assert zones == () and not errs
 
+    def test_non_in_operator_rejected(self):
+        # NotIn [z] must never become a pin TO z (the one zone the volume
+        # cannot attach in); only In is supported on zone keys
+        zones, errs = parse_zone_topology(
+            [{"key": L.ZONE, "operator": "NotIn", "values": ["zone-1a"]}])
+        assert zones == () and "unsupported operator" in errs[0]
+
 
 class TestResolution:
     def test_bound_claim_pins_to_pv_zone(self):
@@ -323,6 +330,20 @@ class TestManifestsAndCodec:
         remote = specialize_for_kubelet(dec, kc).allocatable
         for k, v in local.items():
             assert abs(remote.get(k, 0.0) - v) < 1e-6, (k, v, remote.get(k))
+
+    def test_legacy_overhead_decode(self, small_catalog):
+        """A wire message carrying only the pre-summed overhead (old encoder)
+        still decodes to the same total deduction."""
+        from karpenter_tpu.service import codec
+
+        it = small_catalog[0]
+        msg = codec.encode_instance_type(it)
+        del msg.overhead_kube[:]      # simulate an old encoder
+        del msg.overhead_system[:]
+        del msg.overhead_eviction[:]
+        dec = codec.decode_instance_type(msg)
+        for k, v in it.allocatable.items():
+            assert abs(dec.allocatable.get(k, 0.0) - v) < 1e-6
 
     def test_codec_carries_volume_pins(self):
         from karpenter_tpu.service import codec
