@@ -102,13 +102,17 @@ class ClusterState:
 
     def apply_storage_batch(self, objs) -> None:
         """Register many storage objects with ONE re-pin sweep (bulk manifest
-        apply would otherwise sweep all pods once per object)."""
-        any_applied = False
-        for obj in objs:
-            self._apply_storage_obj(obj)
-            any_applied = True
-        if any_applied:
-            self._storage_changed()
+        apply would otherwise sweep all pods once per object).  The sweep
+        runs even if a later object raises, so objects applied before the
+        failure are still reflected in pod pins."""
+        applied = 0
+        try:
+            for obj in objs:
+                self._apply_storage_obj(obj)
+                applied += 1
+        finally:
+            if applied:
+                self._storage_changed()
 
     def bind_volume(self, namespace: str, claim_name: str, pv) -> None:
         """CSI bound a volume to a claim (the WaitForFirstConsumer aftermath):

@@ -250,8 +250,15 @@ def decode_instance_type(it: pb.InstanceType) -> InstanceType:
                 eviction_threshold=_qdict(it.overhead_eviction),
             )
             if len(it.overhead_kube)
-            # legacy encoder: only the pre-summed total is on the wire
-            else Overhead(kube_reserved=_qdict(it.overhead))
+            # older encoders: field 5 carries either the pre-summed total
+            # (original wire format; fields 6/7 empty) or kube-reserved with
+            # system/eviction in 6/7 — reading 6/7 here is correct for both
+            # (empty lists decode to {} for the original format)
+            else Overhead(
+                kube_reserved=_qdict(it.overhead),
+                system_reserved=_qdict(it.overhead_system),
+                eviction_threshold=_qdict(it.overhead_eviction),
+            )
         ),
     )
 

@@ -268,7 +268,7 @@ class BatchScheduler:
         self, pods, provisioners, instance_types, existing_nodes, daemonsets,
         unavailable, allow_new_nodes, max_new_nodes,
     ) -> SolveResult:
-        if self.backend == "oracle":
+        if self.backend == "oracle" or self._route_small(len(pods)):
             t0 = time.perf_counter()
             try:
                 return oracle_solve(
@@ -442,19 +442,23 @@ class BatchScheduler:
         )
         return res, "oracle"
 
-    def _route_native(self, st, n_pods: int) -> bool:
-        """auto-policy: native C++ tier for small unconstrained batches
-        (per-dispatch device overhead dominates there); the batch solver for
-        everything else."""
-        from . import native as native_mod
+    def _route_small(self, n_pods: int) -> bool:
+        """auto-policy: STEADY-STATE batches below the device-dispatch
+        crossover are served by the sequential CPU oracle — exact-parity FFD
+        at ~ms latency for any constraint shape (r4 weak #3: the native
+        tier's small-shape answer was 19-20 nodes where oracle/device pack
+        16, and it was serving those batches permanently).  The native tier
+        still serves COLD shapes of any size while the device program
+        compiles behind (_cold_solve) — that is where its 50k-in-224ms
+        speed, not its packing polish, is the right trade."""
+        return self.backend == "auto" and n_pods <= self.native_batch_limit
 
-        if self.backend == "native":
-            return True
-        if self.backend != "auto":
-            return False
-        if n_pods > self.native_batch_limit or native_mod.has_topology(st):
-            return False
-        return native_mod.available()
+    def _route_native(self, st, n_pods: int) -> bool:
+        """Forced native backend only.  The auto policy no longer serves
+        steady-state batches from the native tier: small batches go to the
+        oracle (_route_small, exact parity), large ones to the device; the
+        native tier serves cold shapes via _cold_solve."""
+        return self.backend == "native"
 
     def _solve_tpu(
         self, pods, provisioners, instance_types, existing_nodes, daemonsets,

@@ -137,39 +137,44 @@ class TestNativeParity:
 
 
 class TestRouting:
-    def test_auto_routes_small_to_native(self, small_catalog):
+    def test_auto_routes_small_to_oracle(self, small_catalog):
+        """Steady-state sub-crossover batches are served by the oracle —
+        exact FFD parity (r4 weak #3: the native tier permanently served
+        19-20-node answers where the oracle packs 16)."""
         sched = BatchScheduler(backend="auto")
+        assert sched._route_small(10)
         pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(10)]
-        st = tensorize(pods, [default_prov()], small_catalog)
-        assert sched._route_native(st, 10)
-
-    def test_auto_routes_spread_to_native(self, small_catalog):
-        """Zone spread is handled by ffd.cpp place_constrained, so small
-        spread batches stay on the low-latency tier."""
-        sched = BatchScheduler(backend="auto")
-        sel = LabelSelector.of({"app": "x"})
-        pods = [PodSpec(name=f"p{i}", labels={"app": "x"}, requests={"cpu": 1.0},
-                        topology_spread=[TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)])
-                for i in range(10)]
-        st = tensorize(pods, [default_prov()], small_catalog)
-        assert sched._route_native(st, 10)
-
-    def test_auto_routes_positive_affinity_to_device(self, small_catalog):
-        from karpenter_tpu.models.pod import PodAffinityTerm
-
-        sched = BatchScheduler(backend="auto")
-        sel = LabelSelector.of({"app": "x"})
-        pods = [PodSpec(name=f"p{i}", labels={"app": "x"}, requests={"cpu": 1.0},
-                        affinity_terms=[PodAffinityTerm(sel, L.ZONE, anti=False)])
-                for i in range(10)]
         st = tensorize(pods, [default_prov()], small_catalog)
         assert not sched._route_native(st, 10)
 
+    def test_auto_small_batch_matches_oracle_exactly(self, small_catalog):
+        from karpenter_tpu.metrics import SOLVER_BACKEND_DURATION, Registry
+        from karpenter_tpu.solver import reference
+
+        reg = Registry()
+        sched = BatchScheduler(backend="auto", registry=reg)
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}, owner_key="u")
+                for i in range(60)]
+        got = sched.solve(pods, [default_prov()], small_catalog)
+        oracle = reference.solve(pods, [default_prov()], small_catalog)
+        assert len(got.nodes) == len(oracle.nodes)
+        assert abs(got.new_node_cost - oracle.new_node_cost) < 1e-9
+        # and it really was the oracle that served it
+        assert reg.histogram(SOLVER_BACKEND_DURATION).count({"backend": "oracle"}) >= 1
+        assert reg.histogram(SOLVER_BACKEND_DURATION).count({"backend": "tpu"}) == 0
+
     def test_auto_routes_big_to_device(self, small_catalog):
         sched = BatchScheduler(backend="auto", native_batch_limit=64)
+        assert not sched._route_small(100)
         pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(100)]
         st = tensorize(pods, [default_prov()], small_catalog)
         assert not sched._route_native(st, 100)
+
+    def test_forced_native_backend_routes_native(self, small_catalog):
+        sched = BatchScheduler(backend="native")
+        pods = [PodSpec(name=f"p{i}", requests={"cpu": 1.0}) for i in range(10)]
+        st = tensorize(pods, [default_prov()], small_catalog)
+        assert sched._route_native(st, 10)
 
     def test_native_backend_end_to_end(self, small_catalog):
         sched = BatchScheduler(backend="native")

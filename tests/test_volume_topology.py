@@ -332,15 +332,34 @@ class TestManifestsAndCodec:
             assert abs(remote.get(k, 0.0) - v) < 1e-6, (k, v, remote.get(k))
 
     def test_legacy_overhead_decode(self, small_catalog):
-        """A wire message carrying only the pre-summed overhead (old encoder)
-        still decodes to the same total deduction."""
+        """A wire message carrying only the pre-summed overhead (original
+        encoder) still decodes to the same total deduction."""
         from karpenter_tpu.service import codec
 
         it = small_catalog[0]
         msg = codec.encode_instance_type(it)
-        del msg.overhead_kube[:]      # simulate an old encoder
+        del msg.overhead_kube[:]      # simulate the original encoder
         del msg.overhead_system[:]
         del msg.overhead_eviction[:]
+        dec = codec.decode_instance_type(msg)
+        for k, v in it.allocatable.items():
+            assert abs(dec.allocatable.get(k, 0.0) - v) < 1e-6
+
+    def test_transitional_overhead_decode(self, small_catalog):
+        """The transitional encoding (field 5 = kube-reserved, 6/7 =
+        system/eviction, no field 8) must decode to the same total deduction
+        — the legacy branch reads all three (review finding: dropping 6/7
+        inflated allocatable by the system+eviction reservation)."""
+        from karpenter_tpu.service import codec
+        from karpenter_tpu.service import solver_pb2 as pb
+
+        it = small_catalog[0]
+        msg = codec.encode_instance_type(it)
+        del msg.overhead_kube[:]
+        del msg.overhead[:]
+        msg.overhead.extend(
+            pb.Quantity(resource=k, value=v)
+            for k, v in it.overhead.kube_reserved.items())
         dec = codec.decode_instance_type(msg)
         for k, v in it.allocatable.items():
             assert abs(dec.allocatable.get(k, 0.0) - v) < 1e-6
