@@ -204,8 +204,9 @@ def run_bench():
     # TPU solve (tensorize is host prep; solve time is the solver itself,
     # from the fenced measure run — production pays one execution, the bench
     # pays two for an honest post-compile number)
+    # production configuration: assignments tracked (see bench_all._ffd_and_tpu)
     st = tensorize(pods, provs, catalog)
-    out = solve_tensors(st, track_assignments=False, measure=True)
+    out = solve_tensors(st, track_assignments=True, measure=True)
 
     cost_ratio = (
         out.result.new_node_cost / oracle.new_node_cost if oracle.new_node_cost else 1.0

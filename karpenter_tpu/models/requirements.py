@@ -139,11 +139,22 @@ class ValueSet:
 
         req = self.require_exists or other.require_exists
         if self.complement and other.complement:
-            return ValueSet(self.values | other.values, True, greater, less, req)
-        if not self.complement and not other.complement:
-            return ValueSet(self.values & other.values, False, greater, less, req)
-        allow, deny = (self, other) if not self.complement else (other, self)
-        return ValueSet(allow.values - deny.values, False, greater, less, req)
+            out = ValueSet(self.values | other.values, True, greater, less, req)
+        elif not self.complement and not other.complement:
+            out = ValueSet(self.values & other.values, False, greater, less, req)
+        else:
+            allow, deny = (self, other) if not self.complement else (other, self)
+            out = ValueSet(allow.values - deny.values, False, greater, less, req)
+        # a node missing the label satisfies the conjunction iff it satisfies
+        # BOTH conjuncts.  Without this, In{a} ∩ In{b} collapses to the empty
+        # allow-set, which allows_absence() reads as DoesNotExist — a
+        # contradictory pod (volume pin to one zone + node_selector to
+        # another, fuzz seed 18) would then "fit" any label-less node
+        if out.allows_absence() and not (
+            self.allows_absence() and other.allows_absence()
+        ):
+            out = ValueSet(out.values, out.complement, greater, less, True)
+        return out
 
     def enumerate_finite(self) -> Iterator[str]:
         """Iterate concrete values if the set is finite (allow-form)."""

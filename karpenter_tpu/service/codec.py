@@ -87,6 +87,7 @@ def encode_instance_type(it: InstanceType) -> pb.InstanceType:
     out.overhead_kube.extend(_quantities(it.overhead.kube_reserved))
     out.overhead_system.extend(_quantities(it.overhead.system_reserved))
     out.overhead_eviction.extend(_quantities(it.overhead.eviction_threshold))
+    out.has_overhead_components = True
     return out
 
 
@@ -249,7 +250,7 @@ def decode_instance_type(it: pb.InstanceType) -> InstanceType:
                 system_reserved=_qdict(it.overhead_system),
                 eviction_threshold=_qdict(it.overhead_eviction),
             )
-            if len(it.overhead_kube)
+            if it.has_overhead_components
             # older encoders: field 5 carries either the pre-summed total
             # (original wire format; fields 6/7 empty) or kube-reserved with
             # system/eviction in 6/7 — reading 6/7 here is correct for both

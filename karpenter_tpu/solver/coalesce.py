@@ -70,12 +70,24 @@ def label_feasibility(st) -> np.ndarray:
 
 def hostname_constrained(st) -> bool:
     """Any group whose constraints are scoped to individual nodes — merging
-    nodes could violate them, so coalescing is skipped for the whole solve."""
+    nodes could violate them, so coalescing is skipped for the whole solve
+    when per-node group tracking is unavailable."""
     return bool(
         (np.asarray(st.g_host_spread) >= 0).any()
         or (np.asarray(st.g_host_paff) >= 0).any()
         or (np.asarray(st.g_host_cap) > 0).any()
     )
+
+
+def hostname_capped_groups(st) -> set:
+    """Group indices whose hostname rules CAP pods per node (spread maxSkew,
+    anti-affinity) — a merge combining two nodes' counts can violate these,
+    so nodes holding them are frozen out of coalescing.  Positive hostname
+    affinity (g_host_paff) is NOT capping: it wants matching pods together,
+    and merging only ever adds pods to a node, so it cannot break (fuzz
+    seed 23: one paff group used to disable coalescing for the whole solve,
+    stranding mergeable fragments in every other group)."""
+    return set(np.flatnonzero(np.asarray(st.g_host_spread) >= 0).tolist())
 
 
 def _pkey(a: SimNode, b: SimNode) -> tuple:
@@ -122,8 +134,47 @@ def coalesce_new_nodes(
     the rename map.  ``node_groups`` scopes the label-feasibility check to
     the groups actually placed on each node; without it (untracked solves)
     the merge target must be feasible for EVERY group in the solve."""
-    if hostname_constrained(st):
-        return nodes, {}
+    capped = hostname_capped_groups(st)
+    if node_groups is None:
+        # untracked solves can't scope the check per node: all-or-nothing
+        if hostname_constrained(st):
+            return nodes, {}
+        capped = set()
+    # per-node hostname bookkeeping for capped solves: a merge is legal when,
+    # for every hostname slot either node's groups cap, the COMBINED count of
+    # slot-matching pods stays within the stricter cap (anti-affinity
+    # cap 1/0, spread maxSkew).  Group labels are uniform, so counts come
+    # from g_sel_match at group granularity — no per-pod selector matching.
+    # This is what lets bench config 3 (every pod hostname-anti) coalesce its
+    # 1-pod-per-service fragments into shared nodes at equal-or-lower price.
+    g_hs = np.asarray(st.g_host_spread)
+    g_hc = np.asarray(st.g_host_cap)
+    host_active = bool(capped) and (g_hs >= 0).any()
+    pod_group: Dict[str, int] = {}
+    if host_active:
+        for gi, g in enumerate(st.groups):
+            for p in g.pods:
+                pod_group[p.name] = gi
+    S_all = st.g_sel_match.shape[0]
+
+    def _host_state(n: SimNode):
+        """(counts[S], caps[S]) for one node; caps inf where unconstrained."""
+        cnt = np.zeros(S_all, dtype=np.int64)
+        cap = np.full(S_all, np.inf)
+        for p in n.pods:
+            gi = pod_group.get(p.name)
+            if gi is None:
+                # a pod outside this solve (shouldn't happen for new nodes):
+                # be conservative, forbid merging this node
+                cap[:] = -1.0
+                return cnt, cap
+            cnt += st.g_sel_match[:, gi]
+            s = int(g_hs[gi])
+            if s >= 0:
+                cap[s] = min(cap[s], float(g_hc[gi]))
+            # positive hostname affinity (g_host_paff) needs no cap: it wants
+            # matching pods together, and merging only ever ADDS co-residents
+        return cnt, cap
     F = label_feasibility(st)                             # [G, C]
     all_groups = frozenset(range(F.shape[0]))
 
@@ -165,6 +216,35 @@ def coalesce_new_nodes(
             if node_groups is None:
                 return all_groups
             return frozenset(node_groups.get(id(n), all_groups))
+
+        _hstate: Dict[int, tuple] = {}
+
+        def host_state(n: SimNode) -> tuple:
+            got = _hstate.get(id(n))
+            if got is None:
+                got = _host_state(n)
+                _hstate[id(n)] = got
+            return got
+
+        def order_nodes(lst: List[SimNode]) -> List[SimNode]:
+            """Scan order.  Plain solves: smallest-first.  Hostname-capped
+            solves: same, but round-robin across group combinations — the
+            solver creates one group's fragments consecutively, so a
+            smallest-first window would fill with ONE service's nodes, whose
+            pairs all violate the per-node cap; rotating group combos puts
+            mergeable cross-service partners inside the window."""
+            base = sorted(lst, key=lambda n: (size_of(n), n.name))
+            if not host_active:
+                return base
+            seen: Dict[frozenset, int] = {}
+            ranked = []
+            for n in base:
+                key = frozenset(groups_of(n))
+                r = seen.get(key, 0)
+                seen[key] = r + 1
+                ranked.append((r, size_of(n), n.name, n))
+            ranked.sort(key=lambda t: t[:3])
+            return [t[3] for t in ranked]
 
         # per-node precomputes, cached by identity (merged nodes get entries
         # as they're created): candidate-feasibility row (AND over the node's
@@ -253,6 +333,16 @@ def coalesce_new_nodes(
                 capb = cap_w[ai] + cap_w[bj]
                 for r in range(R):
                     ok &= c_cap[None, :, r] <= capb[:, r, None] + 1e-6
+            if host_active:
+                # hostname caps: combined slot-matching counts must respect
+                # the stricter of the two nodes' caps on every slot
+                hcnt = np.stack([host_state(n)[0] for n in window])  # [W,S]
+                hcap = np.stack([host_state(n)[1] for n in window])  # [W,S]
+                pair_ok = (
+                    hcnt[ai] + hcnt[bj]
+                    <= np.minimum(hcap[ai], hcap[bj])
+                ).all(axis=1)
+                ok &= pair_ok[:, None]
             any_p = ok.any(axis=1)
             hits = np.flatnonzero(any_p)
             ks = np.empty(len(fresh), dtype=np.int64)
@@ -267,7 +357,7 @@ def coalesce_new_nodes(
                 else:
                     pair_best[_pkey(a, b)] = None
 
-        group = sorted(group, key=lambda n: (size_of(n), n.name))
+        group = order_nodes(group)
         while len(group) >= 2:
             win = min(len(group), FRAG_WINDOW)
             window = group[:win]
@@ -304,9 +394,14 @@ def coalesce_new_nodes(
                 },
                 existing=False,
             )
+            node.stamp_labels()
             node.pods = list(a.pods) + list(b.pods)
             used_rows[id(node)] = need
             _nF[id(node)] = node_F(a) & node_F(b)
+            if host_active:
+                ca, pa = host_state(a)
+                cb, pb = host_state(b)
+                _hstate[id(node)] = (ca + cb, np.minimum(pa, pb))
             if node_groups is not None:
                 node_groups[id(node)] = set(groups_of(a) | groups_of(b))
             renames[a.name] = node.name
@@ -321,9 +416,8 @@ def coalesce_new_nodes(
             for gone in (id(a), id(b)):
                 for other in partners.pop(gone, ()):  # symmetric cleanup
                     partners.get(other, set()).discard(gone)
-            group = sorted(
-                [n for idx, n in enumerate(group) if idx not in (i, j)] + [node],
-                key=lambda n: (size_of(n), n.name),
+            group = order_nodes(
+                [n for idx, n in enumerate(group) if idx not in (i, j)] + [node]
             )
         out.extend(group)
     return out, renames

@@ -258,6 +258,7 @@ def solve_tensors_native(
                 st.vocab.resources[r]: float(st.cand_alloc[ci, r]) for r in range(R)
             },
         )
+        node.stamp_labels()
         nodes.append(node)
         slot_to_node[s] = node
 

@@ -1287,6 +1287,7 @@ class TpuSolver:
                 },
                 existing=False,
             )
+            node.stamp_labels()
             new_nodes.append(node)
             slot_to_node[si] = node
 

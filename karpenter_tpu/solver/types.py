@@ -57,6 +57,23 @@ class SimNode:
         req.setdefault(L.RESOURCE_PODS, 1.0)
         return fits(req, self.remaining())
 
+    def stamp_labels(self) -> "SimNode":
+        """Stamp the node's own fields as labels (zone/capacity-type/type/
+        provisioner/hostname), mirroring what the oracle's _create_node and
+        real node objects carry — solver-built nodes must be judged by later
+        waves' label-compat checks the same way labeled cluster nodes are
+        (a label-less node reads as 'absent' for every selector)."""
+        for k, v in (
+            (L.ZONE, self.zone),
+            (L.CAPACITY_TYPE, self.capacity_type),
+            (L.INSTANCE_TYPE, self.instance_type),
+            (L.PROVISIONER_NAME, self.provisioner),
+            (L.HOSTNAME, self.name),
+        ):
+            if v:
+                self.labels.setdefault(k, v)
+        return self
+
     def snapshot(self) -> "SimNode":
         """Simulation copy: solvers place pods by mutating ``pods``, and a
         what-if solve (consolidation) must never leak placements into the

@@ -647,10 +647,11 @@ class TestCoalescing:
         oracle = reference.solve(pods, provs, small_catalog)
         assert res.new_node_cost <= oracle.new_node_cost * 1.02 + 1e-9
 
-    def test_hostname_constraints_disable_coalescing(self, small_catalog):
+    def test_hostname_anti_survives_coalescing(self, small_catalog):
         """Hostname anti-affinity caps are per-NODE: two nodes each holding a
-        matching pod must never merge.  The solve-level gate turns the pass
-        off entirely for such tensors."""
+        matching pod must never merge.  Capped solves still coalesce — the
+        pair check just forbids combining nodes whose slot counts would
+        exceed a cap."""
         from karpenter_tpu.solver.coalesce import hostname_constrained
 
         sel = LabelSelector.of({"app": "x"})
@@ -659,11 +660,41 @@ class TestCoalescing:
                         affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME, anti=True)])
                 for i in range(6)]
         st = tensorize(pods, [default_prov()], small_catalog)
-        assert hostname_constrained(st)
+        assert hostname_constrained(st)  # untracked solves still skip the pass
         res = solve_tensors(st).result
-        # anti-affinity still holds node-for-node after extraction
+        # anti-affinity still holds node-for-node after extraction+coalescing
         for node in res.nodes:
             assert sum(1 for p in node.pods if p.labels.get("app") == "x") <= 1
+
+    def test_capped_cross_service_fragments_coalesce(self, small_catalog):
+        """Bench config 3's shape in miniature: many single-pod-per-service
+        hostname-anti fragments merge into shared nodes (one pod per service
+        stays the invariant), instead of the whole solve skipping the pass
+        (r4: config 3 shipped 1900 nodes where ~309 suffice)."""
+        pods = []
+        for s in range(8):
+            sel = LabelSelector.of({"app": f"svc{s}"})
+            for i in range(4):
+                pods.append(PodSpec(
+                    name=f"svc{s}-{i}", labels={"app": f"svc{s}"},
+                    requests={"cpu": 0.5},
+                    affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME, anti=True)],
+                    owner_key=f"svc{s}"))
+        st = tensorize(pods, [default_prov()], small_catalog)
+        res = solve_tensors(st).result
+        assert not res.infeasible
+        # per-node: at most one pod per service, always
+        for node in res.nodes:
+            per = {}
+            for p in node.pods:
+                per[p.labels["app"]] = per.get(p.labels["app"], 0) + 1
+            assert all(v <= 1 for v in per.values()), (node.name, per)
+        # and fragments DID merge: far fewer nodes than one per (svc, pod)
+        assert len(res.nodes) <= 8, f"{len(res.nodes)} nodes for 32 capped pods"
+        # assignments survived the merges
+        node_names = {n.name for n in res.nodes}
+        for p in pods:
+            assert res.assignments[p.name] in node_names
 
     def test_coalesce_respects_type_pinned_selectors(self, small_catalog):
         """Coalescing must honor the same label feasibility the solve did:

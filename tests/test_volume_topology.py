@@ -341,9 +341,34 @@ class TestManifestsAndCodec:
         del msg.overhead_kube[:]      # simulate the original encoder
         del msg.overhead_system[:]
         del msg.overhead_eviction[:]
+        msg.has_overhead_components = False
         dec = codec.decode_instance_type(msg)
         for k, v in it.allocatable.items():
             assert abs(dec.allocatable.get(k, 0.0) - v) < 1e-6
+
+    def test_empty_kube_reserved_not_mistaken_for_legacy(self, small_catalog):
+        """A NEW encoder with a legitimately-empty kube-reserved map must not
+        decode as a legacy message (which would read the pre-summed field 5
+        as kube-reserved and double-count system+eviction)."""
+        from dataclasses import replace
+
+        from karpenter_tpu.models.instancetype import Overhead
+        from karpenter_tpu.service import codec
+
+        it = replace(
+            small_catalog[0],
+            overhead=Overhead(
+                kube_reserved={},
+                system_reserved={L.RESOURCE_MEMORY: 1.0 * 1024**3},
+                eviction_threshold={L.RESOURCE_MEMORY: 0.5 * 1024**3},
+            ),
+        )
+        dec = codec.decode_instance_type(codec.encode_instance_type(it))
+        want = it.overhead.total()
+        got = dec.overhead.total()
+        for k, v in want.items():
+            assert abs(got.get(k, 0.0) - v) < 1e-6, (k, v, got.get(k))
+        assert dec.overhead.kube_reserved == {}
 
     def test_transitional_overhead_decode(self, small_catalog):
         """The transitional encoding (field 5 = kube-reserved, 6/7 =
@@ -360,6 +385,7 @@ class TestManifestsAndCodec:
         msg.overhead.extend(
             pb.Quantity(resource=k, value=v)
             for k, v in it.overhead.kube_reserved.items())
+        msg.has_overhead_components = False
         dec = codec.decode_instance_type(msg)
         for k, v in it.allocatable.items():
             assert abs(dec.allocatable.get(k, 0.0) - v) < 1e-6
