@@ -31,7 +31,7 @@ from ..models.pod import LabelSelector, PodSpec
 from ..models.provisioner import Provisioner
 from ..models.tensorize import device_inexpressible, tensorize
 from .reference import solve as oracle_solve
-from .tpu import TpuSolver
+from .tpu import SlotsExhausted, TpuSolver
 from .types import SimNode, SolveResult
 
 logger = logging.getLogger(__name__)
@@ -605,12 +605,29 @@ class BatchScheduler:
                 )
                 self._start_warm(st, all_existing, max_slots)
             else:
-                out = self._tpu.solve(
-                    st, existing_nodes=all_existing, max_nodes=max_slots,
-                    mesh=self.mesh,
-                )
-                res = out.result
-                backend_used = "tpu"
+                try:
+                    out = self._tpu.solve(
+                        st, existing_nodes=all_existing, max_nodes=max_slots,
+                        mesh=self.mesh,
+                        raise_on_exhaust=(self.backend == "auto"
+                                          and self.compile_behind),
+                    )
+                    res = out.result
+                    backend_used = "tpu"
+                except SlotsExhausted:
+                    # the optimistic node-slot axis ran out and the
+                    # full-budget program is cold: serve from the warm tier
+                    # now, compile the full program behind (the solver
+                    # remembered the exhaustion, so _start_warm targets it)
+                    res, backend_used = self._cold_solve(
+                        st, tpu_pods, provisioners, instance_types,
+                        all_existing, daemonsets, unavailable,
+                        allow_new_nodes, max_slots, max_new_nodes,
+                    )
+                    self.registry.counter(SOLVER_COLD_FALLBACKS).inc(
+                        {"backend": backend_used}
+                    )
+                    self._start_warm(st, all_existing, max_slots)
             self.registry.histogram(SOLVER_BACKEND_DURATION).observe(
                 time.perf_counter() - t0, {"backend": backend_used}
             )

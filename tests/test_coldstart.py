@@ -189,3 +189,80 @@ class TestCompileBehind:
         assert reg.counter(SOLVER_COLD_FALLBACKS).get({"backend": "native"}) == 0
         assert reg.counter(SOLVER_COLD_FALLBACKS).get({"backend": "oracle"}) == 0
         assert reg.histogram(SOLVER_BACKEND_DURATION).count({"backend": "tpu"}) == 1
+
+
+class TestSlotExhaustion:
+    def test_exhausted_shape_warms_full_program_behind(self, small_catalog):
+        """NR-estimate lifecycle (tpu._nr_estimate): an anti-affinity-heavy
+        shape the estimate undershoots is served by the warm tier while the
+        background warm compiles the estimated program, DETECTS the
+        exhaustion itself, and compiles the full-budget program too — so
+        steady-state solves land directly on the program that actually
+        serves the shape, and no caller ever eats a cold compile."""
+        from karpenter_tpu.models.tensorize import tensorize
+        from karpenter_tpu.solver.tpu import _node_budget, solve_dims
+
+        reg = Registry()
+        sched = BatchScheduler(backend="auto", registry=reg,
+                               native_batch_limit=8)
+        prov = Provisioner(name="default").with_defaults()
+        sel = LabelSelector.of({"app": "x"})
+
+        def batch(tag):
+            return [
+                PodSpec(name=f"{tag}{i}", labels={"app": "x"},
+                        requests={"cpu": 0.05},
+                        affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME, anti=True)],
+                        owner_key="x")
+                for i in range(3000)
+            ]
+
+        st = tensorize(batch("probe"), [prov], small_catalog)
+        nb = _node_budget(st, 0, None)
+        est = solve_dims(st, NE=0, node_budget=nb)["NR"]
+        full = solve_dims(st, NE=0, node_budget=nb, full_nr=True)["NR"]
+        assert est < 3000 <= full  # the shape really undershoots
+
+        # solve 1: estimated program cold -> warm tier serves; the warm
+        # compiles est, exhausts, and compiles the full program too
+        r1 = sched.solve(batch("a"), [prov], small_catalog)
+        assert not r1.infeasible
+        assert reg.counter(SOLVER_COLD_FALLBACKS).get({"backend": "native"}) == 1
+        _wait_warm(sched)
+        assert sched._tpu._nr_exhausted  # the warm recorded the exhaustion
+
+        # solve 2: signature now resolves to the full program -> on-device,
+        # no new fallback
+        r2 = sched.solve(batch("b"), [prov], small_catalog)
+        assert not r2.infeasible
+        assert reg.counter(SOLVER_COLD_FALLBACKS).get({"backend": "native"}) == 1
+        assert reg.histogram(SOLVER_BACKEND_DURATION).count({"backend": "tpu"}) == 1
+        for r in (r1, r2):
+            for n in r.nodes:
+                assert sum(1 for p in n.pods
+                           if p.labels.get("app") == "x") <= 1
+
+    def test_raise_on_exhaust_contract(self, small_catalog):
+        """Direct solver contract: raise_on_exhaust surfaces SlotsExhausted
+        when the estimate runs dry and the full program is cold, instead of
+        inline-compiling it on the caller's thread."""
+        import pytest as _pytest
+
+        from karpenter_tpu.models.tensorize import tensorize
+        from karpenter_tpu.solver.tpu import SlotsExhausted, TpuSolver
+
+        prov = Provisioner(name="default").with_defaults()
+        sel = LabelSelector.of({"app": "x"})
+        pods = [PodSpec(name=f"p{i}", labels={"app": "x"},
+                        requests={"cpu": 0.05},
+                        affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME, anti=True)],
+                        owner_key="x")
+                for i in range(3000)]
+        st = tensorize(pods, [prov], small_catalog)
+        solver = TpuSolver()
+        with _pytest.raises(SlotsExhausted):
+            solver.solve(st, raise_on_exhaust=True)
+        assert solver._nr_exhausted
+        # without the flag the same solver inline-retries and places all pods
+        out = solver.solve(st)
+        assert out.result.infeasible == {}

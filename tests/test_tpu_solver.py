@@ -696,6 +696,33 @@ class TestCoalescing:
         for p in pods:
             assert res.assignments[p.name] in node_names
 
+    def test_nr_estimate_exhaustion_retries_at_full_budget(self, small_catalog):
+        """The NR axis is sized by an optimistic resource-only estimate
+        (docs/PROFILE.md: the worst-case one-slot-per-pod axis dominated
+        device time).  A shape the estimate undershoots — hostname
+        anti-affinity forces ~1 pod/node where resources allow hundreds —
+        must exhaust its slots and transparently re-solve at the full
+        budget, placing every pod."""
+        from karpenter_tpu.models.tensorize import tensorize as _tz
+        from karpenter_tpu.solver.tpu import _node_budget, solve_dims
+
+        sel = LabelSelector.of({"app": "x"})
+        pods = [PodSpec(name=f"p{i}", labels={"app": "x"},
+                        requests={"cpu": 0.05},
+                        affinity_terms=[PodAffinityTerm(sel, L.HOSTNAME, anti=True)],
+                        owner_key="x")
+                for i in range(3000)]
+        st = _tz(pods, [default_prov()], small_catalog)
+        nb = _node_budget(st, 0, None)
+        est = solve_dims(st, NE=0, node_budget=nb)["NR"]
+        full = solve_dims(st, NE=0, node_budget=nb, full_nr=True)["NR"]
+        assert est < 3000 <= full, (est, full)  # the retry must be needed
+        out = solve_tensors(st)
+        assert out.result.infeasible == {}
+        assert len(out.result.nodes) >= 3000 / 2  # anti caps at 1 matching/node
+        for n in out.result.nodes:
+            assert sum(1 for p in n.pods if p.labels.get("app") == "x") <= 1
+
     def test_coalesce_respects_type_pinned_selectors(self, small_catalog):
         """Coalescing must honor the same label feasibility the solve did:
         pods pinned by node_selector to one instance type must never come
