@@ -250,17 +250,22 @@ def _repack_to_convergence(catalog, n_nodes, backend, disable_screen,
         deprov_mod.SUBSET_SCREEN_MIN = 10**9
     t0 = _time.perf_counter()
     actions = 0
+    action_nodes = []
     idle_ticks = 0
     ticks = 0
+    other_s = 0.0  # termination + provisioning (drain/rebind) per tick
     try:
         while idle_ticks < 12 and ticks < max_ticks:
             act = deprov.reconcile()
+            t1 = _time.perf_counter()
             term.reconcile()
             prov_ctrl.reconcile()
+            other_s += _time.perf_counter() - t1
             clock.advance(5.0)
             ticks += 1
             if act is not None:
                 actions += 1
+                action_nodes.append(len(act.nodes))
                 idle_ticks = 0
             else:
                 idle_ticks += 1
@@ -271,6 +276,9 @@ def _repack_to_convergence(catalog, n_nodes, backend, disable_screen,
     hist = reg.histogram(DEPROVISIONING_DURATION)
     n_obs = sum(hist.totals.values())
     mean_ms = (sum(hist.sums.values()) / n_obs * 1000.0) if n_obs else 0.0
+    phases = {k: round(v, 1) for k, v in
+              sorted(deprov.phase_s.items(), key=lambda kv: -kv[1])}
+    phases["drain_rebind"] = round(other_s, 1)
     return {
         "initial_cost": round(cost0, 2),
         "final_cost": round(cost1, 2),
@@ -278,10 +286,13 @@ def _repack_to_convergence(catalog, n_nodes, backend, disable_screen,
         "nodes_start": n_nodes,
         "nodes_end": len(state.nodes),
         "actions": actions,
+        "action_nodes": action_nodes[:40],
         "ticks": ticks,
         "pending_end": len(state.pending_pods()),
         "wall_s": round(wall_s, 1),
         "reconcile_mean_ms": round(mean_ms, 1),
+        "phase_s": phases,
+        "phase_calls": dict(deprov.phase_n),
     }
 
 

@@ -55,6 +55,13 @@ REPLACE_RETRY_BACKOFF = 2 * 60.0
 #: above this candidate count, run the one-device-call delete screen
 #: (solver/consolidation.py) before any sequential what-ifs
 SCREEN_THRESHOLD = 32
+#: the subset screen's per-subset pod budget (solver/consolidation.py
+#: screen_subset_deletes pmax_total default): subsets with bigger pod unions
+#: are conservatively unscreenable — _escalate_capped_delete takes over there
+SCREEN_PMAX = 128
+#: single-candidate what-ifs per consolidation pass; the rotating cursor
+#: resumes next pass (the reference's single-node consolidation timeout)
+SINGLE_TRIES_PER_PASS = 100
 #: minimum consolidation candidates before the batched multi-subset screen
 #: runs (below this, the sequential prefix search is cheap and exact)
 SUBSET_SCREEN_MIN = 4
@@ -112,6 +119,10 @@ class DeprovisioningController:
         self.unavailable = getattr(provisioning, "unavailable", None)
         self._last_seqnum = -1
         self._last_action_at = 0.0
+        # per-phase wall-time accumulators (repack bench tick breakdown)
+        self.phase_s: Dict[str, float] = {}
+        self.phase_n: Dict[str, int] = {}
+        self._single_cursor = 0  # rotating single-consolidation resume point
         self._last_eval_at = -1e18
         self._pending: Optional[PendingReplacement] = None
         self._proposed: Optional[Tuple[Action, float]] = None  # (action, validate_at)
@@ -297,6 +308,13 @@ class DeprovisioningController:
         terms = pod.scheduling_requirements()
         return any(reqs.compatible(node.labels) is None for reqs in terms)
 
+    def _phase(self, name: str, seconds: float) -> None:
+        """Accumulate per-phase wall time for the repack bench's tick
+        breakdown (screen / exact-confirm / prefix-search / ...); cheap dict
+        adds, reset by the harness."""
+        self.phase_s[name] = self.phase_s.get(name, 0.0) + seconds
+        self.phase_n[name] = self.phase_n.get(name, 0) + 1
+
     def _consolidation(self) -> Optional[Action]:
         pending = self.state.pending_pods()
         if pending:
@@ -347,17 +365,25 @@ class DeprovisioningController:
                         if ns.node.name in idx_of]
             # compat rows are computed only for candidate sources
             # (O(|cands| x N) host work, not O(N^2))
+            t0 = time.perf_counter()
             compat = compat_matrix(all_nodes, sources=cand_idx)
+            self._phase("compat_matrix", time.perf_counter() - t0)
             singles = [[i] for i in cand_idx] if run_single else []
             multis = self._multi_subsets(cand_idx, cands, idx_of) if run_multi else []
-            screen = screen_subset_deletes(all_nodes, singles + multis, compat)
+            t0 = time.perf_counter()
+            screen = screen_subset_deletes(all_nodes, singles + multis, compat,
+                                           pmax_total=SCREEN_PMAX)
+            self._phase("device_screen", time.perf_counter() - t0)
 
             if multis:
+                t0 = time.perf_counter()
                 attempt = self._confirm_subsets(
                     cands, all_nodes, idx_of, multis,
                     screen.deletable[len(singles):],
                 )
+                self._phase("confirm_subsets", time.perf_counter() - t0)
                 if attempt is not None:
+                    attempt = self._escalate_capped_delete(cands, attempt)
                     return attempt
 
             if run_single:
@@ -373,25 +399,77 @@ class DeprovisioningController:
 
         # 2b) multi-node: binary search the largest disruption-cost prefix
         #     that can be deleted together with <=1 replacement
-        best_multi = None
-        lo, hi = 2, len(cands)
+        t0 = time.perf_counter()
+        best_multi = self._prefix_search(cands, 2, len(cands))
+        self._phase("prefix_search", time.perf_counter() - t0)
+        if best_multi is not None:
+            return best_multi
+
+        # 3) single-node: first candidate (lowest disruption) that works.
+        #    Budgeted per pass with a rotating cursor — the reference bounds
+        #    single-node consolidation the same way (a per-pass timeout that
+        #    resumes where it left off) because each try is a full what-if;
+        #    an unbounded sweep over a big fleet's candidates costs minutes
+        #    per reconcile while finding nothing on converged fleets
+        t0 = time.perf_counter()
+        try:
+            n = len(cands)
+            start = self._single_cursor % n
+            tried = 0
+            for k in range(n):
+                if tried >= SINGLE_TRIES_PER_PASS:
+                    break
+                _, ns = cands[(start + k) % n]
+                tried += 1
+                attempt = self._simulate([ns])
+                if attempt is not None:
+                    self._single_cursor = start + k + 1
+                    return attempt
+            self._single_cursor = start + tried
+            return None
+        finally:
+            self._phase("single_fallback", time.perf_counter() - t0)
+
+    def _prefix_search(self, cands, lo: int, hi: int) -> Optional[Action]:
+        """Binary-search the largest disruption-cost prefix of ``cands`` that
+        exact-confirms (delete, or delete + one replacement)."""
+        best = None
         while lo <= hi:
             mid = (lo + hi) // 2
             attempt = self._simulate([ns for _, ns in cands[:mid]])
             if attempt is not None:
-                best_multi = attempt
+                best = attempt
                 lo = mid + 1
             else:
                 hi = mid - 1
-        if best_multi is not None:
-            return best_multi
+        return best
 
-        # 3) single-node: first candidate (lowest disruption) that works
-        for _, ns in cands:
-            attempt = self._simulate([ns])
-            if attempt is not None:
-                return attempt
-        return None
+    def _escalate_capped_delete(self, cands, attempt: Action) -> Action:
+        """The device screen conservatively rejects subsets whose pod union
+        exceeds its pod budget (SCREEN_PMAX), so on a large under-utilized
+        fleet the biggest SCREENED delete is pod-capped (~SCREEN_PMAX pods)
+        while the true consolidatable prefix is 10-20x larger — the r4
+        repack needed 48 pod-capped actions x one 15 s TTL cycle each where
+        the uncapped oracle loop needed one.  When a confirmed delete looks
+        cap-bound and candidates remain, binary-search beyond it with exact
+        what-ifs and take the bigger delete."""
+        if attempt.kind != "delete" or len(attempt.nodes) >= len(cands):
+            return attempt
+        names = set(attempt.nodes)
+        n_pods = sum(len(ns.node.pods) for _, ns in cands
+                     if ns.node.name in names)
+        if n_pods < int(0.7 * SCREEN_PMAX):
+            return attempt  # genuinely small: the screen wasn't the binder
+        t0 = time.perf_counter()
+        bigger = self._prefix_search(cands, len(attempt.nodes) + 1, len(cands))
+        self._phase("escalate_search", time.perf_counter() - t0)
+        # compare SAVINGS, not node counts: candidates are disruption-ordered,
+        # so a longer prefix of cheap nodes can be worth less than a confirmed
+        # per-type subset of expensive ones
+        if (bigger is not None and bigger.kind == "delete"
+                and bigger.savings > attempt.savings):
+            return bigger
+        return attempt
 
     def _multi_subsets(self, cand_idx, cands, idx_of) -> List[List[int]]:
         """Structured subsets (node indices) worth screening: disruption-cost
@@ -479,7 +557,9 @@ class DeprovisioningController:
         target_names = {ns.node.name for ns in targets}
         pods: List[PodSpec] = [p for ns in targets for p in ns.node.pods
                                if not p.is_daemon]
+        t0 = time.perf_counter()
         result = self._solve_what_if(pods, target_names)
+        self._phase("what_if_solve", time.perf_counter() - t0)
         if result.infeasible:
             return None
         current_cost = sum(ns.node.price for ns in targets)

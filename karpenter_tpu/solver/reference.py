@@ -98,6 +98,14 @@ class _Solver:
         self.prov_usage: Dict[str, ResourceList] = defaultdict(dict)
         self._label_ok_cache: Dict[tuple, bool] = {}
         self._ds_cache: Dict[Tuple[str, str], ResourceList] = {}
+        # per-node caches keyed by identity (nodes are this solve's private
+        # snapshots): label signature never changes mid-solve; remaining()
+        # changes only on _bind, which invalidates.  The heap build calls
+        # _group_cap for every (group, node) pair — at 2k existing nodes the
+        # uncached remaining()/sig-sort work dominated consolidation
+        # what-ifs (O(G*N) * O(pods_per_node))
+        self._sig_cache: Dict[int, tuple] = {}
+        self._rem_cache: Dict[int, ResourceList] = {}
 
         self.all_zones: List[str] = []
         for _, _, it, _ in self.pairs:
@@ -122,10 +130,21 @@ class _Solver:
 
     # ---- per-(group,node-shape) caches --------------------------------
     def _node_sig(self, node: SimNode) -> tuple:
-        return (
-            node.instance_type, node.provisioner, node.capacity_type,
-            tuple(sorted(node.labels.items())), tuple(node.taints),
-        )
+        sig = self._sig_cache.get(id(node))
+        if sig is None:
+            sig = (
+                node.instance_type, node.provisioner, node.capacity_type,
+                tuple(sorted(node.labels.items())), tuple(node.taints),
+            )
+            self._sig_cache[id(node)] = sig
+        return sig
+
+    def _remaining(self, node: SimNode) -> ResourceList:
+        rem = self._rem_cache.get(id(node))
+        if rem is None:
+            rem = node.remaining()
+            self._rem_cache[id(node)] = rem
+        return rem
 
     def _label_taint_ok(self, g: PodGroup, node: SimNode) -> bool:
         key = (id(g), self._node_sig(node))
@@ -238,7 +257,7 @@ class _Solver:
         """How many pods of g this node can take right now."""
         if not self._label_taint_ok(g, node):
             return 0
-        rem = node.remaining()
+        rem = self._remaining(node)
         cap = float("inf")
         for k, v in req.items():
             if v > 0:
@@ -307,6 +326,7 @@ class _Solver:
 
     def _bind(self, pod: PodSpec, node: SimNode) -> None:
         node.pods.append(pod)
+        self._rem_cache.pop(id(node), None)  # remaining() changed
         self.assignments[pod.name] = node.name
         self.topo.observe(pod, node.zone, node.name, self.selectors)
 

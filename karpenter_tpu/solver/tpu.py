@@ -1342,11 +1342,23 @@ class TpuSolver:
                     full_ready = full_key in self._ready
                 if raise_on_exhaust and not full_ready:
                     raise SlotsExhausted(full_key)
-                return self.solve(
-                    st, existing_nodes=existing_nodes, max_nodes=max_nodes,
-                    track_assignments=track_assignments, mesh=mesh,
-                    measure=measure, full_nr=True,
-                )
+                # register the inline full-budget compile so a concurrent
+                # warm_async of the same shape doesn't spawn a duplicate
+                # XLA compile of the identical program
+                with self._lock:
+                    inline_compile = full_key not in self._compiling
+                    if inline_compile:
+                        self._compiling.add(full_key)
+                try:
+                    return self.solve(
+                        st, existing_nodes=existing_nodes, max_nodes=max_nodes,
+                        track_assignments=track_assignments, mesh=mesh,
+                        measure=measure, full_nr=True,
+                    )
+                finally:
+                    if inline_compile:
+                        with self._lock:
+                            self._compiling.discard(full_key)
 
         if measure:
             # Timing run, results discarded.  Two quirks of the tunneled
