@@ -317,6 +317,20 @@ class Requirements:
                 return key
         return None
 
+    def signature(self) -> tuple:
+        """Lossless structural key for memoizing requirement-algebra answers
+        per (requirements, node-class) pair (consolidation.compat_matrix,
+        native.solve_tensors_native, reference._label_taint_ok).  Built from
+        the ValueSet fields directly — ``to_list()``'s canonical operator
+        form is LOSSY (it drops require_exists when a set is
+        complement-with-values, so [Exists(k), NotIn(k,{x})] would collide
+        with [NotIn(k,{x})] and inherit the first-seen answer)."""
+        return tuple(sorted(
+            (k, tuple(sorted(vs.values)), vs.complement, vs.greater,
+             vs.less, vs.require_exists)
+            for k, vs in self._by_key.items()
+        ))
+
     def to_list(self) -> list:
         """Canonical list form (used by serialization + vocab registration)."""
         out = []

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import labels as L
-from .types import SimNode
+from .types import SimNode, node_classes
 
 _RESOURCES = (L.RESOURCE_CPU, L.RESOURCE_MEMORY, L.RESOURCE_PODS)
 
@@ -230,38 +230,17 @@ def compat_matrix(
     for i in src:
         for p in nodes[i].pods:
             reqs = p.scheduling_requirements()[0]
-            # the signature is built from the ValueSet fields directly —
-            # to_list()'s canonical operator form is LOSSY (it drops
-            # require_exists when a set is complement-with-values, so
-            # [Exists(k), NotIn(k,{x})] would collide with [NotIn(k,{x})]
-            # and inherit the first-seen pod's semantics)
-            key = (
-                tuple(sorted(
-                    (k, tuple(sorted(vs.values)), vs.complement,
-                     vs.greater, vs.less, vs.require_exists)
-                    for k, vs in ((k, reqs.get(k)) for k in reqs)
-                )),
-                tuple(p.tolerations),
-            )
+            # Requirements.signature() is the lossless structural key
+            # (to_list()'s canonical operator form would collide
+            # [Exists(k), NotIn(k,{x})] with [NotIn(k,{x})])
+            key = (reqs.signature(), tuple(p.tolerations))
             pod_sig[id(p)] = key
             if key not in sig_reqs:
                 sig_reqs[key] = reqs
                 relevant_keys.update(reqs)
 
-    dst_class = np.zeros(N, dtype=np.int64)
-    class_of: Dict[tuple, int] = {}
-    class_rep: List[SimNode] = []
-    for j, dst in enumerate(nodes):
-        ckey = (
-            tuple(sorted((k, v) for k, v in dst.labels.items()
-                         if k in relevant_keys)),
-            tuple((t.key, t.value, t.effect) for t in dst.taints),
-        )
-        c = class_of.get(ckey)
-        if c is None:
-            c = class_of[ckey] = len(class_rep)
-            class_rep.append(dst)
-        dst_class[j] = c
+    cls_idx, class_rep = node_classes(nodes, relevant_keys)
+    dst_class = np.asarray(cls_idx, dtype=np.int64)
     n_cls = len(class_rep)
 
     sig_cls_ok: Dict[tuple, np.ndarray] = {}  # signature -> [n_cls] bool

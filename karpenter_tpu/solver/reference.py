@@ -106,6 +106,15 @@ class _Solver:
         # what-ifs (O(G*N) * O(pods_per_node))
         self._sig_cache: Dict[int, tuple] = {}
         self._rem_cache: Dict[int, ResourceList] = {}
+        # label keys any group's requirements reference: _node_sig keeps only
+        # these, so a per-node hostname label doesn't split an otherwise
+        # uniform fleet into N signatures and defeat _label_ok_cache (the
+        # heap build asks _label_taint_ok for every (group, node) pair —
+        # O(G*N) requirement-algebra walks at consolidation-what-if scale
+        # without the collapse)
+        self._relevant_keys: Set[str] = set()
+        for g in self.groups:
+            self._relevant_keys.update(g.requirements)
 
         self.all_zones: List[str] = []
         for _, _, it, _ in self.pairs:
@@ -134,7 +143,9 @@ class _Solver:
         if sig is None:
             sig = (
                 node.instance_type, node.provisioner, node.capacity_type,
-                tuple(sorted(node.labels.items())), tuple(node.taints),
+                tuple(sorted((k, v) for k, v in node.labels.items()
+                             if k in self._relevant_keys)),
+                tuple(node.taints),
             )
             self._sig_cache[id(node)] = sig
         return sig

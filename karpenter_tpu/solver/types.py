@@ -114,3 +114,32 @@ class SolveResult:
             f"{self.n_scheduled} pods -> {len(self.nodes)} new nodes "
             f"(${self.new_node_cost:.3f}/hr: {types}); {len(self.infeasible)} infeasible"
         )
+
+
+def node_classes(
+    nodes: Sequence[SimNode], relevant_keys
+) -> Tuple[List[int], List[SimNode]]:
+    """Collapse ``nodes`` into label/taint equivalence classes for memoized
+    requirement-algebra checks (consolidation.compat_matrix,
+    native.existing_compat).  Two nodes share a class iff they agree on
+    every label key in ``relevant_keys`` (the keys any pod/group requirement
+    references — a per-node hostname label must not split an otherwise
+    uniform fleet when nothing selects on hostname) and carry identical
+    taints.  Returns (class index per node, representative node per class);
+    any check that reads only requirement keys + taints is class-invariant.
+    """
+    cls_idx: List[int] = []
+    cls_rep: List[SimNode] = []
+    cls_of: Dict[tuple, int] = {}
+    for node in nodes:
+        ckey = (
+            tuple(sorted((k, v) for k, v in node.labels.items()
+                         if k in relevant_keys)),
+            tuple((t.key, t.value, t.effect) for t in node.taints),
+        )
+        c = cls_of.get(ckey)
+        if c is None:
+            c = cls_of[ckey] = len(cls_rep)
+            cls_rep.append(node)
+        cls_idx.append(c)
+    return cls_idx, cls_rep

@@ -183,6 +183,64 @@ class TestRouting:
         assert res.infeasible == {}
         assert res.n_scheduled == 25
 
+    def test_existing_compat_memo_matches_naive(self, small_catalog):
+        """The [G, NE] compat memo (signature x node-class collapse) must
+        answer exactly what the per-(group, node) requirement-algebra walk
+        answers — across per-node hostname labels (which must NOT split
+        classes), taints vs tolerations, node selectors, and the
+        Exists+NotIn vs NotIn signature-collision case signature() exists
+        to keep apart."""
+        from karpenter_tpu.models.pod import Taint, Toleration
+        from karpenter_tpu.models.requirements import EXISTS, NOT_IN
+
+        it = next(t for t in small_catalog if t.name == "m5.4xlarge")
+
+        def node(i, zone, taints=(), extra=None):
+            n = SimNode(
+                instance_type="m5.4xlarge", provisioner="default", zone=zone,
+                capacity_type="on-demand", price=0.768,
+                allocatable=dict(it.allocatable),
+                labels={**it.labels(), L.ZONE: zone,
+                        L.CAPACITY_TYPE: "on-demand",
+                        L.PROVISIONER_NAME: "default", **(extra or {})},
+                taints=list(taints), existing=True, name=f"ex-{i}",
+            )
+            n.labels[L.HOSTNAME] = n.name  # unique per node
+            return n
+
+        existing = (
+            [node(i, "zone-1a") for i in range(3)]
+            + [node(i + 3, "zone-1b",
+                    taints=[Taint(key="dedicated", effect=L.EFFECT_NO_SCHEDULE,
+                                  value="svc")]) for i in range(3)]
+            + [node(7, "zone-1a", extra={"tier": "gold"})]
+        )
+        pods = (
+            [PodSpec(name=f"plain{i}", requests={"cpu": 0.5},
+                     owner_key=f"o{i}") for i in range(4)]
+            + [PodSpec(name="tol", requests={"cpu": 0.5},
+                       tolerations=[Toleration(key="dedicated",
+                                               operator="Equal", value="svc",
+                                               effect=L.EFFECT_NO_SCHEDULE)])]
+            + [PodSpec(name="sel", requests={"cpu": 0.5},
+                       node_selector={"tier": "gold"})]
+            + [PodSpec(name="notin", requests={"cpu": 0.5},
+                       required_affinity_terms=[[
+                           Requirement("tier", NOT_IN, ["gold"])]])]
+            + [PodSpec(name="exists-notin", requests={"cpu": 0.5},
+                       required_affinity_terms=[[
+                           Requirement("tier", EXISTS),
+                           Requirement("tier", NOT_IN, ["gold"])]])]
+        )
+        st = tensorize(pods, [default_prov()], small_catalog)
+        got = native.existing_compat(st, existing)
+        for gi, g in enumerate(st.groups):
+            rep = g.pods[0]
+            for ni, n in enumerate(existing):
+                want = (not any(t.blocks(rep.tolerations) for t in n.taints)
+                        and g.requirements.compatible(n.labels) is None)
+                assert bool(got[gi, ni]) == want, (g.pods[0].name, n.name)
+
     def test_native_latency_microseconds(self, small_catalog):
         """The point of the tier: sub-millisecond small solves (after warmup)."""
         sched = BatchScheduler(backend="native")
