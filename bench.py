@@ -17,21 +17,84 @@ import time
 METRIC = "solve_50k_pods_full_catalog_3az_spread"
 
 
-def arm_watchdog(deadline_s: float, metric: str = METRIC):
-    """Emit the error JSON line and hard-exit if the bench wall-clock budget
-    expires.  A hung device call never returns to bytecode, so SIGALRM-style
-    handlers can't fire — a daemon thread with os._exit is the only reliable
-    way to leave a parseable artifact behind a wedged TPU tunnel."""
+def arm_watchdog(deadline_s: float, metric: str = METRIC,
+                 rerun_script: str | None = None):
+    """Leave a parseable artifact and hard-exit if the bench wall-clock
+    budget expires.  A hung device call never returns to bytecode, so
+    SIGALRM-style handlers can't fire — a daemon thread with os._exit is the
+    only reliable way out from behind a wedged TPU tunnel.
+
+    The error line is printed FIRST (a driver that hard-kills shortly after
+    the deadline must still find an artifact — the round-1 failure mode).
+    Then, when ``rerun_script`` is set (bench.py's own main only — callers
+    like bench_all arm the watchdog for different sweeps and must not be
+    "recovered" by running this benchmark), the watchdog re-runs the script
+    once pinned to the CPU backend and appends the measured record: slower
+    numbers, but a real JSON line with backend="cpu".  Parsers here and
+    driver-side take the LAST parseable line of the tail.
+
+    Stdout ownership: the returned timer carries ``lock``/``fired``/
+    ``main_done`` — whichever thread takes the lock and sets its flag first
+    owns the artifact from then on.  A device call that unwedges AFTER the
+    deadline must neither interleave its record with the rerun's output nor
+    exit the process (which would kill this daemon thread mid-subprocess and
+    orphan a full CPU bench) — main() blocks forever and lets fire()
+    finish."""
     import threading
 
+    t = threading.Timer(deadline_s, lambda: None)  # function replaced below
+    t.lock = threading.Lock()
+    t.fired = threading.Event()
+    t.main_done = threading.Event()
+
     def fire():
-        print(json.dumps({
-            "metric": metric, "value": None, "unit": "ms", "vs_baseline": None,
-            "error": f"watchdog: exceeded {deadline_s:.0f}s wall clock (device hang?)",
-        }), flush=True)
+        with t.lock:
+            if t.main_done.is_set():
+                return  # main() won the race — its artifact stands
+            t.fired.set()
+            print(json.dumps({
+                "metric": metric, "value": None, "unit": "ms",
+                "vs_baseline": None,
+                "error": f"watchdog: exceeded {deadline_s:.0f}s wall clock "
+                         "(device hang?)",
+            }), flush=True)
+        # rerun outside the lock (minutes long); main() is permanently
+        # blocked once `fired` is set, so stdout is this thread's alone
+        if rerun_script and not os.environ.get("KT_BENCH_NO_RERUN"):
+            try:
+                p = subprocess.run(
+                    [sys.executable, rerun_script],
+                    env=dict(os.environ, JAX_PLATFORMS="cpu",
+                             KT_BENCH_NO_RERUN="1",
+                             BENCH_DEADLINE_S=str(max(300.0, deadline_s / 2))),
+                    capture_output=True, text=True,
+                    timeout=max(600.0, deadline_s),
+                )
+                rec = None
+                if p.returncode == 0:
+                    for ln in reversed(p.stdout.splitlines()):
+                        try:
+                            cand = json.loads(ln)
+                        except ValueError:
+                            continue
+                        if isinstance(cand, dict) and cand.get("value") is not None:
+                            rec = cand
+                            break
+                if rec is not None:
+                    rec["device_hang"] = (
+                        f"device bench exceeded {deadline_s:.0f}s; "
+                        "re-measured on the CPU backend")
+                    print(json.dumps(rec), flush=True)
+                    os._exit(0)
+                print(f"# cpu rerun produced no record: rc={p.returncode} "
+                      f"stderr={p.stderr.strip()[-300:]}", file=sys.stderr,
+                      flush=True)
+            except Exception as e:
+                print(f"# cpu rerun failed: {type(e).__name__}: {e}"[:400],
+                      file=sys.stderr, flush=True)
         os._exit(1)
 
-    t = threading.Timer(deadline_s, fire)
+    t.function = fire
     t.daemon = True
     t.start()
     return t
@@ -46,6 +109,10 @@ def ensure_backend(retries: int = 3, probe_timeout: float = 90.0) -> str:
     the probe runs in a subprocess with a timeout; on repeated failure the
     bench falls back to CPU rather than producing nothing.  Must be called
     before jax is imported in this process.
+
+    The probe executes a REAL device op, not just backend init: the round-5
+    tunnel outage had init succeed and the first computation hang forever —
+    a backend that lists devices but can't add four floats is down.
     """
     if os.environ.get("JAX_PLATFORMS"):
         return os.environ["JAX_PLATFORMS"]
@@ -53,7 +120,10 @@ def ensure_backend(retries: int = 3, probe_timeout: float = 90.0) -> str:
     for attempt in range(retries):
         try:
             p = subprocess.run(
-                [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp;"
+                 "jnp.ones(4).sum().block_until_ready();"
+                 "print(jax.default_backend())"],
                 timeout=probe_timeout, capture_output=True, text=True,
             )
             if p.returncode == 0 and p.stdout.strip():
@@ -163,8 +233,14 @@ def check_regression(rec, prior_dir=None):
                         data = json.loads(line)
                     except ValueError:
                         pass
-        if data.get("value"):
-            prior = (os.path.basename(f), data)
+        if not data.get("value"):
+            continue
+        if data.get("device_hang"):
+            continue  # CPU-rerun record from a tunnel outage — not a baseline
+        if (data.get("backend") and rec.get("backend")
+                and data["backend"] != rec["backend"]):
+            continue  # device-vs-cpu ms are not comparable
+        prior = (os.path.basename(f), data)
     if prior is None:
         return {}
     name, p = prior
@@ -242,20 +318,34 @@ def run_bench():
 
 
 def main():
-    # Always emit exactly one parseable JSON line, success or not.
-    wd = arm_watchdog(float(os.environ.get("BENCH_DEADLINE_S", "1500")))
+    # Emit a parseable JSON artifact no matter what: ONE measured line on
+    # success; on a device hang, an immediate error line followed by the
+    # watchdog's CPU-rerun record (parsers take the last parseable line).
+    wd = arm_watchdog(float(os.environ.get("BENCH_DEADLINE_S", "1500")),
+                      rerun_script=os.path.abspath(__file__))
+    rc = 0
     try:
         ensure_backend()
         rec = run_bench()
-        wd.cancel()
     except BaseException as e:  # noqa: BLE001 — the artifact must exist
-        print(json.dumps({
+        rc = 1
+        rec = {
             "metric": METRIC, "value": None, "unit": "ms",
             "vs_baseline": None, "error": f"{type(e).__name__}: {e}"[:500],
-        }))
-        return 1
-    print(json.dumps(rec))
-    return 0
+        }
+    wd.cancel()
+    with wd.lock:
+        if not wd.fired.is_set():
+            wd.main_done.set()
+            print(json.dumps(rec))
+            return rc
+    # The deadline passed while the device call was wedged and it finished
+    # late: the watchdog owns stdout and the process exit now.  Exiting here
+    # would kill its daemon thread mid-rerun and orphan a full CPU bench —
+    # block and let fire() os._exit with the better artifact.
+    import threading
+
+    threading.Event().wait()
 
 
 if __name__ == "__main__":

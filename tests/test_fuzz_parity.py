@@ -433,6 +433,29 @@ def test_fuzz_kubelet_overrides_parity(seed, small_catalog):
     )
     errs = validate_solution(pods, provs, tpu, small_catalog)
     assert not errs, f"seed {seed}: invalid solution: {errs[:4]}"
+    # Independent density check — validate_solution's pod-density row reads
+    # the node's SELF-reported allocatable, so a solver that ignored maxPods
+    # (and built default-density nodes) would sail through it while packing
+    # 30 pods onto an 11-pod node.  Re-derive the cap from the raw catalog
+    # + the provisioner's kubeletConfiguration (the instancetype.go:326-340
+    # formula) and check the actual per-node pod counts in every tier.
+    from karpenter_tpu.models.instancetype import kubelet_pod_density
+
+    by_prov = {p.name: p for p in provs}
+    by_type = {it.name: it for it in small_catalog}
+    for res in (oracle, tpu):
+        for node in res.nodes:
+            kc = by_prov[node.provisioner].kubelet
+            if kc is None or not (kc.max_pods or kc.pods_per_core):
+                continue
+            it = by_type[node.instance_type]
+            cap = kubelet_pod_density(
+                it.capacity.get(L.RESOURCE_PODS, 110.0),
+                it.capacity.get("cpu", 0.0), kc)
+            assert len(node.pods) <= cap + 1e-9, (
+                f"seed {seed}: {node.name} ({node.instance_type}) packs "
+                f"{len(node.pods)} pods over kubelet density cap {cap}"
+            )
     _gate_cost(seed, "kubelet", oracle, tpu, FUZZ_PARITY_KUBELET)
 
 
