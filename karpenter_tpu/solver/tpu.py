@@ -538,17 +538,71 @@ def _make_step(
 
 
         # ---- zone-seed (mode B): the whole group lands in ONE zone — the
-        # earliest open slot's zone, else the best new-node zone (this is what
-        # the sequential oracle converges to: after the first placement every
-        # later pod must join a zone with a matching pod)
+        # cheapest-absorption zone when open slots exist, else the best
+        # new-node zone (after the first placement every later pod must join
+        # a zone with a matching pod, so the seed choice is the whole game)
         def _z_seed(_):
             # only zones with anti-affinity/spread headroom are seedable
             elb = el & (zone_budget >= 1.0)
             ok_slots0 = rf & (cap >= 1.0) & elb[jnp.maximum(row_zone, 0)]
             has0 = jnp.any(ok_slots0)
-            z_first = row_zone[jnp.argmax(ok_slots0)]
+            # Seed the zone that ABSORBS the group most cheaply, not the
+            # earliest open slot's zone: eligible free-row capacity takes
+            # pods at zero marginal cost, the remainder pays the zone's best
+            # new-node $/pod (kubelet fuzz seed 20: the earliest open slot
+            # sat in zone-1a while a hostname-spread fleet's free rows —
+            # enough for the whole group — sat in zone-1b; chasing the slot
+            # bought 4 dedicated nodes the sequential oracle never buys).
+            # Ties (several zones absorb everything free) break on
+            # first-open-slot order then zone index — the old deterministic
+            # behavior, which also serves as the all-BIG fallback when no
+            # zone can host the whole group.
+            free_z = jnp.zeros(Z, dtype=jnp.float32).at[
+                jnp.maximum(row_zone, 0)
+            ].add(jnp.where(ok_slots0, cap, 0.0))
+            # the zone's LEGAL headroom for this group (anti-affinity +
+            # spread band) caps both free-row absorption and what new nodes
+            # can add — a zone whose rows could hold the group but whose
+            # budget admits one pod must not win on phantom capacity
+            budget_z = jnp.where(elb, zone_budget, 0.0)
+            place_z = jnp.minimum(jnp.minimum(free_z, budget_z), cnt)
+            paid_z = jnp.maximum(jnp.minimum(cnt, budget_z) - place_z, 0.0)
+            ok_cd0 = (new_ok_nolim & _lim_ok_cur(prov_used)[:, None]
+                      & elb[dom_zone][None, :])
+            # $/pod amortized over the ZONE's paid remainder, not the whole
+            # group: a 2-pod remainder on a 40-pod node pays the full node
+            ppp_cd = jnp.where(
+                ok_cd0,
+                cand_price / jnp.maximum(
+                    jnp.minimum(take_pn[:, None], paid_z[dom_zone][None, :]),
+                    1.0,
+                ),
+                BIG,
+            )
+            ppp_z = jnp.full(Z, BIG).at[dom_zone].min(jnp.min(ppp_cd, axis=0))
+            # budget headroom only counts as placeable when there is SUPPLY
+            # behind it — free rows, or a purchasable candidate in the zone
+            # (limits can exhaust a zone's candidates mid-solve; an empty
+            # zone with a big spread budget but nothing to buy must not win
+            # the seed and strand the whole group)
+            purch_z = jnp.where(ppp_z < BIG, paid_z, 0.0)
+            unplaced_z = jnp.maximum(cnt - place_z - purch_z, 0.0)
+            cost_z = jnp.where(
+                elb, jnp.minimum(purch_z * ppp_z, BIG), BIG,
+            )
+            first_slot = jnp.min(
+                jnp.where(
+                    ok_slots0[:, None]
+                    & (row_zone[:, None] == jnp.arange(Z)[None, :]),
+                    slot_idx[:, None].astype(jnp.float32), BIGN,
+                ), axis=0,
+            )                                                       # [Z]
+            z_best = lex_argmin(
+                jnp.where(elb, unplaced_z, BIGN), cost_z, first_slot,
+                jnp.arange(Z, dtype=jnp.float32),
+            ).astype(jnp.int32)
             _bc0, bd0, okp0 = pick(cnt, elb[dom_zone], prov_used)
-            return jnp.where(has0, z_first, jnp.where(okp0, dom_zone[bd0], -1))
+            return jnp.where(has0, z_best, jnp.where(okp0, dom_zone[bd0], -1))
 
         z_star = jax.lax.cond(zone_seed, _z_seed,
                               lambda _: jnp.int32(-1), operand=None)
