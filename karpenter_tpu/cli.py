@@ -223,7 +223,13 @@ def main(argv=None) -> int:
     ver.set_defaults(fn=lambda a: (print(f"karpenter-tpu {__version__}"), 0)[1])
 
     args = p.parse_args(argv)
-    return args.fn(args)
+    rc = args.fn(args)
+    # exit joins non-daemon warm compile threads; bound that wait so a
+    # compile hung on a wedged TPU tunnel cannot pin the process forever
+    from .operator import drain_warm_threads
+
+    drain_warm_threads(rc)
+    return rc
 
 
 if __name__ == "__main__":

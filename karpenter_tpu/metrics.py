@@ -121,6 +121,11 @@ SOLVER_BACKEND_DURATION = "karpenter_solver_backend_duration_seconds"
 SOLVER_COMPILE_IN_PROGRESS = "karpenter_solver_compile_in_progress"
 SOLVER_COMPILE_DURATION = "karpenter_solver_compile_duration_seconds"
 SOLVER_COLD_FALLBACKS = "karpenter_solver_cold_start_fallbacks_total"
+SOLVER_DEVICE_HANGS = "karpenter_solver_device_hangs_total"
+SOLVER_DEVICE_HEALTHY = "karpenter_solver_device_healthy"
+SOLVER_DEGRADED_SOLVES = "karpenter_solver_degraded_solves_total"
+REMOTE_FALLBACK_SOLVES = "karpenter_solver_remote_fallback_solves_total"
+REMOTE_DEGRADED = "karpenter_solver_remote_degraded"
 
 #: metric inventory: name -> (type, labels, help).  docs/METRICS.md is
 #: generated from this table (``karpenter-tpu metrics-doc``), mirroring the
@@ -176,6 +181,27 @@ INVENTORY = {
         "counter", ("backend",),
         "Solves served by the native/oracle warm tier because the device "
         "program for their shape was not compiled yet."),
+    SOLVER_DEVICE_HANGS: (
+        "counter", (),
+        "Device calls abandoned by the hang guard (wedged TPU tunnel); "
+        "each latches the device tier unhealthy until a probe succeeds."),
+    SOLVER_DEVICE_HEALTHY: (
+        "gauge", (),
+        "1 while the in-process device tier is healthy, 0 while latched "
+        "unhealthy after a hang (warm host tiers serve all batches)."),
+    SOLVER_DEGRADED_SOLVES: (
+        "counter", ("backend",),
+        "Solves served by the warm host tiers because the device tier was "
+        "latched unhealthy (distinct from cold-start fallbacks: the device "
+        "program was compiled, the device was not answering)."),
+    REMOTE_FALLBACK_SOLVES: (
+        "counter", (),
+        "Solves served by the local fallback scheduler while the remote "
+        "gRPC solver sidecar was unreachable."),
+    REMOTE_DEGRADED: (
+        "gauge", (),
+        "1 while the remote solver sidecar is unreachable and solves "
+        "degrade to the local fallback; 0 when connected."),
 }
 
 

@@ -19,6 +19,7 @@ import argparse
 import json
 import logging
 import os
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
@@ -514,6 +515,31 @@ def _demo(args) -> None:
     op.shutdown()
 
 
+def drain_warm_threads(rc: int = 0, grace_s: float = 60.0) -> None:
+    """Bounded wait for background compile threads at process exit.
+
+    Warm threads are deliberately non-daemon (a daemon thread hard-killed
+    inside XLA at interpreter teardown aborts the process — solver/tpu.py),
+    so normal exit JOINS them.  A compile hung on a wedged TPU tunnel (the
+    round-5 outage: device calls that never return) would pin shutdown
+    forever; give legitimate compile tails a bounded grace, then force the
+    exit.  Call only from process entry points, after clean shutdown steps.
+    """
+    deadline = time.monotonic() + grace_s
+    for t in threading.enumerate():
+        if t.name == "tpu-solver-warm" and t is not threading.current_thread():
+            t.join(max(0.0, deadline - time.monotonic()))
+    stuck = sum(1 for t in threading.enumerate()
+                if t.name == "tpu-solver-warm" and t.is_alive())
+    if stuck:
+        logging.getLogger(__name__).error(
+            "%d background compile thread(s) still hung after %.0fs grace "
+            "(wedged TPU tunnel?); forcing process exit", stuck, grace_s)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)  # preserve the command's exit code through the force
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="karpenter-tpu")
     parser.add_argument("--demo", action="store_true", help="run the fake-cloud simulation")
@@ -532,6 +558,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.demo:
         _demo(args)
+        drain_warm_threads()
         return 0
     parser.print_help()
     return 1

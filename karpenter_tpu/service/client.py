@@ -31,10 +31,8 @@ from .server import SERVICE
 
 logger = logging.getLogger(__name__)
 
-#: counter: solves served by the local fallback because the sidecar was down
-REMOTE_FALLBACK_SOLVES = "karpenter_solver_remote_fallback_solves_total"
-#: gauge: 1 while the remote solver is considered unreachable
-REMOTE_DEGRADED = "karpenter_solver_remote_degraded"
+from ..metrics import REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES  # noqa: E402
+# (names + help text live in metrics.INVENTORY so docs/METRICS.md covers them)
 
 
 class SolverClient:
@@ -113,6 +111,10 @@ class RemoteScheduler:
         self.reconnect_interval = reconnect_interval
         self._degraded_since: Optional[float] = None
         self._last_probe = 0.0
+        # zero-init so the series exists from the first scrape (inc(0)
+        # creates the sample; construction alone does not)
+        self.registry.counter(REMOTE_FALLBACK_SOLVES).inc(value=0.0)
+        self.registry.gauge(REMOTE_DEGRADED).set(0)
 
     #: RPC status codes that mean "the sidecar is not reachable right now".
     #: Anything else (UNIMPLEMENTED from an older sidecar's missing Warm

@@ -22,6 +22,9 @@ from ..metrics import (
     SOLVER_COLD_FALLBACKS,
     SOLVER_COMPILE_DURATION,
     SOLVER_COMPILE_IN_PROGRESS,
+    SOLVER_DEGRADED_SOLVES,
+    SOLVER_DEVICE_HANGS,
+    SOLVER_DEVICE_HEALTHY,
     Registry,
     registry as default_registry,
 )
@@ -30,6 +33,7 @@ from ..models.instancetype import InstanceType
 from ..models.pod import LabelSelector, PodSpec
 from ..models.provisioner import Provisioner
 from ..models.tensorize import device_inexpressible, tensorize
+from .guard import DeviceGuard, DeviceHang
 from .reference import solve as oracle_solve
 from .tpu import SlotsExhausted, TpuSolver
 from .types import SimNode, SolveResult
@@ -160,6 +164,25 @@ class BatchScheduler:
         )
         self._tpu = TpuSolver()
         self._cold_logged: Set[tuple] = set()  # change-gated stall logging
+        # hang protection for the auto policy's device dispatches (a wedged
+        # TPU tunnel must degrade the reconcile loop to the warm host tiers,
+        # not freeze it — see solver/guard.py); forced backends keep direct
+        # calls so tests and inline-compile flows are untouched
+        self._guard = DeviceGuard(on_health_change=self._device_health_changed)
+        self.registry.gauge(SOLVER_DEVICE_HEALTHY).set(1)
+        # zero-init so the series exists from the first scrape (a counter
+        # first appearing at its first increment loses that increment to
+        # Prometheus rate()/increase()); inc(0) creates the sample, merely
+        # constructing the Counter does not
+        self.registry.counter(SOLVER_DEVICE_HANGS).inc(value=0.0)
+        self.registry.counter(SOLVER_DEGRADED_SOLVES).inc(
+            {"backend": "native"}, value=0.0
+        )
+
+    def _device_health_changed(self, healthy: bool) -> None:
+        self.registry.gauge(SOLVER_DEVICE_HEALTHY).set(1 if healthy else 0)
+        if not healthy:
+            self.registry.counter(SOLVER_DEVICE_HANGS).inc()
 
     def solve(
         self,
@@ -372,7 +395,8 @@ class BatchScheduler:
         empty-cluster ones.  Returns the number of compiles accepted.  Cheap
         to call repeatedly (signatures dedupe), so the operator re-invokes
         it on settings changes that reshape the catalog."""
-        if self.backend not in ("auto", "tpu") or not self.compile_behind:
+        if (self.backend not in ("auto", "tpu") or not self.compile_behind
+                or not self._guard.healthy):
             return 0
         from ..models.pod import TopologySpreadConstraint
 
@@ -422,8 +446,10 @@ class BatchScheduler:
     # ---- compile-behind (cold-start) ----------------------------------
     def stop_warms(self) -> None:
         """Stop background compiles (operator shutdown): queued warms are
-        dropped; exit waits only for compiles already in flight."""
+        dropped; exit waits only for compiles already in flight.  Also stops
+        the device-guard recovery probe."""
         self._tpu.stop_warms()
+        self._guard.stop()
 
     def _warm_done(self, sig, seconds: float, err) -> None:
         # this callback runs BEFORE the warm thread clears its own in-flight
@@ -459,8 +485,8 @@ class BatchScheduler:
         """Kick the background compile for a shape that just went cold,
         with snapshot inputs so the live node objects aren't shared with
         the worker thread.  Logged once per shape."""
-        if not self.compile_behind:
-            return
+        if not self.compile_behind or not self._guard.healthy:
+            return  # a compile against a wedged device would hang its thread
         started = self._tpu.warm_async(
             st, existing_nodes=[n.snapshot() for n in existing_nodes],
             max_nodes=max_slots, mesh=self.mesh, on_done=self._warm_done,
@@ -605,29 +631,57 @@ class BatchScheduler:
                 )
                 self._start_warm(st, all_existing, max_slots)
             else:
-                try:
-                    out = self._tpu.solve(
+                guarded = self.backend == "auto" and self._guard.enabled
+                degraded = guarded and not self._guard.healthy
+
+                def _device_call():
+                    return self._tpu.solve(
                         st, existing_nodes=all_existing, max_nodes=max_slots,
                         mesh=self.mesh,
                         raise_on_exhaust=(self.backend == "auto"
                                           and self.compile_behind),
                     )
-                    res = out.result
-                    backend_used = "tpu"
-                except SlotsExhausted:
-                    # the optimistic node-slot axis ran out and the
-                    # full-budget program is cold: serve from the warm tier
-                    # now, compile the full program behind (the solver
-                    # remembered the exhaustion, so _start_warm targets it)
+
+                if not degraded:
+                    try:
+                        out = (self._guard.run(_device_call) if guarded
+                               else _device_call())
+                        res = out.result
+                        backend_used = "tpu"
+                    except SlotsExhausted:
+                        # the optimistic node-slot axis ran out and the
+                        # full-budget program is cold: serve from the warm
+                        # tier now, compile the full program behind (the
+                        # solver remembered the exhaustion, so _start_warm
+                        # targets it)
+                        res, backend_used = self._cold_solve(
+                            st, tpu_pods, provisioners, instance_types,
+                            all_existing, daemonsets, unavailable,
+                            allow_new_nodes, max_slots, max_new_nodes,
+                        )
+                        self.registry.counter(SOLVER_COLD_FALLBACKS).inc(
+                            {"backend": backend_used}
+                        )
+                        self._start_warm(st, all_existing, max_slots)
+                    except DeviceHang:
+                        # the guard latched the device tier unhealthy; serve
+                        # THIS batch from the warm tier like every batch
+                        # until the recovery probe succeeds
+                        degraded = True
+                if degraded:
                     res, backend_used = self._cold_solve(
                         st, tpu_pods, provisioners, instance_types,
                         all_existing, daemonsets, unavailable,
                         allow_new_nodes, max_slots, max_new_nodes,
                     )
-                    self.registry.counter(SOLVER_COLD_FALLBACKS).inc(
+                    # NOT a cold-start fallback: the program was compiled,
+                    # the device was not answering — distinct counter so
+                    # outage traffic can't pollute cold-start SLOs
+                    self.registry.counter(SOLVER_DEGRADED_SOLVES).inc(
                         {"backend": backend_used}
                     )
-                    self._start_warm(st, all_existing, max_slots)
+                    # no _start_warm here: a background compile against a
+                    # wedged device would hang its warm thread too
             self.registry.histogram(SOLVER_BACKEND_DURATION).observe(
                 time.perf_counter() - t0, {"backend": backend_used}
             )

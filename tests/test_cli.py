@@ -131,7 +131,14 @@ def test_inventory_metrics_are_emitted(small_catalog):
         _time.sleep(0.05)
 
     emitted = (set(reg.counters) | set(reg.gauges) | set(reg.histograms))
-    missing = set(INVENTORY) - emitted
+    # the remote-solver pair is emitted only by the split-topology
+    # deployment's RemoteScheduler (zero-initialized at its construction);
+    # their emission is asserted by tests/test_split_topology.py:118-144 and
+    # tests/test_service.py:217-232, so this single-process scenario carves
+    # them out rather than spinning up a gRPC sidecar here
+    from karpenter_tpu.metrics import REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES
+
+    missing = set(INVENTORY) - emitted - {REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES}
     assert not missing, (
         f"documented metrics never emitted: {sorted(missing)} "
         f"(warm debug: in_flight={auto_sched._tpu.compiles_in_flight()} "
