@@ -204,6 +204,35 @@ class TestPositiveAffinity:
         for i in range(8):
             assert node_zone[tpu.assignments[f"b{i}"]] == "zone-1b"
 
+    def test_zone_affinity_seed_absorbs_into_fleet_zone(self, small_catalog):
+        """The zone seed picks the cheapest-ABSORBING zone, not the earliest
+        open slot's zone: a hostname-spread fleet pinned to zone-1b leaves
+        one-pod-per-node slack there, and a zone-affine group with no pins
+        of its own must ride that slack instead of buying dedicated nodes
+        in whatever zone happens to hold the first open slot (kubelet fuzz
+        seed 20's 1.1151 failure mode, fixed round 5)."""
+        web_sel = LabelSelector.of({"app": "web"})
+        pods = [PodSpec(name=f"web-{i}", labels={"app": "web"},
+                        requests={"cpu": 0.5, "memory": 2 * GIB},
+                        node_selector={L.ZONE: "zone-1b"},
+                        topology_spread=[TopologySpreadConstraint(
+                            1, L.HOSTNAME, "DoNotSchedule", web_sel)],
+                        owner_key="web") for i in range(12)]
+        pods += [PodSpec(name=f"cache-{i}", labels={"app": "cache"},
+                         requests={"cpu": 0.25, "memory": 1 * GIB},
+                         affinity_terms=[PodAffinityTerm(
+                             LabelSelector.of({"app": "cache"}), L.ZONE)],
+                         owner_key="cache") for i in range(10)]
+        oracle, tpu = assert_parity(pods, [default_prov()], small_catalog)
+        assert not tpu.infeasible
+        # the fleet size is set by the hostname spread; cache rides its slack
+        assert len(tpu.nodes) == 12
+        cache_zones = {n.zone for n in tpu.nodes
+                       for p in n.pods if p.owner_key == "cache"}
+        assert cache_zones == {"zone-1b"}
+        assert not [n for n in tpu.nodes
+                    if n.pods and all(p.owner_key == "cache" for p in n.pods)]
+
     def test_hostname_self_affinity_one_node(self, small_catalog):
         sel = LabelSelector.of({"app": "pack"})
         pods = [PodSpec(name=f"p{i}", labels={"app": "pack"},
