@@ -131,6 +131,11 @@ class SolveTensors:
     # positive term per topology key, or a key other than zone/hostname);
     # callers route these pods to the CPU oracle
     g_positive_affinity: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=bool))
+    #: any group carries a hard capacity-type spread — such batches route to
+    #: the sequential oracle wholesale (scheduler.batch_needs_oracle; the
+    #: constraint couples groups through the shared ct domains and limits),
+    #: and the native tier declines them (native.has_topology)
+    has_ct_spread: bool = False
 
     @property
     def G(self) -> int:
@@ -172,11 +177,34 @@ class SolveTensors:
         return np.asarray(row, dtype=np.float32)
 
 
+def batch_needs_oracle(pods: Sequence[PodSpec]) -> bool:
+    """A hard capacity-type spread couples the WHOLE batch to the sequential
+    engine, not just its own group: ct domains are consumed through shared
+    provisioner limits and through co-location on other groups' nodes (the
+    reference's interleaved FFD places a ct-spread pod onto the open capacity
+    an earlier group bought in the scarce ct — fuzz seed 19: a per-group
+    carve-out after right-sized device packing stranded 10 pods the oracle
+    seats).  Such batches solve wholesale on the oracle."""
+    return any(
+        tsc.hard and tsc.topology_key == L.CAPACITY_TYPE
+        for p in pods for tsc in p.topology_spread
+    )
+
+
 def device_inexpressible(pod: PodSpec) -> bool:
-    """Positive-affinity shapes the device solver can't express (v1): more
-    than one positive term per topology key, or a key other than
-    zone/hostname.  Single source of truth — the scheduler's oracle carve-out
-    and tensorize's ``g_positive_affinity`` flag both use this."""
+    """Constraint shapes the device solver can't express (v1): more than one
+    positive affinity term per topology key, an affinity key other than
+    zone/hostname, or a hard topology spread over a key other than
+    zone/hostname — ``karpenter.sh/capacity-type`` spread
+    (scheduling.md:303-346's third supported topologyKey) is placed exactly
+    by the oracle (reference.py ``_place_group_ct``); any OTHER key is
+    rejected there as infeasible with a reason, mirroring the reference's
+    unsupported-topology-key error.  Single source of truth — the
+    scheduler's oracle carve-out and tensorize's ``g_positive_affinity``
+    flag both use this."""
+    for tsc in pod.topology_spread:
+        if tsc.hard and tsc.topology_key not in (L.ZONE, L.HOSTNAME):
+            return True
     nz = nh = 0
     for t in pod.affinity_terms:
         if t.anti:
@@ -557,4 +585,5 @@ def tensorize(
         g_zone_paff=g_zone_paff,
         g_host_paff=g_host_paff,
         g_positive_affinity=g_unsupported,
+        has_ct_spread=batch_needs_oracle(g.pods[0] for g in groups),
     )

@@ -127,15 +127,17 @@ def feasibility_numpy(st: SolveTensors):
 
 def has_topology(st: SolveTensors) -> bool:
     """Groups the native tier can't express: positive pod-affinity (modes
-    A/B/C live on the device / oracle).  Zone/hostname spread and
-    anti-affinity ARE handled natively (ffd.cpp place_constrained) — the
-    binding marshals ex_zone/ex_selcnt/zc0 so the constrained path sees real
-    existing-cluster topology state."""
+    A/B/C live on the device / oracle) and capacity-type spread (routes the
+    whole batch to the oracle — scheduler.batch_needs_oracle).  Zone/hostname
+    spread and anti-affinity ARE handled natively (ffd.cpp place_constrained)
+    — the binding marshals ex_zone/ex_selcnt/zc0 so the constrained path sees
+    real existing-cluster topology state."""
     import numpy as _np
 
     return bool(
         _np.any(st.g_zone_paff >= 0)
         or _np.any(st.g_host_paff >= 0)
+        or st.has_ct_spread
     )
 
 

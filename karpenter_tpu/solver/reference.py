@@ -42,18 +42,23 @@ from .types import SimNode, SolveResult
 
 
 class _TopologyState:
-    """Counts of selector-matching pods per zone / node / total."""
+    """Counts of selector-matching pods per zone / node / capacity-type /
+    total (the reference's three topology domains, scheduling.md:303-346)."""
 
     def __init__(self) -> None:
         self.zone: Dict[tuple, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
         self.node: Dict[tuple, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+        self.ct: Dict[tuple, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
         self.total: Dict[tuple, int] = defaultdict(int)
 
-    def observe(self, pod: PodSpec, zone: str, node_name: str, selectors) -> None:
+    def observe(self, pod: PodSpec, zone: str, node_name: str, selectors,
+                ct: str = "") -> None:
         for key, sel in selectors.items():
             if sel.matches(pod.labels):
                 self.zone[key][zone] += 1
                 self.node[key][node_name] += 1
+                if ct:
+                    self.ct[key][ct] += 1
                 self.total[key] += 1
 
 
@@ -135,7 +140,8 @@ class _Solver:
                  L.RESOURCE_MEMORY: cap.get(L.RESOURCE_MEMORY, 0.0)},
             )
             for p in n.pods:
-                self.topo.observe(p, n.zone, n.name, self.selectors)
+                self.topo.observe(p, n.zone, n.name, self.selectors,
+                                  ct=n.capacity_type)
 
     # ---- per-(group,node-shape) caches --------------------------------
     def _node_sig(self, node: SimNode) -> tuple:
@@ -209,6 +215,52 @@ class _Solver:
                 elif not term.matches_pod(rep):
                     return False
         return True
+
+    def _ct_allowed(self, g: PodGroup, ct: str, eligible: Sequence[str]) -> bool:
+        """Hard capacity-type topology spread (scheduling.md:303-346 — the
+        third supported topologyKey; the canonical use is spreading replicas
+        across spot/on-demand to bound the interruption blast radius)."""
+        rep = g.pods[0]
+        for tsc in rep.topology_spread:
+            if not tsc.hard or tsc.topology_key != L.CAPACITY_TYPE:
+                continue
+            key = (tsc.label_selector, L.CAPACITY_TYPE, "spread")
+            counts = self.topo.ct[key]
+            min_count = min((counts.get(c, 0) for c in eligible), default=0)
+            if counts.get(ct, 0) + 1 - min_count > tsc.max_skew:
+                return False
+        return True
+
+    def _eligible_cts(self, g: PodGroup, eligible_zones: Sequence[str]) -> List[str]:
+        """Capacity-type domains this group could actually use: the cts some
+        tolerable (provisioner, type, offering) admits under the merged and
+        pod-level requirements, IN A ZONE the group may use (k8s semantics
+        judge skew over nodeAffinity-filtered domains — a ct offered only in
+        a zone the pod's selector or volume pin excludes is not a domain the
+        spread can level against).  Skew is judged over reachable domains
+        (the reference computes domains from the provisioners' requirement
+        union, not a global constant set — a spot-only cluster must not
+        strand an on-demand count at zero forever)."""
+        rep = g.pods[0]
+        pod_ct = g.requirements.get(L.CAPACITY_TYPE)
+        zone_ok = set(eligible_zones)
+        out: List[str] = []
+        for _, prov, it, merged in self.pairs:
+            if not prov.tolerates(rep):
+                continue
+            if g.requirements.intersects(merged) is not None:
+                continue
+            merged_ct = merged.get(L.CAPACITY_TYPE)
+            merged_zone = merged.get(L.ZONE)
+            for o in it.offerings:
+                if (o.capacity_type not in out and o.available
+                        and o.zone in zone_ok
+                        and merged_zone.contains(o.zone)
+                        and (it.name, o.zone, o.capacity_type) not in self.unavailable
+                        and merged_ct.contains(o.capacity_type)
+                        and pod_ct.contains(o.capacity_type)):
+                    out.append(o.capacity_type)
+        return sorted(out)
 
     def _host_cap(self, g: PodGroup, node: SimNode) -> float:
         """Max additional pods of g on this node from hostname-scoped rules
@@ -287,6 +339,27 @@ class _Solver:
             (t.hard and t.topology_key == L.ZONE) for t in rep.topology_spread
         ) or any(t.topology_key == L.ZONE for t in rep.affinity_terms)
 
+        unsupported = [t.topology_key for t in rep.topology_spread
+                       if t.hard and t.topology_key not in
+                       (L.ZONE, L.HOSTNAME, L.CAPACITY_TYPE)]
+        if unsupported:
+            # the reference supports exactly three spread topologyKeys
+            # (scheduling.md:339-343) and errors on others — silently
+            # dropping a DoNotSchedule constraint is never acceptable
+            for pod in g.pods:
+                self.infeasible[pod.name] = (
+                    f"unsupported topology key {unsupported[0]!r}")
+            return
+
+        if any(t.hard and t.topology_key == L.CAPACITY_TYPE
+               for t in rep.topology_spread):
+            # rare path: capacity-type spread constrains the (zone, ct)
+            # domain per placement, which the per-zone heaps can't express —
+            # place this group with a direct first-fit scan instead (exact
+            # semantics; O(P*N) only for ct-spread groups)
+            self._place_group_ct(g, req, pod_reqs, eligible, has_zone_rules)
+            return
+
         # per-zone heaps of (creation_index, capacity_left) for open nodes
         heaps: Dict[str, list] = defaultdict(list)
         for idx, node in enumerate(self.nodes):
@@ -335,11 +408,66 @@ class _Solver:
             if cap - 1 > 0:
                 heapq.heappush(heaps[node.zone], [len(self.nodes) - 1, cap - 1, node])
 
+    def _place_group_ct(
+        self, g: PodGroup, req: ResourceList, pod_reqs: Requirements,
+        eligible: Sequence[str], has_zone_rules: bool,
+    ) -> None:
+        """Sequential placement for groups carrying a hard capacity-type
+        spread: every placement re-derives the allowed (zone, ct) domains,
+        first-fits the earliest-created compatible node, else creates a node
+        restricted to the allowed cts.  No heaps/caches — exactness over
+        speed on this rare constraint shape."""
+        eligible_cts = self._eligible_cts(g, eligible)
+        placed = 0
+        for pod in g.pods:
+            zones = ([z for z in eligible if self._zone_allowed(g, z, eligible)]
+                     if has_zone_rules else list(eligible))
+            cts = [c for c in eligible_cts
+                   if self._ct_allowed(g, c, eligible_cts)]
+            if not cts:
+                self.infeasible[pod.name] = (
+                    "capacity-type spread skew exhausted in every domain")
+                continue
+            chosen = None
+            for idx, node in enumerate(self.nodes):
+                if node.zone not in zones or node.capacity_type not in cts:
+                    continue
+                if self._group_cap(g, node, req) > 0:
+                    chosen = node
+                    break
+            if chosen is not None:
+                self._bind(pod, chosen)
+                placed += 1
+                continue
+            if not self.allow_new_nodes:
+                self.infeasible[pod.name] = (
+                    "no existing node fits and new nodes disallowed")
+                continue
+            if self._new_node_host_cap(g) < 1:
+                self.infeasible[pod.name] = (
+                    "hostname-scoped affinity forbids a new node")
+                continue
+            if (self.max_new_nodes is not None
+                    and len(self.new_nodes) >= self.max_new_nodes):
+                self.infeasible[pod.name] = "new-node budget exhausted"
+                continue
+            # fresh best_new per pod: the allowed-ct set changes per
+            # placement, so the per-zone score cache must not carry over
+            node = self._create_node(g, req, pod_reqs, zones,
+                                     g.count - placed, {}, allowed_cts=cts)
+            if node is None:
+                self.infeasible[pod.name] = (
+                    "no feasible (provisioner, instance type, offering)")
+                continue
+            self._bind(pod, node)
+            placed += 1
+
     def _bind(self, pod: PodSpec, node: SimNode) -> None:
         node.pods.append(pod)
         self._rem_cache.pop(id(node), None)  # remaining() changed
         self.assignments[pod.name] = node.name
-        self.topo.observe(pod, node.zone, node.name, self.selectors)
+        self.topo.observe(pod, node.zone, node.name, self.selectors,
+                          ct=node.capacity_type)
 
     def _create_node(
         self,
@@ -349,12 +477,14 @@ class _Solver:
         allowed_zones: Sequence[str],
         remaining: int,
         best_new: Dict[str, Optional[tuple]],
+        allowed_cts: Optional[Sequence[str]] = None,
     ) -> Optional[SimNode]:
         """Pick min-score (candidate, offering) over allowed zones, create node."""
         best = None
         for z in allowed_zones:
             if z not in best_new:
-                best_new[z] = self._best_in_zone(g, req, pod_reqs, z, remaining)
+                best_new[z] = self._best_in_zone(g, req, pod_reqs, z, remaining,
+                                                 allowed_cts=allowed_cts)
             b = best_new[z]
             if b is not None and (best is None or b[0] < best[0]):
                 best = b
@@ -372,8 +502,12 @@ class _Solver:
                 # invalidate zone caches that chose this provisioner and retry once
                 for z in list(best_new):
                     if best_new[z] is not None and best_new[z][1] is prov:
-                        best_new[z] = self._best_in_zone(g, req, pod_reqs, z, remaining)
-                return self._create_node(g, req, pod_reqs, allowed_zones, remaining, best_new)
+                        best_new[z] = self._best_in_zone(
+                            g, req, pod_reqs, z, remaining,
+                            allowed_cts=allowed_cts)
+                return self._create_node(g, req, pod_reqs, allowed_zones,
+                                         remaining, best_new,
+                                         allowed_cts=allowed_cts)
 
         labels = {**it.labels(), **prov.labels}
         for r in merged.to_list() + pod_reqs.to_list():
@@ -404,7 +538,9 @@ class _Solver:
         return node
 
     def _best_in_zone(
-        self, g: PodGroup, req: ResourceList, pod_reqs: Requirements, zone: str, remaining: int
+        self, g: PodGroup, req: ResourceList, pod_reqs: Requirements,
+        zone: str, remaining: int,
+        allowed_cts: Optional[Sequence[str]] = None,
     ) -> Optional[tuple]:
         rep = g.pods[0]
         pod_ct = pod_reqs.get(L.CAPACITY_TYPE)
@@ -440,6 +576,8 @@ class _Solver:
                     continue
                 if not (merged_ct.contains(o.capacity_type) and pod_ct.contains(o.capacity_type)):
                     continue
+                if allowed_cts is not None and o.capacity_type not in allowed_cts:
+                    continue  # capacity-type spread skew forbids this ct now
                 score = (o.price / denom, o.price, ci, oi)
                 if best is None or score < best[0]:
                     best = (score, prov, it, merged, o, eff_alloc)

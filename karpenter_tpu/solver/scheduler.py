@@ -32,7 +32,7 @@ from ..models import labels as L
 from ..models.instancetype import InstanceType
 from ..models.pod import LabelSelector, PodSpec
 from ..models.provisioner import Provisioner
-from ..models.tensorize import device_inexpressible, tensorize
+from ..models.tensorize import batch_needs_oracle, device_inexpressible, tensorize
 from .guard import DeviceGuard, DeviceHang
 from .reference import solve as oracle_solve
 from .tpu import SlotsExhausted, TpuSolver
@@ -352,7 +352,11 @@ class BatchScheduler:
         self, pods, provisioners, instance_types, existing_nodes, daemonsets,
         unavailable, allow_new_nodes, max_new_nodes,
     ) -> SolveResult:
-        if self.backend == "oracle" or self._route_small(len(pods)):
+        # a hard capacity-type spread couples the whole batch to the
+        # sequential engine (batch_needs_oracle) — exact interleaved
+        # semantics, every backend
+        if (self.backend == "oracle" or self._route_small(len(pods))
+                or batch_needs_oracle(pods)):
             t0 = time.perf_counter()
             try:
                 return oracle_solve(

@@ -86,7 +86,8 @@ def _gate_cost(seed, suite, oracle, tpu, ceiling):
 
 
 def validate_solution(pods, provs, res, catalog=(),
-                      all_zones=("zone-1a", "zone-1b", "zone-1c")):
+                      all_zones=("zone-1a", "zone-1b", "zone-1c"),
+                      unavailable=()):
     """Independent constraint check of a SolveResult — not a comparison with
     the oracle, but the ground-truth rules: resource fit, provisioner limits,
     hard zone-spread skew, hostname anti-affinity/spread, taints, selectors.
@@ -183,6 +184,49 @@ def validate_solution(pods, provs, res, catalog=(),
                     )
                     if matches > 1:
                         errs.append(f"{node.name}: {matches} anti-affine pods co-located")
+
+    # hard capacity-type spread: skew over the cts REACHABLE through
+    # tolerable provisioners (mirrors reference._eligible_cts; fuzz pods
+    # carry no ct requirements of their own)
+    ct_groups = {}
+    for node in nodes:
+        for p in node.pods:
+            if p.name not in by_name:
+                continue
+            for tsc in p.topology_spread:
+                if (tsc.when_unsatisfiable != "DoNotSchedule"
+                        or tsc.topology_key != L.CAPACITY_TYPE):
+                    continue
+                key = (tsc.label_selector, tsc.max_skew, p.owner_key)
+                info = ct_groups.setdefault(key, {"pod": p, "counts": {}})
+                info["counts"][node.capacity_type] = (
+                    info["counts"].get(node.capacity_type, 0) + 1)
+    for (_sel, skew, _owner), info in ct_groups.items():
+        rep = info["pod"]
+        eligible = set()
+        for prov in provs:
+            if not prov.tolerates(rep):
+                continue
+            ctr = next((r for r in prov.requirements
+                        if r.key == L.CAPACITY_TYPE), None)
+            for it in catalog:
+                for o in it.offerings:
+                    if not o.available:
+                        continue
+                    if (it.name, o.zone, o.capacity_type) in unavailable:
+                        continue  # ICE'd — the solver excludes it too
+                    if ctr is not None and not ctr.value_set().contains(
+                            o.capacity_type):
+                        continue
+                    eligible.add(o.capacity_type)
+        if not eligible:
+            continue
+        counts = info["counts"]
+        lo = min(counts.get(c, 0) for c in eligible)
+        hi = max(counts.get(c, 0) for c in eligible)
+        if hi - lo > skew:
+            errs.append(
+                f"capacity-type spread violated: {counts} skew {hi - lo} > {skew}")
     return errs
 #: widened by `make battletest` (KT_FUZZ_SEEDS=40)
 SEEDS = range(int(os.environ.get("KT_FUZZ_SEEDS", "10")))
@@ -262,6 +306,22 @@ def random_scenario(seed: int, catalog):
             for pod in pods:
                 if pod.owner_key == f"d{d}":
                     pod.volume_zone_requirements = [req]
+
+    # -- capacity-type spread (scheduling.md:303-346's third topologyKey):
+    # some deployments spread replicas across spot/on-demand.  Separate rng
+    # stream so pre-existing seeds keep their exact scenarios; layers on top
+    # of whatever constraints the deployment already drew (the oracle's
+    # ct path composes with zone rules and hostname caps).
+    crng = np.random.default_rng(seed + 99_000)
+    for d in range(n_dep):
+        if crng.random() < 0.12:
+            sel = LabelSelector.of({"app": f"d{d}"})
+            for pod in pods:
+                if pod.owner_key == f"d{d}":
+                    pod.topology_spread = list(pod.topology_spread) + [
+                        TopologySpreadConstraint(
+                            1, L.CAPACITY_TYPE, "DoNotSchedule", sel)
+                    ]
 
     return pods, provs, unavailable
 
@@ -363,7 +423,8 @@ def test_fuzz_existing_node_parity_and_no_overcommit(seed, small_catalog):
     assert tpu.n_scheduled >= floor, (
         f"seed {seed}: scheduled tpu={tpu.n_scheduled} oracle={oracle.n_scheduled}"
     )
-    errs = validate_solution(pods, provs, tpu, small_catalog)
+    errs = validate_solution(pods, provs, tpu, small_catalog,
+                             unavailable=unavailable)
     assert not errs, f"seed {seed}: invalid solution: {errs[:4]}"
     _gate_cost(seed, "existing", oracle, tpu, FUZZ_PARITY_EXISTING)
 
@@ -392,7 +453,8 @@ def test_fuzz_cost_and_feasibility_parity(seed, small_catalog):
         f"seed {seed}: scheduled tpu={tpu.n_scheduled} oracle={oracle.n_scheduled} "
         f"(tpu infeasible={len(tpu.infeasible)}, oracle={len(oracle.infeasible)})"
     )
-    errs = validate_solution(pods, provs, tpu, small_catalog)
+    errs = validate_solution(pods, provs, tpu, small_catalog,
+                             unavailable=unavailable)
     assert not errs, f"seed {seed}: invalid solution: {errs[:4]}"
     _gate_cost(seed, "plain", oracle, tpu, FUZZ_PARITY)
 
@@ -435,7 +497,8 @@ def test_fuzz_kubelet_overrides_parity(seed, small_catalog):
         f"seed {seed}: scheduled tpu={tpu.n_scheduled} oracle={oracle.n_scheduled} "
         f"(tpu infeasible={len(tpu.infeasible)}, oracle={len(oracle.infeasible)})"
     )
-    errs = validate_solution(pods, provs, tpu, small_catalog)
+    errs = validate_solution(pods, provs, tpu, small_catalog,
+                             unavailable=unavailable)
     assert not errs, f"seed {seed}: invalid solution: {errs[:4]}"
     # Independent density check — validate_solution's pod-density row reads
     # the node's SELF-reported allocatable, so a solver that ignored maxPods
@@ -516,7 +579,8 @@ def test_fuzz_native_parity(seed, small_catalog):
         )
     # over-scheduling must still be VALID: the >= floor above would let an
     # overcommit/limit-violating regression through without this
-    errs = validate_solution(pods, provs, got, small_catalog)
+    errs = validate_solution(pods, provs, got, small_catalog,
+                             unavailable=unavailable)
     assert not errs, f"seed {seed}: invalid native solution: {errs[:4]}"
 
 

@@ -204,6 +204,99 @@ class TestPositiveAffinity:
         for i in range(8):
             assert node_zone[tpu.assignments[f"b{i}"]] == "zone-1b"
 
+    def test_capacity_type_spread_balances_spot_od(self, small_catalog):
+        """karpenter.sh/capacity-type is the reference's third supported
+        spread topologyKey (scheduling.md:303-346): replicas spread across
+        spot/on-demand to bound the interruption blast radius.  The device
+        path serves these via the oracle carve-out (device_inexpressible),
+        so the product boundary must land the exact balanced split."""
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        sel = LabelSelector.of({"app": "web"})
+        prov = Provisioner(name="default", requirements=[
+            Requirement(L.CAPACITY_TYPE, IN,
+                        [L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND]),
+        ]).with_defaults()
+        pods = [PodSpec(name=f"w{i}", labels={"app": "web"},
+                        requests={"cpu": 1.0},
+                        topology_spread=[TopologySpreadConstraint(
+                            1, L.CAPACITY_TYPE, "DoNotSchedule", sel)],
+                        owner_key="web") for i in range(10)]
+        oracle = reference.solve(pods, [prov], small_catalog)
+        got = BatchScheduler(backend="tpu").solve(pods, [prov], small_catalog)
+        for res in (oracle, got):
+            assert not res.infeasible
+            by_ct = {}
+            for n in res.nodes:
+                by_ct[n.capacity_type] = by_ct.get(n.capacity_type, 0) + len(n.pods)
+            assert set(by_ct) == {L.CAPACITY_TYPE_SPOT,
+                                  L.CAPACITY_TYPE_ON_DEMAND}
+            assert abs(by_ct[L.CAPACITY_TYPE_SPOT]
+                       - by_ct[L.CAPACITY_TYPE_ON_DEMAND]) <= 1
+        assert abs(got.new_node_cost - oracle.new_node_cost) < 1e-9
+
+    def test_capacity_type_spread_single_eligible_domain(self, small_catalog):
+        """A spot-only provisioner leaves ONE reachable ct domain; skew is
+        judged over reachable domains (not a global {spot, od} constant), so
+        every pod still places — on spot."""
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        sel = LabelSelector.of({"app": "w"})
+        prov = Provisioner(name="spot-only", requirements=[
+            Requirement(L.CAPACITY_TYPE, IN, [L.CAPACITY_TYPE_SPOT]),
+        ]).with_defaults()
+        pods = [PodSpec(name=f"w{i}", labels={"app": "w"},
+                        requests={"cpu": 0.5},
+                        topology_spread=[TopologySpreadConstraint(
+                            1, L.CAPACITY_TYPE, "DoNotSchedule", sel)],
+                        owner_key="w") for i in range(8)]
+        got = BatchScheduler(backend="tpu").solve(pods, [prov], small_catalog)
+        assert not got.infeasible
+        assert {n.capacity_type for n in got.nodes} == {L.CAPACITY_TYPE_SPOT}
+
+    def test_capacity_type_spread_balances_against_existing(self, small_catalog):
+        """Existing matching pods count toward the ct domains: a spot node
+        already holding 3 web pods forces the next placements toward
+        on-demand until the skew band re-levels."""
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+        from karpenter_tpu.solver.types import SimNode
+
+        sel = LabelSelector.of({"app": "web"})
+        it = next(t for t in small_catalog if t.name == "m5.2xlarge")
+        existing = SimNode(
+            instance_type=it.name, provisioner="default", zone="zone-1a",
+            capacity_type=L.CAPACITY_TYPE_SPOT, price=it.offerings[0].price,
+            allocatable=dict(it.allocatable),
+            labels={**it.labels(), L.ZONE: "zone-1a",
+                    L.CAPACITY_TYPE: L.CAPACITY_TYPE_SPOT},
+            existing=True,
+        )
+        for i in range(3):
+            existing.pods.append(PodSpec(
+                name=f"old{i}", labels={"app": "web"},
+                requests={"cpu": 0.5}, owner_key="web"))
+        # both cts reachable — otherwise the on-demand default would force
+        # the balanced outcome trivially instead of via the skew band
+        prov = Provisioner(name="default", requirements=[
+            Requirement(L.CAPACITY_TYPE, IN,
+                        [L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND]),
+        ]).with_defaults()
+        pods = [PodSpec(name=f"new{i}", labels={"app": "web"},
+                        requests={"cpu": 0.5},
+                        topology_spread=[TopologySpreadConstraint(
+                            1, L.CAPACITY_TYPE, "DoNotSchedule", sel)],
+                        owner_key="web") for i in range(3)]
+        got = BatchScheduler(backend="tpu").solve(
+            pods, [prov], small_catalog, existing_nodes=[existing])
+        assert not got.infeasible
+        counts = {L.CAPACITY_TYPE_SPOT: 3}  # the existing node's web pods
+        for n in list(got.existing_nodes) + list(got.nodes):
+            for p in n.pods:
+                if p.name.startswith("new"):
+                    counts[n.capacity_type] = counts.get(n.capacity_type, 0) + 1
+        # 3 existing spot + 3 new: balanced end state is 3/3
+        assert counts.get(L.CAPACITY_TYPE_ON_DEMAND, 0) == 3
+
     def test_zone_affinity_seed_absorbs_into_fleet_zone(self, small_catalog):
         """The zone seed picks the cheapest-ABSORBING zone, not the earliest
         open slot's zone: a hostname-spread fleet pinned to zone-1b leaves
