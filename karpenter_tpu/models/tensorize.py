@@ -207,14 +207,17 @@ def device_inexpressible(pod: PodSpec) -> bool:
             return True
     nz = nh = 0
     for t in pod.affinity_terms:
+        if t.topology_key not in (L.ZONE, L.HOSTNAME):
+            # exotic (anti-)affinity keys go to the oracle's unsupported-key
+            # rejection — a dropped anti-affinity term silently co-locates
+            # the replicas it exists to separate
+            return True
         if t.anti:
             continue
         if t.topology_key == L.ZONE:
             nz += 1
-        elif t.topology_key == L.HOSTNAME:
-            nh += 1
         else:
-            return True
+            nh += 1
     return nz > 1 or nh > 1
 
 

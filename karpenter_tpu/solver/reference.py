@@ -342,10 +342,13 @@ class _Solver:
         unsupported = [t.topology_key for t in rep.topology_spread
                        if t.hard and t.topology_key not in
                        (L.ZONE, L.HOSTNAME, L.CAPACITY_TYPE)]
+        unsupported += [t.topology_key for t in rep.affinity_terms
+                        if t.topology_key not in (L.ZONE, L.HOSTNAME)]
         if unsupported:
             # the reference supports exactly three spread topologyKeys
-            # (scheduling.md:339-343) and errors on others — silently
-            # dropping a DoNotSchedule constraint is never acceptable
+            # (scheduling.md:339-343) and zone/hostname (anti-)affinity —
+            # silently dropping a required constraint is never acceptable
+            # (a dropped anti-affinity co-locates the replicas it separates)
             for pod in g.pods:
                 self.infeasible[pod.name] = (
                     f"unsupported topology key {unsupported[0]!r}")

@@ -204,6 +204,31 @@ class TestPositiveAffinity:
         for i in range(8):
             assert node_zone[tpu.assignments[f"b{i}"]] == "zone-1b"
 
+    def test_unsupported_topology_keys_reject_with_reason(self, small_catalog):
+        """Required constraints on topology keys outside the supported set
+        must REJECT (infeasible + reason), never silently drop — a dropped
+        anti-affinity term co-locates the replicas it exists to separate.
+        Supported: zone/hostname/capacity-type for spread
+        (scheduling.md:339-343), zone/hostname for (anti-)affinity."""
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        sel = LabelSelector.of({"app": "w"})
+        prov = Provisioner(name="default").with_defaults()
+        for bad in (
+            dict(topology_spread=[TopologySpreadConstraint(
+                1, "topology.example.com/rack", "DoNotSchedule", sel)]),
+            dict(affinity_terms=[PodAffinityTerm(
+                sel, "topology.example.com/rack", anti=True)]),
+            dict(affinity_terms=[PodAffinityTerm(sel, L.CAPACITY_TYPE)]),
+        ):
+            pods = [PodSpec(name=f"w{i}", labels={"app": "w"},
+                            requests={"cpu": 0.5}, owner_key="w", **bad)
+                    for i in range(3)]
+            res = BatchScheduler(backend="tpu").solve(pods, [prov], small_catalog)
+            assert len(res.infeasible) == 3, bad
+            assert all("unsupported topology key" in r
+                       for r in res.infeasible.values()), res.infeasible
+
     def test_capacity_type_spread_balances_spot_od(self, small_catalog):
         """karpenter.sh/capacity-type is the reference's third supported
         spread topologyKey (scheduling.md:303-346): replicas spread across
