@@ -611,6 +611,34 @@ class TestScaleParity:
 
 
 class TestPreferenceRelaxation:
+    def test_soft_ct_spread_relaxes_when_domain_unfundable(self, small_catalog):
+        """ScheduleAnyway capacity-type spread composes with the relaxation
+        ladder: hardened first (riding the oracle batch route), and when the
+        on-demand domain is reachable but unfundable (a tiny provisioner cpu
+        limit) the strand relaxes the soft spread away — everything lands on
+        spot, nothing infeasible."""
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        sel = LabelSelector.of({"app": "w"})
+        provs = [
+            Provisioner(name="od", weight=10, limits={"cpu": 2.0},
+                        requirements=[Requirement(
+                            L.CAPACITY_TYPE, IN,
+                            [L.CAPACITY_TYPE_ON_DEMAND])]).with_defaults(),
+            Provisioner(name="spot", weight=1,
+                        requirements=[Requirement(
+                            L.CAPACITY_TYPE, IN,
+                            [L.CAPACITY_TYPE_SPOT])]).with_defaults(),
+        ]
+        pods = [PodSpec(name=f"w{i}", labels={"app": "w"},
+                        requests={"cpu": 2.0},
+                        topology_spread=[TopologySpreadConstraint(
+                            1, L.CAPACITY_TYPE, "ScheduleAnyway", sel)],
+                        owner_key="w") for i in range(9)]
+        res = BatchScheduler(backend="tpu").solve(pods, provs, small_catalog)
+        assert not res.infeasible
+        assert res.n_scheduled == 9
+
     def test_preferred_zone_honored_when_feasible(self, small_catalog):
         from karpenter_tpu.solver.scheduler import BatchScheduler
 
