@@ -45,18 +45,24 @@ PARITY = 1.02
 #: get a hard per-seed ceiling plus a tight MEAN gate (test_zz_fuzz_cost_mean)
 #: so a systematic regression fails even when each seed stays under the
 #: ceiling.
-#: observed worst case 1.0203 (seed 23, limit-capped purchase mix) over the
-#: 40-seed sweep after round 5's per-node coalescing freeze (one hostname-
-#: capped group no longer disables coalescing for the whole solve) and the
-#: capped-residue reseat epilogue (scheduler._reseat_capped); the round-3
-#: worst (seed 14's 1.104 zone-tail type split) still BEATS the oracle
-FUZZ_PARITY = 1.03           # per-seed, plain scenarios
-#: observed worst case 1.0265 (seed 23) — seed 5's 1.0334 (single-pod
-#: hostname-anti nodes the oracle first-fits onto open capacity) is closed
-#: by the reseat epilogue (1.0133; 1.0068 after the absorption-aware zone
-#: seed)
-FUZZ_PARITY_EXISTING = 1.03  # per-seed, adversarial existing-node scenarios
-FUZZ_MEAN = 1.02             # mean per suite
+#: observed worst case 1.0157 (seed 28) over the 40-seed sweep.  History of
+#: closed worsts: seed 14's 1.104 zone-tail type split (r4 per-zone suffix
+#: projection — now BEATS the oracle), seed 23's 1.0203 limit-capped
+#: purchase mix (drew a capacity-type spread when that axis landed, so the
+#: whole batch now oracle-routes at exact parity; the pure limit-mix shape
+#: remains covered by the other capped seeds under this ceiling)
+FUZZ_PARITY = 1.02           # per-seed, plain scenarios — the parity budget
+#: observed worst case 1.0068 (seed 5: single-pod hostname-anti nodes the
+#: oracle first-fits onto open capacity; 1.0334 before the reseat epilogue,
+#: 1.0133 before the absorption-aware zone seed).  Seed 23's 1.0265
+#: oracle-routes since the ct-spread axis (see above)
+FUZZ_PARITY_EXISTING = 1.02  # per-seed, adversarial existing-node scenarios
+#: per-suite mean gate.  Observed means sit at 0.75-0.77 (the device is
+#: usually far cheaper than sequential FFD); 0.90 leaves population-shift
+#: headroom while still failing a systematic drift toward the per-seed
+#: ceilings long before every seed individually trips — at 1.02 (== the
+#: per-seed ceiling) this gate would be vacuous for plain/existing
+FUZZ_MEAN = 0.90             # mean per suite
 _RATIOS: dict = {}           # suite -> [per-pod cost ratios], gated at the end
 
 
@@ -461,19 +467,17 @@ def test_fuzz_cost_and_feasibility_parity(seed, small_catalog):
 
 #: kubeletConfiguration fuzz: per-seed ceiling for scenarios whose
 #: provisioners carry density caps / reservation overrides.  40-seed sweep:
-#: mean 0.611 (the device is usually far cheaper), 20 of 22 non-skipped
-#: seeds <= 1.016; the two adversarial shapes above the plain suites' 1.03
-#: band:
+#: mean 0.754, 21 of 22 non-skipped seeds <= 1.016; the one adversarial
+#: shape above the plain suites' band (1.02):
 #: - seed 20 (1.0555, was 1.1151): the absorption-aware zone seed closed
 #:   the bulk — the group's zone-affinity seed now lands where a
 #:   hostname-spread fleet's free rows absorb it instead of chasing the
 #:   earliest open slot into a zone that needs 4 dedicated nodes; the
-#:   residue is one extra 2xlarge in the zone-spread alloc for the big
-#:   group,
-#: - seed 3 (1.0500): kube_reserved cpu=2 + a cpu=33 limit — the device's
-#:   group-remainder-capped scoring buys two 4xlarge (paying the per-node
-#:   reservation twice) where the oracle's resource-optimistic pick buys
-#:   one 8xlarge the interleave then fills; same $, one fewer pod seated.
+#:   residue is one extra 2xlarge from the zone-spread count allocation
+#:   (the +1-pod band top lands in a zone whose best type it overflows by
+#:   one pod — a counts-before-types coupling in zoned_alloc).
+#: Closed: seed 3's 1.0500 double-paid-reservation shape drew a ct spread
+#: when that axis landed and now oracle-routes at exact parity.
 FUZZ_PARITY_KUBELET = 1.06
 
 
