@@ -263,39 +263,67 @@ class BatchScheduler:
         self, result: SolveResult, provisioners, instance_types, daemonsets,
         unavailable, *, n_pods: int, max_new_nodes: Optional[int] = None,
     ) -> None:
-        """Cost-decreasing epilogue for hostname-capped residue: the scan
-        solver places per-node-capped pods (hostname anti-affinity / spread
-        caps) group-at-a-time, so a small capped group can buy dedicated
+        """Cost-decreasing epilogue for nearly-empty residue nodes: the scan
+        solver places group-at-a-time, so a group tail (or a per-node-capped
+        group — hostname anti-affinity, spread caps) can buy dedicated
         near-empty nodes where the oracle's pod-interleaved first-fit seats
-        the same pods on other groups' open capacity (fuzz seed 5: 7
-        single-pod m5.large nodes at +3.3% cost).  Take the new nodes whose
-        pods are ALL capped and few, re-solve exactly those pods with the
-        oracle against everything else placed, and adopt the answer only
-        when it is strictly cheaper.  Device backends only — the oracle
-        backend (and auto's oracle-served small batches) already
+        the same pods on other groups' open capacity, or serves them from a
+        cheaper right-sized node (fuzz seed 5: 7 single-pod m5.large at
+        +3.3%; kubelet seed 20: a zone-spread band-top orphan riding a
+        2xlarge it shares with one hostname-spread pod, where re-solving
+        seats the orphan on another zone's slack and downsizes the node).
+        Take the new nodes holding at most two pods, re-solve exactly those
+        pods with the oracle against everything else placed, and adopt the
+        answer only when every pod still places AND it is strictly cheaper —
+        quality can only improve by construction.  Device backends only —
+        the oracle backend (and auto's oracle-served small batches) already
         interleave."""
         if (self.backend == "oracle" or self._route_small(n_pods)
                 or not result.nodes):
             return
 
         def _capped(p: PodSpec) -> bool:
-            # per-node CAPS only: hostname anti-affinity and hard hostname
-            # spread.  Positive hostname affinity wants co-location — its
-            # pods are not capped residue
+            # per-node CAPS: hostname anti-affinity and hard hostname spread
+            # — the shapes whose reseat wins are structural (they build
+            # single-pod fleets with backfillable slack)
             return any(
-                t.anti and t.topology_key == L.HOSTNAME for t in p.affinity_terms
+                t.anti and t.topology_key == L.HOSTNAME
+                for t in p.affinity_terms
             ) or any(
-                t.hard and t.topology_key == L.HOSTNAME for t in p.topology_spread
+                t.hard and t.topology_key == L.HOSTNAME
+                for t in p.topology_spread
             )
 
-        waste = [n for n in result.nodes
-                 if n.pods and len(n.pods) <= 2 and all(_capped(p) for p in n.pods)]
+        waste = [n for n in result.nodes if n.pods and len(n.pods) <= 2]
+        # bounded epilogue: a batch whose pods are node-sized (1-2 per node
+        # by design) would otherwise re-solve nearly everything through the
+        # sequential oracle and erase the device speedup.  Trim to a 64-pod
+        # re-solve budget, keeping capped fleets first (the structural wins)
+        # then the most expensive residue — never skip wholesale
+        if sum(len(n.pods) for n in waste) > 64:
+            waste.sort(key=lambda n: (
+                0 if all(_capped(p) for p in n.pods) else 1, -n.price, n.name))
+            trimmed, tot = [], 0
+            for n in waste:
+                if tot + len(n.pods) > 64:
+                    continue  # overfull node; later smaller ones may still fit
+                trimmed.append(n)
+                tot += len(n.pods)
+            waste = trimmed
         if not waste:
             return
         waste_ids = {id(n) for n in waste}
         waste_pods = [p for n in waste for p in n.pods]
         keep = [n for n in result.nodes if id(n) not in waste_ids]
         others = list(result.existing_nodes) + keep
+        # fast screen before paying a sequential oracle solve on EVERY batch
+        # whose pod count isn't a multiple of node capacity (almost all):
+        # a win requires either free room for a waste pod somewhere else
+        # (resource-only — caps/zones may still block, the oracle decides)
+        # or a waste node that isn't the cheapest catalog way to host its
+        # own pods.  A routine right-sized tail node fails both and skips.
+        if not self._reseat_plausible(waste, others, instance_types):
+            return
         # honor the caller's new-node budget: the epilogue may only spend
         # what the waste nodes gave back (max_new_nodes=1 what-ifs must not
         # come back with 2 replacements)
@@ -310,11 +338,144 @@ class BatchScheduler:
         old_cost = sum(n.price for n in waste)
         if re.infeasible or re.new_node_cost >= old_cost - 1e-9:
             return
+        if not self._reseat_in_band(waste_pods, re, instance_types):
+            return
         placed = list(re.existing_nodes)  # snapshots of others, pods seated
         ne = len(result.existing_nodes)
         result.existing_nodes = placed[:ne]
         result.nodes = placed[ne:] + list(re.nodes)
         result.assignments.update(re.assignments)
+
+    @staticmethod
+    def _reseat_plausible(waste, others, instance_types) -> bool:
+        """Cheap necessary condition for a reseat win: some waste pod has
+        resource-level room on another placed node (absorption might be
+        possible), or some waste node is priced above the cheapest catalog
+        type that fits its pods (downsizing might be possible)."""
+        for n in waste:
+            for p in n.pods:
+                req = dict(p.requests)
+                req.setdefault(L.RESOURCE_PODS, 1.0)
+                for o in others:
+                    rem = o.remaining()
+                    if all(rem.get(k, 0.0) >= v - 1e-9 for k, v in req.items()):
+                        return True
+        for n in waste:
+            total: Dict[str, float] = {}
+            for p in n.pods:
+                for k, v in p.requests.items():
+                    total[k] = total.get(k, 0.0) + v
+            total[L.RESOURCE_PODS] = float(len(n.pods))
+            for it in instance_types:
+                if not all(it.allocatable.get(k, 0.0) >= v - 1e-9
+                           for k, v in total.items()):
+                    continue
+                cheapest = min(
+                    (o.price for o in it.offerings if o.available),
+                    default=None,
+                )
+                if cheapest is not None and cheapest < n.price - 1e-9:
+                    return True
+        return False
+
+    @staticmethod
+    def _reseat_in_band(moved, re, instance_types) -> bool:
+        """Global zone-spread check on a reseat adoption candidate.
+
+        The oracle's incremental band check (`counts[z]+1-min <= skew`)
+        assumes an IN-BAND starting state; removing the waste nodes can hand
+        it a mid-band hole it then legally over-fills from (fuzz seed 17:
+        removing four 2-pod zone-1b nodes left {11,1,8}; per-placement-legal
+        refilling ended {11,7,10} — skew 4 over a 3 band).  Re-check every
+        moved pod's hard zone spread GLOBALLY over its eligible zones and
+        reject the adoption on any violation — the pre-reseat result was
+        valid, so rejecting preserves validity."""
+        # spec key mirrors the ground-truth validator: same selector + skew
+        # but different node pins are DIFFERENT spread groups with different
+        # eligible-zone sets — deduping on (selector, skew) alone would let
+        # a zone-pinned pod (trivially in band over its one zone) mask an
+        # unpinned group's violation.  Specs come from EVERY pod in the
+        # adoption candidate whose selector matches a moved pod, not just
+        # the moved pods' own constraints — a kept group's spread counts
+        # the moved pod too (the oracle's observe() matches by selector,
+        # regardless of which pod carries the constraint)
+        nodes = list(re.existing_nodes) + list(re.nodes)
+        moved_labels = [p.labels for p in moved]
+        specs = {}
+        for n in nodes:
+            for q in n.pods:
+                for tsc in q.topology_spread:
+                    if not (tsc.hard and tsc.topology_key == L.ZONE):
+                        continue
+                    if not any(tsc.label_selector.matches(lb)
+                               for lb in moved_labels):
+                        continue
+                    key = (tsc.label_selector, tsc.max_skew,
+                           tuple(sorted(q.node_selector.items())),
+                           tuple(q.volume_zone_requirements))
+                    specs.setdefault(key, (tsc, q))
+        if specs:
+            all_zones: List[str] = []
+            for it in instance_types:
+                for o in it.offerings:
+                    if o.zone not in all_zones:
+                        all_zones.append(o.zone)
+            for tsc, rep in specs.values():
+                eligible = [
+                    z for z in all_zones
+                    if rep.node_selector.get(L.ZONE, z) == z
+                    and all(r.value_set().contains(z)
+                            for r in rep.volume_zone_requirements)
+                ]
+                if not eligible:
+                    continue
+                counts = {z: 0 for z in eligible}
+                for n in nodes:
+                    if n.zone in counts:
+                        counts[n.zone] += sum(
+                            1 for q in n.pods
+                            if tsc.label_selector.matches(q.labels)
+                        )
+                if max(counts.values()) - min(counts.values()) > tsc.max_skew:
+                    return False
+        # hostname anti-affinity is enforced by the oracle only for the
+        # INCOMING pod's own terms; a moved pod with no terms could land
+        # beside a kept pod whose anti selector matches it.  Re-check every
+        # node that received a moved pod bidirectionally (the validator's
+        # rule: a pod's hostname-anti term may match at most one co-located
+        # pod — itself)
+        moved_names = {p.name for p in moved}
+        for n in nodes:
+            if not any(q.name in moved_names for q in n.pods):
+                continue
+            for q in n.pods:
+                for term in q.affinity_terms:
+                    if term.anti and term.topology_key == L.HOSTNAME:
+                        matches = sum(
+                            1 for r in n.pods
+                            if term.label_selector.matches(r.labels)
+                        )
+                        if matches > 1:
+                            return False
+        # same bidirectional rule at zone scope: any pod in a zone that
+        # received a moved pod may carry a zone anti-affinity term the
+        # moved pod violates (at most one matching pod — itself — in the
+        # zone)
+        moved_zones = {n.zone for n in nodes
+                       if any(q.name in moved_names for q in n.pods)}
+        for z in moved_zones:
+            zone_pods = [q for n in nodes if n.zone == z for q in n.pods]
+            for q in zone_pods:
+                for term in q.affinity_terms:
+                    if term.anti and term.topology_key == L.ZONE:
+                        matches = sum(
+                            1 for r in zone_pods
+                            if term.label_selector.matches(r.labels)
+                        )
+                        allowed = 1 if term.label_selector.matches(q.labels) else 0
+                        if matches > allowed:
+                            return False
+        return True
 
     def _solve_wave(
         self, pods, provisioners, instance_types, existing_nodes, daemonsets,
