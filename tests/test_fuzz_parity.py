@@ -45,7 +45,13 @@ PARITY = 1.02
 #: get a hard per-seed ceiling plus a tight MEAN gate (test_zz_fuzz_cost_mean)
 #: so a systematic regression fails even when each seed stays under the
 #: ceiling.
-#: observed worst case 1.0157 (seed 28) over the 40-seed sweep.  History of
+#: observed worst case 1.0157 (seed 28) over the 40-seed sweep: cross-group
+#: tail interleaving — the oracle seats a 2-pod d0 tail and a 1-pod d4 tail
+#: on SHARED nodes mid-interleave, where the group-at-a-time scan strands
+#: each on its own right-sized node; both nodes pass the reseat screen
+#: honestly (no absorption room anywhere, already the cheapest types), so
+#: closing it needs a whole-batch re-solve — the structural FFD-interleave
+#: edge the batched design trades for its 17x latency win.  History of
 #: closed worsts: seed 14's 1.104 zone-tail type split (r4 per-zone suffix
 #: projection — now BEATS the oracle), seed 23's 1.0203 limit-capped
 #: purchase mix (drew a capacity-type spread when that axis landed, so the
