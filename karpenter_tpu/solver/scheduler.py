@@ -164,6 +164,10 @@ class BatchScheduler:
         )
         self._tpu = TpuSolver()
         self._cold_logged: Set[tuple] = set()  # change-gated stall logging
+        # solve() is not re-entrant on one instance (matching the operator's
+        # serialized reconcile contract): per-solve state like this flag is
+        # instance-scoped, reset at solve() entry
+        self._served_cold = False
         # hang protection for the auto policy's device dispatches (a wedged
         # TPU tunnel must degrade the reconcile loop to the warm host tiers,
         # not freeze it — see solver/guard.py); forced backends keep direct
@@ -206,6 +210,12 @@ class BatchScheduler:
         with the full preference ladder re-applied per term, so a pod landing
         on term[1] still honors its satisfiable preferences."""
         t0 = time.perf_counter()
+        # cold-tier tracking for the reseat epilogue: a solve served by a
+        # transient cold fallback (compile-behind / slots-exhausted) must
+        # return FAST — the device program takes over once compiled, so
+        # spending hundreds of host-side ms polishing the transient answer
+        # violates the cold path's latency contract
+        self._served_cold = False
         try:
             result = self._solve_wave(
                 pods, provisioners, instance_types, list(existing_nodes),
@@ -279,7 +289,7 @@ class BatchScheduler:
         the oracle backend (and auto's oracle-served small batches) already
         interleave."""
         if (self.backend == "oracle" or self._route_small(n_pods)
-                or not result.nodes):
+                or not result.nodes or self._served_cold):
             return
 
         def _capped(p: PodSpec) -> bool:
@@ -791,6 +801,10 @@ class BatchScheduler:
                     daemonsets, unavailable, allow_new_nodes, max_slots,
                     max_new_nodes,
                 )
+                # transient answer — the device program takes over once the
+                # background compile lands; the reseat epilogue skips it so
+                # the cold path keeps its latency contract
+                self._served_cold = True
                 self.registry.counter(SOLVER_COLD_FALLBACKS).inc(
                     {"backend": backend_used}
                 )
@@ -824,6 +838,7 @@ class BatchScheduler:
                             all_existing, daemonsets, unavailable,
                             allow_new_nodes, max_slots, max_new_nodes,
                         )
+                        self._served_cold = True  # transient, see above
                         self.registry.counter(SOLVER_COLD_FALLBACKS).inc(
                             {"backend": backend_used}
                         )
@@ -841,7 +856,10 @@ class BatchScheduler:
                     )
                     # NOT a cold-start fallback: the program was compiled,
                     # the device was not answering — distinct counter so
-                    # outage traffic can't pollute cold-start SLOs
+                    # outage traffic can't pollute cold-start SLOs.  Also
+                    # NOT flagged _served_cold: degraded answers provision
+                    # real long-lived nodes (nothing supersedes them when a
+                    # compile lands), so they keep the reseat polish
                     self.registry.counter(SOLVER_DEGRADED_SOLVES).inc(
                         {"backend": backend_used}
                     )
