@@ -155,6 +155,38 @@ class TestSchedulerDegradation:
         assert reg.counter(SOLVER_DEVICE_HANGS).get() == hangs_before
         hang.set()
 
+    def test_reseat_skips_cold_fallback_keeps_degraded(self, small_catalog, monkeypatch):
+        """The reseat epilogue is skipped for transient cold-fallback solves
+        (compile-behind: the device program supersedes the answer, so the
+        cold path keeps its latency contract) but NOT for device-unhealthy
+        degraded solves, whose nodes are real and long-lived."""
+        calls = []
+
+        def spy(self, result, *a, **k):
+            calls.append(self._served_cold)
+            return None
+
+        monkeypatch.setattr(BatchScheduler, "_reseat_capped", spy)
+        pods, provs, cat = self._scenario(small_catalog)
+
+        # cold path: device not ready -> _cold_solve -> flagged, reseat sees
+        # served_cold=True (the real method would return immediately)
+        sched = BatchScheduler(backend="auto", registry=Registry())
+        monkeypatch.setattr(sched, "_device_ready", lambda *a: False)
+        monkeypatch.setattr(sched, "_start_warm", lambda *a, **k: None)
+        BatchScheduler.solve(sched, pods, provs, cat)
+        assert calls and calls[-1] is True
+
+        # degraded path: unhealthy latch -> warm tier serves, but the solve
+        # is NOT marked cold — the reseat polish applies
+        sched2 = BatchScheduler(backend="auto", registry=Registry())
+        monkeypatch.setattr(sched2, "_device_ready", lambda *a: True)
+        with sched2._guard._lock:
+            sched2._guard._healthy = False
+            sched2._guard._probing = True  # no probe thread in this test
+        BatchScheduler.solve(sched2, pods, provs, cat)
+        assert calls[-1] is False
+
     def test_forced_tpu_backend_is_unguarded(self, small_catalog, monkeypatch):
         sched = BatchScheduler(backend="tpu", registry=Registry())
         sched._guard.timeout_s = 0.05
