@@ -1,0 +1,103 @@
+"""Capstone integration: every round-5 constraint class in ONE flow.
+
+A single provisioning reconcile carries a volume-pinned stateful set, a
+capacity-type-spread deployment, and a density-capped provisioner at the
+same time — the classes are exercised individually elsewhere
+(test_volume_topology, test_kubelet, test_tpu_solver ct tests); this file
+pins their INTERACTION through the controller boundary: batching, the
+device solve with its oracle carve-outs, machine launch against the fake
+cloud, and binding.
+"""
+
+from karpenter_tpu.cloud.fake import FakeCloudProvider
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.state import ClusterState
+from karpenter_tpu.events import Recorder
+from karpenter_tpu.metrics import Registry
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.instancetype import GIB
+from karpenter_tpu.models.pod import (
+    LabelSelector,
+    PodSpec,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.models.provisioner import KubeletConfiguration, Provisioner
+from karpenter_tpu.models.requirements import IN, Requirement
+from karpenter_tpu.models.volume import PersistentVolume, PersistentVolumeClaim, StorageClass
+from karpenter_tpu.solver.scheduler import BatchScheduler
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def test_volumes_kubelet_and_ct_spread_in_one_batch(small_catalog):
+    clock = FakeClock()
+    state = ClusterState(clock=clock)
+    cloud = FakeCloudProvider(small_catalog, clock=clock)
+    reg = Registry()
+    ctrl = ProvisioningController(
+        state, cloud, scheduler=BatchScheduler(backend="tpu", registry=reg),
+        recorder=Recorder(), registry=reg, clock=clock)
+
+    # one provisioner: both capacity types reachable, density capped at 4
+    # pods per node by kubeletConfiguration
+    state.apply_provisioner(Provisioner(
+        name="dense",
+        requirements=[Requirement(
+            L.CAPACITY_TYPE, IN,
+            [L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND])],
+        kubelet=KubeletConfiguration(max_pods=4),
+    ))
+
+    # stateful set: claim bound to a zonal volume in zone-1b
+    state.apply_storage(StorageClass(name="ebs"))
+    state.apply_storage(PersistentVolumeClaim(name="data", storage_class="ebs"))
+    state.bind_volume(
+        "default", "data", PersistentVolume(name="pv-data", zones=("zone-1b",)))
+    for i in range(4):
+        state.add_pod(PodSpec(name=f"db-{i}", labels={"app": "db"},
+                              requests={"cpu": 0.5, "memory": 1 * GIB},
+                              volume_claims=["data"], owner_key="db"))
+
+    # web: hard capacity-type spread, skew 1 (spot/on-demand balanced)
+    web_sel = LabelSelector.of({"app": "web"})
+    for i in range(8):
+        state.add_pod(PodSpec(
+            name=f"web-{i}", labels={"app": "web"},
+            requests={"cpu": 0.25, "memory": 0.5 * GIB},
+            topology_spread=[TopologySpreadConstraint(
+                1, L.CAPACITY_TYPE, "DoNotSchedule", web_sel)],
+            owner_key="web"))
+
+    # filler: plain pods that press against the 4-pods-per-node density cap
+    for i in range(10):
+        state.add_pod(PodSpec(name=f"fill-{i}", labels={"app": "fill"},
+                              requests={"cpu": 0.25}, owner_key="fill"))
+
+    ctrl.reconcile()
+    clock.advance(1.5)
+    ctrl.reconcile()
+
+    # everything bound, nothing pending
+    assert len(state.bindings) == 22, sorted(
+        p.name for p in state.pending_pods())
+
+    # volume pin: every db pod in the volume's zone
+    for i in range(4):
+        assert state.node_of(f"db-{i}").zone == "zone-1b", f"db-{i}"
+
+    # capacity-type spread: web balanced across spot/on-demand
+    ct_counts: dict = {}
+    for i in range(8):
+        ct = state.node_of(f"web-{i}").capacity_type
+        ct_counts[ct] = ct_counts.get(ct, 0) + 1
+    assert set(ct_counts) == {L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND}
+    assert abs(ct_counts[L.CAPACITY_TYPE_SPOT]
+               - ct_counts[L.CAPACITY_TYPE_ON_DEMAND]) <= 1
+
+    # kubelet density: no launched node carries more than 4 pods, and the
+    # fleet is therefore at least ceil(22/4) = 6 nodes
+    per_node: dict = {}
+    for name in state.bindings:
+        node = state.node_of(name)
+        per_node[node.name] = per_node.get(node.name, 0) + 1
+    assert max(per_node.values()) <= 4, per_node
+    assert len(per_node) >= 6  # ceil(22 pods / 4-pod density)
