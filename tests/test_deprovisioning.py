@@ -685,3 +685,58 @@ class TestCapacityTypeSpreadConsolidation:
         cts2, action2 = run(hard=False)
         assert cts2 == {L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND}
         assert action2 is None or action2.kind != "delete", action2
+
+
+class TestVolumePinnedConsolidation:
+    def test_delete_refused_when_pod_is_volume_pinned_off_zone(self, small_catalog):
+        """The what-if injects CURRENT volume pins before simulating a move
+        (deprovisioning._solve_what_if), so a delete whose displaced pod
+        could only land off the volume's zone must not execute; unbinding
+        the claim (control) lets the same consolidation through."""
+        from karpenter_tpu.models.volume import (
+            PersistentVolume, PersistentVolumeClaim, StorageClass,
+        )
+
+        def run(bind_volume: bool):
+            clock, state, cloud, prov_ctrl, term, deprov, _ = make_env(small_catalog)
+            state.apply_storage(StorageClass(name="ebs"))
+            state.apply_storage(PersistentVolumeClaim(
+                name="data", storage_class="ebs"))
+            if bind_volume:
+                state.bind_volume("default", "data", PersistentVolume(
+                    name="pv", zones=("zone-1b",)))
+            # an anchor fleet in zone-1a with slack the displaced pod could
+            # ride — but only if the volume allows leaving zone-1b
+            schedule(state, prov_ctrl, clock, [
+                PodSpec(name=f"web-{i}", requests={"cpu": 1.0},
+                        node_selector={L.ZONE: "zone-1a"}, owner_key="web")
+                for i in range(3)
+            ])
+            # control places in zone-1b via a SOFT preference: honored at
+            # schedule time, relaxable in the what-if — so only the volume
+            # pin (hard, persistent) blocks the move
+            from karpenter_tpu.models.requirements import IN, Requirement
+            db = PodSpec(name="db", requests={"cpu": 0.5},
+                         volume_claims=["data"] if bind_volume else [],
+                         preferred_affinity_terms=(
+                             [] if bind_volume
+                             else [[Requirement(L.ZONE, IN, ["zone-1b"])]]),
+                         owner_key="db")
+            schedule(state, prov_ctrl, clock, [db])
+            db_node = state.node_of("db")
+            assert db_node.zone == "zone-1b"
+            clock.advance(MIN_NODE_LIFETIME + 1)
+            action = deprov.reconcile()
+            return db_node.name, action, state
+
+        name, action, state = run(bind_volume=True)
+        # the db node must survive: the pin forbids riding zone-1a slack
+        assert name in state.nodes, action
+
+        # control: no volume (zone preference only at schedule time via
+        # selector-free re-placement) — the pod may move and the node goes
+        name2, action2, state2 = run(bind_volume=False)
+        # the pin-free fleet consolidates (a delete, or a replace merging
+        # the nodes into one cheaper machine)
+        assert action2 is not None and action2.mechanism == "consolidation"
+        assert name2 in action2.nodes or name2 not in state2.nodes
