@@ -740,3 +740,49 @@ class TestVolumePinnedConsolidation:
         # the nodes into one cheaper machine)
         assert action2 is not None and action2.mechanism == "consolidation"
         assert name2 in action2.nodes or name2 not in state2.nodes
+
+
+class TestKubeletDensityConsolidation:
+    def test_delete_refused_when_density_cap_blocks_merge(self, small_catalog):
+        """A delete whose displaced pods would overflow the survivors'
+        kubeletConfiguration pod-density cap must not execute: the what-if
+        prices the specialized (maxPods-capped) catalog, so tiny pods that
+        FIT by cpu/memory still can't merge past the density ceiling.  The
+        same fleet without the override consolidates (control)."""
+        from karpenter_tpu.models.provisioner import KubeletConfiguration
+
+        def run(shrink_to):
+            prov = Provisioner(
+                name="default", consolidation_enabled=True,
+                kubelet=KubeletConfiguration(max_pods=4),
+            )
+            clock, state, cloud, prov_ctrl, term, deprov, _ = make_env(
+                small_catalog, provisioner=prov)
+            # 8 tiny pods: with maxPods=4 they need two nodes even though
+            # one node's cpu/memory could hold all of them
+            schedule(state, prov_ctrl, clock, [
+                PodSpec(name=f"p-{i}", requests={"cpu": 0.1}, owner_key="d")
+                for i in range(8)
+            ])
+            assert len(state.nodes) == 2  # density forced the split
+            if shrink_to is not None:
+                # shrink each node to ``shrink_to`` pods
+                per: dict = {}
+                for name in sorted(state.bindings):
+                    node = state.node_of(name).name
+                    per[node] = per.get(node, 0) + 1
+                    if per[node] > shrink_to:
+                        state.delete_pod(name)
+            clock.advance(MIN_NODE_LIFETIME + 1)
+            action = deprov.reconcile()
+            return action, state
+
+        # full 4+4 fleet: every survivor is at its density cap — no merge
+        action, state = run(shrink_to=None)
+        assert action is None, action
+        assert len(state.nodes) == 2
+
+        # control: 2+2 after pod churn — a merge to exactly 4 sits AT the
+        # cap and must go through
+        action2, state2 = run(shrink_to=2)
+        assert action2 is not None and action2.mechanism == "consolidation"
