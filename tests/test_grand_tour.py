@@ -101,3 +101,81 @@ def test_volumes_kubelet_and_ct_spread_in_one_batch(small_catalog):
         per_node[node.name] = per_node.get(node.name, 0) + 1
     assert max(per_node.values()) <= 4, per_node
     assert len(per_node) >= 6  # ceil(22 pods / 4-pod density)
+
+
+def test_spot_interruption_restores_ct_balance(small_catalog):
+    """A spot interruption drains one side of a capacity-type-balanced
+    fleet; the displaced pods re-provision THROUGH the same scheduler and
+    the spread lands them back in balance (interruption -> cordon/drain ->
+    pending -> provisioning, all honoring the hard ct spread)."""
+    from karpenter_tpu.controllers.interruption import (
+        SPOT_INTERRUPTION, InterruptionController, InterruptionMessage,
+        MessageQueue,
+    )
+    from karpenter_tpu.controllers.termination import TerminationController
+
+    clock = FakeClock()
+    state = ClusterState(clock=clock)
+    cloud = FakeCloudProvider(small_catalog, clock=clock)
+    reg = Registry()
+    rec = Recorder()
+    ctrl = ProvisioningController(
+        state, cloud, scheduler=BatchScheduler(backend="tpu", registry=reg),
+        recorder=rec, registry=reg, clock=clock)
+    term = TerminationController(state, cloud, recorder=rec, registry=reg,
+                                 clock=clock)
+    queue = MessageQueue()
+    ic = InterruptionController(state, term, queue, recorder=rec,
+                                registry=reg, clock=clock)
+
+    state.apply_provisioner(Provisioner(
+        name="default",
+        requirements=[Requirement(
+            L.CAPACITY_TYPE, IN,
+            [L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND])],
+    ))
+    web_sel = LabelSelector.of({"app": "web"})
+    for i in range(8):
+        state.add_pod(PodSpec(
+            name=f"web-{i}", labels={"app": "web"},
+            requests={"cpu": 0.25},
+            topology_spread=[TopologySpreadConstraint(
+                1, L.CAPACITY_TYPE, "DoNotSchedule", web_sel)],
+            owner_key="web"))
+    ctrl.reconcile(); clock.advance(1.5); ctrl.reconcile()
+
+    def balance():
+        counts: dict = {}
+        for i in range(8):
+            node = state.node_of(f"web-{i}")
+            if node is None:
+                return None  # someone pending
+            counts[node.capacity_type] = counts.get(node.capacity_type, 0) + 1
+        return counts
+
+    counts = balance()
+    assert counts and abs(counts.get(L.CAPACITY_TYPE_SPOT, 0)
+                          - counts.get(L.CAPACITY_TYPE_ON_DEMAND, 0)) <= 1
+
+    # interrupt every spot node
+    spot_nodes = [ns for ns in state.nodes.values()
+                  if ns.node.capacity_type == L.CAPACITY_TYPE_SPOT]
+    assert spot_nodes
+    for ns in spot_nodes:
+        queue.send(InterruptionMessage(
+            SPOT_INTERRUPTION, ns.machine.provider_id, clock.now()))
+    ic.reconcile()
+
+    # displaced pods re-provision in balance (spot offerings still exist —
+    # the interruption blacklists the specific offering, the solver may
+    # pick another spot shape or rebalance toward on-demand within skew)
+    for _ in range(6):
+        if balance():
+            break
+        ctrl.reconcile()
+        clock.advance(1.5)
+    counts2 = balance()
+    assert counts2, "pods left pending after interruption recovery"
+    vals = [counts2.get(L.CAPACITY_TYPE_SPOT, 0),
+            counts2.get(L.CAPACITY_TYPE_ON_DEMAND, 0)]
+    assert abs(vals[0] - vals[1]) <= 1, counts2
