@@ -68,6 +68,49 @@ class Window(Generic[T]):
         return items
 
 
+class InflightQueue(Generic[T]):
+    """Bounded FIFO of in-flight async work — the double-buffer behind the
+    solver's pipelined dispatch (service/server.py SolvePipeline).
+
+    ``push(item)`` appends and returns the items evicted past ``depth``
+    (oldest first) for the caller to finalize; ``pop_to(target)`` pops down
+    to ``target`` for idle drains.  Finalization itself stays with the
+    caller — this class only owns the ordering and the depth bound, so a
+    finalizer that blocks (a device fence) never runs under any lock here.
+    ``on_depth`` fires with the new depth after every change (metrics
+    gauge hook).  Single-producer: the pipeline's dispatcher thread.
+    """
+
+    def __init__(self, depth: int = 2,
+                 on_depth: Optional[Callable[[int], None]] = None) -> None:
+        self.depth = max(1, depth)
+        self._q: "deque[T]" = deque()
+        self._on_depth = on_depth
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def _notify(self) -> None:
+        if self._on_depth is not None:
+            self._on_depth(len(self._q))
+
+    def push(self, item: T) -> List[T]:
+        self._q.append(item)
+        evicted: List[T] = []
+        while len(self._q) > self.depth:
+            evicted.append(self._q.popleft())
+        self._notify()
+        return evicted
+
+    def pop_to(self, target: int = 0) -> List[T]:
+        out: List[T] = []
+        while len(self._q) > target:
+            out.append(self._q.popleft())
+        if out:
+            self._notify()
+        return out
+
+
 @dataclass
 class _Bucket(Generic[T, U]):
     requests: List[T] = field(default_factory=list)

@@ -126,6 +126,10 @@ SOLVER_DEVICE_HEALTHY = "karpenter_solver_device_healthy"
 SOLVER_DEGRADED_SOLVES = "karpenter_solver_degraded_solves_total"
 REMOTE_FALLBACK_SOLVES = "karpenter_solver_remote_fallback_solves_total"
 REMOTE_DEGRADED = "karpenter_solver_remote_degraded"
+TENSORIZE_CACHE_HITS = "karpenter_solver_tensorize_cache_hits_total"
+TENSORIZE_CACHE_MISSES = "karpenter_solver_tensorize_cache_misses_total"
+TENSORIZE_DURATION = "karpenter_solver_tensorize_duration_seconds"
+INFLIGHT_DEPTH = "karpenter_solver_inflight_depth"
 
 #: metric inventory: name -> (type, labels, help).  docs/METRICS.md is
 #: generated from this table (``karpenter-tpu metrics-doc``), mirroring the
@@ -169,7 +173,11 @@ INVENTORY = {
         "Pending pods per provisioning batch window."),
     SOLVER_BACKEND_DURATION: (
         "histogram", ("backend",),
-        "Per-backend (tpu / native / oracle) solve duration, seconds."),
+        "Per-backend (tpu / native / oracle) solve duration, seconds.  On "
+        "the pipelined path (SolvePipeline) the tpu series spans dispatch "
+        "to fence and therefore includes the overlap window in which the "
+        "host tensorizes the NEXT batch — it is the caller-visible stage "
+        "latency, not pure device time (see docs/PROFILE.md round 6)."),
     SOLVER_COMPILE_IN_PROGRESS: (
         "gauge", (),
         "Background XLA compiles currently in flight (compile-behind + "
@@ -202,6 +210,25 @@ INVENTORY = {
         "gauge", (),
         "1 while the remote solver sidecar is unreachable and solves "
         "degrade to the local fallback; 0 when connected."),
+    TENSORIZE_CACHE_HITS: (
+        "counter", ("tier",),
+        "Tensorize cache hits by tier: 'identity' (same pod objects re-"
+        "solved, pointer-compare fast path) or 'shape' (same deployment "
+        "shapes, tensors reused, only the counts vector rebuilt).  A "
+        "healthy steady-state provisioning loop runs >90% hits."),
+    TENSORIZE_CACHE_MISSES: (
+        "counter", (),
+        "Tensorize cache misses (full host tensor build — new batch shape "
+        "or a provisioner/catalog/daemonset change rotated the context)."),
+    TENSORIZE_DURATION: (
+        "histogram", (),
+        "Host tensorize (pods -> device tensors) duration per solver wave, "
+        "seconds; cache hits land in the lowest buckets."),
+    INFLIGHT_DEPTH: (
+        "gauge", ("backend",),
+        "Async device dispatches currently in flight in each backend's "
+        "solve pipeline (double-buffered dispatch overlaps host tensorize "
+        "of batch N+1 with device execution of batch N)."),
 }
 
 

@@ -10,18 +10,141 @@ Run standalone:  python -m karpenter_tpu.service.server --port 50151
 from __future__ import annotations
 
 import argparse
+import os
+import queue
+import threading
 import time
 from concurrent import futures
+from concurrent.futures import Future
 from typing import Optional
 
 import grpc
 
-from ..metrics import Registry, registry as default_registry
+from ..batcher import InflightQueue
+from ..metrics import INFLIGHT_DEPTH, Registry, registry as default_registry
 from ..solver.scheduler import BatchScheduler
 from . import codec
 from . import solver_pb2 as pb
 
 SERVICE = "karpenter.tpu.Solver"
+
+
+class SolvePipeline:
+    """Double-buffered solve dispatch for one scheduler.
+
+    All scheduler access funnels through ONE dispatcher thread (the
+    scheduler is not re-entrant — concurrent RPC handlers previously raced
+    on it), and device dispatch is pipelined: the dispatcher calls
+    ``scheduler.submit`` (host tensorize + async device dispatch, returns
+    before the fence), immediately picks up the NEXT queued request, and
+    only fences batch N when the in-flight queue is past ``depth`` or the
+    inbound queue drains.  Host tensorize of batch N+1 therefore overlaps
+    device execution of batch N; each response still carries its own honest
+    one-RTT-fenced ``solve_ms`` (PendingTpuSolve.result semantics).
+    Finalization is FIFO, so responses keep arrival order.
+    """
+
+    def __init__(self, scheduler: BatchScheduler,
+                 registry: Optional[Registry] = None, depth: int = 2) -> None:
+        self.scheduler = scheduler
+        self.registry = registry or default_registry
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._submit_lock = threading.Lock()  # makes stop-check + put atomic
+        #: the future whose fence the dispatcher is currently blocked on —
+        #: readable by stop() so a wedged fence can't strand its RPC thread
+        self._finalizing: Optional[Future] = None
+        gauge = self.registry.gauge(INFLIGHT_DEPTH)
+        labels = {"backend": scheduler.backend}  # one series per pipeline
+        gauge.set(0, labels)
+        self._inflight: InflightQueue = InflightQueue(
+            depth=depth, on_depth=lambda d: gauge.set(d, labels))
+        self._thread = threading.Thread(
+            target=self._loop, name="solve-pipeline", daemon=True)
+        self._thread.start()
+
+    def solve(self, kwargs: dict):
+        """RPC-thread entry: enqueue and block for this request's result."""
+        fut: Future = Future()
+        # the stop-check and the put are one atomic step: a put that wins
+        # the lock before stop()'s drain is guaranteed to be seen by the
+        # drain; a put that loses sees _stop and refuses — either way no
+        # future is ever left unresolved (an RPC thread blocked forever on
+        # fut.result() would pin process exit)
+        with self._submit_lock:
+            if self._stop.is_set():
+                raise RuntimeError("solve pipeline stopped")
+            self._q.put((kwargs, fut))
+        return fut.result()
+
+    def stop(self) -> None:
+        """Stop the dispatcher.  Requests still queued OR in flight are
+        FAILED, not abandoned — a blocked RPC thread waiting on an
+        unresolved future would pin process exit forever."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # dispatcher wedged (e.g. a device fence behind a dead tunnel,
+            # forced backend so no guard): fail everything still in flight
+            # so the RPC threads unblock; the daemon dispatcher thread
+            # itself cannot pin exit.  deque ops are thread-safe, and the
+            # entry the wedged thread already popped is covered by
+            # _finalizing below.
+            for _pending, fut in self._inflight.pop_to(0):
+                if not fut.done():
+                    fut.set_exception(RuntimeError("solve pipeline stopped"))
+            current = self._finalizing
+            if current is not None and not current.done():
+                current.set_exception(RuntimeError("solve pipeline stopped"))
+        with self._submit_lock:
+            while True:
+                try:
+                    _kwargs, fut = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if not fut.done():
+                    fut.set_exception(RuntimeError("solve pipeline stopped"))
+
+    def _finalize(self, pending, fut: Future) -> None:
+        self._finalizing = fut
+        try:
+            try:
+                result = pending.result()
+            except BaseException as err:  # noqa: BLE001 — fan to the RPC
+                if not fut.done():
+                    fut.set_exception(err)
+                return
+            if not fut.done():
+                fut.set_result(result)
+        finally:
+            self._finalizing = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                kwargs, fut = self._q.get(timeout=0.1)
+            except queue.Empty:
+                for pending, f in self._inflight.pop_to(0):
+                    self._finalize(pending, f)
+                continue
+            try:
+                pending = self.scheduler.submit(
+                    kwargs.pop("pods"), kwargs.pop("provisioners"),
+                    kwargs.pop("instance_types"), **kwargs,
+                )
+            except BaseException as err:  # noqa: BLE001
+                if not fut.done():
+                    fut.set_exception(err)
+                continue
+            for done_pending, done_fut in self._inflight.push((pending, fut)):
+                self._finalize(done_pending, done_fut)
+            if self._q.empty():
+                # no overlap work available: drain so this caller's latency
+                # is one dispatch + one fence, exactly the unpipelined path
+                for done_pending, done_fut in self._inflight.pop_to(0):
+                    self._finalize(done_pending, done_fut)
+        for done_pending, done_fut in self._inflight.pop_to(0):
+            self._finalize(done_pending, done_fut)
 
 
 class SolverService:
@@ -30,24 +153,49 @@ class SolverService:
         self.registry = registry or default_registry
         self.scheduler = scheduler or BatchScheduler(registry=self.registry)
         self._schedulers = {"": self.scheduler}
+        # KT_SOLVE_PIPELINE=0 falls back to direct, lock-serialized solves
+        self._pipelined = os.environ.get("KT_SOLVE_PIPELINE", "1") != "0"
+        self._pipelines: dict = {}
+        self._direct_lock = threading.Lock()
 
     def _scheduler_for(self, backend: str) -> BatchScheduler:
         if backend and backend != self.scheduler.backend:
-            if backend not in self._schedulers:
-                self._schedulers[backend] = BatchScheduler(
-                    backend=backend, registry=self.registry
-                )
-            return self._schedulers[backend]
+            # locked check-then-create: two concurrent first RPCs for the
+            # same backend must share ONE scheduler (and therefore one
+            # pipeline — _pipeline_for keys on the scheduler instance; a
+            # lost race here would leak a live dispatcher thread forever)
+            with self._direct_lock:
+                if backend not in self._schedulers:
+                    self._schedulers[backend] = BatchScheduler(
+                        backend=backend, registry=self.registry
+                    )
+                return self._schedulers[backend]
         return self.scheduler
+
+    def _pipeline_for(self, sched: BatchScheduler) -> SolvePipeline:
+        with self._direct_lock:  # concurrent first RPCs must share one pipe
+            pipe = self._pipelines.get(id(sched))
+            if pipe is None:
+                pipe = SolvePipeline(sched, registry=self.registry)
+                self._pipelines[id(sched)] = pipe
+            return pipe
+
+    def close(self) -> None:
+        for pipe in self._pipelines.values():
+            pipe.stop()
 
     # ---- RPC methods -----------------------------------------------------
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
         kwargs = codec.decode_request(request)
         sched = self._scheduler_for(request.backend)
-        result = sched.solve(
-            kwargs.pop("pods"), kwargs.pop("provisioners"), kwargs.pop("instance_types"),
-            **kwargs,
-        )
+        if self._pipelined:
+            result = self._pipeline_for(sched).solve(kwargs)
+        else:
+            with self._direct_lock:
+                result = sched.solve(
+                    kwargs.pop("pods"), kwargs.pop("provisioners"),
+                    kwargs.pop("instance_types"), **kwargs,
+                )
         return codec.encode_response(result)
 
     def Warm(self, request: pb.WarmRequest, context) -> pb.WarmResponse:
@@ -124,6 +272,7 @@ def main(argv=None) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         server.stop(grace=2.0)
+        service.close()
         for sched in service._schedulers.values():
             sched.stop_warms()
     return 0

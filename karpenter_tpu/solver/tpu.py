@@ -1333,6 +1333,71 @@ class TpuSolver:
 
         return run, init, NE
 
+    def _prepare_dispatch(
+        self, st: SolveTensors, existing_nodes, max_nodes,
+        track_assignments: bool, mesh, full_nr: bool,
+    ):
+        """Shared dispatch preamble for ``solve`` and ``solve_async`` —
+        the SINGLE source of the dims/bucketing/exhausted-promotion steps,
+        so the synchronous and pipelined paths can never run different
+        programs for the same batch.  Returns
+        ``(run, init, NE, est_dims, full_dims, full_nr)``; ``run(init)``
+        has NOT been called."""
+        a, b = _mesh_divs(mesh)
+        NE0 = len(existing_nodes)
+        node_budget = _node_budget(st, NE0, max_nodes)
+        est_dims = solve_dims(st, NE=NE0, node_budget=node_budget, a=a, b=b,
+                              track=track_assignments)
+        full_dims = solve_dims(st, NE=NE0, node_budget=node_budget, a=a, b=b,
+                               track=track_assignments, full_nr=True)
+        if not full_nr:
+            # shape families that exhausted the optimistic NR before go
+            # straight to the full program (see _nr_exhausted)
+            with self._lock:
+                full_nr = _dims_key(est_dims) in self._nr_exhausted
+        run, init, NE = self.prepare(
+            st, existing_nodes=existing_nodes, max_nodes=max_nodes,
+            track_assignments=track_assignments, mesh=mesh, full_nr=full_nr,
+        )
+        return run, init, NE, est_dims, full_dims, full_nr
+
+    def _maybe_retry_exhausted(
+        self, carry, est_dims: dict, full_dims: dict, full_nr: bool,
+        raise_on_exhaust: bool, retry,
+    ) -> Optional["TpuSolveOutput"]:
+        """Slot-exhaustion epilogue, the SINGLE source of the retry protocol
+        shared by ``solve`` and ``PendingTpuSolve.result``: when the
+        optimistic NR axis genuinely ran out of node slots AND left pods
+        unplaced, remember the shape family (``_nr_exhausted``), honor
+        ``raise_on_exhaust`` (the compile-behind contract), register the
+        inline full-budget compile so a concurrent ``warm_async`` of the
+        same shape doesn't spawn a duplicate XLA compile, and run
+        ``retry()`` (a full-budget re-solve).  Returns None when the solve
+        stands.  Rare by construction — the estimate is doubled — so steady
+        state keeps the small fast program."""
+        if full_nr or est_dims["NR"] >= full_dims["NR"]:
+            return None
+        n_used_v = int(np.asarray(carry[7]))
+        infeasible_v = int(np.asarray(carry[11]).sum())
+        if n_used_v < est_dims["NR"] or infeasible_v <= 0:
+            return None
+        full_key = _dims_key(full_dims)
+        with self._lock:
+            self._nr_exhausted.add(_dims_key(est_dims))
+            full_ready = full_key in self._ready
+        if raise_on_exhaust and not full_ready:
+            raise SlotsExhausted(full_key)
+        with self._lock:
+            inline_compile = full_key not in self._compiling
+            if inline_compile:
+                self._compiling.add(full_key)
+        try:
+            return retry()
+        finally:
+            if inline_compile:
+                with self._lock:
+                    self._compiling.discard(full_key)
+
     def solve(
         self,
         st: SolveTensors,
@@ -1356,21 +1421,8 @@ class TpuSolver:
         the full program compiles behind (the 'callers must never eat a cold
         compile' contract)."""
         t0 = time.perf_counter()
-        a, b = _mesh_divs(mesh)
-        NE0 = len(existing_nodes)
-        node_budget = _node_budget(st, NE0, max_nodes)
-        est_dims = solve_dims(st, NE=NE0, node_budget=node_budget, a=a, b=b,
-                              track=track_assignments)
-        full_dims = solve_dims(st, NE=NE0, node_budget=node_budget, a=a, b=b,
-                               track=track_assignments, full_nr=True)
-        if not full_nr:
-            # shape families that exhausted the optimistic NR before go
-            # straight to the full program (see _nr_exhausted)
-            with self._lock:
-                full_nr = _dims_key(est_dims) in self._nr_exhausted
-        run, init, NE = self.prepare(
-            st, existing_nodes=existing_nodes, max_nodes=max_nodes,
-            track_assignments=track_assignments, mesh=mesh, full_nr=full_nr,
+        run, init, NE, est_dims, full_dims, full_nr = self._prepare_dispatch(
+            st, existing_nodes, max_nodes, track_assignments, mesh, full_nr,
         )
         carry, ys = run(init)
         np.asarray(carry[7])  # D2H fence; see timing note below
@@ -1382,37 +1434,17 @@ class TpuSolver:
         self._mark_ready(_dims_key(full_dims if full_nr else est_dims))
 
         # slot-exhaustion retry: NR is sized by an optimistic estimate
-        # (_nr_estimate); when the scan genuinely ran out of node slots AND
-        # left pods unplaced, re-solve once with the worst-case axis.  Rare
-        # by construction (the estimate is doubled), so steady state keeps
-        # the small fast program.
-        if not full_nr and est_dims["NR"] < full_dims["NR"]:
-            n_used_v = int(np.asarray(carry[7]))
-            infeasible_v = int(np.asarray(carry[11]).sum())
-            if n_used_v >= est_dims["NR"] and infeasible_v > 0:
-                full_key = _dims_key(full_dims)
-                with self._lock:
-                    self._nr_exhausted.add(_dims_key(est_dims))
-                    full_ready = full_key in self._ready
-                if raise_on_exhaust and not full_ready:
-                    raise SlotsExhausted(full_key)
-                # register the inline full-budget compile so a concurrent
-                # warm_async of the same shape doesn't spawn a duplicate
-                # XLA compile of the identical program
-                with self._lock:
-                    inline_compile = full_key not in self._compiling
-                    if inline_compile:
-                        self._compiling.add(full_key)
-                try:
-                    return self.solve(
-                        st, existing_nodes=existing_nodes, max_nodes=max_nodes,
-                        track_assignments=track_assignments, mesh=mesh,
-                        measure=measure, full_nr=True,
-                    )
-                finally:
-                    if inline_compile:
-                        with self._lock:
-                            self._compiling.discard(full_key)
+        # (_nr_estimate); see _maybe_retry_exhausted for the protocol
+        retried = self._maybe_retry_exhausted(
+            carry, est_dims, full_dims, full_nr, raise_on_exhaust,
+            lambda: self.solve(
+                st, existing_nodes=existing_nodes, max_nodes=max_nodes,
+                track_assignments=track_assignments, mesh=mesh,
+                measure=measure, full_nr=True,
+            ),
+        )
+        if retried is not None:
+            return retried
 
         if measure:
             # Timing run, results discarded.  Two quirks of the tunneled
@@ -1430,6 +1462,44 @@ class TpuSolver:
         return self._extract(
             st, carry, ys if track_assignments else None, existing_nodes,
             NE, solve_ms, compile_ms,
+        )
+
+    def solve_async(
+        self,
+        st: SolveTensors,
+        *,
+        existing_nodes: Sequence[SimNode] = (),
+        max_nodes: Optional[int] = None,
+        track_assignments: bool = True,
+        mesh=None,
+        raise_on_exhaust: bool = False,
+    ) -> "PendingTpuSolve":
+        """Dispatch one device solve WITHOUT fencing.
+
+        JAX dispatch is asynchronous: ``run(init)`` enqueues the H2D
+        transfers (double-buffered ``device_put`` of this batch's tensors)
+        and the scan, then returns while the device may still be executing
+        the PREVIOUS batch.  The caller keeps the host free — typically to
+        tensorize batch N+1 while batch N computes — and later calls
+        :meth:`PendingTpuSolve.result` to fence and extract.  Callers are
+        expected to dispatch only shapes that are already compiled
+        (``ready()``); a cold shape compiles inline at dispatch, stalling
+        the pipeline exactly like a cold ``solve`` would."""
+        t0 = time.perf_counter()
+        run, init, NE, est_dims, full_dims, full_nr = self._prepare_dispatch(
+            st, existing_nodes, max_nodes, track_assignments, mesh,
+            full_nr=False,
+        )
+        carry, ys = run(init)  # async: enqueued, not fenced
+        return PendingTpuSolve(
+            solver=self, st=st, existing_nodes=existing_nodes, NE=NE,
+            carry=carry, ys=ys, t0=t0, track=track_assignments,
+            est_dims=est_dims, full_dims=full_dims, full_nr=full_nr,
+            raise_on_exhaust=raise_on_exhaust,
+            solve_kwargs=dict(
+                existing_nodes=existing_nodes, max_nodes=max_nodes,
+                track_assignments=track_assignments, mesh=mesh,
+            ),
         )
 
     # ---- result extraction ---------------------------------------------
@@ -1538,6 +1608,61 @@ class TpuSolver:
         if di < 0:
             return ""
         return st.ct_names[di % n_ct]
+
+
+class PendingTpuSolve:
+    """Handle for an async-dispatched device solve (``TpuSolver.solve_async``).
+
+    ``result()`` performs the honest one-RTT D2H fence, then extraction.
+    The published ``solve_ms`` spans dispatch start → fence completion, so
+    it keeps exactly one tunnel RTT by construction and honestly includes
+    any device queue wait behind an earlier in-flight batch (the
+    caller-visible latency of the pipelined solve).  ``result()`` is
+    idempotent; the slot-exhaustion retry semantics match ``solve``
+    (including ``raise_on_exhaust`` for the compile-behind contract).
+    """
+
+    def __init__(self, solver, st, existing_nodes, NE, carry, ys, t0, track,
+                 est_dims, full_dims, full_nr, raise_on_exhaust,
+                 solve_kwargs) -> None:
+        self.solver = solver
+        self.st = st
+        self.existing_nodes = existing_nodes
+        self.NE = NE
+        self.carry = carry
+        self.ys = ys
+        self.t0 = t0
+        self.track = track
+        self.est_dims = est_dims
+        self.full_dims = full_dims
+        self.full_nr = full_nr
+        self.raise_on_exhaust = raise_on_exhaust
+        self.solve_kwargs = solve_kwargs
+        self._out: Optional[TpuSolveOutput] = None
+
+    def result(self) -> TpuSolveOutput:
+        if self._out is not None:
+            return self._out
+        s = self.solver
+        np.asarray(self.carry[7])  # the one-RTT D2H fence
+        elapsed_ms = (time.perf_counter() - self.t0) * 1000.0
+        s._mark_ready(_dims_key(self.full_dims if self.full_nr
+                                else self.est_dims))
+        # slot-exhaustion retry: the async handle resolves to a synchronous
+        # full-budget re-solve via the same shared protocol as solve()
+        retried = s._maybe_retry_exhausted(
+            self.carry, self.est_dims, self.full_dims, self.full_nr,
+            self.raise_on_exhaust,
+            lambda: s.solve(self.st, full_nr=True, **self.solve_kwargs),
+        )
+        if retried is not None:
+            self._out = retried
+            return retried
+        self._out = s._extract(
+            self.st, self.carry, self.ys if self.track else None,
+            self.existing_nodes, self.NE, elapsed_ms, elapsed_ms,
+        )
+        return self._out
 
 
 _default_solver = TpuSolver()

@@ -96,6 +96,14 @@ class SolveResult:
     infeasible: Dict[str, str]              # pod name -> reason
     existing_nodes: List[SimNode] = field(default_factory=list)
     solve_ms: float = 0.0
+    #: host tensorize time spent producing this result (all waves), ms
+    tensorize_ms: float = 0.0
+    #: any wave was served by a transient cold-tier fallback (compile-behind
+    #: / slots-exhausted).  Carried on the result — not on the scheduler —
+    #: so pipelined solves in flight together can't clobber each other's
+    #: flag; the reseat epilogue skips polished cold answers (they are
+    #: superseded once the device program compiles).
+    served_cold: bool = False
 
     @property
     def new_node_cost(self) -> float:

@@ -4,7 +4,12 @@ The pytest gates (tests/test_fuzz_parity.py) assert per-seed ceilings and a
 mean band; this prints the actual per-seed ratios so a scoring change can be
 judged on the whole distribution before touching the ceilings.
 
-    python scripts/fuzz_sweep.py [plain,existing,kubelet] [n_seeds]
+    python scripts/fuzz_sweep.py [plain,existing,kubelet] [n_seeds] [--cached]
+
+``--cached`` re-solves every scenario a second time through ONE scheduler
+instance, so the second pass runs the incremental tensorize cache
+(identity tier) — the sweep then also asserts the cached solve schedules
+the same pods at the same cost and prints the hit/miss totals.
 
 CPU-pinned and repo-rooted; safe to run while the TPU tunnel is down.
 """
@@ -26,13 +31,16 @@ from karpenter_tpu.models.catalog import generate_catalog
 from karpenter_tpu.solver import reference
 from karpenter_tpu.solver.scheduler import BatchScheduler
 
+argv = [a for a in sys.argv[1:] if a != "--cached"]
+cached = "--cached" in sys.argv[1:]
 catalog = generate_catalog(full=False)
-suites = sys.argv[1].split(",") if len(sys.argv) > 1 else ["plain", "existing", "kubelet"]
-n_seeds = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+suites = argv[0].split(",") if len(argv) > 0 else ["plain", "existing", "kubelet"]
+n_seeds = int(argv[1]) if len(argv) > 1 else 40
 
 for suite in suites:
     ratios = {}
     invalid = {}
+    sched = BatchScheduler(backend="tpu") if cached else None
     for seed in range(n_seeds):
         pods, provs, unavailable = random_scenario(seed, catalog)
         kw = {}
@@ -43,8 +51,20 @@ for suite in suites:
         if suite == "existing":
             kw["existing_nodes"] = random_existing_nodes(seed, catalog, provs)
         oracle = reference.solve(pods, provs, catalog, unavailable=unavailable, **kw)
-        tpu = BatchScheduler(backend="tpu").solve(
+        solver = sched or BatchScheduler(backend="tpu")
+        tpu = solver.solve(
             pods, provs, catalog, unavailable=unavailable, **kw)
+        if cached:
+            # second pass: same pod objects through the same scheduler —
+            # identity-tier tensorize cache; the answer must not move
+            tpu2 = solver.solve(
+                pods, provs, catalog, unavailable=unavailable, **kw)
+            if (tpu2.n_scheduled != tpu.n_scheduled
+                    or abs(tpu2.new_node_cost - tpu.new_node_cost) > 1e-6):
+                invalid.setdefault(seed, []).append(
+                    f"cached re-solve diverged: {tpu2.n_scheduled} pods "
+                    f"${tpu2.new_node_cost:.3f} vs {tpu.n_scheduled} "
+                    f"${tpu.new_node_cost:.3f}")
         errs = validate_solution(pods, provs, tpu, catalog)
         if errs:
             invalid[seed] = errs[:2]
@@ -59,6 +79,10 @@ for suite in suites:
     vals = list(ratios.values())
     mean = sum(vals) / max(len(vals), 1)
     worst = sorted(ratios.items(), key=lambda kv: -kv[1])[:5]
-    print(f"{suite}: n={len(vals)} mean={mean:.4f} worst={worst}")
+    extra = ""
+    if cached and sched is not None and sched._tensorize_cache is not None:
+        c = sched._tensorize_cache
+        extra = f" cache_hits={c.hits} misses={c.misses}"
+    print(f"{suite}: n={len(vals)} mean={mean:.4f} worst={worst}{extra}")
     if invalid:
         print(f"  INVALID: {invalid}")

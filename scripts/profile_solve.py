@@ -151,13 +151,32 @@ def main(argv=None) -> int:
     out["tunnel_rtt_ms"] = {"min": round(min(rtts), 2),
                             "median": round(sorted(rtts)[len(rtts) // 2], 2)}
 
-    # 2. host tensorize
+    # 2. host tensorize: from-scratch, then through the incremental cache
+    # (steady state = identity tier: the provisioning loop re-offering the
+    # same pending set; shape tier = fresh pod objects, same shapes)
+    from karpenter_tpu.models.tensorize import TensorizeCache
+
     pods, provs, catalog = build_scenario()
     if args.pods != 50_000:
         pods = pods[:args.pods]
     t0 = time.perf_counter()
     st = tensorize(pods, provs, catalog)
     out["tensorize_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+    cache = TensorizeCache()
+    t0 = time.perf_counter()
+    cache.tensorize(pods, provs, catalog)
+    out["tensorize_cold_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+    t0 = time.perf_counter()
+    _st2, tier = cache.tensorize(pods, provs, catalog)
+    out["tensorize_steady_ms"] = round((time.perf_counter() - t0) * 1000.0, 2)
+    out["tensorize_steady_tier"] = tier
+    pods_fresh = build_scenario()[0]
+    if args.pods != 50_000:
+        pods_fresh = pods_fresh[:args.pods]
+    t0 = time.perf_counter()
+    _st3, tier3 = cache.tensorize(pods_fresh, provs, catalog)
+    out["tensorize_shape_hit_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+    out["tensorize_shape_tier"] = tier3
 
     # 3. compile + fenced steady-state timings
     solver = TpuSolver()

@@ -91,6 +91,43 @@ def test_driver_wrapped_artifact_parsed(tmp_path):
     assert any("warm" in f for f in out["regression_flags"])
 
 
+class TestBudgetGate:
+    """Absolute per-round budgets (bench.check_budgets): steady-state
+    tensorize under threshold, cached-path byte parity, FFD cost parity."""
+
+    BASE = {"tensorize_steady_ms": 3.2, "tensorize_parity": True,
+            "cost_ratio_vs_ffd": 0.99,
+            "tensorize_cold_ms": 200.0, "tensorize_shape_ms": 110.0}
+
+    def test_within_budgets_clean(self):
+        assert benchmod.check_budgets(dict(self.BASE)) == {}
+
+    def test_steady_tensorize_over_budget_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.BASE, tensorize_steady_ms=31.0))
+        assert any("tensorize" in f for f in out["budget_flags"])
+
+    def test_shape_tier_regression_flagged(self):
+        # the shape tier (fresh objects) regressing back toward the cold
+        # build must trip the gate even while the identity tier stays fast
+        out = benchmod.check_budgets(
+            dict(self.BASE, tensorize_shape_ms=190.0))
+        assert any("shape-tier" in f for f in out["budget_flags"])
+
+    def test_parity_break_flagged(self):
+        out = benchmod.check_budgets(dict(self.BASE, tensorize_parity=False))
+        assert any("diverged" in f for f in out["budget_flags"])
+
+    def test_cost_ratio_over_ceiling_flagged(self):
+        out = benchmod.check_budgets(dict(self.BASE, cost_ratio_vs_ffd=1.03))
+        assert any("cost_ratio" in f for f in out["budget_flags"])
+
+    def test_missing_fields_not_flagged(self):
+        # records from before the cached-tensorize round carry none of the
+        # new fields; the gate must not fire on their absence
+        assert benchmod.check_budgets({"value": 100.0}) == {}
+
+
 def test_errored_prior_skipped(tmp_path):
     _write_prior(tmp_path, 3)
     (tmp_path / "BENCH_r04.json").write_text(json.dumps(

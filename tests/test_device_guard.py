@@ -163,7 +163,9 @@ class TestSchedulerDegradation:
         calls = []
 
         def spy(self, result, *a, **k):
-            calls.append(self._served_cold)
+            # served_cold rides on the RESULT (pipelined solves in flight
+            # together must not clobber a shared scheduler flag)
+            calls.append(result.served_cold)
             return None
 
         monkeypatch.setattr(BatchScheduler, "_reseat_capped", spy)
