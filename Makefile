@@ -2,7 +2,7 @@ PYTHON ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
 
-.PHONY: all test native proto bench clean battletest
+.PHONY: all test native proto bench clean battletest lint
 
 all: native proto
 
@@ -19,11 +19,20 @@ karpenter_tpu/service/solver_pb2.py: karpenter_tpu/service/solver.proto
 test:
 	$(PYTHON) -m pytest tests/ -x -q
 
+# ktlint: the repo-specific AST analyzer (rule catalog in docs/ANALYSIS.md);
+# exits non-zero on any unsuppressed KT001-KT006 finding
+lint:
+	$(PYTHON) -m karpenter_tpu.analysis
+
 # the reference's battletest analog (Makefile:69-76: -race + randomized
-# order + random delays): widened seeded churn/fuzz/race sweep, then the suite
-battletest:
-	KT_BATTLE_SEEDS=24 KT_FUZZ_SEEDS=40 $(PYTHON) -m pytest tests/test_battle.py tests/test_fuzz_parity.py -q
-	$(PYTHON) -m pytest tests/ -q
+# order + random delays): lint gate, then widened seeded churn/fuzz/race
+# sweep and the suite, both under KT_SANITIZE=1 — the lock-discipline
+# sanitizer (analysis/sanitize.py) wraps BatchScheduler / SolvePipeline /
+# InflightQueue / TensorizeCache in lock-assertion proxies that raise on
+# cross-thread re-entrancy (the -race analog for our threading contracts)
+battletest: lint
+	KT_SANITIZE=1 KT_BATTLE_SEEDS=24 KT_FUZZ_SEEDS=40 $(PYTHON) -m pytest tests/test_battle.py tests/test_fuzz_parity.py -q
+	KT_SANITIZE=1 $(PYTHON) -m pytest tests/ -q
 
 bench:
 	$(PYTHON) bench.py

@@ -326,7 +326,10 @@ def check_budgets(rec):
 
 
 def _tensors_identical(a, b) -> bool:
-    """Byte-level equality of every ndarray field of two SolveTensors."""
+    """Equality of EVERY SolveTensors field — ndarrays byte-level, plus the
+    vocab/groups/scalar fields (a stale cache entry whose arrays match but
+    whose vocab mapping differs would decode wrong labels at extraction;
+    the published tensorize_parity gate must catch that too)."""
     import dataclasses
 
     import numpy as np
@@ -337,6 +340,16 @@ def _tensors_identical(a, b) -> bool:
             if (x.dtype != y.dtype or x.shape != y.shape
                     or not np.array_equal(x, y)):
                 return False
+        elif f.name == "vocab":
+            if (x.keys != y.keys or x.values != y.values
+                    or x.resources != y.resources):
+                return False
+        elif f.name == "groups":
+            if [g.key for g in x] != [g.key for g in y] or \
+                    [g.count for g in x] != [g.count for g in y]:
+                return False
+        elif x != y:
+            return False
     return True
 
 

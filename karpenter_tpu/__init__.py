@@ -30,3 +30,12 @@ if _os.environ.get("JAX_PLATFORMS"):
             "accelerator plugins may still initialize",
             RuntimeWarning,
         )
+
+# Lock-discipline sanitizer (docs/ANALYSIS.md): KT_SANITIZE=1 wraps the
+# thread-sensitive solver-path classes in lock-assertion proxies that raise
+# on cross-thread re-entrancy.  `make battletest` exports it; production
+# leaves it off.
+if _os.environ.get("KT_SANITIZE") == "1":
+    from .analysis import sanitize as _sanitize
+
+    _sanitize.install()

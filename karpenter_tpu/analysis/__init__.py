@@ -1,0 +1,20 @@
+"""Machine-checked solver invariants: the ktlint static analyzer (KT001-KT006)
+plus the runtime lock-discipline sanitizer (``KT_SANITIZE=1``).
+
+Run the analyzer: ``python -m karpenter_tpu.analysis`` (``make lint``).
+Rule catalog and annotation grammar: docs/ANALYSIS.md.
+
+``sanitize`` is deliberately NOT imported here — the analyzer is pure stdlib
+and must stay importable (and fast) without jax/grpc; the sanitizer pulls in
+the solver stack and is loaded on demand by ``karpenter_tpu.__init__`` when
+``KT_SANITIZE=1``.
+"""
+
+from .ktlint import (  # noqa: F401
+    Finding,
+    analyze_files,
+    analyze_package,
+    analyze_source,
+    load_source,
+    main,
+)

@@ -1,0 +1,10 @@
+"""``python -m karpenter_tpu.analysis`` — run ktlint over the package.
+
+Exits non-zero when any unsuppressed finding remains (``make lint`` /
+tier-1's ``tests/test_lint.py`` both gate on this).
+"""
+
+from .ktlint import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,300 @@
+"""ktlint — AST-level solver-invariant analyzer for karpenter_tpu.
+
+The vectorized solver only counts as fast if it stays *correct*: PR 1's
+threaded ``SolvePipeline`` + ``TensorizeCache`` introduced exactly the bug
+classes the round-5 advisor caught by hand (a scheduler re-entrancy race, a
+missed metric-label zero-init, sync-point drift).  This package encodes those
+invariants as machine-checked rules so every future perf PR is gated by
+``make lint`` / ``tests/test_lint.py`` instead of advisor archaeology.
+
+Rules (each lives in ``analysis/rules/kt00X.py``; catalog in
+``docs/ANALYSIS.md``):
+
+- **KT001** implicit host↔device sync in solver hot paths outside the fence
+  allowlist
+- **KT002** raw ``time.time()`` / ``time.monotonic()`` outside
+  ``utils/clock.py`` (must use the injectable clock)
+- **KT003** labeled counter series incremented somewhere but never
+  zero-inited (Prometheus ``rate()``/``increase()`` lose the first increment
+  of a series born at its first ``inc``)
+- **KT004** lock discipline: ``# guarded-by: <lock>``-declared attributes
+  accessed outside ``with self.<lock>:``
+- **KT005** broad ``except Exception`` that neither re-raises nor logs
+- **KT006** float64 / ``random`` nondeterminism inside jitted solver code
+
+Annotation grammar (shared by the rules):
+
+- suppression — ``# ktlint: allow[KT00X] <reason>`` on the finding line or
+  anywhere in the contiguous pure-comment block directly above it.  The
+  reason is mandatory; a bare ``allow[...]`` is itself reported (KT000) and
+  does not suppress.
+- fence — ``# ktlint: fence <why>`` on a ``def`` line (or anywhere in the
+  contiguous pure-comment block directly above it) marks the function as an
+  allowlisted host↔device sync point for KT001.
+- guarded-by — ``self._attr = ...  # guarded-by: _lock`` in a class body
+  declares that ``self._attr`` may only be touched inside
+  ``with self._lock:`` (KT004).
+
+This module is pure stdlib (``ast`` + ``re``) — importing it must never pull
+jax, so ``make lint`` stays sub-second and runs anywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*ktlint:\s*allow\[(?P<rule>KT\d{3})\](?:\s+(?P<reason>\S.*))?"
+)
+FENCE_RE = re.compile(r"#\s*ktlint:\s*fence\b")
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(?P<lock>\w+)")
+
+#: generated files are not ours to lint
+EXCLUDED_SUFFIXES = ("_pb2.py",)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        out = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """One parsed source file plus its ktlint annotations."""
+
+    path: str                  # slash-normalized, package-relative
+    text: str
+    lines: List[str]
+    tree: ast.AST
+    #: line -> {rule: reason} for well-formed suppressions
+    suppressions: Dict[int, Dict[str, str]]
+    #: lines carrying a malformed (reason-less) suppression
+    malformed: List[int]
+    #: ``def`` linenos annotated as KT001 fences
+    fence_lines: set
+
+
+def load_source(text: str, path: str) -> SourceFile:
+    tree = ast.parse(text, filename=path)
+    lines = text.splitlines()
+    suppressions: Dict[int, Dict[str, str]] = {}
+    malformed: List[int] = []
+    fence_comment_lines = set()
+    for i, line in enumerate(lines, 1):
+        m = SUPPRESS_RE.search(line)
+        if m:
+            if m.group("reason"):
+                suppressions.setdefault(i, {})[m.group("rule")] = m.group("reason")
+            else:
+                malformed.append(i)
+        if FENCE_RE.search(line):
+            fence_comment_lines.add(i)
+    # resolve fence comments to the def they annotate: same line as the def,
+    # or anywhere in the contiguous pure-comment block directly above it
+    # (fence reasons routinely wrap onto a second line)
+    fence_lines = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.lineno in fence_comment_lines:
+                fence_lines.add(node.lineno)
+                continue
+            line = node.lineno - 1
+            while _comment_only(lines, line):
+                if line in fence_comment_lines:
+                    fence_lines.add(node.lineno)
+                    break
+                line -= 1
+    return SourceFile(
+        path=path.replace("\\", "/"), text=text, lines=lines, tree=tree,
+        suppressions=suppressions, malformed=malformed,
+        fence_lines=fence_lines,
+    )
+
+
+def _comment_only(lines: List[str], lineno: int) -> bool:
+    if not 1 <= lineno <= len(lines):
+        return False
+    return lines[lineno - 1].lstrip().startswith("#")
+
+
+# ---- shared AST utilities ------------------------------------------------
+
+def parents_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def iter_functions(tree: ast.AST):
+    """Yield ``(qualname, node, nested)`` for every function; ``nested`` is
+    True when the function is defined inside another function (closures
+    belong to their enclosing method's scan)."""
+    out = []
+
+    def visit(node: ast.AST, prefix: str, in_func: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((q, child, in_func))
+                visit(child, q + ".", True)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", in_func)
+            else:
+                visit(child, prefix, in_func)
+
+    visit(tree, "", False)
+    return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---- driver --------------------------------------------------------------
+
+def all_rules():
+    from .rules import ALL_RULES
+
+    return ALL_RULES
+
+
+def analyze_files(
+    files: Sequence[SourceFile], rules=None,
+) -> Tuple[List[Finding], List[Finding]]:
+    """Run every rule over ``files``; returns ``(active, suppressed)``."""
+    raw: List[Finding] = []
+    for rule in rules if rules is not None else all_rules():
+        raw.extend(rule.check(files))
+    by_path = {f.path: f for f in files}
+    for f in files:
+        for line in f.malformed:
+            raw.append(Finding(
+                "KT000", f.path, line,
+                "malformed suppression: `# ktlint: allow[KT00X]` requires a "
+                "reason and does not suppress without one",
+                hint="write `# ktlint: allow[KT00X] <reason>`",
+            ))
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for fi in raw:
+        f = by_path.get(fi.path)
+        (suppressed if f is not None and _is_suppressed(f, fi) else
+         active).append(fi)
+    key = lambda fi: (fi.path, fi.line, fi.rule)  # noqa: E731
+    return sorted(active, key=key), sorted(suppressed, key=key)
+
+
+def _is_suppressed(f: SourceFile, finding: Finding) -> bool:
+    if finding.rule == "KT000":
+        return False  # the malformed-suppression report is not suppressible
+    if finding.rule in f.suppressions.get(finding.line, {}):
+        return True
+    # or anywhere in the contiguous pure-comment block directly above
+    line = finding.line - 1
+    while _comment_only(f.lines, line):
+        if finding.rule in f.suppressions.get(line, {}):
+            return True
+        line -= 1
+    return False
+
+
+def analyze_source(text: str, path: str, rules=None) -> List[Finding]:
+    """Fixture/test helper: analyze one in-memory source; active findings."""
+    active, _ = analyze_files([load_source(text, path)], rules=rules)
+    return active
+
+
+def package_root() -> Path:
+    return Path(__file__).resolve().parents[1]
+
+
+def collect_package_files(root: Optional[Path] = None) -> List[SourceFile]:
+    root = Path(root) if root is not None else package_root()
+    files: List[SourceFile] = []
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        if any(str(p).endswith(s) for s in EXCLUDED_SUFFIXES):
+            continue
+        rel = f"{root.name}/{p.relative_to(root).as_posix()}"
+        files.append(load_source(p.read_text(), rel))
+    return files
+
+
+def analyze_package(
+    root: Optional[Path] = None, rules=None,
+) -> Tuple[List[Finding], List[Finding], int]:
+    """Analyze the whole package; ``(active, suppressed, n_files)``."""
+    files = collect_package_files(root)
+    active, suppressed = analyze_files(files, rules=rules)
+    return active, suppressed, len(files)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="ktlint",
+        description="repo-specific AST analyzer (rule catalog: docs/ANALYSIS.md)",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: the package)")
+    parser.add_argument("--select", action="append", default=None,
+                        metavar="KT00X", help="run only these rule IDs")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings")
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.select:
+        want = set(args.select)
+        rules = [r for r in rules if r.ID in want]
+        unknown = want - {r.ID for r in rules}
+        if unknown:
+            parser.error(f"unknown rule id(s): {sorted(unknown)}")
+
+    if args.paths:
+        files = []
+        for raw in args.paths:
+            p = Path(raw)
+            if p.is_dir():
+                files.extend(collect_package_files(p))
+            else:
+                files.append(load_source(p.read_text(), str(p)))
+        active, suppressed = analyze_files(files, rules=rules)
+        n_files = len(files)
+    else:
+        active, suppressed, n_files = analyze_package(rules=rules)
+
+    for fi in active:
+        print(fi.format())
+    if args.show_suppressed:
+        for fi in suppressed:
+            print(f"[suppressed] {fi.format()}")
+    print(f"ktlint: {len(active)} finding(s), {len(suppressed)} suppressed, "
+          f"{n_files} file(s)")
+    return 1 if active else 0

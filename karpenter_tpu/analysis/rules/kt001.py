@@ -1,0 +1,125 @@
+"""KT001 — implicit host↔device sync in solver hot paths.
+
+JAX dispatch is asynchronous; the pipelined solve path (PR 1) depends on the
+host staying free between dispatch and fence so batch N+1 tensorizes while
+batch N executes.  A stray ``.block_until_ready()`` / ``float()`` / ``.item()``
+/ ``np.asarray()`` on a device value silently re-serializes the pipeline —
+sync-point drift, the round-5 advisor's third bug class.  Sync constructs in
+the hot-path files are therefore only allowed inside the *fence allowlist*:
+the functions whose entire job is to fence (``TpuSolver.solve``,
+``PendingTpuSolve.result``, extraction/retry epilogues), or any function
+annotated ``# ktlint: fence <why>`` on its ``def`` line.
+
+Device values are tracked with a light intra-function taint: names bound from
+``run(...)`` calls (the prepared device program) or from ``jnp.*``
+expressions, plus parameters named ``carry``/``ys`` (the solver's device
+carry convention).  Host-side numpy (``np.asarray(st.counts)``) stays
+untainted, so the rule does not cry wolf on tensorize code.
+
+The fence set lives IN THE SOURCE, not here: each allowed sync point carries
+``# ktlint: fence <why>`` on (or directly above) its ``def`` line, so the
+exemption and its reason sit next to the code they exempt and cannot go
+stale when a method is renamed or split.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..ktlint import Finding, SourceFile, dotted_name, iter_functions
+
+ID = "KT001"
+TITLE = "implicit host↔device sync outside the fence set"
+HINT = ("move the sync into a fence function, or annotate the def with "
+        "`# ktlint: fence <why>` if its body IS the sync point")
+
+#: files whose functions are solver hot paths (package-relative suffixes)
+HOT_SUFFIXES = ("solver/tpu.py", "solver/scheduler.py")
+
+#: parameter names treated as device-resident by convention
+TAINT_PARAMS = {"carry", "ys"}
+
+
+def _hot_suffix(path: str):
+    for s in HOT_SUFFIXES:
+        if path.endswith(s):
+            return s
+    return None
+
+
+def _expr_tainted(node: ast.AST, tainted: set) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+        if isinstance(n, ast.Attribute):
+            d = dotted_name(n)
+            if d is not None and d.split(".", 1)[0] == "jnp":
+                return True
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "run"):
+            return True
+    return False
+
+
+def _collect_taint(fn: ast.AST) -> set:
+    tainted = set()
+    for arg in getattr(fn, "args", None).args if hasattr(fn, "args") else ():
+        if arg.arg in TAINT_PARAMS:
+            tainted.add(arg.arg)
+    changed = True
+    while changed:
+        changed = False
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and _expr_tainted(n.value, tainted):
+                for t in n.targets:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name) and nm.id not in tainted:
+                            tainted.add(nm.id)
+                            changed = True
+    return tainted
+
+
+def check(files) -> List[Finding]:
+    out: List[Finding] = []
+    for f in files:
+        if _hot_suffix(f.path) is None:
+            continue
+        for qual, fn, nested in iter_functions(f.tree):
+            if nested:
+                continue  # closures scan with their enclosing method
+            if fn.lineno in f.fence_lines:
+                continue
+            out.extend(_scan(fn, f))
+    return out
+
+
+def _scan(fn: ast.AST, f: SourceFile) -> List[Finding]:
+    tainted = _collect_taint(fn)
+    out: List[Finding] = []
+
+    def finding(node: ast.AST, what: str) -> None:
+        out.append(Finding(
+            ID, f.path, node.lineno,
+            f"{what} is an implicit host↔device sync in a solver hot path "
+            "outside the fence allowlist", hint=HINT,
+        ))
+
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        func = n.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "block_until_ready":
+                finding(n, "`.block_until_ready()`")
+            elif func.attr == "item" and _expr_tainted(func.value, tainted):
+                finding(n, "`.item()` on a device value")
+            elif func.attr == "asarray":
+                root = dotted_name(func.value)
+                if (root in ("np", "numpy") and n.args
+                        and _expr_tainted(n.args[0], tainted)):
+                    finding(n, "`np.asarray()` on a device value")
+        elif (isinstance(func, ast.Name) and func.id == "float"
+              and n.args and _expr_tainted(n.args[0], tainted)):
+            finding(n, "`float()` on a device value")
+    return out

@@ -1,0 +1,99 @@
+"""KT006 — float64 / ``random`` nondeterminism inside jitted solver code.
+
+The device solver's parity contract with the CPU oracle (``tests/
+test_fuzz_parity.py``) is bit-honest only while the jitted programs stay
+float32 and deterministic: a float64 constant silently upcasts a whole
+lattice of intermediates (and TPUs demote to bf16/f32 anyway, so the CPU
+test and the device diverge), and Python/numpy ``random`` inside traced code
+is a tracer-time constant — it *looks* random and is baked in at compile,
+the worst kind of nondeterminism.  Scope: functions decorated with
+``jax.jit`` (including ``partial(jax.jit, ...)``), functions wrapped via
+``jax.jit(fn)``, and the kernel library files (``ops/masks.py``,
+``ops/feasibility.py``) whose every function is scan-body code.
+``jax.random`` is exempt — key-threaded randomness is deterministic by
+construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..ktlint import Finding, dotted_name
+
+ID = "KT006"
+TITLE = "float64/random nondeterminism in jitted solver code"
+HINT = ("keep jitted code float32 (the TPU demotes anyway and parity tests "
+        "compare against the oracle) and thread jax.random keys explicitly "
+        "instead of host randomness")
+
+KERNEL_SUFFIXES = ("ops/masks.py", "ops/feasibility.py")
+RANDOM_ROOTS = ("random.", "np.random", "numpy.random")
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for `jit`, `jax.jit`, `partial(jax.jit, ...)`, `jax.jit(...)`."""
+    if isinstance(node, ast.Name) and node.id == "jit":
+        return True
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+        return _is_jit_expr(f)
+    return False
+
+
+def _jit_scopes(tree: ast.AST) -> List[ast.AST]:
+    jit_wrapped_names: Set[str] = set()
+    for n in ast.walk(tree):
+        # jax.jit(fn)(...) / run = jax.jit(fn, ...) — fn becomes jitted
+        if (isinstance(n, ast.Call) and _is_jit_expr(n.func)
+                and not isinstance(n.func, ast.Call) and n.args
+                and isinstance(n.args[0], ast.Name)):
+            jit_wrapped_names.add(n.args[0].id)
+    scopes = []
+    for n in ast.walk(tree):
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if any(_is_jit_expr(d) for d in n.decorator_list):
+            scopes.append(n)
+        elif n.name in jit_wrapped_names:
+            scopes.append(n)
+    return scopes
+
+
+def _scan_scope(scope: ast.AST, f, seen: set, out: List[Finding]) -> None:
+    for n in ast.walk(scope):
+        key = None
+        if isinstance(n, ast.Attribute):
+            d = dotted_name(n)
+            if n.attr == "float64":
+                key = (n.lineno, "float64")
+                msg = "float64 dtype in jitted solver code"
+            elif d is not None and (
+                d.startswith("random.") or "np.random" in d
+                or "numpy.random" in d
+            ) and not d.startswith("jax."):
+                key = (n.lineno, "random")
+                msg = (f"host randomness `{d}` in jitted solver code "
+                       "(baked in at trace time)")
+        elif isinstance(n, ast.Constant) and n.value == "float64":
+            key = (n.lineno, "float64")
+            msg = "float64 dtype in jitted solver code"
+        if key is not None and key not in seen:
+            seen.add(key)
+            out.append(Finding(ID, f.path, key[0], msg, hint=HINT))
+
+
+def check(files) -> List[Finding]:
+    out: List[Finding] = []
+    for f in files:
+        seen: set = set()
+        if any(f.path.endswith(s) for s in KERNEL_SUFFIXES):
+            _scan_scope(f.tree, f, seen, out)
+            continue
+        for scope in _jit_scopes(f.tree):
+            _scan_scope(scope, f, seen, out)
+    return out

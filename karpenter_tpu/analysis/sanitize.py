@@ -1,0 +1,117 @@
+"""Runtime lock-discipline sanitizer (``KT_SANITIZE=1``).
+
+The static rules (KT004) check what annotations declare; this module checks
+what threads actually DO.  It wraps the mutating entry points of the four
+thread-sensitive solver-path classes in *lock-assertion proxies* that raise
+:class:`SanitizerError` the moment two threads are inside the same
+non-reentrant section of the same object — the PR 1 scheduler re-entrancy
+race (two concurrent ``Solve`` RPCs racing one ``BatchScheduler``) becomes a
+deterministic exception at the violation site instead of a corrupted solve
+three calls later.
+
+Guarded sections (one group per contract, per instance):
+
+- ``BatchScheduler.solve`` / ``.submit`` — the scheduler is not re-entrant:
+  all dispatch funnels through one thread at a time (``SolvePipeline``'s
+  dispatcher in the pipelined path; ``_direct_lock`` serialization in the
+  direct path).  Thread HANDOFF is legal (the pipeline is constructed on the
+  RPC thread, dispatches on its own) — only *concurrent* entry raises.
+- ``TensorizeCache.tensorize`` — documented "callers serialize solves".
+- ``InflightQueue.push`` — single producer (the dispatcher).  ``pop_to`` is
+  deliberately shared at shutdown (``SolvePipeline.stop`` drains a wedged
+  dispatcher's queue; deque ops are thread-safe), so it is not wrapped.
+- ``SolvePipeline._finalize`` — finalization is FIFO on the dispatcher;
+  a second concurrent finalizer means two threads fencing one queue.
+
+Enabled by exporting ``KT_SANITIZE=1`` before importing ``karpenter_tpu``
+(``make battletest`` does) or by calling :func:`install` directly (tests).
+The proxies add one dict lookup per call — cheap enough to leave on for the
+whole battletest sweep — and wrapping is idempotent; :func:`uninstall`
+restores the original methods.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+from typing import Dict, List, Tuple
+
+logger = logging.getLogger(__name__)
+
+#: serializes the per-object holder check; held only for the dict peek
+_STATE_LOCK = threading.Lock()
+
+_originals: Dict[Tuple[type, str], object] = {}
+
+
+class SanitizerError(AssertionError):
+    """Two threads entered a non-reentrant section of one object."""
+
+
+def _wrap(cls: type, name: str, group: str):
+    fn = cls.__dict__[name]
+    slot = f"_kt_san_{group}"
+
+    @functools.wraps(fn)
+    def guarded(self, *args, **kwargs):
+        me = threading.current_thread()
+        with _STATE_LOCK:
+            holder = getattr(self, slot, None)
+            if holder is not None and holder is not me:
+                raise SanitizerError(
+                    f"KT_SANITIZE: unguarded cross-thread mutation — "
+                    f"{cls.__name__}.{name} entered by {me.name!r} while "
+                    f"{holder.name!r} is still inside the {group!r} section "
+                    f"of the same object; this object's {group} contract is "
+                    "single-threaded (serialize callers or route through "
+                    "the pipeline dispatcher)"
+                )
+            reentrant = holder is me
+            setattr(self, slot, me)
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            if not reentrant:
+                with _STATE_LOCK:
+                    setattr(self, slot, None)
+
+    guarded._kt_sanitized = True  # type: ignore[attr-defined]
+    _originals.setdefault((cls, name), fn)
+    setattr(cls, name, guarded)
+
+
+def installed() -> bool:
+    return bool(_originals)
+
+
+def install() -> None:
+    """Wrap the solver-path classes in lock-assertion proxies (idempotent)."""
+    from ..batcher import InflightQueue
+    from ..models.tensorize import TensorizeCache
+    from ..solver.scheduler import BatchScheduler
+
+    plan: List[Tuple[type, str, str]] = [
+        (BatchScheduler, "solve", "dispatch"),
+        (BatchScheduler, "submit", "dispatch"),
+        (TensorizeCache, "tensorize", "tensorize"),
+        (InflightQueue, "push", "inflight-producer"),
+    ]
+    try:
+        from ..service.server import SolvePipeline
+    except ImportError as err:  # grpc-less install: everything else still on
+        logger.warning("KT_SANITIZE: SolvePipeline proxy skipped (%r)", err)
+    else:
+        plan.append((SolvePipeline, "_finalize", "finalize"))
+    for cls, name, group in plan:
+        if not getattr(cls.__dict__[name], "_kt_sanitized", False):
+            _wrap(cls, name, group)
+    logger.info("KT_SANITIZE: lock-assertion proxies installed on %d "
+                "methods", len(plan))
+
+
+def uninstall() -> None:
+    """Restore the original methods (test teardown)."""
+    for (cls, name), fn in _originals.items():
+        setattr(cls, name, fn)
+    _originals.clear()

@@ -98,14 +98,25 @@ class InflightQueue(Generic[T]):
         self._q.append(item)
         evicted: List[T] = []
         while len(self._q) > self.depth:
-            evicted.append(self._q.popleft())
+            try:
+                evicted.append(self._q.popleft())
+            except IndexError:  # lost a pop race (see pop_to); len was stale
+                break
         self._notify()
         return evicted
 
     def pop_to(self, target: int = 0) -> List[T]:
+        # len-check-then-popleft is not atomic, and the shutdown path runs
+        # pop_to concurrently with a merely-slow (not wedged) dispatcher's
+        # own drains (SolvePipeline.stop after its join times out).  Each
+        # popleft is itself thread-safe; absorb losing the race so the
+        # caller's remaining drains still run.
         out: List[T] = []
         while len(self._q) > target:
-            out.append(self._q.popleft())
+            try:
+                out.append(self._q.popleft())
+            except IndexError:
+                break  # the racer got it; its owner resolves it
         if out:
             self._notify()
         return out
@@ -156,10 +167,10 @@ class ThreadCoalescer:
         self.idle = idle_seconds
         self.follower_timeout = follower_timeout
         self._lock = threading.Lock()
-        self._buckets: Dict[Hashable, _Batch] = {}
-        self.batch_count = 0                       # backend round trips
-        self.requests_served = 0                   # total requests across batches
-        self.batch_sizes = deque(maxlen=128)       # recent batch sizes
+        self._buckets: Dict[Hashable, _Batch] = {}  # guarded-by: _lock
+        self.batch_count = 0                        # guarded-by: _lock  backend round trips
+        self.requests_served = 0                    # guarded-by: _lock  total requests across batches
+        self.batch_sizes = deque(maxlen=128)        # guarded-by: _lock  recent batch sizes
 
     def call(self, key: Hashable, req: object):
         with self._lock:
@@ -179,6 +190,8 @@ class ThreadCoalescer:
                 reqs = list(batch.reqs)
             try:
                 outcomes = self.execute(reqs)
+            # ktlint: allow[KT005] leader publishes the failure to every
+            # follower as its per-request outcome; each caller re-raises
             except Exception as err:  # backend-wide failure fans out to all
                 outcomes = [("err", err)] * len(reqs)
             batch.results = outcomes

@@ -57,6 +57,8 @@ class BatchedCloud(CloudProvider):
         for m in machines:
             try:
                 out.append(("ok", self.inner.create(m)))
+            # ktlint: allow[KT005] per-machine fan-out contract: each slot
+            # carries its own outcome and the caller re-raises its slot
             except Exception as err:
                 out.append(("err", err))
         return out
@@ -64,6 +66,8 @@ class BatchedCloud(CloudProvider):
     def _do_describes(self, pids: List[str]) -> List[_Outcome]:
         try:
             by_id = {m.provider_id: m for m in self.inner.list()}
+        # ktlint: allow[KT005] a failed list fans the error to every
+        # coalesced describe; each caller re-raises its slot
         except Exception as err:
             return [("err", err)] * len(pids)
         out: List[_Outcome] = []
@@ -81,6 +85,7 @@ class BatchedCloud(CloudProvider):
             try:
                 self.inner.delete(m)
                 out.append(("ok", None))
+            # ktlint: allow[KT005] per-machine fan-out contract, as above
             except Exception as err:
                 out.append(("err", err))
         return out

@@ -290,6 +290,8 @@ class FakeCloudProvider(CloudProvider):
         for m in machines:
             try:
                 out.append(self.create(m))
+            # ktlint: allow[KT005] fleet partial-fulfilment contract: the
+            # per-pool error IS the result slot (createfleet.go semantics)
             except Exception as err:
                 out.append(err)
         return out

@@ -629,6 +629,9 @@ class DeprovisioningController:
         return "planned", result.nodes[0]
 
     def _count_action(self, action: Action) -> None:
+        # ktlint: allow[KT003] the label is a kind/mechanism cross product
+        # whose mechanism set is extended by config (drift/expiry toggles);
+        # pre-creating a partial matrix would be worse than none
         self.registry.counter(DEPROVISIONING_ACTIONS).inc(
             {"action": f"{action.kind}/{action.mechanism}"}
         )
@@ -671,6 +674,10 @@ class DeprovisioningController:
                 except Exception as err:  # ICE etc: abort the action
                     from ..cloud.base import InsufficientCapacityError
 
+                    logger.warning(
+                        "replacement launch for %s failed (%r); action "
+                        "aborted, backoffs armed", action.nodes, err,
+                    )
                     if isinstance(err, InsufficientCapacityError) and self.unavailable:
                         # feed the ICE cache so the next solve routes around it
                         self.unavailable.mark_unavailable(

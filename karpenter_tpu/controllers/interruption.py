@@ -82,6 +82,14 @@ class InterruptionController:
         self.recorder = recorder or Recorder()
         self.registry = registry or default_registry
         self.clock = clock or state.clock
+        # zero-init every known message-kind series so Prometheus
+        # rate()/increase() never lose the first interruption of a kind
+        # (the ADVICE-r5 counter bug class; enforced package-wide by KT003)
+        for kind in (SPOT_INTERRUPTION, REBALANCE_RECOMMENDATION,
+                     SCHEDULED_CHANGE, STATE_CHANGE):
+            self.registry.counter(INTERRUPTION_RECEIVED).inc(
+                {"message_type": kind}, value=0.0
+            )
 
     def reconcile(self) -> int:
         """Drain the queue; returns number of messages handled."""

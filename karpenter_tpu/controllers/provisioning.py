@@ -146,6 +146,9 @@ class ProvisioningController:
                 self.recorder.publish(Event(
                     "Machine", machine.name, "OnDemandFlexibility", w, "Warning",
                 ))
+            # ktlint: allow[KT003] the provisioner label value is runtime
+            # data (user-defined names); the series cannot be pre-created at
+            # construction
             self.registry.counter(NODES_CREATED).inc(
                 {"provisioner": machine.provisioner}
             )

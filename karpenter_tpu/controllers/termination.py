@@ -108,6 +108,8 @@ class TerminationController:
             except MachineNotFoundError:
                 pass  # already gone; proceed to remove the node object
         self.state.remove_node(node_name)
+        # ktlint: allow[KT003] the provisioner label value is runtime data
+        # (user-defined names); the series cannot be pre-created
         self.registry.counter(NODES_TERMINATED).inc(
             {"provisioner": ns.node.provisioner}
         )

@@ -30,6 +30,11 @@ class Counter:
     def get(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self.values.get(_lkey(labels), 0.0)
 
+    def has(self, labels: Optional[Dict[str, str]] = None) -> bool:
+        """Whether the SAMPLE exists (get() returns 0.0 either way — the
+        distinction is exactly the zero-init contract, KT003)."""
+        return _lkey(labels) in self.values
+
 
 class Gauge:
     def __init__(self) -> None:
@@ -40,6 +45,11 @@ class Gauge:
 
     def get(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self.values.get(_lkey(labels), 0.0)
+
+    def has(self, labels: Optional[Dict[str, str]] = None) -> bool:
+        """Whether the sample exists (a live series must not be clobbered
+        by a later default set — see BatchScheduler's INFLIGHT_DEPTH init)."""
+        return _lkey(labels) in self.values
 
 
 class Histogram:

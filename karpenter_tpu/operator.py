@@ -525,10 +525,13 @@ def drain_warm_threads(rc: int = 0, grace_s: float = 60.0) -> None:
     forever; give legitimate compile tails a bounded grace, then force the
     exit.  Call only from process entry points, after clean shutdown steps.
     """
+    # ktlint: allow[KT002] process-exit join deadline: must track real
+    # elapsed time even when the operator under test runs on a FakeClock —
+    # a fake-advanced clock would zero the grace and strand live compiles
     deadline = time.monotonic() + grace_s
     for t in threading.enumerate():
         if t.name == "tpu-solver-warm" and t is not threading.current_thread():
-            t.join(max(0.0, deadline - time.monotonic()))
+            t.join(max(0.0, deadline - time.monotonic()))  # ktlint: allow[KT002] see above
     stuck = sum(1 for t in threading.enumerate()
                 if t.name == "tpu-solver-warm" and t.is_alive())
     if stuck:

@@ -137,6 +137,9 @@ class RemoteScheduler:
                            "falling back to local %s solves", self.target,
                            getattr(err, "code", lambda: err)(),
                            self.fallback.backend)
+        # ktlint: allow[KT002] transport-health stopwatch: reconnect pacing
+        # must follow real wall progress, not the operator's injected clock
+        # (a FakeClock-driven test advancing hours would hot-loop probes)
         self._degraded_since = time.monotonic()
         self._last_probe = self._degraded_since
         self.registry.gauge(REMOTE_DEGRADED).set(1)
@@ -146,7 +149,7 @@ class RemoteScheduler:
         degraded but due for a (successful) health probe."""
         if self._degraded_since is None:
             return True
-        now = time.monotonic()
+        now = time.monotonic()  # ktlint: allow[KT002] see _mark_degraded
         if now - self._last_probe < self.reconnect_interval:
             return False
         self._last_probe = now

@@ -29,6 +29,22 @@ from . import solver_pb2 as pb
 SERVICE = "karpenter.tpu.Solver"
 
 
+def _resolve(fut: Future, result=None, exc: Optional[BaseException] = None) -> None:
+    """Resolve a future exactly once, tolerating the racer.  stop() and the
+    dispatcher's _finalize can reach the same future concurrently (a fence
+    completing at the instant the 5s join gives up); done()-check-then-set
+    is not atomic, so the loser's set raises InvalidStateError — swallow it:
+    either resolution unblocks the RPC thread, which is all that matters."""
+    try:
+        if not fut.done():
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+    except futures.InvalidStateError:
+        pass  # the other side resolved it first
+
+
 class SolvePipeline:
     """Double-buffered solve dispatch for one scheduler.
 
@@ -51,12 +67,20 @@ class SolvePipeline:
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._submit_lock = threading.Lock()  # makes stop-check + put atomic
-        #: the future whose fence the dispatcher is currently blocked on —
-        #: readable by stop() so a wedged fence can't strand its RPC thread
-        self._finalizing: Optional[Future] = None
+        #: futures the dispatcher has popped (from _q or _inflight) but not
+        #: yet resolved — the dispatcher's hand.  Written by the dispatcher
+        #: only; stop() snapshots it after the join times out so a wedge at
+        #: ANY point between pop and resolution (inside submit's device
+        #: dispatch, inside a fence, between an _inflight drain and its
+        #: finalize) can't strand an RPC thread.  _resolve tolerates the
+        #: benign race with a merely-slow dispatcher.
+        self._in_hand: "list[Future]" = []
         gauge = self.registry.gauge(INFLIGHT_DEPTH)
-        labels = {"backend": scheduler.backend}  # one series per pipeline
-        gauge.set(0, labels)
+        labels = {"backend": scheduler.backend}  # one series per backend
+        if not gauge.has(labels):
+            # only when absent: a second pipeline on a shared registry must
+            # not zero a live series (same guard as BatchScheduler.__init__)
+            gauge.set(0, labels)
         self._inflight: InflightQueue = InflightQueue(
             depth=depth, on_depth=lambda d: gauge.set(d, labels))
         self._thread = threading.Thread(
@@ -85,39 +109,40 @@ class SolvePipeline:
         self._thread.join(timeout=5.0)
         if self._thread.is_alive():
             # dispatcher wedged (e.g. a device fence behind a dead tunnel,
-            # forced backend so no guard): fail everything still in flight
-            # so the RPC threads unblock; the daemon dispatcher thread
-            # itself cannot pin exit.  deque ops are thread-safe, and the
-            # entry the wedged thread already popped is covered by
-            # _finalizing below.
+            # forced backend so no guard, or an H2D dispatch inside
+            # scheduler.submit): fail everything still in flight so the RPC
+            # threads unblock; the daemon dispatcher thread itself cannot
+            # pin exit.  deque ops are thread-safe, and every entry the
+            # wedged thread already popped is still in its _in_hand ledger.
             for _pending, fut in self._inflight.pop_to(0):
-                if not fut.done():
-                    fut.set_exception(RuntimeError("solve pipeline stopped"))
-            current = self._finalizing
-            if current is not None and not current.done():
-                current.set_exception(RuntimeError("solve pipeline stopped"))
+                _resolve(fut, exc=RuntimeError("solve pipeline stopped"))
+            for fut in list(self._in_hand):
+                _resolve(fut, exc=RuntimeError("solve pipeline stopped"))
         with self._submit_lock:
             while True:
                 try:
                     _kwargs, fut = self._q.get_nowait()
                 except queue.Empty:
                     break
-                if not fut.done():
-                    fut.set_exception(RuntimeError("solve pipeline stopped"))
+                _resolve(fut, exc=RuntimeError("solve pipeline stopped"))
 
     def _finalize(self, pending, fut: Future) -> None:
-        self._finalizing = fut
         try:
             try:
                 result = pending.result()
+            # ktlint: allow[KT005] the dispatcher must survive any fence
+            # outcome; the exception is handed to the blocked RPC thread via
+            # its future and re-raised there
             except BaseException as err:  # noqa: BLE001 — fan to the RPC
-                if not fut.done():
-                    fut.set_exception(err)
+                _resolve(fut, exc=err)
                 return
-            if not fut.done():
-                fut.set_result(result)
+            _resolve(fut, result=result)
         finally:
-            self._finalizing = None
+            # resolved either way: out of the dispatcher's hand
+            try:
+                self._in_hand.remove(fut)
+            except ValueError:
+                pass  # already failed by a concurrent stop()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -127,14 +152,23 @@ class SolvePipeline:
                 for pending, f in self._inflight.pop_to(0):
                     self._finalize(pending, f)
                 continue
+            # in hand from pop to resolution; _finalize removes it.  A fut
+            # parked in _inflight stays in the ledger too — stop() may then
+            # fail it twice (once per structure), which _resolve absorbs.
+            self._in_hand.append(fut)
             try:
                 pending = self.scheduler.submit(
                     kwargs.pop("pods"), kwargs.pop("provisioners"),
                     kwargs.pop("instance_types"), **kwargs,
                 )
+            # ktlint: allow[KT005] submit failures fan to the waiting RPC
+            # thread through its future; the dispatcher itself must live on
             except BaseException as err:  # noqa: BLE001
-                if not fut.done():
-                    fut.set_exception(err)
+                _resolve(fut, exc=err)
+                try:
+                    self._in_hand.remove(fut)
+                except ValueError:
+                    pass
                 continue
             for done_pending, done_fut in self._inflight.push((pending, fut)):
                 self._finalize(done_pending, done_fut)
@@ -152,10 +186,11 @@ class SolverService:
                  registry: Optional[Registry] = None) -> None:
         self.registry = registry or default_registry
         self.scheduler = scheduler or BatchScheduler(registry=self.registry)
-        self._schedulers = {"": self.scheduler}
+        self._schedulers = {"": self.scheduler}  # guarded-by: _direct_lock
         # KT_SOLVE_PIPELINE=0 falls back to direct, lock-serialized solves
         self._pipelined = os.environ.get("KT_SOLVE_PIPELINE", "1") != "0"
-        self._pipelines: dict = {}
+        self._pipelines: dict = {}               # guarded-by: _direct_lock
+        self._closed = False                     # guarded-by: _direct_lock
         self._direct_lock = threading.Lock()
 
     def _scheduler_for(self, backend: str) -> BatchScheduler:
@@ -174,6 +209,11 @@ class SolverService:
 
     def _pipeline_for(self, sched: BatchScheduler) -> SolvePipeline:
         with self._direct_lock:  # concurrent first RPCs must share one pipe
+            if self._closed:
+                # a Solve racing close() must not construct a fresh pipeline
+                # AFTER close()'s snapshot — its dispatcher thread would
+                # outlive the service with nothing left to stop it
+                raise RuntimeError("solver service closed")
             pipe = self._pipelines.get(id(sched))
             if pipe is None:
                 pipe = SolvePipeline(sched, registry=self.registry)
@@ -181,7 +221,15 @@ class SolverService:
             return pipe
 
     def close(self) -> None:
-        for pipe in self._pipelines.values():
+        # latch closed + snapshot under the lock (a late first RPC racing
+        # shutdown must neither resize the dict mid-iteration nor construct
+        # a never-stopped pipeline after the snapshot), stop outside it —
+        # stop() joins the dispatcher, and a join under _direct_lock would
+        # deadlock against a dispatcher-path call that takes the lock
+        with self._direct_lock:
+            self._closed = True
+            pipes = list(self._pipelines.values())
+        for pipe in pipes:
             pipe.stop()
 
     # ---- RPC methods -----------------------------------------------------

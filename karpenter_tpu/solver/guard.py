@@ -55,9 +55,12 @@ def _drain_abandoned() -> None:
             break
         import time as _time
 
+        # ktlint: allow[KT002] interpreter-exit drain deadline: runs from
+        # atexit after the controllers (and their injected clocks) are gone,
+        # and a fake-advanced clock must never shorten the real join grace
         t0 = _time.monotonic()
         t.join(deadline)
-        deadline -= _time.monotonic() - t0
+        deadline -= _time.monotonic() - t0  # ktlint: allow[KT002] see above
 
 #: default guard timeout.  The guard covers only warm-tier device solves
 #: (the ``auto`` policy never compiles inline — compile-behind serves cold
@@ -119,6 +122,10 @@ class DeviceGuard:
         def work():
             try:
                 box["val"] = fn(*args, **kwargs)
+            # ktlint: allow[KT005] the expendable call thread boxes EVERY
+            # outcome (incl. KeyboardInterrupt) and run() re-raises it on
+            # the caller thread — swallowing here would turn a device error
+            # into a phantom hang
             except BaseException as e:  # noqa: BLE001 — re-raised in caller
                 box["err"] = e
             finally:

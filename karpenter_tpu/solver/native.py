@@ -82,9 +82,13 @@ def _load():
 
 
 def available() -> bool:
+    # the three real failure shapes: g++ missing / CDLL of a bad ELF
+    # (OSError, incl. FileNotFoundError), a failed compile
+    # (CalledProcessError), and a compiled .so whose exported symbols don't
+    # match this binding (AttributeError from ctypes symbol lookup)
     try:
         return _load() is not None
-    except Exception:
+    except (OSError, subprocess.CalledProcessError, AttributeError):
         return False
 
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import copy
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -202,7 +203,12 @@ class BatchScheduler:
             _compile_behind_enabled() if compile_behind is None else compile_behind
         )
         self._tpu = TpuSolver()
-        self._cold_logged: Set[tuple] = set()  # change-gated stall logging
+        # change-gated stall logging; _start_warm runs at fence time, and
+        # WHICH thread fences depends on the caller (pipeline dispatcher vs
+        # direct RPC threads under KT_SOLVE_PIPELINE=0) — a cheap lock makes
+        # the invariant local instead of inherited from caller threading
+        self._cold_lock = threading.Lock()
+        self._cold_logged: Set[tuple] = set()  # guarded-by: _cold_lock
         # incremental host tensorize: group-level tensors built once per
         # batch shape, reused across solves (models/tensorize.TensorizeCache;
         # KT_TENSORIZE_CACHE=0 forces the from-scratch path for A/B runs)
@@ -241,7 +247,7 @@ class BatchScheduler:
         # when absent: re-constructing a scheduler (per-backend lazily, or
         # in tests) must not clobber a live pipeline's depth
         inflight = self.registry.gauge(INFLIGHT_DEPTH)
-        if (("backend", self.backend),) not in inflight.values:
+        if not inflight.has({"backend": self.backend}):
             inflight.set(0, {"backend": self.backend})
 
     def _device_health_changed(self, healthy: bool) -> None:
@@ -860,8 +866,10 @@ class BatchScheduler:
             st, existing_nodes=existing_nodes, max_nodes=max_slots,
             mesh=self.mesh,
         )
-        if sig not in self._cold_logged:
+        with self._cold_lock:
+            first_time = sig not in self._cold_logged
             self._cold_logged.add(sig)
+        if first_time:
             logger.info(
                 "device program for this solve shape was not compiled yet; "
                 "served from the warm tier (compile running in background: "

@@ -51,6 +51,7 @@ import numpy as np
 
 from ..models import labels as L
 from ..models.tensorize import NO_SELECTOR, SolveTensors
+from ..utils.clock import Clock
 from ..ops.masks import (
     BIG,
     gather_pm_bits,
@@ -919,20 +920,23 @@ class TpuSolver:
     #: compile of CPU on every solve of that shape)
     WARM_FAILURE_BACKOFF = 300.0
 
-    def __init__(self) -> None:
+    def __init__(self, clock: Optional[Clock] = None) -> None:
         import threading
 
+        # injectable clock for the warm-failure backoff (tests advance a
+        # FakeClock past WARM_FAILURE_BACKOFF instead of sleeping it out)
+        self._clock = clock or Clock()
         self._lock = threading.Lock()
-        self._ready: set = set()
-        self._compiling: set = set()
-        self._queued: list = []  # [(sig, kwargs)]
-        self._failed_until: Dict[tuple, float] = {}
-        self._stopped = False  # stop_warms() called: no new spawns
+        self._ready: set = set()                     # guarded-by: _lock
+        self._compiling: set = set()                 # guarded-by: _lock
+        self._queued: list = []                      # guarded-by: _lock  [(sig, kwargs)]
+        self._failed_until: Dict[tuple, float] = {}  # guarded-by: _lock
+        self._stopped = False                        # guarded-by: _lock  stop_warms(): no new spawns
         # shape families whose optimistic NR estimate exhausted at least
         # once: their signature permanently resolves to the full-budget
         # dims, so readiness checks / warmups / solves all target the
         # program that will actually serve them (no per-solve double run)
-        self._nr_exhausted: set = set()
+        self._nr_exhausted: set = set()              # guarded-by: _lock
 
     # ---- compile-readiness ----------------------------------------------
     def signature(
@@ -1026,7 +1030,7 @@ class TpuSolver:
                 return False
             if any(s == sig for s, _ in self._queued):
                 return False
-            if time.time() < self._failed_until.get(sig, 0.0):
+            if self._clock.now() < self._failed_until.get(sig, 0.0):
                 return False  # recent compile failure: back off
             if len(self._compiling) >= self.MAX_CONCURRENT_WARMS:
                 if len(self._queued) >= self.MAX_QUEUED_WARMS:
@@ -1047,10 +1051,12 @@ class TpuSolver:
             err = None
             try:
                 self.solve(**kwargs)
+            # ktlint: allow[KT005] compile failure is surfaced via on_done
+            # (the scheduler's callback logs it) and arms the retry backoff
             except Exception as e:  # pragma: no cover - surfaced via on_done
                 err = e
                 with self._lock:
-                    self._failed_until[sig] = time.time() + self.WARM_FAILURE_BACKOFF
+                    self._failed_until[sig] = self._clock.now() + self.WARM_FAILURE_BACKOFF
             try:
                 if on_done is not None:
                     on_done(sig, time.perf_counter() - t0, err)
@@ -1361,6 +1367,8 @@ class TpuSolver:
         )
         return run, init, NE, est_dims, full_dims, full_nr
 
+    # ktlint: fence reads two scalars off the finished carry to decide the
+    # slot-exhaustion retry — the solve is already fenced by its caller
     def _maybe_retry_exhausted(
         self, carry, est_dims: dict, full_dims: dict, full_nr: bool,
         raise_on_exhaust: bool, retry,
@@ -1398,6 +1406,8 @@ class TpuSolver:
                 with self._lock:
                     self._compiling.discard(full_key)
 
+    # ktlint: fence the synchronous solve IS the sync point — dispatch, the
+    # one-RTT D2H fence, and the measured re-run all live here by contract
     def solve(
         self,
         st: SolveTensors,
@@ -1503,6 +1513,8 @@ class TpuSolver:
         )
 
     # ---- result extraction ---------------------------------------------
+    # ktlint: fence extraction reads the whole carry back to host — it runs
+    # strictly after the fence, on already-transferred results
     def _extract(
         self, st, carry, ys, existing_nodes, NE, solve_ms, compile_ms
     ) -> TpuSolveOutput:
@@ -1640,6 +1652,7 @@ class PendingTpuSolve:
         self.solve_kwargs = solve_kwargs
         self._out: Optional[TpuSolveOutput] = None
 
+    # ktlint: fence result() IS the async handle's one-RTT D2H fence
     def result(self) -> TpuSolveOutput:
         if self._out is not None:
             return self._out

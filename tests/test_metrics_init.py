@@ -1,0 +1,90 @@
+"""Labeled metric series exist at zero from construction (ISSUE 2 satellite).
+
+Prometheus ``rate()`` / ``increase()`` diff consecutive samples: a counter
+series that first appears AT its first increment contributes nothing to
+either (no prior sample), so the first degraded solve / cold fallback /
+interruption of each kind would be invisible — the ADVICE-r5 bug class.
+These tests pin the runtime contract the KT003 static rule approximates:
+every statically-enumerable labeled series is born at 0.
+"""
+
+from karpenter_tpu.controllers.interruption import (
+    REBALANCE_RECOMMENDATION,
+    SCHEDULED_CHANGE,
+    SPOT_INTERRUPTION,
+    STATE_CHANGE,
+    InterruptionController,
+    MessageQueue,
+)
+from karpenter_tpu.controllers.state import ClusterState
+from karpenter_tpu.metrics import (
+    INFLIGHT_DEPTH,
+    INTERRUPTION_RECEIVED,
+    SOLVER_COLD_FALLBACKS,
+    SOLVER_DEGRADED_SOLVES,
+    SOLVER_DEVICE_HANGS,
+    TENSORIZE_CACHE_HITS,
+    TENSORIZE_CACHE_MISSES,
+    Registry,
+)
+from karpenter_tpu.solver.scheduler import BatchScheduler
+
+
+def series_exists(counter, labels=None) -> bool:
+    """Presence of the SAMPLE, not just a 0.0 default from get() — get()
+    returns 0.0 for series that were never created, which is exactly the
+    bug this guards against."""
+    return counter.has(labels)
+
+
+class TestSchedulerSeries:
+    def test_every_labeled_solver_series_is_born_at_zero(self):
+        reg = Registry()
+        BatchScheduler(backend="auto", registry=reg)
+        for backend in ("native", "oracle"):
+            for name in (SOLVER_DEGRADED_SOLVES, SOLVER_COLD_FALLBACKS):
+                c = reg.counter(name)
+                assert series_exists(c, {"backend": backend}), \
+                    f"{name}{{backend={backend}}} missing at construction"
+                assert c.get({"backend": backend}) == 0.0
+        for tier in ("identity", "shape"):
+            assert series_exists(reg.counter(TENSORIZE_CACHE_HITS),
+                                 {"tier": tier})
+        assert series_exists(reg.counter(TENSORIZE_CACHE_MISSES))
+        assert series_exists(reg.counter(SOLVER_DEVICE_HANGS))
+        assert reg.gauge(INFLIGHT_DEPTH).has({"backend": "auto"})
+
+    def test_series_survive_into_exposition(self):
+        """The scrape itself must carry the zeros — rate() is computed from
+        what the scraper saw, not from in-process state."""
+        reg = Registry()
+        BatchScheduler(backend="auto", registry=reg)
+        text = reg.expose()
+        assert 'karpenter_solver_degraded_solves_total{backend="native"} 0' in text
+        assert 'karpenter_solver_degraded_solves_total{backend="oracle"} 0' in text
+        assert 'karpenter_solver_cold_start_fallbacks_total{backend="native"} 0' in text
+        assert 'karpenter_solver_cold_start_fallbacks_total{backend="oracle"} 0' in text
+
+    def test_reconstruction_does_not_clobber_live_series(self):
+        """Re-building a scheduler over a shared registry (per-backend lazy
+        construction) must not reset counted traffic."""
+        reg = Registry()
+        BatchScheduler(backend="auto", registry=reg)
+        reg.counter(SOLVER_DEGRADED_SOLVES).inc({"backend": "native"})
+        BatchScheduler(backend="tpu", registry=reg)
+        assert reg.counter(SOLVER_DEGRADED_SOLVES).get(
+            {"backend": "native"}) == 1.0
+
+
+class TestInterruptionSeries:
+    def test_every_message_kind_series_is_born_at_zero(self):
+        reg = Registry()
+        state = ClusterState()
+        InterruptionController(state, termination=None, queue=MessageQueue(),
+                               registry=reg)
+        c = reg.counter(INTERRUPTION_RECEIVED)
+        for kind in (SPOT_INTERRUPTION, REBALANCE_RECOMMENDATION,
+                     SCHEDULED_CHANGE, STATE_CHANGE):
+            assert series_exists(c, {"message_type": kind}), \
+                f"{INTERRUPTION_RECEIVED}{{message_type={kind}}} missing"
+            assert c.get({"message_type": kind}) == 0.0

@@ -282,3 +282,42 @@ class TestFallback:
             remote.close()
         finally:
             srv.stop(grace=None)
+
+
+class TestPipelineWedgedStop:
+    def test_stop_fails_request_wedged_inside_submit(self):
+        """A dispatcher wedged INSIDE scheduler.submit (H2D dispatch on a
+        dead tunnel — before the request reaches the inflight queue) must
+        not strand its RPC thread: stop() fails everything in the
+        dispatcher's _in_hand ledger, not just the queued/inflight entries
+        (review finding on the ISSUE 2 round)."""
+        import threading
+
+        from karpenter_tpu.service.server import SolvePipeline
+
+        wedged = threading.Event()
+
+        class WedgingScheduler:
+            backend = "oracle"
+
+            def submit(self, *a, **kw):
+                wedged.set()
+                threading.Event().wait()  # never returns
+
+        pipe = SolvePipeline(WedgingScheduler())
+        outcome = {}
+
+        def rpc():
+            try:
+                outcome["val"] = pipe.solve(
+                    dict(pods=[], provisioners=[], instance_types=[]))
+            except RuntimeError as e:
+                outcome["err"] = str(e)
+
+        t = threading.Thread(target=rpc)
+        t.start()
+        assert wedged.wait(5)
+        pipe.stop()  # join times out (5s), then drains the in-hand ledger
+        t.join(5)
+        assert not t.is_alive(), "RPC thread stranded on a wedged submit"
+        assert "stopped" in outcome.get("err", "")

@@ -919,3 +919,37 @@ class TestCoalescing:
             assert node.instance_type == "r5.large", (
                 f"{p.name} pinned to r5.large but landed on {node.instance_type}"
             )
+
+
+class TestWarmFailureBackoffClock:
+    """ISSUE 2 satellite: the warm-failure backoff runs on the injectable
+    clock (KT002), so tests advance a FakeClock past WARM_FAILURE_BACKOFF
+    instead of sleeping it out."""
+
+    def test_backoff_expires_on_the_injected_clock(self, small_catalog):
+        from karpenter_tpu.solver.tpu import TpuSolver
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock(start=1_000.0)
+        solver = TpuSolver(clock=clock)
+        pods = [PodSpec(name=f"w-{i}", requests={"cpu": 0.5, "memory": GIB},
+                        owner_key="w") for i in range(4)]
+        st = tensorize(pods, [default_prov()], small_catalog)
+        sig = solver.signature(st)
+        spawned = []
+        solver._spawn_warm = lambda sig, kwargs: spawned.append(sig)
+
+        # a compile failure arms the backoff at now + WARM_FAILURE_BACKOFF
+        solver._failed_until[sig] = clock.now() + TpuSolver.WARM_FAILURE_BACKOFF
+        assert solver.warm_async(st) is False   # inside the backoff window
+        assert spawned == []
+
+        clock.advance(TpuSolver.WARM_FAILURE_BACKOFF - 1.0)
+        assert solver.warm_async(st) is False   # still 1s short
+        assert spawned == []
+
+        clock.advance(2.0)                      # past the backoff
+        assert solver.warm_async(st) is True
+        assert spawned == [sig]
+        # accepted warm is now in flight: immediate retry dedupes
+        assert solver.warm_async(st) is False
