@@ -2,7 +2,7 @@ PYTHON ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
 
-.PHONY: all test native proto bench clean battletest lint
+.PHONY: all test native proto bench clean battletest lint obs-demo
 
 all: native proto
 
@@ -36,6 +36,12 @@ battletest: lint
 
 bench:
 	$(PYTHON) bench.py
+
+# observability demo (docs/OBSERVABILITY.md): run the fake-cloud operator
+# demo with tracing on and print a /tracez + /statusz snapshot — per-span
+# p50/p99 over the run plus the recent per-solve trace trees
+obs-demo:
+	JAX_PLATFORMS=cpu $(PYTHON) -m karpenter_tpu.operator --demo --small --pods 60 --tracez
 
 clean:
 	rm -f karpenter_tpu/solver/_native*.so

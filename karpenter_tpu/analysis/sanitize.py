@@ -49,6 +49,23 @@ class SanitizerError(AssertionError):
     """Two threads entered a non-reentrant section of one object."""
 
 
+def _notify_flight(obj, detail: str) -> None:
+    """Hand the violation to the flight recorder so the dump captures the
+    traces/events leading up to it (a sanitizer error IS an anomaly — the
+    black-box must survive the crash site).  Prefer the violating object's
+    OWN recorder (a BatchScheduler over a private registry rings its own
+    black box, not the process-global one whose ring holds unrelated
+    traffic); fall back to the process default.  Best-effort: observability
+    must never mask the error it is reporting."""
+    try:
+        from ..obs import default_flight
+
+        flight = getattr(getattr(obj, "tracer", None), "flight", None)
+        (flight or default_flight()).anomaly("sanitizer_error", detail=detail)
+    except Exception:  # noqa: BLE001 — the SanitizerError must still raise
+        logger.debug("sanitizer flight-recorder dump failed", exc_info=True)
+
+
 def _wrap(cls: type, name: str, group: str):
     fn = cls.__dict__[name]
     slot = f"_kt_san_{group}"
@@ -58,17 +75,22 @@ def _wrap(cls: type, name: str, group: str):
         me = threading.current_thread()
         with _STATE_LOCK:
             holder = getattr(self, slot, None)
-            if holder is not None and holder is not me:
-                raise SanitizerError(
-                    f"KT_SANITIZE: unguarded cross-thread mutation — "
-                    f"{cls.__name__}.{name} entered by {me.name!r} while "
-                    f"{holder.name!r} is still inside the {group!r} section "
-                    f"of the same object; this object's {group} contract is "
-                    "single-threaded (serialize callers or route through "
-                    "the pipeline dispatcher)"
-                )
-            reentrant = holder is me
-            setattr(self, slot, me)
+            if holder is None or holder is me:
+                reentrant = holder is me
+                setattr(self, slot, me)
+        if holder is not None and holder is not me:
+            # outside _STATE_LOCK: the flight-recorder dump serializes the
+            # trace ring and must not run under the sanitizer's own lock
+            msg = (
+                f"KT_SANITIZE: unguarded cross-thread mutation — "
+                f"{cls.__name__}.{name} entered by {me.name!r} while "
+                f"{holder.name!r} is still inside the {group!r} section "
+                f"of the same object; this object's {group} contract is "
+                "single-threaded (serialize callers or route through "
+                "the pipeline dispatcher)"
+            )
+            _notify_flight(self, msg)
+            raise SanitizerError(msg)
         try:
             return fn(self, *args, **kwargs)
         finally:

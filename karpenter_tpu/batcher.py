@@ -54,6 +54,14 @@ class Window(Generic[T]):
     def __len__(self) -> int:
         return len(self._items)
 
+    @property
+    def opened_at(self) -> Optional[float]:
+        """When the first item of the current batch arrived (None while
+        empty) — the start of the trace's "window" span: time pods spent
+        waiting for the idle/max batching window to fire is part of their
+        caller-visible scheduling latency."""
+        return self._first_at
+
     def ready(self) -> bool:
         if not self._items:
             return False

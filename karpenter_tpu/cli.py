@@ -119,7 +119,8 @@ def cmd_serve(args) -> int:
 
     _maybe_profile(args.profile_port)
     _maybe_jit_cache(args.jit_cache_dir)
-    return serve_main(["--port", str(args.port), "--backend", args.backend])
+    return serve_main(["--port", str(args.port), "--backend", args.backend,
+                       "--obs-port", str(args.obs_port)])
 
 
 def cmd_bench(args) -> int:
@@ -205,6 +206,9 @@ def main(argv=None) -> int:
     v = sub.add_parser("serve", help="gRPC solver sidecar")
     v.add_argument("--port", type=int, default=50151)
     v.add_argument("--backend", default="auto", choices=["auto", "tpu", "oracle"])
+    v.add_argument("--obs-port", type=int, default=0,
+                   help="observability HTTP port (/tracez, /statusz, "
+                        "/metrics — docs/OBSERVABILITY.md); 0 disables")
     v.add_argument("--profile-port", type=int, default=0)
     v.add_argument("--jit-cache-dir", default=os.environ.get("KT_JIT_CACHE_DIR", ""),
                    help="persistent XLA compile cache directory")

@@ -33,6 +33,8 @@ from ..metrics import (
 )
 from ..models import labels as L
 from ..models.pod import PodSpec
+from ..obs import tracer_for
+from ..obs.trace import NULL_TRACE
 from ..solver.scheduler import BatchScheduler
 from ..solver.types import SimNode, SolveResult
 from ..utils.clock import Clock
@@ -105,6 +107,7 @@ class DeprovisioningController:
         clock: Optional[Clock] = None,
         drift_enabled: bool = False,            # feature gate (settings.md:76-78)
         deprovisioning_ttl: float = DEPROVISIONING_TTL,
+        tracer=None,
     ) -> None:
         self.state = state
         self.cloud = cloud
@@ -114,6 +117,12 @@ class DeprovisioningController:
         self.recorder = recorder or Recorder()
         self.registry = registry or default_registry
         self.clock = clock or state.clock
+        self.tracer = (tracer if tracer is not None
+                       else tracer_for(self.registry, clock=self.clock))
+        # the trace of the in-progress consolidation evaluation, so the
+        # what-if solves deep in the mechanism attribute to it (the tick is
+        # single-threaded; no lock needed)
+        self._eval_trace = None
         self.drift_enabled = drift_enabled
         self.deprovisioning_ttl = deprovisioning_ttl
         self.unavailable = getattr(provisioning, "unavailable", None)
@@ -163,7 +172,20 @@ class DeprovisioningController:
                 or self._emptiness()
             )
             if action is None and self._should_evaluate_consolidation():
-                action = self._consolidation()
+                # one trace per consolidation evaluation: the repack search
+                # is the expensive deprovisioning phase, and its what-if
+                # solves attribute to this trace via _eval_trace
+                with self.tracer.start("deprovision",
+                                       mechanism="consolidation") as trace:
+                    self._eval_trace = trace
+                    try:
+                        action = self._consolidation()
+                    finally:
+                        self._eval_trace = None
+                    trace.annotate(
+                        action=action.kind if action is not None else "none",
+                        n_nodes=len(self.state.nodes),
+                    )
                 if action is None:
                     self._last_seqnum = self.state.seqnum
                     self._last_eval_at = self.clock.now()
@@ -598,12 +620,15 @@ class DeprovisioningController:
             n for n in self.state.schedulable_nodes() if n.name not in exclude
         ]
         provisioners = [p.with_defaults() for p in self.state.provisioners.values()]
-        return self.scheduler.solve(
-            pods, provisioners, self.cloud.get_instance_types(),
-            existing_nodes=others, daemonsets=self.state.daemonsets,
-            unavailable=self.unavailable.as_set() if self.unavailable else None,
-            allow_new_nodes=True, max_new_nodes=1,
-        )
+        trace = self._eval_trace or NULL_TRACE
+        with trace.span("what_if", n_pods=len(pods), n_excluded=len(exclude)):
+            return self.scheduler.solve(
+                pods, provisioners, self.cloud.get_instance_types(),
+                existing_nodes=others, daemonsets=self.state.daemonsets,
+                unavailable=self.unavailable.as_set() if self.unavailable else None,
+                allow_new_nodes=True, max_new_nodes=1,
+                trace=trace,
+            )
 
     def _plan_replacement(self, action: Action) -> Tuple[str, Optional[SimNode]]:
         """Size a replacement for a drift/expiration replace: can the nodes'

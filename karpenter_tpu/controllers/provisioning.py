@@ -27,6 +27,8 @@ from ..models import labels as L
 from ..models.machine import Machine
 from ..models.pod import PodSpec
 from ..models.requirements import IN, Requirement, Requirements
+from ..obs import tracer_for
+from ..obs.trace import NULL_TRACE, Tracer
 from ..solver.scheduler import BatchScheduler
 from ..solver.types import SimNode, SolveResult
 from ..utils.clock import Clock
@@ -45,6 +47,7 @@ class ProvisioningController:
         clock: Optional[Clock] = None,
         idle_seconds: float = 1.0,
         max_seconds: float = 10.0,
+        tracer: Optional[Tracer] = None,
     ) -> None:
         self.state = state
         self.cloud = cloud
@@ -53,6 +56,10 @@ class ProvisioningController:
         self.registry = registry or default_registry
         self.unavailable = unavailable or UnavailableOfferings(clock=clock or state.clock)
         self.clock = clock or state.clock
+        # after self.clock: the default tracer must run on the controller's
+        # clock, or FakeClock tests would mix two time bases in one trace
+        self.tracer = (tracer if tracer is not None
+                       else tracer_for(self.registry, clock=self.clock))
         self.window: Window[PodSpec] = Window(idle_seconds, max_seconds, clock=self.clock)
         self._queued: Set[str] = set()
 
@@ -66,6 +73,7 @@ class ProvisioningController:
                 self._queued.add(pod.name)
         if not self.window.ready():
             return None
+        window_opened = self.window.opened_at
         batch = self.window.pop()
         self._queued.difference_update(p.name for p in batch)
         # pods may have been deleted/bound/replaced while queued: re-resolve
@@ -78,9 +86,16 @@ class ProvisioningController:
         if not batch:
             return None
         self.registry.histogram(BATCH_SIZE).observe(len(batch))
-        return self._provision(batch)
+        # one trace per provisioning pass: the batcher window the pods sat
+        # in, then the scheduler's own spans (tensorize/dispatch/fence/
+        # reseat), then the machine launches
+        with self.tracer.start("provision", n_pods=len(batch)) as trace:
+            if window_opened is not None:
+                trace.record("window", window_opened, self.clock.now())
+            return self._provision(batch, trace=trace)
 
-    def _provision(self, batch: List[PodSpec]) -> SolveResult:
+    def _provision(self, batch: List[PodSpec],
+                   trace=NULL_TRACE) -> SolveResult:
         # volume-topology injection: fold each pod's storage reach (bound PV
         # zone / WaitForFirstConsumer allowedTopologies) into its scheduling
         # requirements before the solve (scheduling.md:378-433).  Pods whose
@@ -108,6 +123,7 @@ class ProvisioningController:
             existing_nodes=self.state.schedulable_nodes(),
             daemonsets=self.state.daemonsets,
             unavailable=self.unavailable.as_set(),
+            trace=trace,
         )
 
         for pod_name, reason in result.infeasible.items():
@@ -123,60 +139,61 @@ class ProvisioningController:
                 self.state.bind(pod_name, node_name)
 
         # launch one machine per proposed node
-        for node in result.nodes:
-            machine = self._machine_for(node, provisioners)
-            try:
-                machine = self.cloud.create(machine)
-            except InsufficientCapacityError as err:
-                self.unavailable.mark_unavailable(
-                    err.instance_type, err.zone, err.capacity_type
+        with trace.span("launch", n_nodes=len(result.nodes)):
+            for node in result.nodes:
+                machine = self._machine_for(node, provisioners)
+                try:
+                    machine = self.cloud.create(machine)
+                except InsufficientCapacityError as err:
+                    self.unavailable.mark_unavailable(
+                        err.instance_type, err.zone, err.capacity_type
+                    )
+                    self.recorder.publish(Event(
+                        "Machine", machine.name, "InsufficientCapacity",
+                        str(err), "Warning",
+                    ))
+                    # pods stay pending; next reconcile re-solves around the ICE
+                    continue
+                # ICE'd pools the fleet skipped on the way to success still feed
+                # the blacklist (instance.go:395-401); flexibility warnings
+                # surface as events (checkODFallback, instance.go:261-281)
+                for t, z, ct in machine.ice_errors:
+                    self.unavailable.mark_unavailable(t, z, ct)
+                for w in machine.launch_warnings:
+                    self.recorder.publish(Event(
+                        "Machine", machine.name, "OnDemandFlexibility", w, "Warning",
+                    ))
+                # ktlint: allow[KT003] the provisioner label value is runtime
+                # data (user-defined names); the series cannot be pre-created at
+                # construction
+                self.registry.counter(NODES_CREATED).inc(
+                    {"provisioner": machine.provisioner}
                 )
-                self.recorder.publish(Event(
-                    "Machine", machine.name, "InsufficientCapacity",
-                    str(err), "Warning",
-                ))
-                # pods stay pending; next reconcile re-solves around the ICE
-                continue
-            # ICE'd pools the fleet skipped on the way to success still feed
-            # the blacklist (instance.go:395-401); flexibility warnings
-            # surface as events (checkODFallback, instance.go:261-281)
-            for t, z, ct in machine.ice_errors:
-                self.unavailable.mark_unavailable(t, z, ct)
-            for w in machine.launch_warnings:
-                self.recorder.publish(Event(
-                    "Machine", machine.name, "OnDemandFlexibility", w, "Warning",
-                ))
-            # ktlint: allow[KT003] the provisioner label value is runtime
-            # data (user-defined names); the series cannot be pre-created at
-            # construction
-            self.registry.counter(NODES_CREATED).inc(
-                {"provisioner": machine.provisioner}
-            )
-            launched = SimNode(
-                instance_type=machine.instance_type,
-                provisioner=machine.provisioner,
-                zone=machine.zone,
-                capacity_type=machine.capacity_type,
-                price=machine.price,
-                allocatable=dict(machine.allocatable),
-                labels=dict(machine.labels),
-                taints=list(machine.taints),
-                existing=True,
-                # the registered node carries the cloud's name (per
-                # nodeNameConvention, settings.go:52); binds below use it,
-                # and existing-vs-new discrimination above used node.name
-                name=machine.node_name or node.name,
-                created_at=self.clock.now(),
-            )
-            launched.labels[L.HOSTNAME] = launched.name
-            prov = self.state.provisioners.get(machine.provisioner)
-            if prov and prov.ttl_seconds_until_expired is not None:
-                launched.expires_at = self.clock.now() + prov.ttl_seconds_until_expired
-            ns = self.state.add_node(launched, machine=machine)
-            ns.initialized = True
-            for pod in node.pods:
-                if pod.name in self.state.pods:
-                    self.state.bind(pod.name, launched.name)
+                launched = SimNode(
+                    instance_type=machine.instance_type,
+                    provisioner=machine.provisioner,
+                    zone=machine.zone,
+                    capacity_type=machine.capacity_type,
+                    price=machine.price,
+                    allocatable=dict(machine.allocatable),
+                    labels=dict(machine.labels),
+                    taints=list(machine.taints),
+                    existing=True,
+                    # the registered node carries the cloud's name (per
+                    # nodeNameConvention, settings.go:52); binds below use it,
+                    # and existing-vs-new discrimination above used node.name
+                    name=machine.node_name or node.name,
+                    created_at=self.clock.now(),
+                )
+                launched.labels[L.HOSTNAME] = launched.name
+                prov = self.state.provisioners.get(machine.provisioner)
+                if prov and prov.ttl_seconds_until_expired is not None:
+                    launched.expires_at = self.clock.now() + prov.ttl_seconds_until_expired
+                ns = self.state.add_node(launched, machine=machine)
+                ns.initialized = True
+                for pod in node.pods:
+                    if pod.name in self.state.pods:
+                        self.state.bind(pod.name, launched.name)
         self._observe_bind_latency(result)
         self._update_limit_gauges()
         return result

@@ -8,8 +8,16 @@ its control plane.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Callable, List, Optional
+import os
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Deque, List, Optional
+
+#: default in-memory retention; under sustained traffic an unbounded list
+#: is a slow leak (every reconcile tick can publish), so the recorder keeps
+#: a ring — old events fall off, the sink (control plane / flight recorder)
+#: has already seen them.  Override per-recorder or via KT_EVENTS_CAPACITY.
+DEFAULT_CAPACITY = 2048
 
 
 @dataclass(frozen=True)
@@ -22,8 +30,13 @@ class Event:
 
 
 class Recorder:
-    def __init__(self, sink: Optional[Callable[[Event], None]] = None) -> None:
-        self.events: List[Event] = []
+    def __init__(self, sink: Optional[Callable[[Event], None]] = None,
+                 capacity: Optional[int] = None) -> None:
+        if capacity is None:
+            capacity = int(os.environ.get("KT_EVENTS_CAPACITY",
+                                          str(DEFAULT_CAPACITY)))
+        self.capacity = max(1, capacity)
+        self.events: Deque[Event] = deque(maxlen=self.capacity)
         self._sink = sink
 
     def publish(self, event: Event) -> None:

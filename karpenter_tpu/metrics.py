@@ -89,25 +89,48 @@ class Registry:
     def histogram(self, name: str) -> Histogram:
         return self.histograms.setdefault(name, Histogram())
 
+    @staticmethod
+    def _sample(name: str, lkey: tuple, value) -> str:
+        lbl = ",".join(f'{k}="{val}"' for k, val in lkey)
+        # bucket/count samples are ints — keep them exact (``:g`` would turn
+        # 1000000 into 1e+06); float samples keep the compact form
+        v = str(value) if isinstance(value, int) else f"{value:g}"
+        return f"{name}{{{lbl}}} {v}" if lbl else f"{name} {v}"
+
     def expose(self) -> str:
-        """Prometheus text exposition."""
+        """Prometheus text exposition: ``# HELP`` (from :data:`INVENTORY`) +
+        ``# TYPE`` per family; histograms emit the full exposition format —
+        cumulative ``_bucket`` samples with ``le`` labels (including
+        ``+Inf``), ``_sum`` and ``_count`` — so quantile queries
+        (``histogram_quantile``) work against the scrape, not just counts."""
         lines: List[str] = []
+
+        def header(name: str, kind: str) -> None:
+            inv = INVENTORY.get(name)
+            if inv is not None:
+                lines.append(f"# HELP {name} {inv[2]}")
+            lines.append(f"# TYPE {name} {kind}")
+
         for name, c in sorted(self.counters.items()):
-            lines.append(f"# TYPE {name} counter")
+            header(name, "counter")
             for lkey, v in sorted(c.values.items()):
-                lbl = ",".join(f'{k}="{val}"' for k, val in lkey)
-                lines.append(f"{name}{{{lbl}}} {v:g}" if lbl else f"{name} {v:g}")
+                lines.append(self._sample(name, lkey, v))
         for name, g in sorted(self.gauges.items()):
-            lines.append(f"# TYPE {name} gauge")
+            header(name, "gauge")
             for lkey, v in sorted(g.values.items()):
-                lbl = ",".join(f'{k}="{val}"' for k, val in lkey)
-                lines.append(f"{name}{{{lbl}}} {v:g}" if lbl else f"{name} {v:g}")
+                lines.append(self._sample(name, lkey, v))
         for name, h in sorted(self.histograms.items()):
-            lines.append(f"# TYPE {name} histogram")
+            header(name, "histogram")
             for lkey, total in sorted(h.totals.items()):
-                lbl = ",".join(f'{k}="{val}"' for k, val in lkey)
-                base = f"{name}_count{{{lbl}}}" if lbl else f"{name}_count"
-                lines.append(f"{base} {total}")
+                cum = 0
+                for i, b in enumerate(h.buckets):
+                    cum += h.counts[lkey][i]
+                    lines.append(self._sample(
+                        f"{name}_bucket", lkey + (("le", f"{b:g}"),), cum))
+                lines.append(self._sample(
+                    f"{name}_bucket", lkey + (("le", "+Inf"),), total))
+                lines.append(self._sample(f"{name}_sum", lkey, h.sums[lkey]))
+                lines.append(self._sample(f"{name}_count", lkey, total))
         return "\n".join(lines)
 
 
@@ -140,6 +163,10 @@ TENSORIZE_CACHE_HITS = "karpenter_solver_tensorize_cache_hits_total"
 TENSORIZE_CACHE_MISSES = "karpenter_solver_tensorize_cache_misses_total"
 TENSORIZE_DURATION = "karpenter_solver_tensorize_duration_seconds"
 INFLIGHT_DEPTH = "karpenter_solver_inflight_depth"
+TRACE_TRACES = "karpenter_trace_traces_total"
+TRACE_SPAN_DURATION = "karpenter_trace_span_duration_seconds"
+TRACE_RING_EVICTIONS = "karpenter_trace_ring_evictions_total"
+FLIGHT_DUMPS = "karpenter_trace_flight_recorder_dumps_total"
 
 #: metric inventory: name -> (type, labels, help).  docs/METRICS.md is
 #: generated from this table (``karpenter-tpu metrics-doc``), mirroring the
@@ -239,6 +266,27 @@ INVENTORY = {
         "Async device dispatches currently in flight in each backend's "
         "solve pipeline (double-buffered dispatch overlaps host tensorize "
         "of batch N+1 with device execution of batch N)."),
+    TRACE_TRACES: (
+        "counter", (),
+        "Per-solve traces recorded by the tracer (obs/trace.py); one per "
+        "sampled solve/provision/deprovision pass.  KT_TRACE=0 disables "
+        "sampling entirely, KT_TRACE_SAMPLE_EVERY=N keeps 1 in N."),
+    TRACE_SPAN_DURATION: (
+        "histogram", ("span",),
+        "Duration of each named trace span (window / tensorize / dispatch "
+        "/ fence / reseat / respond / ...), seconds — the per-phase "
+        "attribution behind /tracez p50/p99."),
+    TRACE_RING_EVICTIONS: (
+        "counter", (),
+        "Traces evicted from the flight recorder's bounded ring to admit "
+        "newer ones (ring capacity: KT_FLIGHT_TRACES)."),
+    FLIGHT_DUMPS: (
+        "counter", ("reason",),
+        "Flight-recorder dumps triggered by anomaly, by reason: "
+        "device_hang (hang-guard trip), degraded_solve (warm-tier serve "
+        "while the device tier is latched unhealthy), budget_breach (a "
+        "trace exceeded KT_TRACE_SLOW_S), sanitizer_error (KT_SANITIZE "
+        "lock-discipline violation)."),
 }
 
 

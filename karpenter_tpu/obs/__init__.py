@@ -1,0 +1,73 @@
+"""karpenter_tpu.obs — per-solve span tracing + black-box flight recorder.
+
+Three pieces (docs/OBSERVABILITY.md has the operator-facing guide):
+
+- :mod:`.trace` — ``Tracer`` / ``Trace`` / ``Span``: one span tree per
+  solve (window → tensorize → dispatch → fence → reseat → respond),
+  near-zero-cost when sampling is off (``KT_TRACE=0``).
+- :mod:`.recorder` — ``FlightRecorder``: bounded ring of recent traces,
+  events and counter deltas, dumped automatically on anomalies (hang-guard
+  trip, degraded solve, latency-budget breach, sanitizer error).
+- :mod:`.export` — ``/tracez`` + ``/statusz`` JSON documents, the sidecar
+  observability HTTP server, and the terminal renderer.
+
+Process-default singletons mirror ``metrics.registry``: components accept
+an injected ``Tracer``; those constructed bare share :func:`default_tracer`
+(whose traces land in :func:`default_flight`).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .recorder import FlightRecorder
+from .trace import NULL_SPAN, NULL_TRACE, Span, Trace, Tracer
+
+__all__ = [
+    "FlightRecorder", "NULL_SPAN", "NULL_TRACE", "Span", "Trace", "Tracer",
+    "default_flight", "default_tracer", "tracer_for",
+]
+
+# RLock: default_tracer() resolves default_flight() while holding it
+_defaults_lock = threading.RLock()
+_default_flight: Optional[FlightRecorder] = None
+_default_tracer: Optional[Tracer] = None
+
+
+def default_flight() -> FlightRecorder:
+    """The process-default flight recorder (lazy; global metrics registry)."""
+    global _default_flight
+    with _defaults_lock:
+        if _default_flight is None:
+            _default_flight = FlightRecorder()
+        return _default_flight
+
+
+def default_tracer() -> Tracer:
+    """The process-default tracer, reporting into :func:`default_flight`."""
+    global _default_tracer
+    with _defaults_lock:
+        if _default_tracer is None:
+            _default_tracer = Tracer(flight=default_flight())
+        return _default_tracer
+
+
+def tracer_for(registry, clock=None) -> Tracer:
+    """Default tracer for a component handed ``registry`` but no tracer.
+
+    Metric ownership must follow the registry: a component constructed over
+    a private Registry (tests, per-scenario operators) must emit its trace
+    metrics THERE, not onto the process globals — so it gets a
+    registry-local tracer + flight recorder, on the component's injected
+    ``clock`` so FakeClock-driven traces keep ONE time base.  Only the
+    global registry maps to the shared process singletons (whose clock is
+    necessarily the wall clock).  (Components meant to share one ring — the
+    operator and its controllers — inject one Tracer explicitly.)
+    """
+    from .. import metrics
+
+    if registry is None or registry is metrics.registry:
+        return default_tracer()
+    return Tracer(clock=clock, registry=registry,
+                  flight=FlightRecorder(clock=clock, registry=registry))

@@ -1,0 +1,161 @@
+"""Observability HTTP surface: /tracez, /statusz, JSON trace export.
+
+``tracez``/``statusz`` build the JSON documents; :func:`serve` runs a tiny
+HTTP server over them for the solver sidecar (the operator mounts the same
+documents on its existing metrics server), and :func:`render_tracez` renders
+a terminal snapshot for ``make obs-demo``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..metrics import (
+    FLIGHT_DUMPS,
+    INFLIGHT_DEPTH,
+    REMOTE_DEGRADED,
+    SOLVER_COLD_FALLBACKS,
+    SOLVER_COMPILE_IN_PROGRESS,
+    SOLVER_DEGRADED_SOLVES,
+    SOLVER_DEVICE_HANGS,
+    SOLVER_DEVICE_HEALTHY,
+    TENSORIZE_CACHE_HITS,
+    TENSORIZE_CACHE_MISSES,
+    TRACE_TRACES,
+    Registry,
+)
+from .recorder import ANOMALY_REASONS, FlightRecorder
+
+
+def tracez(flight: FlightRecorder, limit: int = 50) -> dict:
+    """Recent traces (newest first, full span trees) + per-span p50/p99."""
+    traces = flight.traces()
+    return {
+        "count": len(traces),
+        "spans": flight.span_stats(),
+        "traces": [t.to_dict() for t in reversed(traces[-limit:])],
+    }
+
+
+def _series(metric, label: str) -> dict:
+    """{label-value: sample} for a single-label metric family."""
+    out = {}
+    for lkey, v in metric.values.items():
+        labels = dict(lkey)
+        out[labels.get(label, "")] = v
+    return out
+
+
+def statusz(registry: Registry, flight: Optional[FlightRecorder] = None) -> dict:
+    """One-page operational snapshot: backend health, cache hit rates,
+    inflight depth, fallback counters, flight-recorder state."""
+    hits = _series(registry.counter(TENSORIZE_CACHE_HITS), "tier")
+    n_hits = sum(hits.values())
+    n_miss = registry.counter(TENSORIZE_CACHE_MISSES).get()
+    total = n_hits + n_miss
+    doc = {
+        "device": {
+            "healthy": registry.gauge(SOLVER_DEVICE_HEALTHY).get() == 1.0,
+            "hangs": registry.counter(SOLVER_DEVICE_HANGS).get(),
+            "compiles_in_progress":
+                registry.gauge(SOLVER_COMPILE_IN_PROGRESS).get(),
+        },
+        "tensorize_cache": {
+            "hits": hits,
+            "misses": n_miss,
+            "hit_rate": round(n_hits / total, 4) if total else None,
+        },
+        "inflight_depth": _series(registry.gauge(INFLIGHT_DEPTH), "backend"),
+        "fallbacks": {
+            "cold": _series(registry.counter(SOLVER_COLD_FALLBACKS), "backend"),
+            "degraded": _series(
+                registry.counter(SOLVER_DEGRADED_SOLVES), "backend"),
+            "remote_degraded": registry.gauge(REMOTE_DEGRADED).get() == 1.0,
+        },
+        "traces_recorded": registry.counter(TRACE_TRACES).get(),
+    }
+    if flight is not None:
+        doc["flight_recorder"] = {
+            "ring": len(flight.traces()),
+            "capacity": flight.capacity,
+            "events": len(flight.events()),
+            "dumps": {
+                r: flight.registry.counter(FLIGHT_DUMPS).get({"reason": r})
+                for r in ANOMALY_REASONS
+            },
+            "last_dump": (
+                {k: flight.last_dump()[k] for k in ("seq", "reason", "detail", "at")}
+                if flight.last_dump() else None
+            ),
+        }
+    return doc
+
+
+def render_tracez(flight: FlightRecorder, limit: int = 8) -> str:
+    """Terminal snapshot of /tracez (``make obs-demo``)."""
+    lines = ["== /tracez =="]
+    stats = flight.span_stats()
+    if stats:
+        lines.append(f"{'span':<16} {'n':>5} {'p50_ms':>10} {'p99_ms':>10} "
+                     f"{'max_ms':>10}")
+        for name, s in stats.items():
+            lines.append(f"{name:<16} {s['n']:>5} {s['p50_ms']:>10.3f} "
+                         f"{s['p99_ms']:>10.3f} {s['max_ms']:>10.3f}")
+    traces = flight.traces()
+    lines.append(f"-- last {min(limit, len(traces))} of {len(traces)} "
+                 "trace(s) --")
+
+    def walk(d: dict, depth: int) -> None:
+        dur = d.get("duration_ms")
+        attrs = d.get("attrs") or {}
+        a = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        lines.append(
+            f"  {'  ' * depth}{d['name']:<{max(2, 18 - 2 * depth)}} "
+            f"{'open' if dur is None else f'{dur:9.3f}ms'}"
+            + (f"  [{a}]" if a else ""))
+        for c in d.get("spans", ()):
+            walk(c, depth + 1)
+
+    for tr in reversed(traces[-limit:]):
+        d = tr.to_dict()
+        lines.append(f"{d['trace_id']}:")
+        walk(d, 0)
+    return "\n".join(lines)
+
+
+def serve(registry: Registry, flight: FlightRecorder, port: int = 0,
+          host: str = "127.0.0.1") -> "tuple[ThreadingHTTPServer, int]":
+    """Start the sidecar observability server: /tracez, /statusz, /metrics.
+    Returns (server, bound_port); ``server.shutdown()`` stops it."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # silence
+            pass
+
+        def do_GET(self):
+            ctype = "application/json"
+            if self.path.startswith("/tracez"):
+                body = json.dumps(tracez(flight), default=str).encode()
+                code = 200
+            elif self.path.startswith("/statusz"):
+                body = json.dumps(statusz(registry, flight),
+                                  default=str).encode()
+                code = 200
+            elif self.path.startswith("/metrics"):
+                body, ctype, code = registry.expose().encode(), "text/plain", 200
+            else:
+                body, code = b'{"error": "not found"}', 404
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    bound = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="obs-http").start()
+    return server, bound

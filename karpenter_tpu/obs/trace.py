@@ -1,0 +1,363 @@
+"""Per-solve span tracing.
+
+The pipelined solver made a solve's latency a composite — batcher window,
+tensorize-cache tier, H2D dispatch, device fence, reseat/repair — but the
+aggregate histograms cannot explain a SINGLE slow or degraded solve after
+the fact.  A :class:`Tracer` produces one :class:`Trace` per solve: a tree
+of named :class:`Span`\\ s (``window`` → ``tensorize`` → ``dispatch`` →
+``fence`` → ``reseat`` → ``respond``) carrying attributes (backend, cache
+tier, ``served_cold``, batch size, cost), timestamped through the injectable
+:class:`~karpenter_tpu.utils.clock.Clock` so FakeClock tests are
+deterministic (and KT002 stays clean).
+
+Design constraints, in order:
+
+- **Near-zero cost when sampling is off.**  ``Tracer.start`` returns the
+  :data:`NULL_TRACE` singleton when disabled/unsampled; every span call on
+  it is a constant no-op, so the hot path pays one attribute check.
+- **Thread-crossing solves.**  A pipelined solve opens its root on the RPC
+  thread, its dispatch/fence spans on the dispatcher thread, and may fence
+  on the hang guard's expendable thread.  Nesting is tracked with a
+  per-thread open-span stack: a span opened on a thread with no open parent
+  attaches to the root.  Already-elapsed cross-thread phases (the pipeline
+  queue wait) are attached with :meth:`Trace.record`, which never leaves a
+  span open.
+- **Lock discipline.**  The span tree is mutated from multiple threads and
+  read mid-solve by the flight recorder's anomaly dumps; all tree state is
+  ``# guarded-by:`` the trace lock (KT004) and ``to_dict`` snapshots under
+  it.
+- **Context-manager lifecycle (KT007).**  ``with tracer.start(...) as
+  trace:`` / ``with trace.span(...):`` are the only blessed forms — a bare
+  ``Tracer.start()`` leaks an open trace on any exception path, and ktlint
+  rule KT007 flags it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import threading
+from typing import Dict, List, Optional
+
+from ..metrics import (
+    TRACE_SPAN_DURATION,
+    TRACE_TRACES,
+    Registry,
+    registry as default_registry,
+)
+from ..utils.clock import Clock
+
+#: hard per-trace span cap: a runaway retry ladder must not grow one trace
+#: without bound (spans past the cap are dropped and counted on the root)
+MAX_SPANS_PER_TRACE = 512
+
+_TRACE_IDS = itertools.count(1)
+
+
+class Span:
+    """One timed, attributed phase of a trace.  Obtained from
+    :meth:`Trace.span` (context manager) or :meth:`Trace.record`
+    (pre-closed); never constructed directly by instrumentation."""
+
+    __slots__ = ("name", "t0", "t1", "attrs", "children", "_trace")
+
+    def __init__(self, trace: "Trace", name: str, t0: float,
+                 attrs: Optional[dict] = None) -> None:
+        self.name = name
+        self.t0 = t0
+        self.t1: Optional[float] = None
+        self.attrs: Dict[str, object] = dict(attrs or ())
+        self.children: List["Span"] = []  # guarded-by the owning trace lock
+        self._trace = trace
+
+    @property
+    def done(self) -> bool:
+        return self.t1 is not None
+
+    @property
+    def duration_s(self) -> float:
+        return 0.0 if self.t1 is None else max(0.0, self.t1 - self.t0)
+
+    def annotate(self, **attrs) -> "Span":
+        self._trace._annotate_span(self, attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None:
+            self._trace._annotate_span(self, {"error": repr(exc)})
+        self._trace._close_span(self)
+        return False  # never swallow
+
+    def _to_dict_locked(self) -> dict:
+        """Serialize (caller holds the trace lock; see Trace.to_dict)."""
+        out: dict = {
+            "name": self.name,
+            "start": self.t0,
+            "end": self.t1,
+            "duration_ms": (None if self.t1 is None
+                            else round(self.duration_s * 1000.0, 3)),
+        }
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        if self.children:
+            out["spans"] = [c._to_dict_locked() for c in self.children]
+        return out
+
+
+class _NullSpan:
+    """Do-nothing span: the entire cost of tracing while sampling is off."""
+
+    __slots__ = ()
+
+    name = ""
+    attrs: dict = {}
+    children: list = []
+    done = True
+    duration_s = 0.0
+
+    def annotate(self, **attrs) -> "_NullSpan":
+        return self
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _NullTrace:
+    """Do-nothing trace returned by a disabled/unsampled ``Tracer.start``.
+    Falsy, so instrumentation can write ``trace = trace or NULL_TRACE`` and
+    branch on ``if trace:`` where it matters."""
+
+    __slots__ = ()
+
+    trace_id = ""
+    name = ""
+    duration_s = 0.0
+
+    def __bool__(self) -> bool:
+        return False
+
+    def now(self) -> float:
+        return 0.0
+
+    def span(self, name: str, **attrs) -> _NullSpan:
+        return NULL_SPAN
+
+    def record(self, name: str, t0: float, t1: float, **attrs) -> _NullSpan:
+        return NULL_SPAN
+
+    def annotate(self, **attrs) -> None:
+        return None
+
+    def spans(self) -> list:
+        return []
+
+    def span_names(self) -> list:
+        return []
+
+    def to_dict(self) -> dict:
+        return {}
+
+    def __enter__(self) -> "_NullTrace":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+NULL_TRACE = _NullTrace()
+
+
+class Trace:
+    """One solve's span tree.  Context manager: exiting closes the root and
+    hands the finished trace to the tracer (metrics + flight recorder)."""
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 attrs: Optional[dict] = None) -> None:
+        self._tracer = tracer
+        self._clock = tracer.clock
+        self.trace_id = f"t{next(_TRACE_IDS):06d}"
+        self.name = name
+        self._lock = threading.Lock()
+        self._n_spans = 1           # guarded-by: _lock
+        self._n_dropped = 0         # guarded-by: _lock
+        self.root = Span(self, name, self._clock.now(), attrs)
+        self._open = threading.local()  # per-thread open-span stack
+
+    # ---- time -----------------------------------------------------------
+    def now(self) -> float:
+        """The trace's clock (so callers on other threads timestamp
+        cross-thread phases consistently with the span tree)."""
+        return self._clock.now()
+
+    @property
+    def duration_s(self) -> float:
+        return self.root.duration_s
+
+    # ---- span lifecycle -------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._open, "stack", None)
+        if st is None:
+            st = self._open.stack = []
+        return st
+
+    def span(self, name: str, **attrs):
+        """Open a child span under this thread's innermost open span (the
+        root when none).  Use as ``with trace.span("tensorize") as sp:``."""
+        stack = self._stack()
+        parent = stack[-1] if stack else self.root
+        with self._lock:
+            if self._n_spans >= MAX_SPANS_PER_TRACE:
+                self._n_dropped += 1
+                self.root.attrs["spans_dropped"] = self._n_dropped
+                return NULL_SPAN
+            self._n_spans += 1
+            sp = Span(self, name, self._clock.now(), attrs)
+            parent.children.append(sp)
+        stack.append(sp)
+        return sp
+
+    def record(self, name: str, t0: float, t1: float, **attrs):
+        """Attach an already-elapsed span (cross-thread phases — e.g. the
+        pipeline queue wait, timestamped on the RPC thread and recorded by
+        the dispatcher).  The span is born closed, so no context manager is
+        needed and nothing can leak."""
+        with self._lock:
+            if self._n_spans >= MAX_SPANS_PER_TRACE:
+                self._n_dropped += 1
+                self.root.attrs["spans_dropped"] = self._n_dropped
+                return NULL_SPAN
+            self._n_spans += 1
+            sp = Span(self, name, t0, attrs)
+            sp.t1 = t1
+            self.root.children.append(sp)
+        return sp
+
+    def _close_span(self, span: Span) -> None:
+        with self._lock:
+            if span.t1 is None:
+                span.t1 = self._clock.now()
+        stack = self._stack()
+        if stack and stack[-1] is span:
+            stack.pop()
+
+    def _annotate_span(self, span: Span, attrs: dict) -> None:
+        with self._lock:
+            span.attrs.update(attrs)
+
+    def annotate(self, **attrs) -> None:
+        """Attach attributes to the root span (backend, batch size, cost,
+        served_cold, ...)."""
+        self._annotate_span(self.root, attrs)
+
+    # ---- completion / introspection -------------------------------------
+    def finish(self) -> "Trace":
+        with self._lock:
+            if self.root.t1 is None:
+                self.root.t1 = self._clock.now()
+        return self
+
+    def spans(self) -> List[Span]:
+        """Flat snapshot of every span (root first, depth-first)."""
+        with self._lock:
+            out: List[Span] = []
+            stack = [self.root]
+            while stack:
+                sp = stack.pop()
+                out.append(sp)
+                stack.extend(reversed(sp.children))
+            return out
+
+    def span_names(self) -> List[str]:
+        return [sp.name for sp in self.spans()]
+
+    def to_dict(self) -> dict:
+        """JSON-ready snapshot; safe to call mid-solve (anomaly dumps
+        serialize in-flight traces — open spans carry ``end: null``)."""
+        with self._lock:
+            return {"trace_id": self.trace_id, **self.root._to_dict_locked()}
+
+    def __enter__(self) -> "Trace":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None:
+            self.annotate(error=repr(exc))
+        self._tracer._finish(self)
+        return False
+
+
+class Tracer:
+    """Trace factory + completion sink.
+
+    ``enabled`` defaults from ``KT_TRACE`` (``0`` disables — the hot path
+    then costs one attribute check per solve); ``sample_every`` (from
+    ``KT_TRACE_SAMPLE_EVERY``) keeps one trace in every N starts, for
+    high-rate deployments where even ring churn matters.  Finished traces
+    are counted (``karpenter_trace_traces_total``), their spans observed
+    into ``karpenter_trace_span_duration_seconds{span=...}``, and handed to
+    the attached :class:`~karpenter_tpu.obs.recorder.FlightRecorder`.
+    """
+
+    def __init__(
+        self,
+        clock: Optional[Clock] = None,
+        registry: Optional[Registry] = None,
+        flight=None,
+        enabled: Optional[bool] = None,
+        sample_every: Optional[int] = None,
+    ) -> None:
+        self.clock = clock or Clock()
+        self.registry = registry or default_registry
+        self.flight = flight
+        if enabled is None:
+            enabled = os.environ.get("KT_TRACE", "1") != "0"
+        self.enabled = enabled
+        if sample_every is None:
+            sample_every = int(os.environ.get("KT_TRACE_SAMPLE_EVERY", "1"))
+        self.sample_every = max(1, sample_every)
+        self._lock = threading.Lock()
+        self._n_started = 0  # guarded-by: _lock
+        # zero-init so the series exists from the first scrape (KT003), and
+        # register the span-duration family so the documented metric is
+        # visible before the first trace completes
+        self.registry.counter(TRACE_TRACES).inc(value=0.0)
+        self.registry.histogram(TRACE_SPAN_DURATION)
+
+    def start(self, name: str, **attrs):
+        """Begin a trace — ALWAYS as ``with tracer.start(...) as trace:``
+        (ktlint KT007 flags bare starts).  Returns :data:`NULL_TRACE` when
+        disabled or unsampled."""
+        if not self.enabled:
+            return NULL_TRACE
+        with self._lock:
+            self._n_started += 1
+            sampled = self._n_started % self.sample_every == 0
+        if not sampled:
+            return NULL_TRACE
+        return Trace(self, name, attrs)
+
+    def _finish(self, trace: Trace) -> None:
+        trace.finish()
+        self.registry.counter(TRACE_TRACES).inc()
+        hist = self.registry.histogram(TRACE_SPAN_DURATION)
+        for sp in trace.spans():
+            if sp.done:
+                hist.observe(sp.duration_s, {"span": sp.name})
+        if self.flight is not None:
+            try:
+                self.flight.add(trace)
+            except Exception:  # noqa: BLE001 — runs in Trace.__exit__ on the
+                # solve path; a recorder failure must not fail the solve
+                logging.getLogger(__name__).warning(
+                    "flight recorder rejected trace %s", trace.trace_id,
+                    exc_info=True)

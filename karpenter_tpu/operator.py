@@ -42,6 +42,8 @@ from .metrics import Registry, decorate, registry as default_registry
 from .models.catalog import generate_catalog
 from .models.pod import PodSpec
 from .models.provisioner import Provisioner
+from .obs import FlightRecorder, Tracer
+from .obs import export as obs_export
 from .providers.pricing import PricingProvider
 from .providers.securitygroup import SecurityGroupProvider
 from .providers.subnet import SubnetProvider
@@ -175,7 +177,13 @@ class Operator:
         self.clock = clock or Clock()
         self.settings = settings or SettingsStore()
         self.registry = registry or default_registry
-        self.recorder = Recorder()
+        # observability spine (docs/OBSERVABILITY.md): one tracer + flight
+        # recorder per operator, on the operator's clock/registry; events
+        # feed the flight recorder's ring so anomaly dumps carry them
+        self.flight = FlightRecorder(clock=self.clock, registry=self.registry)
+        self.tracer = Tracer(clock=self.clock, registry=self.registry,
+                             flight=self.flight)
+        self.recorder = Recorder(sink=self.flight.add_event)
         self.elector = LeaderElector(
             identity=identity, store=lease_store, clock=self.clock
         )
@@ -207,7 +215,9 @@ class Operator:
                 registry=self.registry,
             )
         else:
-            self.scheduler = BatchScheduler(backend=scheduler_backend, registry=self.registry)
+            self.scheduler = BatchScheduler(backend=scheduler_backend,
+                                            registry=self.registry,
+                                            tracer=self.tracer)
         s = self.settings.current
         self.pricing = PricingProvider(
             cloud.get_instance_types(), clock=self.clock,
@@ -220,6 +230,7 @@ class Operator:
             self.state, self.cloud, scheduler=self.scheduler, recorder=self.recorder,
             registry=self.registry, unavailable=self.unavailable, clock=self.clock,
             idle_seconds=s.batch_idle_duration, max_seconds=s.batch_max_duration,
+            tracer=self.tracer,
         )
         self.termination = TerminationController(
             self.state, self.cloud, recorder=self.recorder,
@@ -230,6 +241,7 @@ class Operator:
             scheduler=self.scheduler, recorder=self.recorder, registry=self.registry,
             clock=self.clock, drift_enabled=s.drift_enabled,
             deprovisioning_ttl=s.deprovisioning_ttl,
+            tracer=self.tracer,
         )
         self.interruption = InterruptionController(
             self.state, self.termination, self.queue, unavailable=self.unavailable,
@@ -366,6 +378,7 @@ class Operator:
                 pass
 
             def do_GET(self):
+                ctype = None
                 if self.path == "/metrics":
                     body = op.registry.expose().encode()
                     self.send_response(200)
@@ -373,9 +386,23 @@ class Operator:
                     ok = op.healthz()
                     body = (b"ok" if ok else b"unhealthy")
                     self.send_response(200 if ok else 503)
+                elif self.path.startswith("/tracez"):
+                    # recent traces + per-span p50/p99 (obs/export.py)
+                    body = json.dumps(obs_export.tracez(op.flight),
+                                      default=str).encode()
+                    ctype = "application/json"
+                    self.send_response(200)
+                elif self.path.startswith("/statusz"):
+                    body = json.dumps(
+                        obs_export.statusz(op.registry, op.flight),
+                        default=str).encode()
+                    ctype = "application/json"
+                    self.send_response(200)
                 else:
                     body = b"not found"
                     self.send_response(404)
+                if ctype:
+                    self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -512,6 +539,15 @@ def _demo(args) -> None:
     cost2 = sum(ns.node.price for ns in op.state.nodes.values())
     print(f"  -> {len(op.state.nodes)} nodes, ${cost2:.2f}/hr, "
           f"pending={len(op.state.pending_pods())}, saved ${cost - cost2:.2f}/hr")
+    if getattr(args, "tracez", False):
+        # the observability surface, rendered for the terminal (make
+        # obs-demo): per-span p50/p99 over the run + the recent trace trees
+        from .obs.export import render_tracez, statusz
+
+        print(render_tracez(op.flight))
+        st = statusz(op.registry, op.flight)
+        print("== /statusz ==")
+        print(json.dumps(st, indent=2, default=str))
     op.shutdown()
 
 
@@ -558,6 +594,9 @@ def main(argv=None) -> int:
     parser.add_argument("--config", default="",
                         help="YAML manifest file/dir (Provisioners, "
                              "NodeTemplates, settings) loaded through admission")
+    parser.add_argument("--tracez", action="store_true",
+                        help="print a /tracez + /statusz snapshot after the "
+                             "demo (make obs-demo)")
     args = parser.parse_args(argv)
     if args.demo:
         _demo(args)

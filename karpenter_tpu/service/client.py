@@ -22,6 +22,7 @@ import grpc
 from ..metrics import Registry, registry as default_registry
 from ..models.instancetype import InstanceType
 from ..models.pod import PodSpec
+from ..obs.trace import NULL_TRACE
 from ..models.provisioner import Provisioner
 from ..solver.scheduler import BatchScheduler
 from ..solver.types import SimNode, SolveResult
@@ -177,37 +178,47 @@ class RemoteScheduler:
         unavailable: Optional[Set[tuple]] = None,
         allow_new_nodes: bool = True,
         max_new_nodes: Optional[int] = None,
+        trace=None,
     ) -> SolveResult:
+        trace = trace or NULL_TRACE
         if self._remote_ok():
-            req = codec.encode_request(
-                pods, provisioners, instance_types,
-                existing_nodes=existing_nodes, daemonsets=daemonsets,
-                unavailable=unavailable, allow_new_nodes=allow_new_nodes,
-                max_new_nodes=max_new_nodes, backend=self.backend,
-            )
-            try:
-                resp = self.client.solve_raw(req)
-            except grpc.RpcError as err:
-                if self._transport_failure(err):
-                    self._mark_degraded(err)
+            # the trace stays operator-side: the wire carries no context, so
+            # the whole RPC is one "remote" span here and the sidecar cuts
+            # its own trace (its /tracez has the per-phase breakdown)
+            with trace.span("remote", target=self.target) as span:
+                req = codec.encode_request(
+                    pods, provisioners, instance_types,
+                    existing_nodes=existing_nodes, daemonsets=daemonsets,
+                    unavailable=unavailable, allow_new_nodes=allow_new_nodes,
+                    max_new_nodes=max_new_nodes, backend=self.backend,
+                )
+                try:
+                    resp = self.client.solve_raw(req)
+                except grpc.RpcError as err:
+                    span.annotate(transport_error=str(
+                        err.code() if callable(getattr(err, "code", None))
+                        else err))
+                    if self._transport_failure(err):
+                        self._mark_degraded(err)
+                    else:
+                        logger.warning("remote solve failed (%s); serving this "
+                                       "solve from the local fallback",
+                                       err.code(), exc_info=True)
                 else:
-                    logger.warning("remote solve failed (%s); serving this "
-                                   "solve from the local fallback",
-                                   err.code(), exc_info=True)
-            else:
-                result = codec.decode_response(resp)
-                # re-attach real PodSpecs to returned nodes (wire carries
-                # names only)
-                by_name = {p.name: p for p in pods}
-                for node in result.nodes:
-                    node.pods = [by_name.get(p.name, p) for p in node.pods]
-                return result
+                    result = codec.decode_response(resp)
+                    # re-attach real PodSpecs to returned nodes (wire carries
+                    # names only)
+                    by_name = {p.name: p for p in pods}
+                    for node in result.nodes:
+                        node.pods = [by_name.get(p.name, p) for p in node.pods]
+                    return result
         self.registry.counter(REMOTE_FALLBACK_SOLVES).inc()
+        trace.annotate(remote_fallback=True)
         return self.fallback.solve(
             pods, provisioners, instance_types,
             existing_nodes=existing_nodes, daemonsets=daemonsets,
             unavailable=unavailable, allow_new_nodes=allow_new_nodes,
-            max_new_nodes=max_new_nodes,
+            max_new_nodes=max_new_nodes, trace=trace,
         )
 
     def warm_startup(

@@ -22,6 +22,8 @@ import grpc
 
 from ..batcher import InflightQueue
 from ..metrics import INFLIGHT_DEPTH, Registry, registry as default_registry
+from ..obs import tracer_for
+from ..obs.trace import NULL_TRACE, Tracer
 from ..solver.scheduler import BatchScheduler
 from . import codec
 from . import solver_pb2 as pb
@@ -90,6 +92,12 @@ class SolvePipeline:
     def solve(self, kwargs: dict):
         """RPC-thread entry: enqueue and block for this request's result."""
         fut: Future = Future()
+        # queue-wait attribution: stamp the enqueue on the request's trace
+        # clock here (RPC thread); the dispatcher closes the "window" span
+        # when it picks the request up — the cross-thread phase is recorded
+        # as an already-closed span, so nothing can leak
+        trace = kwargs.get("trace") or NULL_TRACE
+        t_enq = trace.now()
         # the stop-check and the put are one atomic step: a put that wins
         # the lock before stop()'s drain is guaranteed to be seen by the
         # drain; a put that loses sees _stop and refuses — either way no
@@ -98,7 +106,7 @@ class SolvePipeline:
         with self._submit_lock:
             if self._stop.is_set():
                 raise RuntimeError("solve pipeline stopped")
-            self._q.put((kwargs, fut))
+            self._q.put((kwargs, fut, t_enq))
         return fut.result()
 
     def stop(self) -> None:
@@ -121,7 +129,7 @@ class SolvePipeline:
         with self._submit_lock:
             while True:
                 try:
-                    _kwargs, fut = self._q.get_nowait()
+                    _kwargs, fut, _t_enq = self._q.get_nowait()
                 except queue.Empty:
                     break
                 _resolve(fut, exc=RuntimeError("solve pipeline stopped"))
@@ -147,11 +155,16 @@ class SolvePipeline:
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
-                kwargs, fut = self._q.get(timeout=0.1)
+                kwargs, fut, t_enq = self._q.get(timeout=0.1)
             except queue.Empty:
                 for pending, f in self._inflight.pop_to(0):
                     self._finalize(pending, f)
                 continue
+            # close the queue-wait phase on the request's trace: enqueue
+            # (RPC thread) -> pickup (this dispatcher)
+            trace = kwargs.get("trace") or NULL_TRACE
+            trace.record("window", t_enq, trace.now(),
+                         inflight=len(self._inflight))
             # in hand from pop to resolution; _finalize removes it.  A fut
             # parked in _inflight stays in the ledger too — stop() may then
             # fail it twice (once per structure), which _resolve absorbs.
@@ -183,9 +196,14 @@ class SolvePipeline:
 
 class SolverService:
     def __init__(self, scheduler: Optional[BatchScheduler] = None,
-                 registry: Optional[Registry] = None) -> None:
+                 registry: Optional[Registry] = None,
+                 tracer: Optional[Tracer] = None) -> None:
         self.registry = registry or default_registry
         self.scheduler = scheduler or BatchScheduler(registry=self.registry)
+        # per-RPC traces; default to the scheduler's tracer so the sidecar's
+        # /tracez sees exactly what its scheduler recorded
+        self.tracer = tracer or getattr(
+            self.scheduler, "tracer", None) or tracer_for(self.registry)
         self._schedulers = {"": self.scheduler}  # guarded-by: _direct_lock
         # KT_SOLVE_PIPELINE=0 falls back to direct, lock-serialized solves
         self._pipelined = os.environ.get("KT_SOLVE_PIPELINE", "1") != "0"
@@ -236,15 +254,27 @@ class SolverService:
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
         kwargs = codec.decode_request(request)
         sched = self._scheduler_for(request.backend)
-        if self._pipelined:
-            result = self._pipeline_for(sched).solve(kwargs)
-        else:
-            with self._direct_lock:
-                result = sched.solve(
-                    kwargs.pop("pods"), kwargs.pop("provisioners"),
-                    kwargs.pop("instance_types"), **kwargs,
-                )
-        return codec.encode_response(result)
+        # one trace per RPC, threaded through the pipeline's dispatch/
+        # finalize boundary via the kwargs dict (the dispatcher records the
+        # queue-wait "window" span on it; the scheduler opens tensorize/
+        # dispatch/fence/reseat under it); "respond" covers the encode back
+        # onto the wire
+        with self.tracer.start(
+            "solve", rpc="Solve", backend=sched.backend,
+            n_pods=len(kwargs.get("pods", ())),
+        ) as trace:
+            kwargs["trace"] = trace
+            if self._pipelined:
+                result = self._pipeline_for(sched).solve(kwargs)
+            else:
+                with self._direct_lock:
+                    result = sched.solve(
+                        kwargs.pop("pods"), kwargs.pop("provisioners"),
+                        kwargs.pop("instance_types"), **kwargs,
+                    )
+            with trace.span("respond"):
+                resp = codec.encode_response(result)
+        return resp
 
     def Warm(self, request: pb.WarmRequest, context) -> pb.WarmResponse:
         """Forwarded warm_startup: the operator ships its live provisioners,
@@ -311,10 +341,21 @@ def main(argv=None) -> int:
     # strand the operator on its local fallback forever
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--backend", default="auto", choices=["auto", "tpu", "oracle"])
+    parser.add_argument("--obs-port", type=int, default=0,
+                        help="observability HTTP port (/tracez, /statusz, "
+                             "/metrics); 0 disables")
     args = parser.parse_args(argv)
     service = SolverService(BatchScheduler(backend=args.backend))
     server, port = make_server(service, port=args.port, host=args.host)
     print(f"solver sidecar listening on {args.host}:{port} (backend={args.backend})")
+    if args.obs_port:
+        from ..obs import default_flight
+        from ..obs.export import serve as obs_serve
+
+        flight = service.tracer.flight or default_flight()
+        _obs_server, obs_port = obs_serve(
+            service.registry, flight, port=args.obs_port, host=args.host)
+        print(f"observability on http://{args.host}:{obs_port}/tracez")
     try:
         while True:
             time.sleep(3600)

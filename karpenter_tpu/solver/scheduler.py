@@ -43,6 +43,8 @@ from ..models.tensorize import (
     device_inexpressible,
     tensorize,
 )
+from ..obs import tracer_for
+from ..obs.trace import NULL_TRACE, Tracer
 from .guard import DeviceGuard, DeviceHang
 from .reference import solve as oracle_solve
 from .tpu import SlotsExhausted, TpuSolver
@@ -193,10 +195,15 @@ class BatchScheduler:
         mesh=None,
         native_batch_limit: int = NATIVE_BATCH_LIMIT,
         compile_behind: Optional[bool] = None,  # None: KT_COMPILE_BEHIND env
+        tracer: Optional[Tracer] = None,
     ) -> None:
         assert backend in ("auto", "tpu", "native", "oracle")
         self.backend = backend
         self.registry = registry or default_registry
+        # per-solve span tracing + anomaly dumps (obs/): callers pass a
+        # Trace per solve via the `trace` kwarg; the tracer itself is held
+        # for its flight recorder (hang/degraded anomaly hooks)
+        self.tracer = tracer if tracer is not None else tracer_for(self.registry)
         self.mesh = mesh
         self.native_batch_limit = native_batch_limit
         self.compile_behind = (
@@ -266,6 +273,7 @@ class BatchScheduler:
         unavailable: Optional[Set[tuple]] = None,
         allow_new_nodes: bool = True,
         max_new_nodes: Optional[int] = None,
+        trace=None,
     ) -> SolveResult:
         """Solve with preference relaxation: pods carrying preferences
         (preferred affinity terms, ScheduleAnyway topology spreads) are first
@@ -280,7 +288,7 @@ class BatchScheduler:
             pods, provisioners, instance_types,
             existing_nodes=existing_nodes, daemonsets=daemonsets,
             unavailable=unavailable, allow_new_nodes=allow_new_nodes,
-            max_new_nodes=max_new_nodes,
+            max_new_nodes=max_new_nodes, trace=trace,
             # a synchronous caller fences immediately — async dispatch buys
             # no overlap and would just split the device call across two
             # code paths; keep solve() on the classic sync path
@@ -298,6 +306,7 @@ class BatchScheduler:
         unavailable: Optional[Set[tuple]] = None,
         allow_new_nodes: bool = True,
         max_new_nodes: Optional[int] = None,
+        trace=None,
     ) -> "PendingScheduleResult":
         """Async entry point for pipelined callers (service/server.py
         SolvePipeline): tensorizes and DISPATCHES the first solver wave to
@@ -314,7 +323,7 @@ class BatchScheduler:
             pods, provisioners, instance_types,
             existing_nodes=existing_nodes, daemonsets=daemonsets,
             unavailable=unavailable, allow_new_nodes=allow_new_nodes,
-            max_new_nodes=max_new_nodes, dispatch=True,
+            max_new_nodes=max_new_nodes, trace=trace, dispatch=True,
         )
 
     def _submit(
@@ -328,16 +337,24 @@ class BatchScheduler:
         unavailable: Optional[Set[tuple]] = None,
         allow_new_nodes: bool = True,
         max_new_nodes: Optional[int] = None,
+        trace=None,
         dispatch: bool = False,
     ) -> "PendingScheduleResult":
         t0 = time.perf_counter()
+        trace = trace or NULL_TRACE
+        trace.annotate(backend=self.backend, n_pods=len(pods))
         hardened = [_harden_preferences(p) for p in pods]
         try:
-            first = self._solve_once(
-                hardened, provisioners,
-                instance_types, list(existing_nodes), daemonsets, unavailable,
-                allow_new_nodes, max_new_nodes, dispatch=dispatch,
-            )
+            # the dispatch span covers tensorize + H2D + device enqueue on
+            # the async path; on the sync/oracle path it covers the whole
+            # first wave (there is no separate fence to split out)
+            with trace.span("dispatch", async_dispatch=dispatch):
+                first = self._solve_once(
+                    hardened, provisioners,
+                    instance_types, list(existing_nodes), daemonsets,
+                    unavailable, allow_new_nodes, max_new_nodes,
+                    dispatch=dispatch, trace=trace,
+                )
         except BaseException:
             # the old solve() observed in a finally around the WHOLE solve;
             # a synchronous failure before the finish closure exists must
@@ -348,68 +365,92 @@ class BatchScheduler:
 
         def _finish() -> SolveResult:
             try:
-                res0 = first.finish() if isinstance(first, _PendingWave) else first
+                if isinstance(first, _PendingWave):
+                    # the overlap window closes here: one RTT to the device
+                    # fence (plus any slot-exhaustion retry) — the span that
+                    # explains a solve stuck behind a wedged tunnel
+                    with trace.span("fence"):
+                        res0 = first.finish()
+                else:
+                    res0 = first
                 result = self._solve_wave(
                     pods, provisioners, instance_types, list(existing_nodes),
                     daemonsets, unavailable, allow_new_nodes, max_new_nodes,
-                    first=res0,
+                    first=res0, trace=trace,
                 )
 
-                # OR'd required-affinity terms beyond the first: the solvers
-                # pack under term[0] only (tensorize.group_pods), so still-
-                # infeasible pods retry under each alternate term in order —
-                # the term list is a disjunction (scheduling.md
-                # nodeSelectorTerms semantics).
-                max_terms = max(
-                    (len(p.required_affinity_terms) for p in pods), default=0)
-                for k in range(1, max_terms):
-                    alts = []
-                    for p in pods:
-                        if p.name in result.infeasible and len(p.required_affinity_terms) > k:
-                            q = copy.copy(p)
-                            q.required_affinity_terms = [p.required_affinity_terms[k]]
-                            q.__dict__.pop("_group_key", None)
-                            alts.append(q)
-                    if not alts:
-                        break
-                    _merge(result, self._solve_wave(
-                        alts, provisioners, instance_types,
-                        list(result.existing_nodes) + result.nodes, daemonsets,
-                        unavailable, allow_new_nodes,
-                        _budget_left(result, max_new_nodes),
-                    ))
+                # the post-fence repair epilogues (OR-term ladder, residue
+                # convergence, capped-node reseat) share one "reseat" span —
+                # zero-iteration in steady state, the whole story when a
+                # solve is slow because its batch needed repair waves
+                with trace.span("reseat") as reseat_span:
+                    waves = 0
+                    # OR'd required-affinity terms beyond the first: the
+                    # solvers pack under term[0] only (tensorize.group_pods),
+                    # so still-infeasible pods retry under each alternate
+                    # term in order — the term list is a disjunction
+                    # (scheduling.md nodeSelectorTerms semantics).
+                    max_terms = max(
+                        (len(p.required_affinity_terms) for p in pods), default=0)
+                    for k in range(1, max_terms):
+                        alts = []
+                        for p in pods:
+                            if p.name in result.infeasible and len(p.required_affinity_terms) > k:
+                                q = copy.copy(p)
+                                q.required_affinity_terms = [p.required_affinity_terms[k]]
+                                q.__dict__.pop("_group_key", None)
+                                alts.append(q)
+                        if not alts:
+                            break
+                        waves += 1
+                        _merge(result, self._solve_wave(
+                            alts, provisioners, instance_types,
+                            list(result.existing_nodes) + result.nodes, daemonsets,
+                            unavailable, allow_new_nodes,
+                            _budget_left(result, max_new_nodes), trace=trace,
+                        ))
 
-                # residue convergence (see MAX_RESIDUE_WAVES): re-offer the
-                # still-infeasible pods the state every prior wave produced —
-                # open rows on placed nodes and the limit headroom left after
-                # funded creations — until a wave places nothing new.
-                for _ in range(MAX_RESIDUE_WAVES):
-                    retry = [p for p in pods if p.name in result.infeasible]
-                    if not retry:
-                        break
-                    sub = self._solve_wave(
-                        retry, provisioners, instance_types,
-                        list(result.existing_nodes) + result.nodes, daemonsets,
-                        unavailable, allow_new_nodes,
-                        _budget_left(result, max_new_nodes),
-                    )
-                    if not sub.assignments:
-                        break  # no progress: the residue is genuinely infeasible
-                    _merge(result, sub)
-                # ct-spread batches are already fully oracle-interleaved
-                # (batch_needs_oracle routing); the reseat epilogue buys
-                # nothing there and its incremental _ct_allowed re-fill has
-                # the same mid-band-hole weakness the zone check guards
-                # (ADVICE r5 medium) — skip it wholesale.  Judged on the
-                # HARDENED pods: routing hardens first, so a ScheduleAnyway
-                # ct spread becomes DoNotSchedule and oracle-routes exactly
-                # like a hard one — the skip must see the same batch
-                if not batch_needs_oracle(hardened):
-                    self._reseat_capped(
-                        result, provisioners, instance_types, daemonsets,
-                        unavailable, n_pods=len(pods),
-                        max_new_nodes=max_new_nodes,
-                    )
+                    # residue convergence (see MAX_RESIDUE_WAVES): re-offer
+                    # the still-infeasible pods the state every prior wave
+                    # produced — open rows on placed nodes and the limit
+                    # headroom left after funded creations — until a wave
+                    # places nothing new.
+                    for _ in range(MAX_RESIDUE_WAVES):
+                        retry = [p for p in pods if p.name in result.infeasible]
+                        if not retry:
+                            break
+                        sub = self._solve_wave(
+                            retry, provisioners, instance_types,
+                            list(result.existing_nodes) + result.nodes, daemonsets,
+                            unavailable, allow_new_nodes,
+                            _budget_left(result, max_new_nodes), trace=trace,
+                        )
+                        if not sub.assignments:
+                            break  # no progress: the residue is genuinely infeasible
+                        waves += 1
+                        _merge(result, sub)
+                    # ct-spread batches are already fully oracle-interleaved
+                    # (batch_needs_oracle routing); the reseat epilogue buys
+                    # nothing there and its incremental _ct_allowed re-fill has
+                    # the same mid-band-hole weakness the zone check guards
+                    # (ADVICE r5 medium) — skip it wholesale.  Judged on the
+                    # HARDENED pods: routing hardens first, so a ScheduleAnyway
+                    # ct spread becomes DoNotSchedule and oracle-routes exactly
+                    # like a hard one — the skip must see the same batch
+                    if not batch_needs_oracle(hardened):
+                        self._reseat_capped(
+                            result, provisioners, instance_types, daemonsets,
+                            unavailable, n_pods=len(pods),
+                            max_new_nodes=max_new_nodes,
+                        )
+                    reseat_span.annotate(repair_waves=waves)
+                trace.annotate(
+                    served_cold=result.served_cold,
+                    n_nodes=len(result.nodes),
+                    n_infeasible=len(result.infeasible),
+                    cost=round(result.new_node_cost, 4),
+                    solve_ms=round(result.solve_ms, 3),
+                )
                 return result
             finally:
                 self.registry.histogram(SCHEDULING_DURATION).observe(
@@ -680,6 +721,7 @@ class BatchScheduler:
     def _solve_wave(
         self, pods, provisioners, instance_types, existing_nodes, daemonsets,
         unavailable, allow_new_nodes, max_new_nodes, first=None,
+        trace=None,
     ) -> SolveResult:
         """One pod wave with the preference-relaxation ladder applied.
         ``first`` short-circuits the all-preferences-hardened opening solve
@@ -687,7 +729,7 @@ class BatchScheduler:
         result = first if first is not None else self._solve_once(
             [_harden_preferences(p) for p in pods], provisioners,
             instance_types, existing_nodes, daemonsets, unavailable,
-            allow_new_nodes, max_new_nodes,
+            allow_new_nodes, max_new_nodes, trace=trace,
         )
         # cap the ladder depth like the reference caps its long axes
         # (SURVEY §5 long-context analog: 60-type truncation, batching):
@@ -707,13 +749,14 @@ class BatchScheduler:
                 provisioners, instance_types,
                 list(result.existing_nodes) + result.nodes, daemonsets,
                 unavailable, allow_new_nodes,
-                _budget_left(result, max_new_nodes),
+                _budget_left(result, max_new_nodes), trace=trace,
             ))
         return result
 
     def _solve_once(
         self, pods, provisioners, instance_types, existing_nodes, daemonsets,
         unavailable, allow_new_nodes, max_new_nodes, dispatch=False,
+        trace=None,
     ):
         # a hard capacity-type spread couples the whole batch to the
         # sequential engine (batch_needs_oracle) — exact interleaved
@@ -735,6 +778,7 @@ class BatchScheduler:
         return self._solve_tpu(
             pods, provisioners, instance_types, existing_nodes, daemonsets,
             unavailable, allow_new_nodes, max_new_nodes, dispatch=dispatch,
+            trace=trace,
         )
 
     #: startup-warmup shape profiles: (groups, total_pods, with_zone_spread).
@@ -917,22 +961,24 @@ class BatchScheduler:
         return self.backend == "native"
 
     def _tensorize(self, pods, provisioners, instance_types, daemonsets,
-                   unavailable) -> Tuple["object", float]:
+                   unavailable, trace=NULL_TRACE) -> Tuple["object", float]:
         """Host tensorize through the incremental cache (steady-state: a
         lookup plus a counts vector — models/tensorize.TensorizeCache).
         Returns (tensors, seconds spent)."""
         t0 = time.perf_counter()
-        if self._tensorize_cache is not None:
-            st, tier = self._tensorize_cache.tensorize(
-                pods, provisioners, instance_types,
-                daemonsets=daemonsets, unavailable=unavailable,
-            )
-        else:
-            st = tensorize(
-                pods, provisioners, instance_types,
-                daemonsets=daemonsets, unavailable=unavailable,
-            )
-            tier = "off"
+        with trace.span("tensorize") as span:
+            if self._tensorize_cache is not None:
+                st, tier = self._tensorize_cache.tensorize(
+                    pods, provisioners, instance_types,
+                    daemonsets=daemonsets, unavailable=unavailable,
+                )
+            else:
+                st = tensorize(
+                    pods, provisioners, instance_types,
+                    daemonsets=daemonsets, unavailable=unavailable,
+                )
+                tier = "off"
+            span.annotate(tier=tier)
         dt = time.perf_counter() - t0
         self.registry.histogram(TENSORIZE_DURATION).observe(dt)
         if tier in ("identity", "shape"):
@@ -941,15 +987,32 @@ class BatchScheduler:
             self.registry.counter(TENSORIZE_CACHE_MISSES).inc()
         return st, dt
 
+    def _flight_anomaly(self, reason: str, detail: str, trace) -> None:
+        """Hand an anomaly (hang-guard trip, degraded solve) to the flight
+        recorder with the in-flight trace, so the dump explains THIS solve,
+        not just the ring before it.  Best-effort by contract: this sits on
+        the degraded/hang FALLBACK paths, where a failure to record must
+        never fail the solve the warm tier is about to serve."""
+        try:
+            flight = getattr(self.tracer, "flight", None)
+            if flight is not None:
+                flight.anomaly(reason, detail=detail,
+                               trace=trace if trace else None)
+        except Exception:  # noqa: BLE001 — observability must not fail solves
+            logger.warning("flight-recorder anomaly dump failed (%s)",
+                           reason, exc_info=True)
+
     def _solve_tpu(
         self, pods, provisioners, instance_types, existing_nodes, daemonsets,
         unavailable, allow_new_nodes, max_new_nodes, dispatch=False,
+        trace=None,
     ):
         """Device-tier wave.  Returns a SolveResult — or, when ``dispatch``
         is set and the batch takes the plain already-compiled device path
         with no oracle carve-outs, a :class:`_PendingWave` whose ``finish``
         fences the async dispatch (the pipelined-overlap window lives
         between the two)."""
+        trace = trace or NULL_TRACE
         # carve out pods the device solver can't express (rare shapes only)
         tpu_pods = [p for p in pods if not device_inexpressible(p)]
         cpu_pods = [p for p in pods if device_inexpressible(p)]
@@ -1033,7 +1096,8 @@ class BatchScheduler:
             return _tail()
 
         st, tsec = self._tensorize(
-            tpu_pods, provisioners, instance_types, daemonsets, unavailable)
+            tpu_pods, provisioners, instance_types, daemonsets, unavailable,
+            trace=trace)
         tensorize_ms += tsec * 1000.0
         t0 = time.perf_counter()
         new_budget = len(tpu_pods) if max_new_nodes is None else max_new_nodes
@@ -1044,6 +1108,7 @@ class BatchScheduler:
             """Post-device bookkeeping (metrics, what-if filtering, chain) —
             identical for the sync and async returns."""
             nonlocal solve_ms
+            trace.annotate(backend_used=backend_used)
             self.registry.histogram(SOLVER_BACKEND_DURATION).observe(
                 time.perf_counter() - t0, {"backend": backend_used}
             )
@@ -1074,6 +1139,7 @@ class BatchScheduler:
                 max_new_nodes,
             )
             served_cold = True
+            trace.annotate(served_cold=True)
             self.registry.counter(SOLVER_COLD_FALLBACKS).inc(
                 {"backend": backend_used}
             )
@@ -1097,6 +1163,11 @@ class BatchScheduler:
             self.registry.counter(SOLVER_DEGRADED_SOLVES).inc(
                 {"backend": backend_used}
             )
+            trace.annotate(degraded=True)
+            self._flight_anomaly(
+                "degraded_solve",
+                f"device tier latched unhealthy; {len(tpu_pods)}-pod batch "
+                f"served by the warm {backend_used} tier", trace)
             return res, backend_used
 
         if self._route_native(st, len(tpu_pods)):
@@ -1134,12 +1205,16 @@ class BatchScheduler:
                 return self._tpu.solve_async(
                     st, existing_nodes=all_existing, max_nodes=max_slots,
                     mesh=self.mesh, raise_on_exhaust=raise_on_exhaust,
+                    trace=trace,
                 )
 
             try:
                 pending = (self._guard.run(_dispatch_call) if guarded
                            else _dispatch_call())
             except DeviceHang:
+                self._flight_anomaly(
+                    "device_hang", "H2D dispatch hung past the guard "
+                    "deadline (wedged tunnel?)", trace)
                 res, backend_used = _degraded_fallback()
                 return _adopt_device(res, backend_used)
 
@@ -1152,6 +1227,9 @@ class BatchScheduler:
                     res, backend_used = _cold_fallback()
                     return _adopt_device(res, backend_used)
                 except DeviceHang:
+                    self._flight_anomaly(
+                        "device_hang", "device fence hung past the guard "
+                        "deadline (wedged tunnel?)", trace)
                     res, backend_used = _degraded_fallback()
                     return _adopt_device(res, backend_used)
 
@@ -1161,6 +1239,7 @@ class BatchScheduler:
             return self._tpu.solve(
                 st, existing_nodes=all_existing, max_nodes=max_slots,
                 mesh=self.mesh, raise_on_exhaust=raise_on_exhaust,
+                trace=trace,
             )
 
         if not degraded:
@@ -1179,6 +1258,8 @@ class BatchScheduler:
                 # the guard latched the device tier unhealthy; serve THIS
                 # batch from the warm tier like every batch until the
                 # recovery probe succeeds
-                pass
+                self._flight_anomaly(
+                    "device_hang", "device solve hung past the guard "
+                    "deadline (wedged tunnel?)", trace)
         res, backend_used = _degraded_fallback()
         return _adopt_device(res, backend_used)

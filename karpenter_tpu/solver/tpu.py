@@ -51,6 +51,7 @@ import numpy as np
 
 from ..models import labels as L
 from ..models.tensorize import NO_SELECTOR, SolveTensors
+from ..obs.trace import NULL_TRACE
 from ..utils.clock import Clock
 from ..ops.masks import (
     BIG,
@@ -1419,6 +1420,7 @@ class TpuSolver:
         measure: bool = False,
         full_nr: bool = False,
         raise_on_exhaust: bool = False,
+        trace=None,
     ) -> TpuSolveOutput:
         """One device solve.  ``measure=True`` adds a second, results-discarded
         execution with fenced timing (benchmarks only — production controller
@@ -1431,11 +1433,14 @@ class TpuSolver:
         the full program compiles behind (the 'callers must never eat a cold
         compile' contract)."""
         t0 = time.perf_counter()
-        run, init, NE, est_dims, full_dims, full_nr = self._prepare_dispatch(
-            st, existing_nodes, max_nodes, track_assignments, mesh, full_nr,
-        )
-        carry, ys = run(init)
-        np.asarray(carry[7])  # D2H fence; see timing note below
+        trace = trace or NULL_TRACE
+        with trace.span("device_prepare"):
+            run, init, NE, est_dims, full_dims, full_nr = self._prepare_dispatch(
+                st, existing_nodes, max_nodes, track_assignments, mesh, full_nr,
+            )
+        with trace.span("device_execute", full_nr=full_nr):
+            carry, ys = run(init)
+            np.asarray(carry[7])  # D2H fence; see timing note below
         compile_ms = (time.perf_counter() - t0) * 1000.0
         solve_ms = compile_ms
         # mark ready the key of the program that ACTUALLY compiled (a fresh
@@ -1469,10 +1474,11 @@ class TpuSolver:
             np.asarray(carry2[7])
             solve_ms = (time.perf_counter() - t1) * 1000.0
 
-        return self._extract(
-            st, carry, ys if track_assignments else None, existing_nodes,
-            NE, solve_ms, compile_ms,
-        )
+        with trace.span("extract"):
+            return self._extract(
+                st, carry, ys if track_assignments else None, existing_nodes,
+                NE, solve_ms, compile_ms,
+            )
 
     def solve_async(
         self,
@@ -1483,6 +1489,7 @@ class TpuSolver:
         track_assignments: bool = True,
         mesh=None,
         raise_on_exhaust: bool = False,
+        trace=None,
     ) -> "PendingTpuSolve":
         """Dispatch one device solve WITHOUT fencing.
 
@@ -1496,11 +1503,13 @@ class TpuSolver:
         (``ready()``); a cold shape compiles inline at dispatch, stalling
         the pipeline exactly like a cold ``solve`` would."""
         t0 = time.perf_counter()
-        run, init, NE, est_dims, full_dims, full_nr = self._prepare_dispatch(
-            st, existing_nodes, max_nodes, track_assignments, mesh,
-            full_nr=False,
-        )
-        carry, ys = run(init)  # async: enqueued, not fenced
+        trace = trace or NULL_TRACE
+        with trace.span("device_dispatch"):
+            run, init, NE, est_dims, full_dims, full_nr = self._prepare_dispatch(
+                st, existing_nodes, max_nodes, track_assignments, mesh,
+                full_nr=False,
+            )
+            carry, ys = run(init)  # async: enqueued, not fenced
         return PendingTpuSolve(
             solver=self, st=st, existing_nodes=existing_nodes, NE=NE,
             carry=carry, ys=ys, t0=t0, track=track_assignments,
@@ -1510,6 +1519,7 @@ class TpuSolver:
                 existing_nodes=existing_nodes, max_nodes=max_nodes,
                 track_assignments=track_assignments, mesh=mesh,
             ),
+            trace=trace,
         )
 
     # ---- result extraction ---------------------------------------------
@@ -1636,8 +1646,9 @@ class PendingTpuSolve:
 
     def __init__(self, solver, st, existing_nodes, NE, carry, ys, t0, track,
                  est_dims, full_dims, full_nr, raise_on_exhaust,
-                 solve_kwargs) -> None:
+                 solve_kwargs, trace=NULL_TRACE) -> None:
         self.solver = solver
+        self.trace = trace
         self.st = st
         self.existing_nodes = existing_nodes
         self.NE = NE
@@ -1657,7 +1668,8 @@ class PendingTpuSolve:
         if self._out is not None:
             return self._out
         s = self.solver
-        np.asarray(self.carry[7])  # the one-RTT D2H fence
+        with self.trace.span("device_fence"):
+            np.asarray(self.carry[7])  # the one-RTT D2H fence
         elapsed_ms = (time.perf_counter() - self.t0) * 1000.0
         s._mark_ready(_dims_key(self.full_dims if self.full_nr
                                 else self.est_dims))
@@ -1671,10 +1683,11 @@ class PendingTpuSolve:
         if retried is not None:
             self._out = retried
             return retried
-        self._out = s._extract(
-            self.st, self.carry, self.ys if self.track else None,
-            self.existing_nodes, self.NE, elapsed_ms, elapsed_ms,
-        )
+        with self.trace.span("extract"):
+            self._out = s._extract(
+                self.st, self.carry, self.ys if self.track else None,
+                self.existing_nodes, self.NE, elapsed_ms, elapsed_ms,
+            )
         return self._out
 
 

@@ -127,6 +127,18 @@ class TestBudgetGate:
         # new fields; the gate must not fire on their absence
         assert benchmod.check_budgets({"value": 100.0}) == {}
 
+    def test_trace_overhead_over_budget_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.BASE, trace_overhead_pct=3.5))
+        assert any("trace overhead" in f for f in out["budget_flags"])
+
+    def test_trace_overhead_within_budget_clean(self):
+        assert benchmod.check_budgets(
+            dict(self.BASE, trace_overhead_pct=1.2)) == {}
+        # the noise floor can read slightly negative — never a flag
+        assert benchmod.check_budgets(
+            dict(self.BASE, trace_overhead_pct=-0.8)) == {}
+
 
 def test_errored_prior_skipped(tmp_path):
     _write_prior(tmp_path, 3)

@@ -438,6 +438,72 @@ class TestKT006JitNondeterminism:
         assert lint(src) == []
 
 
+class TestKT007SpanLifecycle:
+    def test_bare_tracer_start_fires(self):
+        src = """
+        def solve(tracer):
+            trace = tracer.start("solve")
+            trace.annotate(backend="tpu")
+        """
+        assert rules_of(lint(src)) == ["KT007"]
+
+    def test_with_form_is_clean(self):
+        src = """
+        def solve(tracer):
+            with tracer.start("solve") as trace:
+                with trace.span("tensorize") as sp:
+                    sp.annotate(tier="identity")
+                trace.record("window", 0.0, 1.0)
+        """
+        assert lint(src) == []
+
+    def test_self_attribute_tracer_fires(self):
+        src = """
+        class Controller:
+            def reconcile(self):
+                trace = self._tracer.start("provision")
+                return trace
+        """
+        assert rules_of(lint(src)) == ["KT007"]
+
+    def test_bare_trace_span_fires(self):
+        src = """
+        def f(trace):
+            sp = trace.span("launch")
+            sp.annotate(n=1)
+        """
+        assert rules_of(lint(src)) == ["KT007"]
+
+    def test_start_span_fires_regardless_of_receiver(self):
+        src = """
+        def f(t):
+            return t.start_span("x")
+        """
+        assert rules_of(lint(src)) == ["KT007"]
+
+    def test_thread_and_server_starts_never_match(self):
+        src = """
+        import threading
+
+        def f(server):
+            t = threading.Thread(target=f)
+            t.start()
+            server.start()
+            self_thread = t
+            self_thread.start()
+        """
+        assert lint(src) == []
+
+    def test_suppression_with_reason(self):
+        src = """
+        def f(tracer):
+            # ktlint: allow[KT007] handed to the dispatcher, closed in _finalize
+            trace = tracer.start("solve")
+            return trace
+        """
+        assert lint(src) == []
+
+
 class TestSuppressionGrammar:
     SRC = """
     import time

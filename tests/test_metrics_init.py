@@ -76,6 +76,65 @@ class TestSchedulerSeries:
             {"backend": "native"}) == 1.0
 
 
+class TestExpositionFormat:
+    """Registry.expose() emits the full Prometheus text format (ISSUE 3
+    satellite): # HELP lines from INVENTORY, and for histograms the
+    cumulative _bucket series with le labels (incl. +Inf), _sum and _count —
+    pinned as a golden document so format drift is a diff, not a surprise."""
+
+    def test_golden_exposition(self):
+        from karpenter_tpu.metrics import BATCH_SIZE, NODES_CREATED
+
+        reg = Registry()
+        reg.counter(NODES_CREATED).inc({"provisioner": "default"}, value=3)
+        reg.gauge("karpenter_test_gauge").set(2.5)
+        h = reg.histogram(BATCH_SIZE)
+        h.buckets = (0.5, 1.0, 5.0)  # small ladder keeps the golden readable
+        h.observe(0.3)
+        h.observe(0.7)
+        h.observe(9.0)  # overflow -> +Inf only
+        golden = "\n".join([
+            "# HELP karpenter_nodes_created_total Nodes launched, by provisioner.",
+            "# TYPE karpenter_nodes_created_total counter",
+            'karpenter_nodes_created_total{provisioner="default"} 3',
+            "# TYPE karpenter_test_gauge gauge",
+            "karpenter_test_gauge 2.5",
+            "# HELP karpenter_provisioner_batch_size Pending pods per provisioning batch window.",
+            "# TYPE karpenter_provisioner_batch_size histogram",
+            'karpenter_provisioner_batch_size_bucket{le="0.5"} 1',
+            'karpenter_provisioner_batch_size_bucket{le="1"} 2',
+            'karpenter_provisioner_batch_size_bucket{le="5"} 2',
+            'karpenter_provisioner_batch_size_bucket{le="+Inf"} 3',
+            "karpenter_provisioner_batch_size_sum 10",
+            "karpenter_provisioner_batch_size_count 3",
+        ])
+        assert reg.expose() == golden
+
+    def test_histogram_buckets_are_cumulative_per_label_set(self):
+        from karpenter_tpu.metrics import SOLVER_BACKEND_DURATION
+
+        reg = Registry()
+        h = reg.histogram(SOLVER_BACKEND_DURATION)
+        h.buckets = (1.0, 2.0)
+        for v in (0.5, 0.6, 1.5):
+            h.observe(v, {"backend": "tpu"})
+        h.observe(0.1, {"backend": "oracle"})
+        text = reg.expose()
+        assert ('karpenter_solver_backend_duration_seconds_bucket'
+                '{backend="tpu",le="1"} 2') in text
+        assert ('karpenter_solver_backend_duration_seconds_bucket'
+                '{backend="tpu",le="2"} 3') in text
+        assert ('karpenter_solver_backend_duration_seconds_bucket'
+                '{backend="tpu",le="+Inf"} 3') in text
+        assert ('karpenter_solver_backend_duration_seconds_count'
+                '{backend="tpu"} 3') in text
+        assert ('karpenter_solver_backend_duration_seconds_bucket'
+                '{backend="oracle",le="+Inf"} 1') in text
+        # quantile math needs _sum too
+        assert ('karpenter_solver_backend_duration_seconds_sum'
+                '{backend="tpu"} 2.6') in text
+
+
 class TestInterruptionSeries:
     def test_every_message_kind_series_is_born_at_zero(self):
         reg = Registry()

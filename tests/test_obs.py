@@ -1,0 +1,415 @@
+"""Observability (ISSUE 3): per-solve span tracing + the flight recorder.
+
+Five surfaces:
+
+1. **Tracer semantics** — nesting via the per-thread open-span stack,
+   cross-thread ``record``, FakeClock-driven durations, the NULL fast path
+   when sampling is off, 1-in-N sampling, the per-trace span cap.
+2. **The acceptance path** — a steady-state solve through
+   ``SolverService.Solve`` yields a trace with >= 5 named spans,
+   retrievable over HTTP from ``/tracez`` (and ``/statusz`` reports the
+   surrounding state).
+3. **Attribution under concurrency** — N concurrent Solve RPCs through
+   ``SolvePipeline`` under KT_SANITIZE=1: each request gets its own trace,
+   spans land on the right trace with the right nesting, nothing bleeds.
+4. **The flight recorder** — bounded rings, eviction accounting, anomaly
+   dumps (contents, counter deltas, rate limiting, on-disk export), the
+   budget-breach auto-dump, and the injected-device-hang dump carrying the
+   hanging solve's own trace.
+5. **Bounded events** — ``events.Recorder`` keeps a capacity ring.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.events import Event, Recorder
+from karpenter_tpu.metrics import (
+    FLIGHT_DUMPS,
+    TRACE_RING_EVICTIONS,
+    TRACE_SPAN_DURATION,
+    TRACE_TRACES,
+    Registry,
+)
+from karpenter_tpu.models.instancetype import GIB
+from karpenter_tpu.models.pod import PodSpec
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.obs import FlightRecorder, Tracer
+from karpenter_tpu.obs import export
+from karpenter_tpu.obs.trace import MAX_SPANS_PER_TRACE, NULL_SPAN, NULL_TRACE
+from karpenter_tpu.solver.scheduler import BatchScheduler
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def batch(n=5, app="a"):
+    return [PodSpec(name=f"{app}-{i}", labels={"app": app},
+                    requests={"cpu": 0.5, "memory": GIB}, owner_key=app)
+            for i in range(n)]
+
+
+def make_obs(clock=None, **flight_kw):
+    clock = clock or FakeClock()
+    reg = Registry()
+    flight_kw.setdefault("min_dump_interval_s", 0.0)
+    flight = FlightRecorder(clock=clock, registry=reg, **flight_kw)
+    tracer = Tracer(clock=clock, registry=reg, flight=flight)
+    return clock, reg, flight, tracer
+
+
+class TestTracer:
+    def test_nesting_attribution_and_fakeclock_durations(self):
+        clock, reg, flight, tracer = make_obs()
+        with tracer.start("solve", n_pods=3) as trace:
+            clock.advance(0.5)
+            with trace.span("dispatch") as d:
+                with trace.span("tensorize") as sp:
+                    clock.advance(0.25)
+                    sp.annotate(tier="identity")
+            trace.record("window", 0.0, 0.5)
+        d = trace.to_dict()
+        assert d["name"] == "solve" and d["attrs"]["n_pods"] == 3
+        by_name = {c["name"]: c for c in d["spans"]}
+        # tensorize nested UNDER dispatch (the thread-local stack), window
+        # attached to the root (record)
+        assert set(by_name) == {"dispatch", "window"}
+        inner = by_name["dispatch"]["spans"][0]
+        assert inner["name"] == "tensorize"
+        assert inner["attrs"]["tier"] == "identity"
+        assert inner["duration_ms"] == 250.0
+        assert by_name["window"]["duration_ms"] == 500.0
+        assert trace.duration_s == 0.75
+        # finished traces land in metrics + the flight ring
+        assert reg.counter(TRACE_TRACES).get() == 1.0
+        assert reg.histogram(TRACE_SPAN_DURATION).count({"span": "tensorize"}) == 1
+        assert flight.traces() == [trace]
+
+    def test_cross_thread_span_attaches_to_root(self):
+        clock, _reg, _flight, tracer = make_obs()
+        with tracer.start("solve") as trace:
+            def dispatcher():
+                with trace.span("dispatch"):
+                    clock.advance(0.1)
+
+            t = threading.Thread(target=dispatcher)
+            t.start()
+            t.join()
+        d = trace.to_dict()
+        assert [c["name"] for c in d["spans"]] == ["dispatch"]
+
+    def test_disabled_tracer_is_null_and_costless(self):
+        _clock, reg, flight, _ = make_obs()
+        tracer = Tracer(registry=reg, flight=flight, enabled=False)
+        with tracer.start("solve") as trace:
+            assert trace is NULL_TRACE
+            assert trace.span("x") is NULL_SPAN
+            assert trace.record("y", 0, 1) is NULL_SPAN
+            trace.annotate(backend="tpu")  # no-op, no raise
+        assert not trace  # falsy: `trace or NULL_TRACE` idiom
+        assert flight.traces() == []
+        assert reg.counter(TRACE_TRACES).get() == 0.0
+
+    def test_sample_every_keeps_one_in_n(self):
+        _clock, _reg, flight, _ = make_obs()
+        tracer = Tracer(registry=Registry(), flight=flight, sample_every=3)
+        kept = 0
+        for _ in range(9):
+            with tracer.start("solve") as trace:
+                kept += 1 if trace else 0
+        assert kept == 3
+
+    def test_span_cap_bounds_runaway_traces(self):
+        _clock, _reg, _flight, tracer = make_obs()
+        with tracer.start("solve") as trace:
+            for _ in range(MAX_SPANS_PER_TRACE + 50):
+                with trace.span("s"):
+                    pass
+        assert len(trace.spans()) <= MAX_SPANS_PER_TRACE
+        assert trace.to_dict()["attrs"]["spans_dropped"] >= 50
+
+    def test_exception_annotates_and_still_finishes(self):
+        _clock, _reg, flight, tracer = make_obs()
+        with pytest.raises(ValueError):
+            with tracer.start("solve") as trace:
+                with trace.span("dispatch"):
+                    raise ValueError("boom")
+        assert "boom" in trace.to_dict()["attrs"]["error"]
+        assert flight.traces() == [trace]  # finished despite the raise
+
+
+class TestServiceTraceAcceptance:
+    """ISSUE 3 acceptance: a steady-state solve through SolverService.Solve
+    yields a trace with >= 5 named spans retrievable from /tracez."""
+
+    def _service(self, backend="oracle"):
+        from karpenter_tpu.service.server import SolverService
+
+        _clock, reg, flight, tracer = make_obs(clock=None)
+        sched = BatchScheduler(backend=backend, registry=reg, tracer=tracer)
+        svc = SolverService(sched, registry=reg)
+        return svc, reg, flight, tracer
+
+    def test_solve_rpc_trace_has_five_named_spans_on_tracez(self, small_catalog):
+        from karpenter_tpu.service import codec
+
+        svc, reg, flight, _tracer = self._service()
+        try:
+            prov = Provisioner(name="default").with_defaults()
+            req = codec.encode_request(batch(8), [prov], small_catalog)
+            resp = svc.Solve(req, None)
+            assert resp.assignments
+        finally:
+            svc.close()
+        traces = flight.traces()
+        assert len(traces) == 1
+        names = set(traces[0].span_names())
+        assert {"solve", "window", "dispatch", "reseat", "respond"} <= names
+        assert len(names) >= 5
+        # ... retrievable from /tracez over HTTP
+        server, port = export.serve(reg, flight, port=0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/tracez", timeout=10) as r:
+                doc = json.loads(r.read())
+            assert doc["count"] == 1
+            tr = doc["traces"][0]
+            flat = set()
+
+            def walk(d):
+                flat.add(d["name"])
+                for c in d.get("spans", ()):
+                    walk(c)
+
+            walk(tr)
+            assert {"solve", "window", "dispatch", "reseat", "respond"} <= flat
+            assert tr["attrs"]["n_pods"] == 8
+            # /statusz serves the surrounding operational state
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/statusz", timeout=10) as r:
+                st = json.loads(r.read())
+            assert st["traces_recorded"] == 1.0
+            assert st["flight_recorder"]["ring"] == 1
+            assert st["device"]["healthy"] is True
+        finally:
+            server.shutdown()
+
+    def test_device_path_trace_has_tensorize_and_fence(self, small_catalog):
+        """Forced-tpu backend through the pipelined RPC path: the async
+        dispatch/fence split plus the tensorize span are all attributed."""
+        from karpenter_tpu.service import codec
+
+        svc, _reg, flight, _tracer = self._service(backend="tpu")
+        try:
+            prov = Provisioner(name="default").with_defaults()
+            req = codec.encode_request(batch(3, "dev"), [prov], small_catalog,
+                                       backend="tpu")
+            resp = svc.Solve(req, None)
+            assert resp.assignments
+        finally:
+            svc.close()
+        names = set(flight.traces()[-1].span_names())
+        assert {"solve", "window", "tensorize", "dispatch", "fence",
+                "reseat", "respond"} <= names
+
+
+class TestConcurrentAttribution:
+    def test_concurrent_rpcs_each_get_their_own_nested_trace(
+            self, small_catalog):
+        """ISSUE 3 satellite: trace-span nesting/attribution under
+        KT_SANITIZE=1 through concurrent SolvePipeline RPCs — every RPC cuts
+        its own trace, each carries the full pipeline span set exactly once,
+        and attributes match that request's batch."""
+        from karpenter_tpu.analysis import sanitize
+        from karpenter_tpu.service import codec
+        from karpenter_tpu.service.server import SolverService
+
+        pre = sanitize.installed()
+        sanitize.install()
+        try:
+            _clock, reg, flight, tracer = make_obs()
+            svc = SolverService(
+                BatchScheduler(backend="oracle", registry=reg, tracer=tracer),
+                registry=reg)
+            prov = Provisioner(name="default").with_defaults()
+            n = 6
+            errors = []
+
+            def call(i):
+                try:
+                    req = codec.encode_request(
+                        batch(4 + i, f"g{i}"), [prov], small_catalog)
+                    svc.Solve(req, None)
+                except Exception as e:  # pragma: no cover - surfaced below
+                    errors.append((i, e))
+
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            svc.close()
+            assert not errors
+            traces = flight.traces()
+            assert len(traces) == n
+            sizes = set()
+            for tr in traces:
+                d = tr.to_dict()
+                top = [c["name"] for c in d["spans"]]
+                # the full pipeline span set, exactly once per trace — a
+                # span bleeding onto a neighbor's trace would double one
+                # name here and drop it there
+                for name in ("window", "dispatch", "reseat", "respond"):
+                    assert top.count(name) == 1, (name, top)
+                assert d["attrs"]["n_nodes"] >= 1
+                sizes.add(d["attrs"]["n_pods"])
+            # attribution: each trace kept its own request's batch size
+            assert sizes == {4 + i for i in range(n)}
+        finally:
+            if not pre:
+                sanitize.uninstall()
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_evictions_are_counted(self):
+        clock = FakeClock()
+        reg = Registry()
+        flight = FlightRecorder(capacity=4, clock=clock, registry=reg)
+        tracer = Tracer(clock=clock, registry=reg, flight=flight)
+        for i in range(10):
+            with tracer.start(f"s{i}"):
+                clock.advance(0.01)
+        traces = flight.traces()
+        assert len(traces) == 4
+        assert [t.name for t in traces] == ["s6", "s7", "s8", "s9"]
+        assert reg.counter(TRACE_RING_EVICTIONS).get() == 6.0
+
+    def test_anomaly_dump_contents_and_counter_deltas(self, tmp_path):
+        clock, reg, flight, tracer = make_obs()
+        flight.dump_dir = str(tmp_path / "flight")
+        with tracer.start("solve") as trace:
+            clock.advance(0.2)
+        reg.counter("karpenter_solver_device_hangs_total").inc()
+        flight.add_event(Event("Node", "n1", "SpotInterrupted", "2m notice"))
+        dump = flight.anomaly("device_hang", detail="fence hung",
+                              trace=trace)
+        assert dump["reason"] == "device_hang" and dump["detail"] == "fence hung"
+        assert dump["trace"]["trace_id"] == trace.trace_id
+        assert [t["trace_id"] for t in dump["traces"]] == [trace.trace_id]
+        assert dump["events"][0]["reason"] == "SpotInterrupted"
+        assert dump["counter_deltas"][
+            "karpenter_solver_device_hangs_total"] == 1.0
+        assert reg.counter(FLIGHT_DUMPS).get({"reason": "device_hang"}) == 1.0
+        # written to disk for post-mortem collection
+        on_disk = json.loads(open(dump["path"]).read())
+        assert on_disk["reason"] == "device_hang"
+        # deltas reset at each dump: a second dump shows only NEW movement
+        dump2 = flight.anomaly("degraded_solve", detail="warm tier")
+        assert "karpenter_solver_device_hangs_total" not in dump2["counter_deltas"]
+
+    def test_rate_limit_suppresses_same_reason_dumps(self):
+        clock = FakeClock()
+        reg = Registry()
+        flight = FlightRecorder(clock=clock, registry=reg,
+                                min_dump_interval_s=30.0)
+        assert flight.anomaly("degraded_solve") is not None
+        assert flight.anomaly("degraded_solve") is None  # inside the window
+        assert flight.anomaly("device_hang") is not None  # other reasons pass
+        clock.advance(31.0)
+        assert flight.anomaly("degraded_solve") is not None
+        assert reg.counter(FLIGHT_DUMPS).get({"reason": "degraded_solve"}) == 2.0
+
+    def test_slow_trace_triggers_budget_breach_dump(self):
+        clock = FakeClock()
+        reg = Registry()
+        flight = FlightRecorder(clock=clock, registry=reg, slow_trace_s=5.0,
+                                min_dump_interval_s=0.0)
+        tracer = Tracer(clock=clock, registry=reg, flight=flight)
+        with tracer.start("fast"):
+            clock.advance(1.0)
+        assert flight.dumps() == []
+        with tracer.start("stuck") as slow:
+            clock.advance(6.0)
+        dumps = flight.dumps()
+        assert len(dumps) == 1 and dumps[0]["reason"] == "budget_breach"
+        assert dumps[0]["trace"]["trace_id"] == slow.trace_id
+
+    def test_unknown_reason_folds_into_other(self):
+        _clock, reg, flight, _tracer = make_obs()
+        dump = flight.anomaly("cosmic_rays")
+        assert dump["reason"] == "other"
+        assert reg.counter(FLIGHT_DUMPS).get({"reason": "other"}) == 1.0
+
+
+class TestInjectedDeviceHang:
+    def test_hang_dump_contains_the_hanging_solves_trace(self, small_catalog):
+        """ISSUE 3 acceptance: an injected device hang produces a
+        flight-recorder dump containing that solve's trace (FakeClock-driven
+        timestamps), while the solve itself degrades to the warm tier."""
+        from karpenter_tpu.solver.guard import DeviceHang
+        from karpenter_tpu.solver.types import SolveResult
+
+        clock, reg, flight, tracer = make_obs()
+        sched = BatchScheduler(backend="auto", registry=reg, tracer=tracer,
+                               native_batch_limit=0, compile_behind=False)
+        # the device program is "compiled"; the guard trips at the call
+        sched._device_ready = lambda *a, **k: True
+
+        def wedged_run(fn, *a, **k):
+            clock.advance(180.0)  # the guard deadline elapsing, fake time
+            raise DeviceHang("injected: call exceeded 180s")
+
+        sched._guard.run = wedged_run
+        prov = Provisioner(name="default").with_defaults()
+        with tracer.start("solve", n_pods=6) as trace:
+            result = sched.solve(batch(6), [prov], small_catalog, trace=trace)
+        # the solve degraded to a warm host tier, it did not fail
+        assert isinstance(result, SolveResult)
+        assert not result.infeasible
+        dumps = flight.dumps()
+        reasons = [d["reason"] for d in dumps]
+        assert "device_hang" in reasons and "degraded_solve" in reasons
+        hang = dumps[reasons.index("device_hang")]
+        # the dump carries THIS solve's (then in-flight) trace, tensorize
+        # and dispatch already cut, and the root span still open at dump
+        # time (end: null) — the black-box contract
+        assert hang["trace"]["trace_id"] == trace.trace_id
+        flat = set()
+
+        def walk(d):
+            flat.add(d["name"])
+            for c in d.get("spans", ()):
+                walk(c)
+
+        walk(hang["trace"])
+        assert {"tensorize", "dispatch"} <= flat
+        assert hang["trace"]["end"] is None
+        assert reg.counter(FLIGHT_DUMPS).get({"reason": "device_hang"}) == 1.0
+        # the finished trace records the degradation attribution
+        assert trace.to_dict()["attrs"]["degraded"] is True
+
+
+class TestBoundedEvents:
+    def test_recorder_keeps_a_capacity_ring(self):
+        rec = Recorder(capacity=5)
+        for i in range(12):
+            rec.publish(Event("Pod", f"p{i}", "FailedScheduling", "m"))
+        assert len(rec.events) == 5
+        assert [e.name for e in rec.events] == [f"p{i}" for i in range(7, 12)]
+        # of()/clear() keep their contracts on the ring
+        assert len(rec.of("FailedScheduling")) == 5
+        rec.clear()
+        assert len(rec.events) == 0
+
+    def test_sink_still_sees_every_event(self):
+        seen = []
+        rec = Recorder(sink=seen.append, capacity=2)
+        for i in range(6):
+            rec.publish(Event("Pod", f"p{i}", "R", "m"))
+        assert len(seen) == 6 and len(rec.events) == 2
+
+    def test_env_capacity(self, monkeypatch):
+        monkeypatch.setenv("KT_EVENTS_CAPACITY", "3")
+        rec = Recorder()
+        assert rec.capacity == 3

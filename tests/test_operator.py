@@ -146,6 +146,20 @@ class TestOperator:
             assert "karpenter_nodes_created_total" in body
             health = urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
             assert health.status == 200
+            # the observability surface rides the same server (ISSUE 3):
+            # the provisioning pass above cut a trace with the window/
+            # dispatch spans, and /statusz reports the flight-recorder ring
+            import json as _json
+
+            tz = _json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/tracez").read())
+            assert tz["count"] >= 1
+            names = {c["name"] for t in tz["traces"]
+                     for c in t.get("spans", ())}
+            assert {"window", "dispatch"} <= names
+            st = _json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz").read())
+            assert st["flight_recorder"]["ring"] == tz["count"]
             with pytest.raises(urllib.error.HTTPError):
                 urllib.request.urlopen(f"http://127.0.0.1:{port}/nope")
         finally:
