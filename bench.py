@@ -301,13 +301,41 @@ TENSORIZE_SHAPE_MAX_COLD_FRACTION = 0.75
 #: solve may be at most this much slower than sampling-OFF (ISSUE 3)
 TRACE_OVERHEAD_BUDGET_PCT = 2.0
 
+#: megabatch gates (ISSUE 4): a coalescer that batches must BEAT serial
+#: dispatch under load, and a lone request must not pay for the machinery
+SINGLE_LATENCY_REGRESSION_MAX = 1.10
+#: warmup-enabled cold start: first RPC of a precompiled bucket must answer
+#: within this (the AOT win the --warmup flag buys)
+WARMUP_COLD_SOLVE_BUDGET_MS = 100.0
+
 
 def check_budgets(rec):
     """Absolute per-round gates (no prior round needed): steady-state
     tensorize stays under budget, the shape tier stays well under the cold
-    build, the cached tensorize path is byte-exact, and FFD cost parity
-    holds.  Returns {} or {"budget_flags": [...]}."""
+    build, the cached tensorize path is byte-exact, FFD cost parity holds,
+    the occupied megabatch beats serial dispatch without taxing lone
+    requests, and a warmed bucket's first solve stays under the AOT
+    budget.  Returns {} or {"budget_flags": [...]}."""
     flags = []
+    c1, c32 = rec.get("solves_per_sec_c1"), rec.get("solves_per_sec_c32")
+    if c1 and c32 and c32 <= c1:
+        flags.append(
+            f"megabatch throughput {c32:.1f}/s at concurrency 32 does not "
+            f"beat the serial concurrency-1 baseline {c1:.1f}/s")
+    lr = rec.get("single_latency_ratio")
+    if lr is not None and lr > SINGLE_LATENCY_REGRESSION_MAX:
+        flags.append(
+            f"single-request latency with the coalescer on is {lr:.2f}x the "
+            f"coalescer-off path (budget {SINGLE_LATENCY_REGRESSION_MAX}x)")
+    wm = rec.get("cold_first_solve_warm_ms")
+    if wm is not None and wm > WARMUP_COLD_SOLVE_BUDGET_MS:
+        flags.append(
+            f"warmup-enabled cold first solve {wm:.1f}ms exceeds the "
+            f"{WARMUP_COLD_SOLVE_BUDGET_MS:.0f}ms AOT budget")
+    if rec.get("cold_first_solve_warm_served_cold"):
+        flags.append(
+            "warmup-enabled first solve was still served from the warm "
+            "host tier — the precompile did not cover its bucket")
     ts = rec.get("tensorize_steady_ms")
     if ts is not None and ts > TENSORIZE_STEADY_BUDGET_MS:
         flags.append(
@@ -420,6 +448,185 @@ def measure_trace_overhead(pairs: int = 11, solves: int = 2,
             round(statistics.median(ons) * 1000.0, 2))
 
 
+def _serving_pods(client: int, n_groups: int = 8, per: int = 40):
+    """One serving client's pod batch: same SHAPES across clients (one
+    megabatch bucket) but distinct pods/labels/requests per client — the
+    multi-tenant traffic the coalescer exists for.  320 pods sits above the
+    auto policy's oracle crossover, so these ride the device path."""
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.instancetype import GIB
+    from karpenter_tpu.models.pod import (
+        LabelSelector,
+        PodSpec,
+        TopologySpreadConstraint,
+    )
+
+    pods = []
+    for gi in range(n_groups):
+        sel = LabelSelector.of({"app": f"c{client}-g{gi}"})
+        for i in range(per):
+            pods.append(PodSpec(
+                name=f"c{client}-g{gi}-{i}",
+                labels={"app": f"c{client}-g{gi}"},
+                requests={"cpu": 0.25 * (1 + (gi + client) % 6),
+                          "memory": float(1 + (gi + client) % 3) * GIB},
+                topology_spread=[TopologySpreadConstraint(
+                    1, L.ZONE, "DoNotSchedule", sel)],
+                owner_key=f"c{client}-g{gi}",
+            ))
+    return pods
+
+
+def measure_throughput(duration_s: float = 4.0, max_slots: int = 8):
+    """Closed-loop service throughput (ISSUE 4): N client threads each
+    re-submitting their own pending set through the SolvePipeline, at
+    concurrency 1 / 8 / 32.  The concurrency-1 run uses a max_slots=1
+    pipeline — the serial-dispatch baseline — so the c32 number measures
+    exactly what cross-request megabatching buys; a second c1 run with the
+    coalescer ON gates the lone-request latency tax.  Returns the record
+    fragment (solves_per_sec_c{1,8,32}, batch_occupancy_mean,
+    megabatch_speedup, single_latency_{on,off}_ms + ratio)."""
+    import threading
+
+    from karpenter_tpu.metrics import MEGABATCH_SLOTS, Registry
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.service.server import SolvePipeline
+    from karpenter_tpu.solver.scheduler import BatchScheduler
+
+    catalog = generate_catalog(full=False)
+    provs = [Provisioner(name="default").with_defaults()]
+    reg = Registry()
+    sched = BatchScheduler(backend="tpu", registry=reg)
+    client_pods = [_serving_pods(c) for c in range(32)]
+
+    # warm every program the phases will hit: the single-solve program plus
+    # the megabatch rungs up to max_slots, against the REAL request shape
+    st, _ = sched._tensorize_cache.tensorize(client_pods[0], provs, catalog)
+    sched._tpu.warm_async(st, on_done=sched._warm_done)
+    rung = 2
+    while rung <= max_slots:
+        sched._tpu.warm_async(st, slots=rung, on_done=sched._warm_done)
+        rung *= 2
+    deadline = time.perf_counter() + 1200.0
+    while not sched._tpu.warm_idle() and time.perf_counter() < deadline:
+        time.sleep(0.3)
+
+    def phase(concurrency: int, slots: int):
+        pipe = SolvePipeline(sched, registry=reg, max_slots=slots)
+        try:
+            h = reg.histogram(MEGABATCH_SLOTS)
+            occ0 = (sum(h.sums.values()), sum(h.totals.values()))
+            counts = [0] * concurrency
+            stop_at = time.perf_counter() + duration_s
+            start = threading.Barrier(concurrency + 1)
+
+            def client(ci):
+                start.wait()
+                while time.perf_counter() < stop_at:
+                    pipe.solve(dict(pods=client_pods[ci],
+                                    provisioners=provs,
+                                    instance_types=catalog))
+                    counts[ci] += 1
+
+            threads = [threading.Thread(target=client, args=(ci,))
+                       for ci in range(concurrency)]
+            for t in threads:
+                t.start()
+            t0 = time.perf_counter()
+            start.wait()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            occ1 = (sum(h.sums.values()), sum(h.totals.values()))
+            d_sum, d_n = occ1[0] - occ0[0], occ1[1] - occ0[1]
+            occupancy = (d_sum / d_n) if d_n else None
+            return sum(counts) / max(elapsed, 1e-9), occupancy
+        finally:
+            pipe.stop()
+
+    c1_serial, _ = phase(1, slots=1)       # the serial-dispatch baseline
+    c1_coal, _ = phase(1, slots=max_slots)  # lone request, coalescer armed
+    c8, _ = phase(8, slots=max_slots)
+    c32, occupancy = phase(32, slots=max_slots)
+
+    lat_off = 1000.0 / max(c1_serial, 1e-9)
+    lat_on = 1000.0 / max(c1_coal, 1e-9)
+    return {
+        "solves_per_sec_c1": round(c1_serial, 2),
+        "solves_per_sec_c8": round(c8, 2),
+        "solves_per_sec_c32": round(c32, 2),
+        "megabatch_speedup": round(c32 / max(c1_serial, 1e-9), 2),
+        "batch_occupancy_mean": (None if occupancy is None
+                                 else round(occupancy, 2)),
+        "megabatch_max_slots": max_slots,
+        "single_latency_off_ms": round(lat_off, 2),
+        "single_latency_on_ms": round(lat_on, 2),
+        "single_latency_ratio": round(lat_on / max(lat_off, 1e-9), 3),
+    }
+
+
+_WARMCOLD_SNIPPET = """
+import os, time, importlib.util
+spec = importlib.util.spec_from_file_location("benchmod", {bench!r})
+b = importlib.util.module_from_spec(spec); spec.loader.exec_module(b)
+from karpenter_tpu.models.catalog import generate_catalog
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.solver.scheduler import BatchScheduler
+catalog = generate_catalog(full=False)
+provs = [Provisioner(name="default").with_defaults()]
+pods = b._serving_pods(0)
+sched = BatchScheduler(backend="auto")
+if {warmup!r} == "on":
+    t0 = time.perf_counter()
+    n = sched.precompile_buckets(provs, catalog, profiles=((8, 320, True),),
+                                 mega_slots=(), wait=True, timeout=1500)
+    print("WARMED", n, round(time.perf_counter() - t0, 1))
+t0 = time.perf_counter()
+res = sched.solve(pods, provs, catalog)
+print("FIRST_MS", (time.perf_counter() - t0) * 1000.0, len(res.nodes),
+      int(res.served_cold))
+"""
+
+
+def measure_warm_coldstart():
+    """First-solve latency of a SERVING-shaped batch in a brand-new process,
+    warmup on vs off (ISSUE 4's AOT story): ``on`` runs the blocking
+    bucket-grid precompile (``serve --warmup``) and the first RPC must ride
+    the compiled device program under the 100 ms budget; ``off`` keeps the
+    compile-behind posture (KT_COMPILE_BEHIND=0 so the probe process exits
+    without waiting an XLA compile out) and is served by the warm host
+    tier.  Returns (warm_ms, warm_served_cold, nowarm_ms, err)."""
+    import subprocess
+
+    out = {}
+    for mode in ("on", "off"):
+        env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+            "JAX_PLATFORMS", ""))
+        if mode == "off":
+            env["KT_COMPILE_BEHIND"] = "0"
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 _WARMCOLD_SNIPPET.format(bench=os.path.abspath(__file__),
+                                          warmup=mode)],
+                capture_output=True, text=True, timeout=1600, env=env,
+            )
+            rec = None
+            for line in p.stdout.splitlines():
+                if line.startswith("FIRST_MS"):
+                    _, ms, _nodes, cold = line.split()
+                    rec = (round(float(ms), 1), bool(int(cold)))
+            if rec is None:
+                return None, None, None, (
+                    f"mode={mode} rc={p.returncode}: "
+                    f"{(p.stderr or '').strip()[-300:]}")
+            out[mode] = rec
+        except Exception as e:  # timeout etc.
+            return None, None, None, f"mode={mode} {type(e).__name__}: {e}"[:300]
+    return out["on"][0], out["on"][1], out["off"][0], None
+
+
 def _tensors_identical(a, b) -> bool:
     """Equality of EVERY SolveTensors field — ndarrays byte-level, plus the
     vocab/groups/scalar fields (a stale cache entry whose arrays match but
@@ -493,14 +700,23 @@ def run_bench():
 
     cold_ms, cold_nodes, cold_infeasible, cold_err = measure_coldstart()
     trace_overhead_pct, trace_off_ms, trace_on_ms = measure_trace_overhead()
+    throughput = measure_throughput()
+    warm_ms, warm_cold, nowarm_ms, warmcold_err = measure_warm_coldstart()
 
     rec_cold = {
         "cold_first_solve_ms": cold_ms,
         "cold_nodes": cold_nodes,
         "cold_infeasible": cold_infeasible,
+        # AOT story (serving shape): warmup-on must ride the compiled
+        # device program; warmup-off documents the compile-behind fallback
+        "cold_first_solve_warm_ms": warm_ms,
+        "cold_first_solve_warm_served_cold": warm_cold,
+        "cold_first_solve_nowarm_ms": nowarm_ms,
     }
     if cold_err is not None:
         rec_cold["cold_error"] = cold_err
+    if warmcold_err is not None:
+        rec_cold["warm_cold_error"] = warmcold_err
 
     rec = {
         "metric": METRIC,
@@ -519,6 +735,7 @@ def run_bench():
         "trace_overhead_pct": trace_overhead_pct,
         "trace_solve_off_ms": trace_off_ms,
         "trace_solve_on_ms": trace_on_ms,
+        **throughput,
         "cost_ratio_vs_ffd": round(cost_ratio, 4),
         "tpu_nodes": len(out.result.nodes),
         "ffd_nodes": len(oracle.nodes),

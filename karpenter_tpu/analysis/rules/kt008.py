@@ -1,0 +1,141 @@
+"""KT008 — jitted callable off the registered bucket grid.
+
+The serving path's no-compile contract (compile-behind + AOT bucket
+precompile) only holds while every XLA program it can reach is
+*precompilable*: module-level jit wrappers whose compile signatures are
+drawn from the rung-bucketed dims ``solve_dims`` produces.  Two ways code
+silently breaks that contract, both caught here:
+
+1. **Per-call jit wrappers** — ``jax.jit(fn)`` (or ``partial(jax.jit, ...)``)
+   applied *inside a function body* builds a FRESH wrapper, with a fresh
+   compile cache, on every call: the program recompiles per solve no matter
+   how warm the process is.  This was live in ``TpuSolver.prepare``'s
+   multi-process branch until this rule's round (hoisted to the module-level
+   ``feasibility_jit``).
+2. **Off-grid static shape args** — ``static_argnames`` entries are compile-
+   signature axes; a name outside the registered bucket-grid vocabulary
+   (:data:`BUCKET_GRID_STATICS` — the ``solve_dims`` dims keys plus the
+   kernel statics) means a program keyed on shapes no rung ladder bounds,
+   so warmup can never cover it and the serving path eats the compile.
+
+Scope: the serving-path packages (``solver/``, ``ops/``, ``parallel/``,
+``service/``).  Suppress genuinely-off-path uses with
+``# ktlint: allow[KT008] <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..ktlint import Finding, iter_functions
+
+ID = "KT008"
+TITLE = "jitted callable off the registered bucket grid"
+HINT = ("hoist the jit to module level (a per-call wrapper owns a fresh "
+        "compile cache = silent recompile every solve) and draw "
+        "static_argnames only from the bucket-grid vocabulary "
+        "(solve_dims keys + kernel statics), so every reachable program "
+        "sits on a precompilable rung ladder")
+
+#: serving-path file prefixes (package-relative paths)
+SERVING_DIRS = (
+    "karpenter_tpu/solver/",
+    "karpenter_tpu/ops/",
+    "karpenter_tpu/parallel/",
+    "karpenter_tpu/service/",
+)
+
+#: the registered bucket grid: exactly the dims keys ``solver/tpu.py
+#: solve_dims`` emits (the single source of the rung-bucketing math) plus
+#: the vmapped kernel's vocab-position statics.  A static shape arg outside
+#: this set keys compiles on shapes no rung ladder bounds —
+#: tests/test_lint.py pins this list against solve_dims at runtime.
+BUCKET_GRID_STATICS = frozenset({
+    "G", "C", "NR", "NE_pad", "S", "P", "D", "R", "Z", "K", "W",
+    "track", "a", "b",
+    "zone_key", "ct_key",
+})
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    """`jit` / `jax.jit` (the bare callable, not an application)."""
+    if isinstance(node, ast.Name) and node.id == "jit":
+        return True
+    return isinstance(node, ast.Attribute) and node.attr == "jit"
+
+
+def _jit_application(node: ast.AST) -> Optional[ast.Call]:
+    """The Call that APPLIES jit to a function, if ``node`` is one:
+    ``jax.jit(fn, ...)``, ``partial(jax.jit, ...)`` (the partial itself is
+    the application — it carries the kwargs), or
+    ``partial(jax.jit, ...)(fn)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if _is_jit_name(f):
+        return node
+    if isinstance(f, ast.Name) and f.id == "partial" and node.args \
+            and _is_jit_name(node.args[0]):
+        return node
+    return None
+
+
+def _static_argnames(call: ast.Call):
+    """String constants named by a jit application's static_argnames."""
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            yield v.value, kw.value.lineno
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    yield el.value, el.lineno
+
+
+def check(files) -> List[Finding]:
+    out: List[Finding] = []
+    for f in files:
+        if not any(f.path.startswith(d) for d in SERVING_DIRS):
+            continue
+        # (1) jit applications inside function bodies = per-call wrappers
+        for qual, fn, _nested in iter_functions(f.tree):
+            for stmt in fn.body:
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.FunctionDef):
+                        # a nested def's own decorators: @jax.jit there is a
+                        # per-enclosing-call wrapper too
+                        for dec in n.decorator_list:
+                            if _is_jit_name(dec) or \
+                                    _jit_application(dec) is not None:
+                                out.append(Finding(
+                                    ID, f.path, n.lineno,
+                                    f"`{qual}` jit-decorates the nested "
+                                    f"function `{n.name}` — a fresh wrapper "
+                                    "(and compile cache) per enclosing "
+                                    "call: silent recompile on the serving "
+                                    "path", hint=HINT))
+                        continue
+                    app = _jit_application(n)
+                    if app is not None:
+                        out.append(Finding(
+                            ID, f.path, n.lineno,
+                            f"jit applied inside `{qual}` — a fresh wrapper "
+                            "(and compile cache) per call: silent recompile "
+                            "on the serving path", hint=HINT))
+        # (2) off-grid static shape args, anywhere in the file
+        for n in ast.walk(f.tree):
+            app = _jit_application(n)
+            if app is None:
+                continue
+            for name, lineno in _static_argnames(app):
+                if name not in BUCKET_GRID_STATICS:
+                    out.append(Finding(
+                        ID, f.path, lineno,
+                        f"static_argnames entry `{name}` is outside the "
+                        "registered bucket-grid vocabulary — its compile "
+                        "signatures sit on no rung ladder, so AOT warmup "
+                        "can never cover them", hint=HINT))
+    return out

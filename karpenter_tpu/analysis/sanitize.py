@@ -116,6 +116,11 @@ def install() -> None:
     plan: List[Tuple[type, str, str]] = [
         (BatchScheduler, "solve", "dispatch"),
         (BatchScheduler, "submit", "dispatch"),
+        # the megabatch entries share the dispatch contract: registration,
+        # bucketing, and the vmapped dispatch all belong to ONE thread at a
+        # time (the pipeline's dispatcher)
+        (BatchScheduler, "submit_many", "dispatch"),
+        (BatchScheduler, "bucket_key", "dispatch"),
         (TensorizeCache, "tensorize", "tensorize"),
         (InflightQueue, "push", "inflight-producer"),
     ]

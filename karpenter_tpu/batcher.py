@@ -130,6 +130,93 @@ class InflightQueue(Generic[T]):
         return out
 
 
+class SlotCoalescer(Generic[T]):
+    """Deadline-aware request-slot coalescer — the continuous-batching front
+    of the solver's cross-request megabatch path (service/server.py
+    SolvePipeline drives it between the RPC queue and the device dispatch).
+
+    Items arrive tagged with a *bucket key* (the megabatch compile-signature
+    bucket; ``None`` = cannot ride a megabatch).  Consecutive same-key items
+    accumulate into one batch of up to ``max_slots``; a batch flushes when
+
+    - **full** — it reached ``max_slots``,
+    - **bucket** — an arriving item carries a different (or None) key,
+    - **deadline** — its oldest item has waited ``max_wait`` seconds
+      (``poll``/``flush``, clocked through the injectable Clock so
+      FakeClock tests are deterministic).
+
+    Single-threaded by contract: the pipeline's dispatcher thread owns it,
+    exactly like ``InflightQueue``'s producer side.  The coalescer never
+    executes anything — it only decides batch boundaries; the caller
+    dispatches and observes the flush metrics."""
+
+    def __init__(
+        self,
+        max_slots: int = 8,
+        max_wait: float = 0.0,
+        clock: Optional[Clock] = None,
+    ) -> None:
+        self.max_slots = max(1, max_slots)
+        self.max_wait = max(0.0, max_wait)
+        self.clock = clock or Clock()
+        self._key: Optional[Hashable] = None
+        self._items: List[T] = []
+        self._first_at: Optional[float] = None
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    @property
+    def key(self) -> Optional[Hashable]:
+        return self._key
+
+    def deadline(self) -> Optional[float]:
+        """Absolute clock time at which the held batch must flush (None
+        while empty) — the dispatcher bounds its queue-poll timeout by it."""
+        if not self._items:
+            return None
+        return self._first_at + self.max_wait
+
+    def _take(self) -> List[T]:
+        items, self._items = self._items, []
+        self._key = None
+        self._first_at = None
+        return items
+
+    def add(self, key: Optional[Hashable], item: T):
+        """Admit one item; returns the list of ``(reason, key, items)``
+        batches this admission flushed, oldest first.  A ``None`` key first
+        flushes the held batch (bucket change), then flushes the item alone
+        — unbatchable requests never wait behind a deadline."""
+        out = []
+        if self._items and (key is None or key != self._key):
+            out.append(("bucket", self._key, self._take()))
+        if key is None:
+            out.append(("bucket", None, [item]))
+            return out
+        if not self._items:
+            self._key = key
+            self._first_at = self.clock.now()
+        self._items.append(item)
+        if len(self._items) >= self.max_slots:
+            out.append(("full", self._key, self._take()))
+        return out
+
+    def poll(self):
+        """Deadline check — call when the inbound queue goes idle; returns
+        the expired batch as ``[(\"deadline\", key, items)]`` or ``[]``."""
+        if self._items and self.clock.now() >= self._first_at + self.max_wait:
+            return [("deadline", self._key, self._take())]
+        return []
+
+    def flush(self, reason: str = "deadline"):
+        """Unconditional flush of whatever is held (queue-idle fast path
+        when no max-wait is configured, and the shutdown drain)."""
+        if not self._items:
+            return []
+        return [(reason, self._key, self._take())]
+
+
 @dataclass
 class _Bucket(Generic[T, U]):
     requests: List[T] = field(default_factory=list)

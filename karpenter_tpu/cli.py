@@ -119,8 +119,17 @@ def cmd_serve(args) -> int:
 
     _maybe_profile(args.profile_port)
     _maybe_jit_cache(args.jit_cache_dir)
-    return serve_main(["--port", str(args.port), "--backend", args.backend,
-                       "--obs-port", str(args.obs_port)])
+    argv = ["--port", str(args.port), "--backend", args.backend,
+            "--obs-port", str(args.obs_port)]
+    if args.max_slots is not None:
+        argv += ["--max-slots", str(args.max_slots)]
+    if args.max_wait_ms is not None:
+        argv += ["--max-wait-ms", str(args.max_wait_ms)]
+    if args.warmup:
+        argv.append("--warmup")
+    if args.small:
+        argv.append("--small")
+    return serve_main(argv)
 
 
 def cmd_bench(args) -> int:
@@ -212,6 +221,18 @@ def main(argv=None) -> int:
     v.add_argument("--profile-port", type=int, default=0)
     v.add_argument("--jit-cache-dir", default=os.environ.get("KT_JIT_CACHE_DIR", ""),
                    help="persistent XLA compile cache directory")
+    v.add_argument("--max-slots", type=int, default=None,
+                   help="megabatch request slots per coalescer flush "
+                        "(KT_MAX_SLOTS; 1 disables cross-request batching)")
+    v.add_argument("--max-wait-ms", type=float, default=None,
+                   help="max hold before a partial megabatch flushes "
+                        "(KT_MAX_WAIT_MS; 0 = flush on queue idle)")
+    v.add_argument("--warmup", action="store_true",
+                   help="block startup on the AOT bucket-grid precompile "
+                        "(single ladder + megabatch rungs) so the serving "
+                        "path never compiles")
+    v.add_argument("--small", action="store_true",
+                   help="--warmup against the 20-type catalog")
     v.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("bench", help="run BASELINE benchmark configs")

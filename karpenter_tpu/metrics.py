@@ -159,6 +159,9 @@ SOLVER_DEVICE_HEALTHY = "karpenter_solver_device_healthy"
 SOLVER_DEGRADED_SOLVES = "karpenter_solver_degraded_solves_total"
 REMOTE_FALLBACK_SOLVES = "karpenter_solver_remote_fallback_solves_total"
 REMOTE_DEGRADED = "karpenter_solver_remote_degraded"
+MEGABATCH_SLOTS = "karpenter_solver_megabatch_slots"
+MEGABATCH_FLUSH = "karpenter_solver_megabatch_flush_total"
+PRECOMPILE_DURATION = "karpenter_solver_precompile_duration_seconds"
 TENSORIZE_CACHE_HITS = "karpenter_solver_tensorize_cache_hits_total"
 TENSORIZE_CACHE_MISSES = "karpenter_solver_tensorize_cache_misses_total"
 TENSORIZE_DURATION = "karpenter_solver_tensorize_duration_seconds"
@@ -247,6 +250,25 @@ INVENTORY = {
         "gauge", (),
         "1 while the remote solver sidecar is unreachable and solves "
         "degrade to the local fallback; 0 when connected."),
+    MEGABATCH_SLOTS: (
+        "histogram", (),
+        "Occupied request slots per megabatch device dispatch (the "
+        "cross-request continuous-batching path: one vmapped program solves "
+        "every slot in a single device round trip; serial fallbacks while a "
+        "slot-rung program compiles behind observe 1 per dispatch).  "
+        "sum/count is the bench's batch_occupancy_mean."),
+    MEGABATCH_FLUSH: (
+        "counter", ("reason",),
+        "Coalescer batch flushes by reason: 'full' (max-slots reached), "
+        "'deadline' (max-wait expired, or the inbound queue went idle with "
+        "no wait configured), 'bucket' (an arriving request's shape bucket "
+        "differed from the held batch's, or the request cannot ride a "
+        "megabatch at all)."),
+    PRECOMPILE_DURATION: (
+        "histogram", (),
+        "Wall time of one blocking ahead-of-time bucket-grid precompile "
+        "pass (precompile_buckets(wait=True) — the serve --warmup path), "
+        "seconds: startup cost paid so the serving path never compiles."),
     TENSORIZE_CACHE_HITS: (
         "counter", ("tier",),
         "Tensorize cache hits by tier: 'identity' (same pod objects re-"

@@ -458,11 +458,15 @@ class TensorizeCache:
     after solve; steady-state tensorize should be a cache lookup plus a
     counts vector, not a 50k-row rebuild.  Three tiers, fastest first:
 
-    - **identity** — the pod sequence is element-identical to the previous
-      call's (one C-level pointer-compare pass; pods are treated as
-      immutable after construction, the same contract ``PodSpec.group_key``
-      memoization already relies on): the previous ``SolveTensors`` is
-      returned verbatim, counts included.
+    - **identity** — the pod sequence is element-identical to one of the
+      last :data:`MAX_IDENTITY` calls' (a C-level pointer-compare pass per
+      probed entry; pods are treated as immutable after construction, the
+      same contract ``PodSpec.group_key`` memoization already relies on):
+      that call's ``SolveTensors`` is returned verbatim, counts included.
+      An LRU, not a single slot, because the megabatch serving path
+      interleaves many clients' reconcile loops through one scheduler —
+      each re-offering its own pending set — and a depth-1 tier would
+      thrash to the grouping pass on every request.
     - **shape** — the pods group to a key sequence seen before (same
       deployment shapes, possibly different replica counts or fresh pod
       objects): every tensor is reused by reference and only ``groups`` +
@@ -479,14 +483,17 @@ class TensorizeCache:
     """
 
     MAX_SHAPES = 128
+    #: identity-tier LRU depth: one slot per concurrently-reconciling client
+    #: the serving path interleaves (service/server.py --max-slots tops out
+    #: at 32; the +1 absorbs a one-off extra caller)
+    MAX_IDENTITY = 33
 
     def __init__(self) -> None:
         self._ctx: Optional[TensorizeContext] = None
         self._ctx_key: Optional[tuple] = None
         self._shapes: Dict[tuple, SolveTensors] = {}
-        self._last_pods: Optional[list] = None
-        self._last_ukey: Optional[frozenset] = None
-        self._last_st: Optional[SolveTensors] = None
+        #: most-recent-first [(pods_list, ukey, st)]
+        self._ident: List[tuple] = []
         self.hits: Dict[str, int] = {"identity": 0, "shape": 0}
         self.misses = 0
 
@@ -506,7 +513,7 @@ class TensorizeCache:
                                          daemonsets)
             self._ctx_key = ckey
             self._shapes.clear()
-            self._last_pods = self._last_ukey = self._last_st = None
+            self._ident.clear()
         ukey = frozenset(unavailable or ())
         # snapshot the sequence: storing the caller's own list would alias
         # it, and an in-place append before the next call would then compare
@@ -515,12 +522,17 @@ class TensorizeCache:
         pods_list = list(pods)
         # identity tier: list == compares elements via the C-level identity
         # shortcut (PyObject_RichCompareBool), so a re-solve of the same pod
-        # objects costs one pointer pass; fresh-but-equal objects differ at
-        # their uid field and fall through after ONE structural compare
-        if (self._last_st is not None and self._last_ukey == ukey
-                and self._last_pods == pods_list):
-            self.hits["identity"] += 1
-            return self._last_st, "identity"
+        # objects costs one pointer pass per probed LRU entry; fresh-but-
+        # equal objects differ at their uid field and fall through after ONE
+        # structural compare per entry.  Length pre-check skips the pass for
+        # differently-sized clients.
+        for i, (ident_pods, ident_ukey, ident_st) in enumerate(self._ident):
+            if (ident_ukey == ukey and len(ident_pods) == len(pods_list)
+                    and ident_pods == pods_list):
+                if i:
+                    self._ident.insert(0, self._ident.pop(i))
+                self.hits["identity"] += 1
+                return ident_st, "identity"
         groups = group_pods(pods_list)
         skey = (ukey, tuple(g.key for g in groups))
         st = self._shapes.get(skey)
@@ -543,9 +555,8 @@ class TensorizeCache:
             self._shapes[skey] = dataclasses.replace(st, groups=[])
             self.misses += 1
             tier = "miss"
-        self._last_pods = pods_list
-        self._last_ukey = ukey
-        self._last_st = st
+        self._ident.insert(0, (pods_list, ukey, st))
+        del self._ident[self.MAX_IDENTITY:]
         return st, tier
 
 

@@ -281,15 +281,21 @@ class Operator:
         self.pricing.maybe_refresh()
         self._warm_solver()
 
-    def _warm_solver(self) -> None:
+    def _warm_solver(self, wait: bool = False) -> None:
         provs = [p.with_defaults() for p in self.state.provisioners.values()]
+        # in-process schedulers warm the full bucket grid (single-solve
+        # ladder + megabatch slot rungs); the RemoteScheduler facade only
+        # has warm_startup — the sidecar owns its own rungs (serve --warmup)
+        warm = getattr(self.scheduler, "precompile_buckets", None)
+        kwargs = {} if warm is None else {"wait": wait}
         try:
-            self.scheduler.warm_startup(
+            (warm or self.scheduler.warm_startup)(
                 provs or [Provisioner(name="default").with_defaults()],
                 self.cloud.get_instance_types(),
                 daemonsets=self.state.daemonsets,
                 existing_nodes=[n.snapshot()
                                 for n in self.state.schedulable_nodes()],
+                **kwargs,
             )
         except Exception:  # warmup is best-effort; solves fall back warm
             logging.getLogger(__name__).warning(
@@ -510,6 +516,11 @@ def _demo(args) -> None:
         op.state.apply_provisioner(
             Provisioner(name="default", consolidation_enabled=True)
         )
+    if getattr(args, "warmup", False):
+        # blocking AOT bucket-grid precompile before traffic: the demo's
+        # first solves then never see a cold program OR a warm-tier serve
+        print("warmup: blocking bucket-grid precompile...")
+        op._warm_solver(wait=True)
 
     print(f"scale-up: {args.pods} pods")
     for i in range(args.pods):
@@ -597,6 +608,9 @@ def main(argv=None) -> int:
     parser.add_argument("--tracez", action="store_true",
                         help="print a /tracez + /statusz snapshot after the "
                              "demo (make obs-demo)")
+    parser.add_argument("--warmup", action="store_true",
+                        help="block on the AOT bucket-grid precompile "
+                             "before the demo's first solve")
     args = parser.parse_args(argv)
     if args.demo:
         _demo(args)

@@ -26,6 +26,35 @@ import sys
 from typing import List, Optional
 
 
+def multiprocess_cpu_support() -> Optional[str]:
+    """None when multi-process execution over virtual CPU devices is
+    available in this jaxlib, else the precise missing capability (tests use
+    it as a skip reason — a capability probe, not a blanket skip).
+
+    CPU multi-process programs need an explicit cross-process collectives
+    backend: without one, the first sharded computation raises
+    INVALID_ARGUMENT "Multiprocess computations aren't implemented on the
+    CPU backend".  jaxlib exposes that backend through the
+    ``jax_cpu_collectives_implementation`` config (gloo); a build without
+    the option cannot run the 2-process dryrun at all."""
+    import jax
+
+    if "jax_cpu_collectives_implementation" not in jax.config.values:
+        return ("this jaxlib has no jax_cpu_collectives_implementation "
+                "config (no gloo CPU collectives): multi-process CPU "
+                "computations are unimplemented")
+    return None
+
+
+def _enable_cpu_collectives() -> None:
+    """Select the gloo cross-process collectives backend for CPU workers.
+    Must run before ``jax.distributed.initialize``."""
+    import jax
+
+    if "jax_cpu_collectives_implementation" in jax.config.values:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
 def put_sharded(value, sharding):
     """Place a host value under ``sharding``, multi-process safe.
 
@@ -49,6 +78,9 @@ def replicate_for_host(mesh, value):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    # ktlint: allow[KT008] dryrun-validation helper, two calls per worker
+    # process lifetime: the per-call wrapper is deliberate (out_shardings
+    # closes over the worker's mesh), and no serving path reaches it
     return jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))(value)
 
 
@@ -94,6 +126,7 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         args.coordinator, num_processes=args.num_processes,
         process_id=args.process_id,

@@ -38,12 +38,16 @@ from ..metrics import REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES  # noqa: E402
 
 class SolverClient:
     def __init__(self, target: str, timeout: float = 60.0) -> None:
+        self.target = target
+        self.timeout = timeout
+        self._connect()
+
+    def _connect(self) -> None:
         self.channel = grpc.insecure_channel(
-            target,
+            self.target,
             options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
                      ("grpc.max_send_message_length", 256 * 1024 * 1024)],
         )
-        self.timeout = timeout
         self._solve = self.channel.unary_unary(
             f"/{SERVICE}/Solve",
             request_serializer=pb.SolveRequest.SerializeToString,
@@ -59,6 +63,16 @@ class SolverClient:
             request_serializer=pb.HealthRequest.SerializeToString,
             response_deserializer=pb.HealthResponse.FromString,
         )
+
+    def reset(self) -> None:
+        """Drop and rebuild the channel.  A grpc channel whose connection
+        attempts started while the server was down can wedge in a
+        reconnect-backoff state that outlives the outage (observed on this
+        host as endless 'tcp handshaker shutdown' UNAVAILABLE errors against
+        a LISTENING server); a fresh channel connects on its first try, so
+        the degraded-path health probe resets after every failed attempt."""
+        self.close()
+        self._connect()
 
     def health(self, timeout: Optional[float] = None) -> pb.HealthResponse:
         return self._health(pb.HealthRequest(), timeout=timeout or self.timeout)
@@ -157,6 +171,11 @@ class RemoteScheduler:
         try:
             ok = bool(self.client.health(timeout=self.PROBE_TIMEOUT).ok)
         except grpc.RpcError:
+            # arm the NEXT probe with a fresh channel: a channel that began
+            # connecting while the sidecar was down can stay wedged after it
+            # comes back (see SolverClient.reset) — without this the remote
+            # path would never recover on affected stacks
+            self.client.reset()
             return False
         if ok:
             logger.info("solver sidecar %s back after %.1fs; resuming remote "

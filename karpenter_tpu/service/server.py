@@ -20,15 +20,32 @@ from typing import Optional
 
 import grpc
 
-from ..batcher import InflightQueue
-from ..metrics import INFLIGHT_DEPTH, Registry, registry as default_registry
+from ..batcher import InflightQueue, SlotCoalescer
+from ..metrics import (
+    INFLIGHT_DEPTH,
+    MEGABATCH_FLUSH,
+    MEGABATCH_SLOTS,
+    Registry,
+    registry as default_registry,
+)
 from ..obs import tracer_for
 from ..obs.trace import NULL_TRACE, Tracer
 from ..solver.scheduler import BatchScheduler
+from ..solver.tpu import MEGA_MAX_SLOTS
+from ..utils.clock import Clock
 from . import codec
 from . import solver_pb2 as pb
 
 SERVICE = "karpenter.tpu.Solver"
+
+#: default megabatch request-slot cap per coalescer flush (KT_MAX_SLOTS /
+#: --max-slots override; 1 disables cross-request batching)
+DEFAULT_MAX_SLOTS = 8
+#: default max-wait before a partially-filled batch flushes, milliseconds
+#: (KT_MAX_WAIT_MS / --max-wait-ms).  0 = flush the moment the inbound
+#: queue goes idle — single-request latency then matches the unbatched
+#: path; coalescing engages exactly when requests actually queue up.
+DEFAULT_MAX_WAIT_MS = 0.0
 
 
 def _resolve(fut: Future, result=None, exc: Optional[BaseException] = None) -> None:
@@ -48,24 +65,48 @@ def _resolve(fut: Future, result=None, exc: Optional[BaseException] = None) -> N
 
 
 class SolvePipeline:
-    """Double-buffered solve dispatch for one scheduler.
+    """Double-buffered, cross-request-batching solve dispatch for one
+    scheduler.
 
     All scheduler access funnels through ONE dispatcher thread (the
     scheduler is not re-entrant — concurrent RPC handlers previously raced
-    on it), and device dispatch is pipelined: the dispatcher calls
-    ``scheduler.submit`` (host tensorize + async device dispatch, returns
-    before the fence), immediately picks up the NEXT queued request, and
-    only fences batch N when the in-flight queue is past ``depth`` or the
-    inbound queue drains.  Host tensorize of batch N+1 therefore overlaps
-    device execution of batch N; each response still carries its own honest
-    one-RTT-fenced ``solve_ms`` (PendingTpuSolve.result semantics).
-    Finalization is FIFO, so responses keep arrival order.
+    on it).  Two throughput mechanisms compose behind it:
+
+    - **Pipelining** (PR 1): ``scheduler.submit`` returns after the async
+      device dispatch; the dispatcher tensorizes batch N+1 while batch N
+      executes, fencing via the in-flight queue.  Serves the low-concurrency
+      regime.
+    - **Cross-request megabatching** (this round): a deadline-aware
+      :class:`~karpenter_tpu.batcher.SlotCoalescer` drains concurrent RPCs
+      into request slots (flush on max-slots, max-wait, or shape-bucket
+      change) and ``scheduler.submit_many`` solves the whole flush in ONE
+      vmapped device dispatch — service throughput stops being capped at
+      one solve per device round trip.  Engages exactly when requests
+      queue; a lone request flushes immediately (``max_wait=0`` default),
+      so single-request latency matches the unbatched path.
+
+    Responses keep arrival order (singles and megabatches share ONE
+    FIFO in-flight queue), and every megabatched response carries the
+    honest per-request ``solve_ms``: enqueue→respond wall time, NOT the
+    megabatch-amortized device time.
     """
 
     def __init__(self, scheduler: BatchScheduler,
-                 registry: Optional[Registry] = None, depth: int = 2) -> None:
+                 registry: Optional[Registry] = None, depth: int = 2,
+                 max_slots: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 clock: Optional[Clock] = None) -> None:
         self.scheduler = scheduler
         self.registry = registry or default_registry
+        if max_slots is None:
+            max_slots = int(os.environ.get("KT_MAX_SLOTS",
+                                           str(DEFAULT_MAX_SLOTS)))
+        if max_wait_ms is None:
+            max_wait_ms = float(os.environ.get("KT_MAX_WAIT_MS",
+                                               str(DEFAULT_MAX_WAIT_MS)))
+        self.max_slots = max(1, min(MEGA_MAX_SLOTS, max_slots))
+        self.max_wait = max(0.0, max_wait_ms) / 1000.0
+        self._clock = clock or Clock()
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._submit_lock = threading.Lock()  # makes stop-check + put atomic
@@ -75,7 +116,9 @@ class SolvePipeline:
         #: ANY point between pop and resolution (inside submit's device
         #: dispatch, inside a fence, between an _inflight drain and its
         #: finalize) can't strand an RPC thread.  _resolve tolerates the
-        #: benign race with a merely-slow dispatcher.
+        #: benign race with a merely-slow dispatcher.  Coalesced-but-not-
+        #: yet-flushed requests are in it too — a stop() mid-hold fails
+        #: them instead of stranding them in the coalescer.
         self._in_hand: "list[Future]" = []
         gauge = self.registry.gauge(INFLIGHT_DEPTH)
         labels = {"backend": scheduler.backend}  # one series per backend
@@ -85,6 +128,16 @@ class SolvePipeline:
             gauge.set(0, labels)
         self._inflight: InflightQueue = InflightQueue(
             depth=depth, on_depth=lambda d: gauge.set(d, labels))
+        #: dispatcher-owned: batch boundaries for the megabatch path
+        self._coal: SlotCoalescer = SlotCoalescer(
+            max_slots=self.max_slots, max_wait=self.max_wait,
+            clock=self._clock)
+        # zero-init every flush-reason series (KT003: a counter born at its
+        # first increment loses that increment to rate()/increase())
+        flush = self.registry.counter(MEGABATCH_FLUSH)
+        for reason in ("full", "deadline", "bucket"):
+            flush.inc({"reason": reason}, value=0.0)
+        self.registry.histogram(MEGABATCH_SLOTS)
         self._thread = threading.Thread(
             target=self._loop, name="solve-pipeline", daemon=True)
         self._thread.start()
@@ -95,9 +148,11 @@ class SolvePipeline:
         # queue-wait attribution: stamp the enqueue on the request's trace
         # clock here (RPC thread); the dispatcher closes the "window" span
         # when it picks the request up — the cross-thread phase is recorded
-        # as an already-closed span, so nothing can leak
+        # as an already-closed span, so nothing can leak.  The perf_counter
+        # stamp feeds the megabatch path's honest enqueue→respond solve_ms.
         trace = kwargs.get("trace") or NULL_TRACE
         t_enq = trace.now()
+        t_wall = time.perf_counter()
         # the stop-check and the put are one atomic step: a put that wins
         # the lock before stop()'s drain is guaranteed to be seen by the
         # drain; a put that loses sees _stop and refuses — either way no
@@ -106,7 +161,7 @@ class SolvePipeline:
         with self._submit_lock:
             if self._stop.is_set():
                 raise RuntimeError("solve pipeline stopped")
-            self._q.put((kwargs, fut, t_enq))
+            self._q.put((kwargs, fut, t_enq, t_wall))
         return fut.result()
 
     def stop(self) -> None:
@@ -121,15 +176,21 @@ class SolvePipeline:
             # scheduler.submit): fail everything still in flight so the RPC
             # threads unblock; the daemon dispatcher thread itself cannot
             # pin exit.  deque ops are thread-safe, and every entry the
-            # wedged thread already popped is still in its _in_hand ledger.
-            for _pending, fut in self._inflight.pop_to(0):
-                _resolve(fut, exc=RuntimeError("solve pipeline stopped"))
+            # wedged thread already popped is still in its _in_hand ledger
+            # (coalescer-held requests included).
+            for head, rest in self._inflight.pop_to(0):
+                if head == "mega":
+                    for (_kw, fut, _t, _w), _pending in rest:
+                        _resolve(fut,
+                                 exc=RuntimeError("solve pipeline stopped"))
+                else:
+                    _resolve(rest, exc=RuntimeError("solve pipeline stopped"))
             for fut in list(self._in_hand):
                 _resolve(fut, exc=RuntimeError("solve pipeline stopped"))
         with self._submit_lock:
             while True:
                 try:
-                    _kwargs, fut, _t_enq = self._q.get_nowait()
+                    _kwargs, fut, _t_enq, _t_wall = self._q.get_nowait()
                 except queue.Empty:
                     break
                 _resolve(fut, exc=RuntimeError("solve pipeline stopped"))
@@ -152,54 +213,157 @@ class SolvePipeline:
             except ValueError:
                 pass  # already failed by a concurrent stop()
 
+    def _bucket_of(self, kwargs: dict):
+        """Megabatch bucket probe — None routes the request down the classic
+        single path (also when the scheduler has no bucketing: RemoteScheduler
+        facades, test doubles)."""
+        if self.max_slots <= 1:
+            return None
+        bucket = getattr(self.scheduler, "bucket_key", None)
+        if bucket is None:
+            return None
+        # the probe itself never fails a request (bucket_key boxes its own
+        # errors and returns None), but a facade without that contract must
+        # not take the dispatcher down either
+        try:
+            return bucket(kwargs)
+        # ktlint: allow[KT005] probe failure = unbatchable, logged at the
+        # scheduler layer; the request solves on the single path
+        except Exception:
+            return None
+
+    def _flush(self, batch, reason: str) -> None:
+        """Dispatch one coalescer flush: a single request keeps the classic
+        pipelined submit; 2+ requests ride one scheduler.submit_many
+        megabatch dispatch.  NEITHER fences here — both park in the
+        in-flight queue so the dispatcher coalesces/tensorizes the next
+        batch while this one executes; megabatched responses get honest
+        enqueue→respond solve_ms at finalization."""
+        if not batch:
+            return
+        self.registry.counter(MEGABATCH_FLUSH).inc({"reason": reason})
+        if len(batch) == 1:
+            self._dispatch_single(*batch[0])
+            return
+        try:
+            pendings = self.scheduler.submit_many(
+                [kw for kw, _f, _t, _w in batch])
+        # ktlint: allow[KT005] submit failures fan to every waiting RPC
+        # thread through their futures; the dispatcher itself must live on
+        except BaseException as err:  # noqa: BLE001
+            for _kw, fut, _t, _w in batch:
+                _resolve(fut, exc=err)
+                self._unhand(fut)
+            return
+        # one in-flight entry for the WHOLE megabatch (depth counts device
+        # dispatches, and the megabatch is one); finalization order stays
+        # FIFO because singles and megabatches share the one queue
+        self._drain(self._inflight.push(("mega", list(zip(batch, pendings)))))
+        if self._q.empty() and not len(self._coal):
+            self._drain(self._inflight.pop_to(0))
+
+    def _unhand(self, fut: Future) -> None:
+        try:
+            self._in_hand.remove(fut)
+        except ValueError:
+            pass  # already failed by a concurrent stop()
+
+    def _drain(self, entries) -> None:
+        for entry in entries:
+            head, rest = entry
+            if head == "mega":
+                self._finalize_mega(rest)
+            else:
+                self._finalize(head, rest)
+
+    def _finalize_mega(self, pairs) -> None:
+        for (kwargs, fut, _t_enq, t_wall), pending in pairs:
+            try:
+                result = pending.result()
+                # honest per-request latency: this RPC's enqueue → respond
+                # wall time, not the megabatch-amortized device time
+                result.solve_ms = (time.perf_counter() - t_wall) * 1000.0
+            # ktlint: allow[KT005] per-request failure fans to ITS RPC
+            # thread only; batchmates still resolve
+            except BaseException as err:  # noqa: BLE001
+                _resolve(fut, exc=err)
+            else:
+                _resolve(fut, result=result)
+            self._unhand(fut)
+
+    def _dispatch_single(self, kwargs: dict, fut: Future, t_enq, t_wall) -> None:
+        try:
+            pending = self.scheduler.submit(
+                kwargs.pop("pods"), kwargs.pop("provisioners"),
+                kwargs.pop("instance_types"), **kwargs,
+            )
+        # ktlint: allow[KT005] submit failures fan to the waiting RPC
+        # thread through its future; the dispatcher itself must live on
+        except BaseException as err:  # noqa: BLE001
+            _resolve(fut, exc=err)
+            self._unhand(fut)
+            return
+        self._drain(self._inflight.push((pending, fut)))
+        if self._q.empty() and not len(self._coal):
+            # no overlap work available: drain so this caller's latency
+            # is one dispatch + one fence, exactly the unpipelined path
+            self._drain(self._inflight.pop_to(0))
+
     def _loop(self) -> None:
         while not self._stop.is_set():
+            deadline = self._coal.deadline()
+            if deadline is not None:
+                timeout = min(0.1, max(0.0, deadline - self._clock.now()))
+            else:
+                timeout = 0.1
             try:
-                kwargs, fut, t_enq = self._q.get(timeout=0.1)
+                kwargs, fut, t_enq, t_wall = self._q.get(timeout=timeout)
             except queue.Empty:
-                for pending, f in self._inflight.pop_to(0):
-                    self._finalize(pending, f)
+                for reason, _key, batch in self._coal.poll():
+                    self._flush(batch, reason)
+                if not len(self._coal):
+                    self._drain(self._inflight.pop_to(0))
                 continue
             # close the queue-wait phase on the request's trace: enqueue
             # (RPC thread) -> pickup (this dispatcher)
             trace = kwargs.get("trace") or NULL_TRACE
             trace.record("window", t_enq, trace.now(),
-                         inflight=len(self._inflight))
-            # in hand from pop to resolution; _finalize removes it.  A fut
-            # parked in _inflight stays in the ledger too — stop() may then
+                         inflight=len(self._inflight),
+                         coalesced=len(self._coal))
+            # in hand from pop to resolution (_flush/_finalize remove it);
+            # coalescer-held requests stay in the ledger so a stop() mid-
+            # hold fails them instead of stranding their RPC threads.  A
+            # fut parked in _inflight is in the ledger too — stop() may
             # fail it twice (once per structure), which _resolve absorbs.
             self._in_hand.append(fut)
-            try:
-                pending = self.scheduler.submit(
-                    kwargs.pop("pods"), kwargs.pop("provisioners"),
-                    kwargs.pop("instance_types"), **kwargs,
-                )
-            # ktlint: allow[KT005] submit failures fan to the waiting RPC
-            # thread through its future; the dispatcher itself must live on
-            except BaseException as err:  # noqa: BLE001
-                _resolve(fut, exc=err)
-                try:
-                    self._in_hand.remove(fut)
-                except ValueError:
-                    pass
-                continue
-            for done_pending, done_fut in self._inflight.push((pending, fut)):
-                self._finalize(done_pending, done_fut)
-            if self._q.empty():
-                # no overlap work available: drain so this caller's latency
-                # is one dispatch + one fence, exactly the unpipelined path
-                for done_pending, done_fut in self._inflight.pop_to(0):
-                    self._finalize(done_pending, done_fut)
-        for done_pending, done_fut in self._inflight.pop_to(0):
-            self._finalize(done_pending, done_fut)
+            key = self._bucket_of(kwargs)
+            for reason, _key, batch in self._coal.add(
+                    key, (kwargs, fut, t_enq, t_wall)):
+                self._flush(batch, reason)
+            if len(self._coal) and self._q.empty() and self.max_wait <= 0.0:
+                # queue went idle with no wait configured: flush NOW so a
+                # lone request's latency matches the unbatched path; under
+                # real concurrency the queue is non-empty here and slots
+                # keep filling
+                for reason, _key, batch in self._coal.flush("deadline"):
+                    self._flush(batch, reason)
+        for reason, _key, batch in self._coal.flush("deadline"):
+            self._flush(batch, reason)
+        self._drain(self._inflight.pop_to(0))
 
 
 class SolverService:
     def __init__(self, scheduler: Optional[BatchScheduler] = None,
                  registry: Optional[Registry] = None,
-                 tracer: Optional[Tracer] = None) -> None:
+                 tracer: Optional[Tracer] = None,
+                 max_slots: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None) -> None:
         self.registry = registry or default_registry
         self.scheduler = scheduler or BatchScheduler(registry=self.registry)
+        # serving knobs for every pipeline this service constructs (None:
+        # KT_MAX_SLOTS / KT_MAX_WAIT_MS env, then the module defaults)
+        self.max_slots = max_slots
+        self.max_wait_ms = max_wait_ms
         # per-RPC traces; default to the scheduler's tracer so the sidecar's
         # /tracez sees exactly what its scheduler recorded
         self.tracer = tracer or getattr(
@@ -234,7 +398,9 @@ class SolverService:
                 raise RuntimeError("solver service closed")
             pipe = self._pipelines.get(id(sched))
             if pipe is None:
-                pipe = SolvePipeline(sched, registry=self.registry)
+                pipe = SolvePipeline(sched, registry=self.registry,
+                                     max_slots=self.max_slots,
+                                     max_wait_ms=self.max_wait_ms)
                 self._pipelines[id(sched)] = pipe
             return pipe
 
@@ -299,7 +465,11 @@ class SolverService:
 def make_server(
     service: Optional[SolverService] = None,
     port: int = 0,
-    max_workers: int = 4,
+    # enough RPC threads to fill a full megabatch: handlers just block on
+    # the pipeline's futures (the dispatcher does the work), so idle-parked
+    # threads are cheap — but 4 workers would cap the coalescer's reachable
+    # occupancy at 4 no matter how many clients queue
+    max_workers: int = MEGA_MAX_SLOTS + 4,
     host: str = "127.0.0.1",
 ) -> "tuple[grpc.Server, int]":
     service = service or SolverService()
@@ -344,8 +514,39 @@ def main(argv=None) -> int:
     parser.add_argument("--obs-port", type=int, default=0,
                         help="observability HTTP port (/tracez, /statusz, "
                              "/metrics); 0 disables")
+    parser.add_argument("--max-slots", type=int, default=None,
+                        help="megabatch request slots per coalescer flush "
+                             f"(default KT_MAX_SLOTS or {DEFAULT_MAX_SLOTS}; "
+                             "1 disables cross-request batching)")
+    parser.add_argument("--max-wait-ms", type=float, default=None,
+                        help="max hold before a partial batch flushes "
+                             f"(default KT_MAX_WAIT_MS or "
+                             f"{DEFAULT_MAX_WAIT_MS:g}; 0 flushes the "
+                             "moment the inbound queue idles)")
+    parser.add_argument("--warmup", action="store_true",
+                        help="block until the AOT bucket-grid precompile "
+                             "lands (single-solve ladder + megabatch slot "
+                             "rungs against the generated catalog) before "
+                             "accepting traffic; pair with --jit-cache-dir "
+                             "to skip even this across restarts")
+    parser.add_argument("--small", action="store_true",
+                        help="--warmup against the 20-type catalog")
     args = parser.parse_args(argv)
-    service = SolverService(BatchScheduler(backend=args.backend))
+    service = SolverService(BatchScheduler(backend=args.backend),
+                            max_slots=args.max_slots,
+                            max_wait_ms=args.max_wait_ms)
+    if args.warmup:
+        from ..models.catalog import generate_catalog
+        from ..models.provisioner import Provisioner
+
+        print("warmup: AOT bucket-grid precompile running "
+              "(single ladder + megabatch rungs)...", flush=True)
+        n = service.scheduler.precompile_buckets(
+            [Provisioner(name="default").with_defaults()],
+            generate_catalog(full=not args.small),
+            wait=True,
+        )
+        print(f"warmup: {n} bucket programs compiled; serving", flush=True)
     server, port = make_server(service, port=args.port, host=args.host)
     print(f"solver sidecar listening on {args.host}:{port} (backend={args.backend})")
     if args.obs_port:

@@ -18,6 +18,9 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import logging
 
 from ..metrics import (
+    MEGABATCH_FLUSH,
+    MEGABATCH_SLOTS,
+    PRECOMPILE_DURATION,
     SCHEDULING_DURATION,
     SOLVER_BACKEND_DURATION,
     SOLVER_COLD_FALLBACKS,
@@ -47,7 +50,7 @@ from ..obs import tracer_for
 from ..obs.trace import NULL_TRACE, Tracer
 from .guard import DeviceGuard, DeviceHang
 from .reference import solve as oracle_solve
-from .tpu import SlotsExhausted, TpuSolver
+from .tpu import MEGA_MAX_SLOTS, SlotsExhausted, TpuSolver
 from .types import SimNode, SolveResult
 
 logger = logging.getLogger(__name__)
@@ -187,6 +190,161 @@ def _budget_left(result: SolveResult, max_new_nodes: Optional[int]) -> Optional[
             else max(0, max_new_nodes - len(result.nodes)))
 
 
+class _MegaSlot:
+    """One request's slot in a pending megabatch dispatch: ``result()`` is
+    valid after the owning collector's ``dispatch()`` ran; it fences lazily
+    (first resolver fences the whole group — the overlap window between
+    megabatch N's dispatch and its fence belongs to the pipeline) and
+    re-raises the slot's own exception (SlotsExhausted / DeviceHang) so the
+    per-request fallback ladder in ``_solve_tpu`` stays identical to the
+    single path."""
+
+    __slots__ = ("_collector", "_idx")
+
+    def __init__(self, collector: "_MegaCollector", idx: int) -> None:
+        self._collector = collector
+        self._idx = idx
+
+    def result(self):
+        return self._collector.resolve(self._idx)
+
+
+class _MegaCollector:
+    """Deferred cross-request device dispatch (``BatchScheduler.submit_many``).
+
+    During the registration phase ``_solve_tpu`` routes each request's first
+    device wave here instead of dispatching it; ``dispatch()`` then enqueues
+    ONE vmapped device program per shape bucket (``solve_many_async``) —
+    or, while a slot-rung program is still compiling behind, per-request
+    async dispatches on the already-compiled single program (warming the
+    rung).  Nothing fences at dispatch: the first ``resolve()`` of a group
+    pays its single batch-wide fence, so the pipeline coalesces and
+    tensorizes megabatch N+1 while N executes on the device.
+    Single-threaded: registration, dispatch, and resolution all happen on
+    the pipeline's dispatcher thread (the submit_many contract)."""
+
+    def __init__(self, solver: TpuSolver, guard=None, registry=None,
+                 warm=None) -> None:
+        self.solver = solver
+        self.guard = guard
+        self.registry = registry
+        self.warm = warm
+        self.entries: List[dict] = []
+        #: per-slot resolver state after dispatch():
+        #: ("mega", PendingMegaSolve, pos) | ("single", PendingTpuSolve)
+        #: | ("err", Exception)
+        self._slots: List[tuple] = []
+
+    def add(self, **entry) -> _MegaSlot:
+        self.entries.append(entry)
+        return _MegaSlot(self, len(self.entries) - 1)
+
+    def _observe_slots(self, occupied: int) -> None:
+        if self.registry is not None:
+            self.registry.histogram(MEGABATCH_SLOTS).observe(occupied)
+
+    def _guarded(self, fn):
+        return self.guard.run(fn) if self.guard else fn()
+
+    def dispatch(self) -> None:
+        self._slots = [None] * len(self.entries)
+        groups: Dict[tuple, List[int]] = {}
+        for i, e in enumerate(self.entries):
+            key = self.solver.mega_signature(
+                e["st"], existing_nodes=e["existing_nodes"],
+                max_nodes=e["max_nodes"], slots=1,
+            )
+            groups.setdefault(key, []).append(i)
+        for idxs in groups.values():
+            use_mega = len(idxs) > 1
+            if use_mega:
+                first = self.entries[idxs[0]]
+                mega_sig = self.solver.mega_signature(
+                    first["st"], existing_nodes=first["existing_nodes"],
+                    max_nodes=first["max_nodes"], slots=len(idxs),
+                )
+                if not self.solver.ready(mega_sig):
+                    # callers must never eat a cold compile (the compile-
+                    # behind contract): serve this flush from the compiled
+                    # single program, compile the slot-rung program behind
+                    if self.warm is not None:
+                        self.warm(first, len(idxs))
+                    use_mega = False
+            if use_mega:
+                reqs = [
+                    dict(
+                        st=self.entries[i]["st"],
+                        existing_nodes=self.entries[i]["existing_nodes"],
+                        max_nodes=self.entries[i]["max_nodes"],
+                        raise_on_exhaust=self.entries[i]["raise_on_exhaust"],
+                        trace=self.entries[i]["trace"],
+                    )
+                    for i in idxs
+                ]
+                try:
+                    handle = self._guarded(
+                        lambda reqs=reqs: self.solver.solve_many_async(reqs))
+                except DeviceHang as err:
+                    # hang at H2D dispatch: fan to every slot — each
+                    # request's _finish_mega degrades to the warm tier
+                    for i in idxs:
+                        self._slots[i] = ("err", err)
+                    continue
+                # ktlint: allow[KT005] megabatch CONSTRUCTION failures
+                # (bucket mismatch after a raced warm-state flip, stacking
+                # errors) degrade the flush to the proven serial path —
+                # clients must never fail on an optimization-layer error
+                except Exception:  # noqa: BLE001
+                    logger.warning(
+                        "megabatch dispatch failed; serving the flush "
+                        "serially", exc_info=True)
+                    self._dispatch_serial(idxs)
+                    continue
+                self._observe_slots(len(idxs))
+                for pos, i in enumerate(idxs):
+                    self._slots[i] = ("mega", handle, pos)
+            else:
+                self._dispatch_serial(idxs)
+
+    def _dispatch_serial(self, idxs: List[int]) -> None:
+        """Per-request async dispatches on the single-solve program: still
+        one enqueue per request before any fence (the cold-rung and
+        degraded-flush path)."""
+        for i in idxs:
+            e = self.entries[i]
+            self._observe_slots(1)
+            try:
+                pending = self._guarded(
+                    lambda e=e: self.solver.solve_async(
+                        e["st"], existing_nodes=e["existing_nodes"],
+                        max_nodes=e["max_nodes"],
+                        raise_on_exhaust=e["raise_on_exhaust"],
+                        trace=e["trace"],
+                    ))
+            # ktlint: allow[KT005] boxed per-slot outcome, re-raised
+            # by the request's own _MegaSlot.result()
+            except BaseException as err:  # noqa: BLE001
+                self._slots[i] = ("err", err)
+                continue
+            self._slots[i] = ("single", pending)
+
+    def resolve(self, idx: int):
+        """Fence-and-extract slot ``idx`` (first resolver of a mega group
+        fences the whole group; later ones hit the cached outputs)."""
+        state = self._slots[idx]
+        assert state is not None, "megabatch slot read before dispatch()"
+        if state[0] == "err":
+            raise state[1]
+        if state[0] == "single":
+            return self._guarded(state[1].result)
+        _kind, handle, pos = state
+        outs = self._guarded(handle.results)
+        out = outs[pos]
+        if isinstance(out, BaseException):
+            raise out
+        return out
+
+
 class BatchScheduler:
     def __init__(
         self,
@@ -256,6 +414,19 @@ class BatchScheduler:
         inflight = self.registry.gauge(INFLIGHT_DEPTH)
         if not inflight.has({"backend": self.backend}):
             inflight.set(0, {"backend": self.backend})
+        # megabatch collector: non-None only INSIDE submit_many's
+        # registration phase, on the pipeline dispatcher thread — _solve_tpu
+        # routes first device waves through it instead of dispatching
+        self._mega_collect: Optional[_MegaCollector] = None
+        # register the megabatch/precompile families so the documented
+        # metrics are visible before the first megabatch lands; every flush
+        # reason exists at 0 from construction (KT003 — the pipeline
+        # re-zero-inits too, for facade schedulers without this init)
+        self.registry.histogram(MEGABATCH_SLOTS)
+        self.registry.histogram(PRECOMPILE_DURATION)
+        for reason in ("full", "deadline", "bucket"):
+            self.registry.counter(MEGABATCH_FLUSH).inc(
+                {"reason": reason}, value=0.0)
 
     def _device_health_changed(self, healthy: bool) -> None:
         self.registry.gauge(SOLVER_DEVICE_HEALTHY).set(1 if healthy else 0)
@@ -325,6 +496,108 @@ class BatchScheduler:
             unavailable=unavailable, allow_new_nodes=allow_new_nodes,
             max_new_nodes=max_new_nodes, trace=trace, dispatch=True,
         )
+
+    def submit_many(
+        self, requests: Sequence[dict],
+    ) -> List["PendingScheduleResult"]:
+        """Cross-request megabatch entry (service/server.py SolvePipeline's
+        coalescer flushes here): each request is a kwargs dict (``pods``,
+        ``provisioners``, ``instance_types`` plus the :meth:`solve`
+        keywords).  The registration phase runs each request's tensorize +
+        routing exactly like :meth:`submit`, but first device waves land in
+        a :class:`_MegaCollector` instead of dispatching; one vmapped device
+        call per shape bucket then solves every slot in a single round trip.
+        Returns per-request handles IN ORDER — ``result()`` runs that
+        request's own epilogues (relaxation ladder, residue waves, reseat)
+        against its own result only; requests share nothing but the device
+        dispatch.  Same single-thread contract as :meth:`submit`."""
+        guarded = self.backend == "auto" and self._guard.enabled
+        collector = _MegaCollector(
+            self._tpu, guard=self._guard if guarded else None,
+            registry=self.registry, warm=self._warm_mega,
+        )
+        self._mega_collect = collector
+        try:
+            pendings = [
+                self._submit(
+                    req["pods"], req["provisioners"], req["instance_types"],
+                    **{k: v for k, v in req.items()
+                       if k not in ("pods", "provisioners", "instance_types")},
+                    dispatch=True,
+                )
+                for req in requests
+            ]
+        finally:
+            self._mega_collect = None
+        collector.dispatch()
+        return pendings
+
+    def bucket_key(self, kwargs: dict) -> Optional[tuple]:
+        """Megabatch shape bucket of one queued solve request, or None when
+        it cannot ride a megabatch (non-device backend, oracle routing,
+        device carve-outs, cold shape, unhealthy device, cache disabled).
+        Pipeline-dispatcher-only, like submit: the tensorize it performs
+        lands in the cache, so the real solve's tensorize is a hit."""
+        if self.backend not in ("auto", "tpu"):
+            return None
+        if self.mesh is not None:
+            return None  # megabatch programs are single-device; a meshed
+            # scheduler must keep its sharded single-solve path
+        if self._tensorize_cache is None:
+            return None  # bucketing leans on cached tensorize; without it
+            # the probe would pay a full host build per queued request
+        pods = list(kwargs.get("pods") or ())
+        if not pods or not kwargs.get("allow_new_nodes", True):
+            return None
+        if self._route_small(len(pods)):
+            return None
+        try:
+            hardened = [_harden_preferences(p) for p in pods]
+            if batch_needs_oracle(hardened):
+                return None
+            if any(device_inexpressible(p) for p in hardened):
+                return None  # oracle carve-outs couple waves; keep serial
+            if (self.backend == "auto" and self._guard.enabled
+                    and not self._guard.healthy):
+                return None
+            tpu_pods = hardened
+            st, _tier = self._tensorize_cache.tensorize(
+                tpu_pods, kwargs["provisioners"], kwargs["instance_types"],
+                daemonsets=kwargs.get("daemonsets") or (),
+                unavailable=kwargs.get("unavailable"),
+            )
+            existing = list(kwargs.get("existing_nodes") or ())
+            max_new = kwargs.get("max_new_nodes")
+            new_budget = len(tpu_pods) if max_new is None else max_new
+            max_slots = len(existing) + new_budget
+            if not self._device_ready(st, existing, max_slots):
+                return None  # cold shapes keep the compile-behind path
+            return self._tpu.mega_signature(
+                st, existing_nodes=existing, max_nodes=max_slots, slots=1,
+            )
+        # ktlint: allow[KT005] the bucket probe must never fail a request —
+        # an unbucketable request just solves on the classic single path,
+        # where a real error surfaces with full context
+        except Exception:
+            logger.debug("bucket_key probe failed; request rides the single "
+                         "path", exc_info=True)
+            return None
+
+    def _warm_mega(self, entry: dict, slots: int) -> None:
+        """Background-compile the megabatch program for a bucket whose flush
+        just fell back to serial dispatches (cold slot rung)."""
+        if not self.compile_behind or not self._guard.healthy:
+            return
+        started = self._tpu.warm_async(
+            entry["st"],
+            existing_nodes=[n.snapshot() for n in entry["existing_nodes"]],
+            max_nodes=entry["max_nodes"], slots=max(2, slots),
+            on_done=self._warm_done,
+        )
+        if started:
+            self.registry.gauge(SOLVER_COMPILE_IN_PROGRESS).set(
+                self._tpu.compiles_in_flight()
+            )
 
     def _submit(
         self,
@@ -790,6 +1063,41 @@ class BatchScheduler:
     #: (_device_ready), never by a caller stall.
     WARM_PROFILES = ((16, 400, False), (16, 400, True))
 
+    #: megabatch slot rungs the startup precompile covers by default: the
+    #: coalescer pads flushes to power-of-two rungs (tpu._mega_rung), so
+    #: warming these serves every occupancy up to the default --max-slots
+    WARM_MEGA_SLOTS = (2, 4, 8)
+
+    def _profile_tensors(self, provisioners, instance_types, daemonsets,
+                         profiles=None):
+        """Tensorized startup-warmup batches, one per shape profile — the
+        single source :meth:`warm_startup` (single-solve ladder) and
+        :meth:`precompile_buckets` (megabatch rungs) both warm from."""
+        from ..models.pod import TopologySpreadConstraint
+
+        out = []
+        for groups, total, spread in (profiles or self.WARM_PROFILES):
+            pods = []
+            per = max(1, total // groups)
+            for gi in range(groups):
+                sel = LabelSelector.of({"warmup-group": f"g{gi}"})
+                constraints = (
+                    [TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)]
+                    if spread else []
+                )
+                for i in range(per):
+                    pods.append(PodSpec(
+                        name=f"warmup-g{gi}-{i}",
+                        labels={"warmup-group": f"g{gi}"},
+                        requests={"cpu": 0.25 * (1 + gi % 8),
+                                  "memory": float(2 ** (30 + gi % 3))},
+                        topology_spread=list(constraints),
+                        owner_key=f"warmup-g{gi}",
+                    ))
+            out.append(tensorize(pods, provisioners, instance_types,
+                                 daemonsets=daemonsets))
+        return out
+
     def warm_startup(
         self,
         provisioners,
@@ -809,29 +1117,9 @@ class BatchScheduler:
         if (self.backend not in ("auto", "tpu") or not self.compile_behind
                 or not self._guard.healthy):
             return 0
-        from ..models.pod import TopologySpreadConstraint
-
         started = 0
-        for groups, total, spread in (profiles or self.WARM_PROFILES):
-            pods = []
-            per = max(1, total // groups)
-            for gi in range(groups):
-                sel = LabelSelector.of({"warmup-group": f"g{gi}"})
-                constraints = (
-                    [TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)]
-                    if spread else []
-                )
-                for i in range(per):
-                    pods.append(PodSpec(
-                        name=f"warmup-g{gi}-{i}",
-                        labels={"warmup-group": f"g{gi}"},
-                        requests={"cpu": 0.25 * (1 + gi % 8),
-                                  "memory": float(2 ** (30 + gi % 3))},
-                        topology_spread=list(constraints),
-                        owner_key=f"warmup-g{gi}",
-                    ))
-            st = tensorize(pods, provisioners, instance_types,
-                           daemonsets=daemonsets)
+        for st in self._profile_tensors(provisioners, instance_types,
+                                        daemonsets, profiles):
             # provisioning shape: batch solved against the current cluster
             if self._tpu.warm_async(st, existing_nodes=existing_nodes,
                                     mesh=self.mesh, on_done=self._warm_done):
@@ -852,6 +1140,60 @@ class BatchScheduler:
             )
             logger.info("startup warmup: %d solver shape compiles accepted "
                         "in the background", started)
+        return started
+
+    def precompile_buckets(
+        self,
+        provisioners,
+        instance_types,
+        daemonsets: Sequence[PodSpec] = (),
+        existing_nodes: Sequence[SimNode] = (),
+        profiles=None,
+        mega_slots: Optional[Sequence[int]] = None,
+        wait: bool = False,
+        timeout: float = 1800.0,
+    ) -> int:
+        """Ahead-of-time bucket-grid precompile: the startup single-solve
+        ladder (:meth:`warm_startup`) PLUS the megabatch programs at the
+        given request-slot rungs, so both the serial and the coalesced
+        serving paths are warm before the first RPC.  ``wait=True`` blocks
+        until every accepted compile lands (the ``serve --warmup`` path) and
+        observes the total in ``karpenter_solver_precompile_duration_seconds``
+        — pair with ``--jit-cache-dir`` and restarts skip even this.
+        Returns the number of compiles accepted."""
+        t0 = time.perf_counter()
+        started = self.warm_startup(
+            provisioners, instance_types, daemonsets=daemonsets,
+            existing_nodes=existing_nodes, profiles=profiles,
+        )
+        if (self.backend in ("auto", "tpu") and self.compile_behind
+                and self._guard.healthy and self.mesh is None):
+            rungs = sorted({
+                s for s in (mega_slots or self.WARM_MEGA_SLOTS)
+                if 2 <= s <= MEGA_MAX_SLOTS
+            })
+            for st in self._profile_tensors(provisioners, instance_types,
+                                            daemonsets, profiles):
+                for s in rungs:
+                    if self._tpu.warm_async(
+                        st, existing_nodes=existing_nodes, slots=s,
+                        on_done=self._warm_done,
+                    ):
+                        started += 1
+        if wait and started:
+            deadline = time.perf_counter() + timeout
+            while (not self._tpu.warm_idle()
+                   and time.perf_counter() < deadline):
+                time.sleep(0.25)
+            self.registry.histogram(PRECOMPILE_DURATION).observe(
+                time.perf_counter() - t0)
+            if not self._tpu.warm_idle():
+                logger.warning("bucket precompile still running after %.0fs "
+                               "wait budget; remaining compiles finish "
+                               "behind", timeout)
+            else:
+                logger.info("bucket precompile complete: %d programs in "
+                            "%.1fs", started, time.perf_counter() - t0)
         return started
 
     # ---- compile-behind (cold-start) ----------------------------------
@@ -1191,6 +1533,35 @@ class BatchScheduler:
         guarded = self.backend == "auto" and self._guard.enabled
         degraded = guarded and not self._guard.healthy
         raise_on_exhaust = self.backend == "auto" and self.compile_behind
+
+        collector = self._mega_collect
+        if (dispatch and not degraded and collector is not None
+                and self.mesh is None):
+            # megabatch registration (submit_many): the first device wave
+            # joins the collector's pending batch instead of dispatching;
+            # ONE vmapped device call later serves every slot.  The fallback
+            # ladder at fence time is identical to the single async path —
+            # per REQUEST, so one exhausted/hung slot degrades itself only.
+            slot = collector.add(
+                st=st, existing_nodes=all_existing, max_nodes=max_slots,
+                raise_on_exhaust=raise_on_exhaust, trace=trace,
+            )
+
+            def _finish_mega() -> SolveResult:
+                try:
+                    out = slot.result()
+                    return _adopt_device(out.result, "tpu")
+                except SlotsExhausted:
+                    res, backend_used = _cold_fallback()
+                    return _adopt_device(res, backend_used)
+                except DeviceHang:
+                    self._flight_anomaly(
+                        "device_hang", "megabatch device dispatch hung past "
+                        "the guard deadline (wedged tunnel?)", trace)
+                    res, backend_used = _degraded_fallback()
+                    return _adopt_device(res, backend_used)
+
+            return _PendingWave(_finish_mega)
 
         if dispatch and not degraded:
             # async dispatch: enqueue the device program WITHOUT fencing and

@@ -229,6 +229,17 @@ def compute_feasibility(
     return F, dom_ok
 
 
+# Module-level jitted feasibility.  The wrapper is created ONCE: a per-call
+# ``jax.jit(compute_feasibility)`` owns a fresh compile cache and silently
+# recompiles on every solve (the KT008 class); here the cache persists and
+# the bucketed input shapes keep the compile count log-bounded.  The zone/ct
+# key ids are static so the traced program indexes with constants, exactly
+# like the eager path.
+feasibility_jit = partial(jax.jit, static_argnames=("zone_key", "ct_key"))(
+    compute_feasibility
+)
+
+
 # ---------------------------------------------------------------------------
 # the scan step
 # ---------------------------------------------------------------------------
@@ -871,6 +882,51 @@ def _run_scan(consts, init, NR: int, Z: int, track: bool):
     return jax.lax.scan(step, init, jnp.arange(G, dtype=jnp.int32))
 
 
+#: megabatch request-slot cap: one vmapped dispatch solves at most this many
+#: independent solve requests (service/server.py --max-slots clamps here)
+MEGA_MAX_SLOTS = 32
+
+
+def _mega_rung(n: int) -> int:
+    """Pad the request-slot axis to a power-of-two rung (1,2,4,...,32): the
+    megabatch kernel compiles per (dims, B) signature, so bucketing B keeps
+    the compile ladder log-bounded and AOT-precompilable, exactly like the
+    tensor-axis rungs of :func:`_rung`."""
+    r = 1
+    while r < min(max(1, n), MEGA_MAX_SLOTS):
+        r *= 2
+    return r
+
+
+@partial(jax.jit, static_argnames=("NR", "Z", "track", "zone_key", "ct_key"))
+def _run_scan_many(consts_b, feas_b, init_b, NR: int, Z: int, track: bool,
+                   zone_key: int, ct_key: int):
+    """Megabatch kernel: B independent solve requests in ONE device dispatch.
+
+    ``jax.vmap`` over the per-request (consts, feasibility-input, init)
+    pytrees — every slot runs the same feasibility + scan program the single
+    path runs, over its own tensors.  Slots cannot interact by construction:
+    vmap introduces no cross-batch reductions, so a slot's result is a pure
+    function of that slot's inputs (tests/test_megabatch.py pins per-request
+    byte parity with serial solves and adversarial cross-tenant isolation).
+    Feasibility runs inside the program (not eagerly per request) so the
+    whole megabatch costs one dispatch + one fence."""
+
+    def one(consts, feas, init):
+        F, dom_ok = compute_feasibility(
+            feas["pm"], consts["requests"], feas["gp_ok"], feas["cand_vw"],
+            feas["cand_vb"], consts["cand_alloc"], consts["cand_prov"],
+            feas["key_check"], feas["dom_vw"], feas["dom_vb"],
+            zone_key, ct_key,
+        )
+        consts = dict(consts, F=F, dom_ok=dom_ok)
+        step = _make_step(consts, NR, Z, track)
+        G = consts["counts"].shape[0]
+        return jax.lax.scan(step, init, jnp.arange(G, dtype=jnp.int32))
+
+    return jax.vmap(one)(consts_b, feas_b, init_b)
+
+
 # ---------------------------------------------------------------------------
 # host-facing API
 # ---------------------------------------------------------------------------
@@ -892,6 +948,13 @@ class SlotsExhausted(Exception):
     def __init__(self, full_sig: tuple) -> None:
         super().__init__("node-slot estimate exhausted; full program cold")
         self.full_sig = full_sig
+
+
+class MegaBucketMismatch(ValueError):
+    """A megabatch flush's requests do not share one compile bucket (the
+    caller's grouping raced a bucket-state change, or a direct caller
+    over/mis-filled the slots).  The collector degrades the flush to serial
+    per-request dispatches — clients must never see this."""
 
 
 def _node_budget(st: SolveTensors, NE: int, max_nodes: Optional[int]) -> int:
@@ -966,6 +1029,30 @@ class TpuSolver:
             ))
         return key
 
+    def mega_signature(
+        self,
+        st: SolveTensors,
+        *,
+        existing_nodes: Sequence[SimNode] = (),
+        max_nodes: Optional[int] = None,
+        track_assignments: bool = True,
+        slots: int = 2,
+    ) -> tuple:
+        """Compile signature of the megabatch program that would serve a
+        ``slots``-request batch of this shape: the single-solve dims key plus
+        the padded request-slot rung and the vocab positions of the zone/ct
+        keys (static args of the vmapped kernel — two catalogs interning the
+        keys differently are different programs AND different buckets)."""
+        base = self.signature(
+            st, existing_nodes=existing_nodes, max_nodes=max_nodes,
+            track_assignments=track_assignments,
+        )
+        return base + (
+            ("mega_slots", _mega_rung(slots)),
+            ("zk", st.vocab.key_id[L.ZONE]),
+            ("ck", st.vocab.key_id[L.CAPACITY_TYPE]),
+        )
+
     def ready(self, sig: tuple) -> bool:
         with self._lock:
             return sig in self._ready
@@ -1009,20 +1096,32 @@ class TpuSolver:
         track_assignments: bool = True,
         mesh=None,
         on_done=None,
+        slots: Optional[int] = None,
     ) -> bool:
         """Compile this solve's signature on a background thread (running
         the full solve and discarding the result — compile dominates).
         Returns True when the warm was accepted (started or queued), False
         when the signature is already ready/compiling/queued, is in its
         failure backoff, or the queue is full.  ``on_done(sig, seconds,
-        error)`` fires from the worker thread when the warm ends."""
-        sig = self.signature(
-            st, existing_nodes=existing_nodes, max_nodes=max_nodes,
-            track_assignments=track_assignments, mesh=mesh,
-        )
+        error)`` fires from the worker thread when the warm ends.
+        ``slots`` > 1 warms the MEGABATCH program at that request-slot rung
+        instead of the single-solve program (mesh must be None)."""
+        if slots and slots > 1:
+            assert mesh is None, "megabatch programs are single-device"
+            sig = self.mega_signature(
+                st, existing_nodes=existing_nodes, max_nodes=max_nodes,
+                track_assignments=track_assignments, slots=slots,
+            )
+        else:
+            slots = None
+            sig = self.signature(
+                st, existing_nodes=existing_nodes, max_nodes=max_nodes,
+                track_assignments=track_assignments, mesh=mesh,
+            )
         kwargs = dict(
             st=st, existing_nodes=existing_nodes, max_nodes=max_nodes,
             track_assignments=track_assignments, mesh=mesh, on_done=on_done,
+            slots=slots,
         )
         with self._lock:
             if self._stopped:
@@ -1046,12 +1145,21 @@ class TpuSolver:
         import threading
 
         on_done = kwargs.pop("on_done")
+        slots = kwargs.pop("slots", None)
 
         def work():
             t0 = time.perf_counter()
             err = None
             try:
-                self.solve(**kwargs)
+                if slots:
+                    # megabatch warm: one request padded up to the slot rung
+                    # compiles exactly the program a full batch will run
+                    kwargs.pop("mesh", None)
+                    outs = self.solve_many([dict(kwargs)], min_slots=slots)
+                    if isinstance(outs[0], Exception):
+                        raise outs[0]
+                else:
+                    self.solve(**kwargs)
             # ktlint: allow[KT005] compile failure is surfaced via on_done
             # (the scheduler's callback logs it) and arms the retry backoff
             except Exception as e:  # pragma: no cover - surfaced via on_done
@@ -1103,24 +1211,29 @@ class TpuSolver:
         # which is the safe behavior for operator shutdown and CLI runs
         threading.Thread(target=work, name="tpu-solver-warm").start()
 
-    def prepare(
+    def _host_arrays(
         self,
         st: SolveTensors,
+        existing_nodes: Sequence[SimNode],
         *,
-        existing_nodes: Sequence[SimNode] = (),
-        max_nodes: Optional[int] = None,
-        track_assignments: bool = True,
-        mesh=None,
-        full_nr: bool = False,
+        node_budget: int,
+        track_assignments: bool,
+        full_nr: bool,
+        a: int = 1,
+        b: int = 1,
     ):
-        """Build (run_fn, init_carry).  ``mesh`` shards the group/candidate/
-        node-slot axes over a jax.sharding.Mesh (parallel/mesh.py layout)."""
+        """Pure-host (numpy) build of one solve's padded tensors: returns
+        ``(np_consts, feas, np_init, dims)`` with every value a numpy array.
+        The SINGLE source of the padding/bucketing both device paths share:
+        :meth:`prepare` (single solve — device placement + feasibility
+        precompute) and :meth:`solve_many` (megabatch — slot-stacked arrays,
+        feasibility inside the vmapped program) each consume this, so the
+        two programs can never pad a batch differently.  No device ops run
+        here (``feas`` carries the feasibility INPUTS, not F)."""
         G, C, D, R = st.G, max(1, st.C), st.D, st.R
         S, Z = st.S, max(1, st.n_zones)
         K, W = st.pm.shape[1], st.pm.shape[2]
         NE = len(existing_nodes)
-
-        node_budget = _node_budget(st, NE, max_nodes)
 
         # ---- shape bucketing + mesh padding ------------------------------
         # The scan compiles per (G, C, NR, ...) signature; rung-bucketing the
@@ -1128,7 +1241,6 @@ class TpuSolver:
         # makes repeated controller solves hit the persistent jit cache
         # instead of paying a fresh XLA compile per batch shape, and keeps
         # the total rung ladder small enough to precompile (warm_async).
-        a, b = _mesh_divs(mesh)
         dims = solve_dims(st, NE=NE, node_budget=node_budget, a=a, b=b,
                           track=track_assignments, full_nr=full_nr)
         pad_g = dims["G"] - G
@@ -1235,33 +1347,81 @@ class TpuSolver:
                 zc0[si, zone_index.get(node.zone, 0)] += n_match
                 tot0[si] += n_match
 
-        consts = dict(
-            counts=jnp.asarray(np_counts),
-            suffix_res=jnp.asarray(np_suffix_res),
-            suffix_cnt=jnp.asarray(np_suffix_cnt),
-            requests=jnp.asarray(np_requests),
-            g_zone_spread=jnp.asarray(np_gzs),
-            g_zone_skew=jnp.asarray(np_gzk),
-            g_host_spread=jnp.asarray(np_ghs),
-            g_host_cap=jnp.asarray(np_ghc),
-            g_zone_anti=jnp.asarray(np_gza),
-            g_zone_paff=jnp.asarray(np_gzp),
-            g_host_paff=jnp.asarray(np_ghp),
-            g_sel_match=jnp.asarray(np_gsm),
-            cand_alloc=jnp.asarray(np_calloc),
-            cand_cap=jnp.asarray(np_ccap),
-            cand_prov=jnp.asarray(np_cprov),
-            cand_price=jnp.asarray(np.where(np.isinf(np_cprice), np.float32(3.0e38), np_cprice).astype(np.float32)),
-            cand_avail=jnp.asarray(np_cavail),
-            prov_limits=jnp.asarray(_pad(
+        np_consts = dict(
+            counts=np_counts,
+            suffix_res=np_suffix_res,
+            suffix_cnt=np_suffix_cnt,
+            requests=np_requests,
+            g_zone_spread=np_gzs,
+            g_zone_skew=np_gzk,
+            g_host_spread=np_ghs,
+            g_host_cap=np_ghc,
+            g_zone_anti=np_gza,
+            g_zone_paff=np_gzp,
+            g_host_paff=np_ghp,
+            g_sel_match=np_gsm,
+            cand_alloc=np_calloc,
+            cand_cap=np_ccap,
+            cand_prov=np_cprov,
+            cand_price=np.where(np.isinf(np_cprice), np.float32(3.0e38),
+                                np_cprice).astype(np.float32),
+            cand_avail=np_cavail,
+            prov_limits=_pad(
                 np.where(np.isinf(st.prov_limits), np.float32(3.0e38),
                          st.prov_limits).astype(np.float32),
                 P_pad - st.prov_limits.shape[0], 0, np.float32(3.0e38),
-            )),
-            dom_zone=jnp.asarray(st.dom_zone),
-            ex_ok=jnp.asarray(ex_ok),
-            node_budget=jnp.int32(node_budget),
+            ),
+            dom_zone=st.dom_zone,
+            ex_ok=ex_ok,
+            node_budget=np.int32(node_budget),
         )
+        feas = dict(
+            pm=np_pm,
+            gp_ok=np_gp_ok,
+            cand_vw=np_cvw,
+            cand_vb=np_cvb,
+            key_check=st.key_check,
+            dom_vw=st.dom_vw,
+            dom_vb=st.dom_vb,
+        )
+        np_init = (
+            ex_res,                                  # res
+            ex_zone,                                 # row_zone
+            np.full(NR, -1, dtype=np.int32),         # row_dom
+            np.full(NR, -1, dtype=np.int32),         # row_cand
+            ex_price,                                # row_price
+            ex_sel,                                  # selcnt
+            np.arange(NR) < NE,                      # active
+            np.int32(NE),                            # n_used
+            zc0,                                     # zc
+            tot0,                                    # tot
+            prov_used0,                              # prov_used
+            np.zeros(G, dtype=np.int32),             # infeasible
+        )
+        return np_consts, feas, np_init, dims
+
+    def prepare(
+        self,
+        st: SolveTensors,
+        *,
+        existing_nodes: Sequence[SimNode] = (),
+        max_nodes: Optional[int] = None,
+        track_assignments: bool = True,
+        mesh=None,
+        full_nr: bool = False,
+    ):
+        """Build (run_fn, init_carry).  ``mesh`` shards the group/candidate/
+        node-slot axes over a jax.sharding.Mesh (parallel/mesh.py layout)."""
+        NE = len(existing_nodes)
+        node_budget = _node_budget(st, NE, max_nodes)
+        a, b = _mesh_divs(mesh)
+        np_consts, feas, np_init, dims = self._host_arrays(
+            st, existing_nodes, node_budget=node_budget,
+            track_assignments=track_assignments, full_nr=full_nr, a=a, b=b,
+        )
+        NR, Z = dims["NR"], dims["Z"]
+
+        consts = {k: jnp.asarray(v) for k, v in np_consts.items()}
 
         zone_key = st.vocab.key_id[L.ZONE]
         ct_key = st.vocab.key_id[L.CAPACITY_TYPE]
@@ -1292,39 +1452,43 @@ class TpuSolver:
             # jitted SPMD program over explicitly placed inputs
             from ..parallel.distributed import put_sharded
 
-            F, dom_ok = jax.jit(
-                compute_feasibility, static_argnames=("zone_key", "ct_key")
-            )(
-                put_sharded(np_pm, sg), consts["requests"],
-                put_sharded(np_gp_ok, sg), put_sharded(np_cvw, sc),
-                put_sharded(np_cvb, sc), consts["cand_alloc"],
-                consts["cand_prov"], put_sharded(st.key_check, sr),
-                put_sharded(st.dom_vw, sr), put_sharded(st.dom_vb, sr),
+            F, dom_ok = feasibility_jit(
+                put_sharded(feas["pm"], sg), consts["requests"],
+                put_sharded(feas["gp_ok"], sg),
+                put_sharded(feas["cand_vw"], sc),
+                put_sharded(feas["cand_vb"], sc), consts["cand_alloc"],
+                consts["cand_prov"], put_sharded(feas["key_check"], sr),
+                put_sharded(feas["dom_vw"], sr),
+                put_sharded(feas["dom_vb"], sr),
                 zone_key=zone_key, ct_key=ct_key,
             )
-        else:
+        elif mesh is not None:
+            # single-process mesh: eager compute respects the consts'
+            # explicit shardings (GSPMD layout is driven by input placement)
             F, dom_ok = compute_feasibility(
-                jnp.asarray(np_pm), consts["requests"], jnp.asarray(np_gp_ok),
-                jnp.asarray(np_cvw), jnp.asarray(np_cvb), consts["cand_alloc"],
-                consts["cand_prov"], jnp.asarray(st.key_check),
-                jnp.asarray(st.dom_vw), jnp.asarray(st.dom_vb), zone_key, ct_key,
+                jnp.asarray(feas["pm"]), consts["requests"],
+                jnp.asarray(feas["gp_ok"]), jnp.asarray(feas["cand_vw"]),
+                jnp.asarray(feas["cand_vb"]), consts["cand_alloc"],
+                consts["cand_prov"], jnp.asarray(feas["key_check"]),
+                jnp.asarray(feas["dom_vw"]), jnp.asarray(feas["dom_vb"]),
+                zone_key, ct_key,
+            )
+        else:
+            # single-device: the module-level jitted program replaces ~a
+            # dozen eager op dispatches per solve (each ~host-ms on the
+            # serving path); compare ops and exact bf16 bit-counts make the
+            # jitted result byte-identical to the eager one
+            F, dom_ok = feasibility_jit(
+                jnp.asarray(feas["pm"]), consts["requests"],
+                jnp.asarray(feas["gp_ok"]), jnp.asarray(feas["cand_vw"]),
+                jnp.asarray(feas["cand_vb"]), consts["cand_alloc"],
+                consts["cand_prov"], jnp.asarray(feas["key_check"]),
+                jnp.asarray(feas["dom_vw"]), jnp.asarray(feas["dom_vb"]),
+                zone_key=zone_key, ct_key=ct_key,
             )
         consts["F"], consts["dom_ok"] = F, dom_ok
 
-        init = (
-            jnp.asarray(ex_res),                                 # res
-            jnp.asarray(ex_zone),                                # row_zone
-            jnp.full(NR, -1, dtype=jnp.int32),                   # row_dom
-            jnp.full(NR, -1, dtype=jnp.int32),                   # row_cand
-            jnp.asarray(ex_price),                               # row_price
-            jnp.asarray(ex_sel),                                 # selcnt
-            jnp.asarray(np.arange(NR) < NE),                     # active
-            jnp.int32(NE),                                       # n_used
-            jnp.asarray(zc0),                                    # zc
-            jnp.asarray(tot0),                                   # tot
-            jnp.asarray(prov_used0),                             # prov_used
-            jnp.zeros(G, dtype=jnp.int32),                       # infeasible
-        )
+        init = tuple(jnp.asarray(v) for v in np_init)
         if mesh is not None:
             from ..parallel.distributed import put_sharded
             from ..parallel.mesh import POD_AXIS
@@ -1522,6 +1686,138 @@ class TpuSolver:
             trace=trace,
         )
 
+    def solve_many_async(
+        self,
+        requests: Sequence[dict],
+        *,
+        min_slots: Optional[int] = None,
+    ) -> "PendingMegaSolve":
+        """Dispatch B independent, signature-compatible solve requests as
+        ONE vmapped device program over padded request slots, WITHOUT
+        fencing — the continuous-batching analog of :meth:`solve_async`:
+        the caller (SolvePipeline via the scheduler's collector) coalesces
+        and tensorizes megabatch N+1 while megabatch N executes, then calls
+        :meth:`PendingMegaSolve.results` for the single batch-wide fence.
+
+        Each request is a dict with ``st`` (required) and optionally
+        ``existing_nodes``, ``max_nodes``, ``track_assignments``,
+        ``raise_on_exhaust``, ``trace``.  Every request must resolve to the
+        SAME :meth:`mega_signature` bucket (the scheduler's coalescer groups
+        by it; asserted here).  The batch axis pads up to the power-of-two
+        slot rung (``_mega_rung``; ``min_slots`` forces a larger rung — the
+        warm path compiles the full-batch program from one request); padding
+        slots replicate request 0 and their outputs are discarded — vmap
+        slots are independent by construction, so padding can never leak
+        into a real request's result."""
+        assert requests, "empty megabatch"
+        if len(requests) > MEGA_MAX_SLOTS:
+            # a silent truncation would compile at shape B while marking the
+            # rung-32 signature ready — callers (the pipeline's coalescer)
+            # clamp to MEGA_MAX_SLOTS; a direct caller must too
+            raise MegaBucketMismatch(
+                f"{len(requests)} requests exceed MEGA_MAX_SLOTS="
+                f"{MEGA_MAX_SLOTS}")
+        t0 = time.perf_counter()
+        defaults = dict(
+            existing_nodes=(), max_nodes=None, track_assignments=True,
+            raise_on_exhaust=False, trace=NULL_TRACE,
+        )
+        reqs = [{**defaults, **r} for r in requests]
+        n_slots = max(len(reqs), min_slots or 1)
+        # ONE snapshot of the exhausted families for the whole call: a
+        # background warm thread flipping _nr_exhausted mid-flush must not
+        # make the per-request dims diverge (the single path guards the
+        # same race in solve(); see _mark_ready's comment there)
+        with self._lock:
+            exhausted = set(self._nr_exhausted)
+        track = reqs[0]["track_assignments"]
+        zone_key = reqs[0]["st"].vocab.key_id[L.ZONE]
+        ct_key = reqs[0]["st"].vocab.key_id[L.CAPACITY_TYPE]
+
+        entries = []
+        for r in reqs:
+            st = r["st"]
+            NE = len(r["existing_nodes"])
+            nb = _node_budget(st, NE, r["max_nodes"])
+            est_dims = solve_dims(st, NE=NE, node_budget=nb, track=track)
+            full_dims = solve_dims(st, NE=NE, node_budget=nb, track=track,
+                                   full_nr=True)
+            full_nr = _dims_key(est_dims) in exhausted
+            np_consts, feas, np_init, dims = self._host_arrays(
+                st, r["existing_nodes"], node_budget=nb,
+                track_assignments=track, full_nr=full_nr,
+            )
+            entries.append(dict(
+                r=r, np_consts=np_consts, feas=feas, np_init=np_init,
+                dims=dims, est_dims=est_dims, full_dims=full_dims,
+                full_nr=full_nr, NE=NE,
+            ))
+        dims0 = entries[0]["dims"]
+        if not all(e["dims"] == dims0 for e in entries) or any(
+            r["st"].vocab.key_id[L.ZONE] != zone_key
+            or r["st"].vocab.key_id[L.CAPACITY_TYPE] != ct_key
+            or r["track_assignments"] != track
+            for r in reqs
+        ):
+            # mis-bucketed flush (caller raced a bucket-state change): a
+            # typed error the collector degrades to serial dispatches on —
+            # never an opaque crash fanned to every RPC in the batch
+            raise MegaBucketMismatch("requests span megabatch buckets")
+        NR, Z = dims0["NR"], dims0["Z"]
+        mega_key = _dims_key(dims0) + (
+            ("mega_slots", _mega_rung(n_slots)),
+            ("zk", zone_key), ("ck", ct_key),
+        )
+
+        B = len(entries)
+        B_pad = _mega_rung(n_slots)
+        padded = entries + [entries[0]] * (B_pad - B)
+
+        consts_b = {
+            k: jnp.asarray(np.stack([e["np_consts"][k] for e in padded]))
+            for k in entries[0]["np_consts"]
+        }
+        feas_b = {
+            k: jnp.asarray(np.stack([e["feas"][k] for e in padded]))
+            for k in entries[0]["feas"]
+        }
+        init_b = tuple(
+            jnp.asarray(np.stack([e["np_init"][i] for e in padded]))
+            for i in range(len(entries[0]["np_init"]))
+        )
+
+        # per-request trace stamps: the shared device phase is recorded on
+        # EVERY request's trace as a pre-closed "megabatch" span carrying its
+        # slot index and the batch occupancy (obs: per-slot attribution of a
+        # shared dispatch)
+        t_starts = [e["r"]["trace"].now() for e in entries]
+        carry_b, ys_b = _run_scan_many(  # async: enqueued, not fenced
+            consts_b, feas_b, init_b, NR, Z, track, zone_key, ct_key,
+        )
+        return PendingMegaSolve(
+            solver=self, entries=entries, carry_b=carry_b, ys_b=ys_b,
+            t0=t0, t_starts=t_starts, track=track, B=B, B_pad=B_pad,
+            mega_key=mega_key,
+        )
+
+    def solve_many(
+        self,
+        requests: Sequence[dict],
+        *,
+        min_slots: Optional[int] = None,
+    ) -> List[object]:
+        """Synchronous megabatch: :meth:`solve_many_async` + the one
+        batch-wide fence.  Returns one entry per request IN ORDER: a
+        :class:`TpuSolveOutput`, or the Exception that request alone hit
+        (``SlotsExhausted`` under the compile-behind contract) — a bad slot
+        must not poison its batchmates.  Per-request ``solve_ms`` is the
+        megabatch wall time (dispatch→fence); callers wanting
+        enqueue→respond latency stamp it themselves (service/server.py
+        SolvePipeline does)."""
+        if not requests:
+            return []
+        return self.solve_many_async(requests, min_slots=min_slots).results()
+
     # ---- result extraction ---------------------------------------------
     # ktlint: fence extraction reads the whole carry back to host — it runs
     # strictly after the fence, on already-transferred results
@@ -1689,6 +1985,77 @@ class PendingTpuSolve:
                 self.existing_nodes, self.NE, elapsed_ms, elapsed_ms,
             )
         return self._out
+
+
+class PendingMegaSolve:
+    """Handle for an async-dispatched megabatch (``solve_many_async``):
+    ``results()`` performs the ONE batch-wide D2H fence, then per-slot
+    extraction.  Idempotent; per-slot slot-exhaustion semantics match
+    ``solve_many``."""
+
+    def __init__(self, solver, entries, carry_b, ys_b, t0, t_starts, track,
+                 B, B_pad, mega_key) -> None:
+        self.solver = solver
+        self.entries = entries
+        self.carry_b = carry_b
+        self.ys_b = ys_b
+        self.t0 = t0
+        self.t_starts = t_starts
+        self.track = track
+        self.B = B
+        self.B_pad = B_pad
+        self.mega_key = mega_key
+        self._outputs: Optional[List[object]] = None
+
+    # ktlint: fence the megabatch handle's one D2H read completes ALL
+    # request slots (the whole point: B solves, one device round trip)
+    def results(self) -> List[object]:
+        if self._outputs is not None:
+            return self._outputs
+        s = self.solver
+        np.asarray(self.carry_b[7])  # the one fence for the WHOLE batch
+        elapsed_ms = (time.perf_counter() - self.t0) * 1000.0
+        s._mark_ready(self.mega_key)
+
+        carry_np = [np.asarray(x) for x in self.carry_b]
+        ys_np = np.asarray(self.ys_b) if self.track else None
+        outputs: List[object] = []
+        for i, e in enumerate(self.entries):
+            r = e["r"]
+            trace = r["trace"] or NULL_TRACE
+            trace.record(
+                "megabatch", self.t_starts[i], trace.now(),
+                slot=i, slots=self.B_pad, occupied=self.B,
+            )
+            carry_i = tuple(x[i] for x in carry_np)
+            ys_i = ys_np[i] if ys_np is not None else None
+            try:
+                retried = s._maybe_retry_exhausted(
+                    carry_i, e["est_dims"], e["full_dims"], e["full_nr"],
+                    r["raise_on_exhaust"],
+                    lambda r=r: s.solve(
+                        r["st"], existing_nodes=r["existing_nodes"],
+                        max_nodes=r["max_nodes"],
+                        track_assignments=r["track_assignments"],
+                        full_nr=True,
+                    ),
+                )
+            # ktlint: allow[KT005] per-slot boxed outcome: the exhausted
+            # slot's exception is returned in its slot so batchmates still
+            # get their results; the caller re-raises per request
+            except Exception as err:
+                outputs.append(err)
+                continue
+            if retried is not None:
+                outputs.append(retried)
+                continue
+            with trace.span("extract", slot=i):
+                outputs.append(s._extract(
+                    r["st"], carry_i, ys_i, r["existing_nodes"], e["NE"],
+                    elapsed_ms, elapsed_ms,
+                ))
+        self._outputs = outputs
+        return outputs
 
 
 _default_solver = TpuSolver()

@@ -504,6 +504,102 @@ class TestKT007SpanLifecycle:
         assert lint(src) == []
 
 
+class TestKT008BucketGrid:
+    HOT = "karpenter_tpu/solver/newkernel.py"
+
+    def test_jit_inside_function_fires(self):
+        src = """
+        import jax
+
+        def prepare(fn, x):
+            return jax.jit(fn)(x)
+        """
+        assert rules_of(lint(src, self.HOT)) == ["KT008"]
+
+    def test_partial_jit_inside_function_fires(self):
+        src = """
+        import jax
+        from functools import partial
+
+        def prepare(fn, x):
+            run = partial(jax.jit, static_argnames=("NR",))(fn)
+            return run(x)
+        """
+        assert rules_of(lint(src, self.HOT)) == ["KT008"]
+
+    def test_jit_decorated_nested_def_fires(self):
+        src = """
+        import jax
+
+        def prepare(x):
+            @jax.jit
+            def run(y):
+                return y
+            return run(x)
+        """
+        assert rules_of(lint(src, self.HOT)) == ["KT008"]
+
+    def test_module_level_on_grid_jit_is_clean(self):
+        src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("NR", "Z", "track"))
+        def run_scan(consts, init, NR, Z, track):
+            return consts
+        """
+        assert lint(src, self.HOT) == []
+
+    def test_off_grid_static_argnames_fires(self):
+        src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("NR", "batch_hint"))
+        def run_scan(consts, NR, batch_hint):
+            return consts
+        """
+        findings = lint(src, self.HOT)
+        assert rules_of(findings) == ["KT008"]
+        assert "batch_hint" in findings[0].message
+
+    def test_off_path_files_are_out_of_scope(self):
+        src = """
+        import jax
+
+        def controller_helper(fn, x):
+            return jax.jit(fn)(x)
+        """
+        assert lint(src, "karpenter_tpu/controllers/provisioning.py") == []
+
+    def test_suppression_with_reason(self):
+        src = """
+        import jax
+
+        def replicate(mesh, value):
+            # ktlint: allow[KT008] dryrun-only helper, two calls per process
+            return jax.jit(lambda x: x)(value)
+        """
+        assert lint(src, self.HOT) == []
+
+    def test_grid_vocabulary_matches_solve_dims(self, small_catalog):
+        """The rule's static registry must cover exactly what solve_dims
+        emits (plus the kernel statics) — a dims key added to the solver
+        without registering it here would flag the solver's own kernels."""
+        from karpenter_tpu.analysis.rules.kt008 import BUCKET_GRID_STATICS
+        from karpenter_tpu.models.pod import PodSpec
+        from karpenter_tpu.models.provisioner import Provisioner
+        from karpenter_tpu.models.tensorize import tensorize
+        from karpenter_tpu.solver.tpu import solve_dims
+
+        st = tensorize([PodSpec(name="p0", requests={"cpu": 1.0})],
+                       [Provisioner(name="default").with_defaults()],
+                       small_catalog)
+        dims = solve_dims(st, NE=0, node_budget=8)
+        assert set(dims) <= BUCKET_GRID_STATICS
+        assert {"zone_key", "ct_key"} <= BUCKET_GRID_STATICS
+
+
 class TestSuppressionGrammar:
     SRC = """
     import time

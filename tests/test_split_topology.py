@@ -48,18 +48,24 @@ def sidecar():
          "--port", str(port), "--backend", "oracle"],
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
-    client = SolverClient(f"127.0.0.1:{port}", timeout=2.0)
-    deadline = time.monotonic() + 30.0
+    # fresh channel per probe: a grpc channel whose first connection attempts
+    # race the server's startup can wedge in reconnect backoff FOREVER on
+    # this host ("tcp handshaker shutdown" against a listening server — see
+    # SolverClient.reset); a new channel connects on its first try once the
+    # sidecar is actually up
+    deadline = time.monotonic() + 60.0
     while True:
+        client = SolverClient(f"127.0.0.1:{port}", timeout=2.0)
         try:
             assert client.health().ok
+            client.close()
             break
         except grpc.RpcError:
+            client.close()
             if time.monotonic() > deadline or proc.poll() is not None:
                 proc.kill()
                 raise RuntimeError("sidecar never became healthy")
             time.sleep(0.2)
-    client.close()
     yield port, proc
     if proc.poll() is None:
         proc.kill()

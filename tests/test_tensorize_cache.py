@@ -189,9 +189,12 @@ class TestCacheInvalidation:
         assert tier == "miss"  # different ICE mask may not reuse tensors
         assert tensors_equal(
             st_ice, tensorize(pods, [prov], small_catalog, unavailable=ice)) == []
-        # and flipping back serves the first entry again, unchanged
+        # and flipping back serves the first entry again, unchanged — the
+        # identity LRU keys on (pods, ICE mask), so the original entry comes
+        # back verbatim (a "shape" rebuild before the tier grew its LRU)
         st_back, tier_back = cache.tensorize(pods, [prov], small_catalog)
-        assert tier_back == "shape"
+        assert tier_back == "identity"
+        assert st_back is st_plain
         assert tensors_equal(st_back, st_plain) == []
 
 
