@@ -2,7 +2,7 @@ PYTHON ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
 
-.PHONY: all test native proto bench clean battletest lint obs-demo
+.PHONY: all test native proto bench clean battletest lint obs-demo overload-demo
 
 all: native proto
 
@@ -42,6 +42,13 @@ bench:
 # p50/p99 over the run plus the recent per-solve trace trees
 obs-demo:
 	JAX_PLATFORMS=cpu $(PYTHON) -m karpenter_tpu.operator --demo --small --pods 60 --tracez
+
+# admission demo (docs/ADMISSION.md): 4x closed-loop overdrive of mixed
+# critical/best_effort clients through the solve pipeline with tight
+# quotas — prints the per-class admitted/shed scoreboard, p50/p99,
+# breaker state and brownout level
+overload-demo:
+	JAX_PLATFORMS=cpu $(PYTHON) -m karpenter_tpu.admission
 
 clean:
 	rm -f karpenter_tpu/solver/_native*.so

@@ -125,6 +125,12 @@ def cmd_serve(args) -> int:
         argv += ["--max-slots", str(args.max_slots)]
     if args.max_wait_ms is not None:
         argv += ["--max-wait-ms", str(args.max_wait_ms)]
+    if args.admission is not None:
+        argv += ["--admission", args.admission]
+    if args.default_priority is not None:
+        argv += ["--default-priority", args.default_priority]
+    if args.default_deadline_ms is not None:
+        argv += ["--default-deadline-ms", str(args.default_deadline_ms)]
     if args.warmup:
         argv.append("--warmup")
     if args.small:
@@ -227,6 +233,16 @@ def main(argv=None) -> int:
     v.add_argument("--max-wait-ms", type=float, default=None,
                    help="max hold before a partial megabatch flushes "
                         "(KT_MAX_WAIT_MS; 0 = flush on queue idle)")
+    v.add_argument("--admission", choices=["on", "off"], default=None,
+                   help="admission control & overload protection "
+                        "(docs/ADMISSION.md; KT_ADMISSION, default on)")
+    v.add_argument("--default-priority", default=None,
+                   choices=["critical", "batch", "best_effort"],
+                   help="priority class for requests carrying none "
+                        "(KT_DEFAULT_PRIORITY_CLASS; default batch)")
+    v.add_argument("--default-deadline-ms", type=float, default=None,
+                   help="enqueue deadline when the RPC carries none "
+                        "(KT_DEFAULT_DEADLINE_MS; 0 = no deadline)")
     v.add_argument("--warmup", action="store_true",
                    help="block startup on the AOT bucket-grid precompile "
                         "(single ladder + megabatch rungs) so the serving "

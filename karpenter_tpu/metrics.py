@@ -170,6 +170,14 @@ TRACE_TRACES = "karpenter_trace_traces_total"
 TRACE_SPAN_DURATION = "karpenter_trace_span_duration_seconds"
 TRACE_RING_EVICTIONS = "karpenter_trace_ring_evictions_total"
 FLIGHT_DUMPS = "karpenter_trace_flight_recorder_dumps_total"
+ADMISSION_ADMITTED = "karpenter_admission_admitted_total"
+ADMISSION_SHED = "karpenter_admission_shed_total"
+ADMISSION_QUEUE_DEPTH = "karpenter_admission_queue_depth"
+ADMISSION_QUEUE_DELAY = "karpenter_admission_queue_delay_seconds"
+ADMISSION_BREAKER_STATE = "karpenter_admission_breaker_state"
+ADMISSION_BREAKER_TRANSITIONS = "karpenter_admission_breaker_transitions_total"
+ADMISSION_BROWNOUT_LEVEL = "karpenter_admission_brownout_level"
+ADMISSION_HOST_ROUTED = "karpenter_admission_host_routed_total"
 
 #: metric inventory: name -> (type, labels, help).  docs/METRICS.md is
 #: generated from this table (``karpenter-tpu metrics-doc``), mirroring the
@@ -309,6 +317,56 @@ INVENTORY = {
         "while the device tier is latched unhealthy), budget_breach (a "
         "trace exceeded KT_TRACE_SLOW_S), sanitizer_error (KT_SANITIZE "
         "lock-discipline violation)."),
+    ADMISSION_ADMITTED: (
+        "counter", ("class",),
+        "Solve requests admitted into the bounded priority queue, by "
+        "priority class (critical / batch / best_effort).  Admitted does "
+        "not mean solved: a request can still expire its deadline while "
+        "queued (counted in karpenter_admission_shed_total{reason="
+        "'deadline'})."),
+    ADMISSION_SHED: (
+        "counter", ("class", "reason"),
+        "Solve requests rejected by admission control, by priority class "
+        "and reason: 'queue_full' (class or total queue-depth quota), "
+        "'rate_limited' (class token bucket empty), 'concurrency' (class "
+        "in-flight quota), 'deadline' (enqueue deadline expired before "
+        "dispatch — rejected BEFORE tensorize/dispatch so timed-out work "
+        "never burns a device round trip), 'preempted' (evicted from a "
+        "full queue by a higher-class arrival), 'brownout' (the load-"
+        "responsive degradation ladder reached its shed rung for this "
+        "class).  Every shed maps to RESOURCE_EXHAUSTED / "
+        "DEADLINE_EXCEEDED on the wire."),
+    ADMISSION_QUEUE_DEPTH: (
+        "gauge", ("class",),
+        "Requests currently held in the admission queue, per priority "
+        "class (bounded by the per-class and total queue-depth quotas)."),
+    ADMISSION_QUEUE_DELAY: (
+        "histogram", (),
+        "Enqueue-to-dispatch wait of admitted requests, seconds — the "
+        "signal driving the brownout ladder's queue-delay EWMA."),
+    ADMISSION_BREAKER_STATE: (
+        "gauge", (),
+        "Device-path circuit breaker state: 0 closed (TPU path open), "
+        "1 half-open (probe traffic only), 2 open (all solves routed to "
+        "the host FFD tier until the open interval elapses)."),
+    ADMISSION_BREAKER_TRANSITIONS: (
+        "counter", ("to",),
+        "Circuit-breaker state transitions, by target state (closed / "
+        "open / half_open).  The breaker trips on accumulated device-"
+        "health failures (hang-guard trips, degraded solves) and re-"
+        "closes only after a half-open probe window passes clean."),
+    ADMISSION_BROWNOUT_LEVEL: (
+        "gauge", (),
+        "Current brownout degradation rung (0 = normal): 1 shrink the "
+        "coalescer max-wait, 2 cap megabatch slots, 3 route best_effort "
+        "to the host FFD reference solver, 4 shed best_effort at "
+        "admission.  Driven by the queue-delay EWMA with hysteresis."),
+    ADMISSION_HOST_ROUTED: (
+        "counter", ("class", "reason"),
+        "Admitted solves routed to the host FFD tier instead of the "
+        "device path, by class and reason: 'breaker' (circuit open / "
+        "half-open non-probe) or 'brownout' (degradation ladder rung 3+ "
+        "for this class)."),
 }
 
 

@@ -14,6 +14,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..metrics import (
+    ADMISSION_ADMITTED,
+    ADMISSION_BREAKER_STATE,
+    ADMISSION_BROWNOUT_LEVEL,
+    ADMISSION_QUEUE_DEPTH,
+    ADMISSION_SHED,
     FLIGHT_DUMPS,
     INFLIGHT_DEPTH,
     REMOTE_DEGRADED,
@@ -28,6 +33,8 @@ from ..metrics import (
     Registry,
 )
 from .recorder import ANOMALY_REASONS, FlightRecorder
+
+_BREAKER_STATES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
 
 
 def tracez(flight: FlightRecorder, limit: int = 50) -> dict:
@@ -77,6 +84,23 @@ def statusz(registry: Registry, flight: Optional[FlightRecorder] = None) -> dict
         },
         "traces_recorded": registry.counter(TRACE_TRACES).get(),
     }
+    shed = registry.counter(ADMISSION_SHED)
+    if shed.values or registry.gauge(ADMISSION_QUEUE_DEPTH).values:
+        # admission control is live (docs/ADMISSION.md): the overload view
+        sheds_by_class: dict = {}
+        for lkey, v in shed.values.items():
+            labels = dict(lkey)
+            if v:
+                sheds_by_class.setdefault(
+                    labels.get("class", ""), {})[labels.get("reason", "")] = v
+        doc["admission"] = {
+            "queued": _series(registry.gauge(ADMISSION_QUEUE_DEPTH), "class"),
+            "admitted": _series(registry.counter(ADMISSION_ADMITTED), "class"),
+            "shed": sheds_by_class,
+            "breaker": _BREAKER_STATES.get(
+                registry.gauge(ADMISSION_BREAKER_STATE).get(), "closed"),
+            "brownout_level": registry.gauge(ADMISSION_BROWNOUT_LEVEL).get(),
+        }
     if flight is not None:
         doc["flight_recorder"] = {
             "ring": len(flight.traces()),

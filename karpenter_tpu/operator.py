@@ -207,12 +207,25 @@ class Operator:
             # reconciles.  The reference consumes its remote boundary the
             # same way (cmd/controller/main.go:44).  Falls back to a local
             # oracle solve while the sidecar is unreachable.
+            from .admission import CRITICAL
             from .service.client import RemoteScheduler
 
+            deadline_ms = float(
+                os.environ.get("KT_SOLVER_DEADLINE_MS", "0") or 0.0)
             self.scheduler = RemoteScheduler(
                 solver_address,
                 backend="" if scheduler_backend == "auto" else scheduler_backend,
                 registry=self.registry,
+                # the provisioning reconcile loop is the service's highest
+                # class: never shed while lower classes can absorb, fills
+                # megabatch slots first (docs/ADMISSION.md)
+                priority=CRITICAL,
+                deadline_s=(deadline_ms / 1000.0) if deadline_ms > 0 else None,
+                # availability first: the reconcile loop has no backoff
+                # story, so a (rare) shed of critical traffic is logged
+                # and served from the local fallback instead of raising
+                # through tick() and killing the operator
+                shed_fallback=True,
             )
         else:
             self.scheduler = BatchScheduler(backend=scheduler_backend,

@@ -19,6 +19,7 @@ from typing import Optional, Sequence, Set
 
 import grpc
 
+from ..admission import SolveDeadlineError, SolveShedError, parse_class
 from ..metrics import Registry, registry as default_registry
 from ..models.instancetype import InstanceType
 from ..models.pod import PodSpec
@@ -77,8 +78,9 @@ class SolverClient:
     def health(self, timeout: Optional[float] = None) -> pb.HealthResponse:
         return self._health(pb.HealthRequest(), timeout=timeout or self.timeout)
 
-    def solve_raw(self, request: pb.SolveRequest) -> pb.SolveResponse:
-        return self._solve(request, timeout=self.timeout)
+    def solve_raw(self, request: pb.SolveRequest,
+                  timeout: Optional[float] = None) -> pb.SolveResponse:
+        return self._solve(request, timeout=timeout or self.timeout)
 
     def warm_raw(self, request: pb.WarmRequest) -> pb.WarmResponse:
         return self._warm(request, timeout=self.timeout)
@@ -114,10 +116,29 @@ class RemoteScheduler:
         fallback: Optional[BatchScheduler] = None,
         reconnect_interval: float = RECONNECT_INTERVAL,
         registry: Optional[Registry] = None,
+        priority: str = "",
+        deadline_s: Optional[float] = None,
+        shed_fallback: bool = False,
     ) -> None:
         self.client = SolverClient(target, timeout=timeout)
         self.target = target
         self.backend = backend
+        # admission identity (docs/ADMISSION.md): every Solve this facade
+        # sends carries the caller's priority class and deadline budget.
+        # Constructor-level (not per-call) so the BatchScheduler facade
+        # contract (tests/test_service.py::TestFacadeContract) stays
+        # byte-for-byte — a control loop IS one priority class.
+        self.priority = parse_class(priority) if priority else ""
+        self.deadline_s = deadline_s
+        # shed posture: library callers get the typed SolveShedError /
+        # SolveDeadlineError (back off, re-plan); an availability-first
+        # control loop (the operator's reconciler — it has no backoff
+        # story, a raised shed would kill the whole loop) sets
+        # shed_fallback=True: the shed is logged + counted and THIS solve
+        # is served locally, WITHOUT latching the degraded path — the
+        # sidecar is healthy and protecting itself, so the next solve
+        # goes remote again.
+        self.shed_fallback = shed_fallback
         self.mesh = None  # the device mesh lives sidecar-side
         self.registry = registry or default_registry
         self.fallback = fallback or BatchScheduler(
@@ -210,14 +231,68 @@ class RemoteScheduler:
                     existing_nodes=existing_nodes, daemonsets=daemonsets,
                     unavailable=unavailable, allow_new_nodes=allow_new_nodes,
                     max_new_nodes=max_new_nodes, backend=self.backend,
+                    priority=self.priority,
+                    deadline_ms=(self.deadline_s * 1000.0
+                                 if self.deadline_s else None),
                 )
+                # the wire deadline budget also bounds the RPC itself: a
+                # caller with 250ms left must not block 60s on the channel
+                rpc_timeout = (min(self.client.timeout, self.deadline_s)
+                               if self.deadline_s else None)
                 try:
-                    resp = self.client.solve_raw(req)
+                    resp = self.client.solve_raw(req, timeout=rpc_timeout)
                 except grpc.RpcError as err:
-                    span.annotate(transport_error=str(
-                        err.code() if callable(getattr(err, "code", None))
-                        else err))
-                    if self._transport_failure(err):
+                    code = (err.code()
+                            if callable(getattr(err, "code", None)) else None)
+                    span.annotate(transport_error=str(code or err))
+                    if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                        # the sidecar SHED this request (admission queue
+                        # full / rate limit / brownout).  Overload is not
+                        # an outage — NEVER latch the degraded path (the
+                        # sidecar is healthy, it is protecting itself).
+                        # Library callers get the typed error so they back
+                        # off; an availability-first reconcile loop
+                        # (shed_fallback=True) logs it and serves THIS
+                        # solve locally, next one goes remote again.
+                        detail = getattr(err, "details", lambda: "")() or ""
+                        if not self.shed_fallback:
+                            # ktlint: allow[KT009] client-side re-map of a
+                            # shed the serving side already counted in
+                            # karpenter_admission_shed_total
+                            raise SolveShedError(
+                                f"solver sidecar shed this solve: {detail}",
+                                pclass=self.priority, reason="remote_shed",
+                            ) from err
+                        logger.warning(
+                            "solver sidecar shed this solve (%s); serving "
+                            "it from the local fallback", detail)
+                    elif (code == grpc.StatusCode.DEADLINE_EXCEEDED
+                            and self.deadline_s is not None):
+                        # the caller CONFIGURED a deadline budget and it is
+                        # spent — whether in the sidecar's queue (its
+                        # DEADLINE_EXCEEDED shed) or on the wire (the
+                        # rpc_timeout above).  Latching degraded would hide
+                        # sustained overload as an outage; a local solve
+                        # blows the budget, so typed error by default —
+                        # the reconcile loop (shed_fallback=True) prefers
+                        # a late local answer over no answer.
+                        # Without a configured budget, DEADLINE_EXCEEDED
+                        # keeps its pre-admission meaning (the 60s channel
+                        # timeout = sidecar unreachable -> degrade).
+                        detail = getattr(err, "details", lambda: "")() or ""
+                        if not self.shed_fallback:
+                            # ktlint: allow[KT009] client-side re-map of a
+                            # deadline the serving side already counted
+                            raise SolveDeadlineError(
+                                f"solve deadline budget "
+                                f"({self.deadline_s:g}s) spent: {detail}",
+                                pclass=self.priority, reason="deadline",
+                            ) from err
+                        logger.warning(
+                            "solve deadline budget (%gs) spent (%s); "
+                            "serving this solve from the local fallback",
+                            self.deadline_s, detail)
+                    elif self._transport_failure(err):
                         self._mark_degraded(err)
                     else:
                         logger.warning("remote solve failed (%s); serving this "

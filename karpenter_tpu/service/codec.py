@@ -143,8 +143,16 @@ def encode_request(
     allow_new_nodes: bool = True,
     max_new_nodes: Optional[int] = None,
     backend: str = "",
+    priority: str = "",
+    deadline_ms: Optional[float] = None,
 ) -> pb.SolveRequest:
-    req = pb.SolveRequest(allow_new_nodes=allow_new_nodes, backend=backend)
+    # admission fields (docs/ADMISSION.md): "" / 0 are the backward-
+    # compatible wire defaults — the server folds them into its configured
+    # default class / deadline, so an old client is indistinguishable from
+    # one that sent nothing
+    req = pb.SolveRequest(allow_new_nodes=allow_new_nodes, backend=backend,
+                          priority_class=priority or "",
+                          deadline_ms=float(deadline_ms or 0.0))
     req.pods.extend(encode_pod(p) for p in pods)
     req.provisioners.extend(encode_provisioner(p) for p in provisioners)
     req.instance_types.extend(encode_instance_type(t) for t in instance_types)

@@ -10,6 +10,7 @@ Run standalone:  python -m karpenter_tpu.service.server --port 50151
 from __future__ import annotations
 
 import argparse
+import logging
 import os
 import queue
 import threading
@@ -20,6 +21,13 @@ from typing import Optional
 
 import grpc
 
+from ..admission import (
+    AdmissionControl,
+    SolveDeadlineError,
+    SolveShedError,
+    admission_enabled,
+    parse_class,
+)
 from ..batcher import InflightQueue, SlotCoalescer
 from ..metrics import (
     INFLIGHT_DEPTH,
@@ -30,6 +38,7 @@ from ..metrics import (
 )
 from ..obs import tracer_for
 from ..obs.trace import NULL_TRACE, Tracer
+from ..solver.guard import DeviceHang
 from ..solver.scheduler import BatchScheduler
 from ..solver.tpu import MEGA_MAX_SLOTS
 from ..utils.clock import Clock
@@ -95,7 +104,8 @@ class SolvePipeline:
                  registry: Optional[Registry] = None, depth: int = 2,
                  max_slots: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
-                 clock: Optional[Clock] = None) -> None:
+                 clock: Optional[Clock] = None,
+                 admission: Optional[AdmissionControl] = None) -> None:
         self.scheduler = scheduler
         self.registry = registry or default_registry
         if max_slots is None:
@@ -138,12 +148,42 @@ class SolvePipeline:
         for reason in ("full", "deadline", "bucket"):
             flush.inc({"reason": reason}, value=0.0)
         self.registry.histogram(MEGABATCH_SLOTS)
+        # admission control (docs/ADMISSION.md): the bounded priority queue
+        # + breaker + brownout front door.  None = construct from env
+        # (KT_ADMISSION=0 disables); False = force off (bench A/B runs).
+        # Disabled keeps the raw FIFO above verbatim — byte-identical to
+        # the pre-admission path.
+        if admission is None and admission_enabled():
+            admission = AdmissionControl(
+                registry=self.registry, clock=self._clock,
+                flight=getattr(getattr(scheduler, "tracer", None),
+                               "flight", None),
+            )
+        self._adm: Optional[AdmissionControl] = admission or None
+        if self._adm is not None:
+            # a preemption happens on the PREEMPTING request's RPC thread;
+            # the victim's blocked RPC thread is unblocked right there
+            self._adm.on_shed = lambda t, exc: _resolve(t.item[1], exc=exc)
+        #: lazily-built host FFD scheduler for breaker-open / brownout
+        #: routed solves (device capacity stays reserved for the classes
+        #: that keep the device path)
+        self._host_sched: Optional[BatchScheduler] = None
+        #: dispatcher-owned: futures whose dispatch was host-routed — their
+        #: outcomes must NOT feed the breaker's device-path probe accounting
+        self._host_futs: set = set()
         self._thread = threading.Thread(
             target=self._loop, name="solve-pipeline", daemon=True)
         self._thread.start()
 
-    def solve(self, kwargs: dict):
-        """RPC-thread entry: enqueue and block for this request's result."""
+    def solve(self, kwargs: dict, pclass: Optional[str] = None,
+              deadline_s: Optional[float] = None):
+        """RPC-thread entry: enqueue and block for this request's result.
+
+        With admission enabled, ``pclass``/``deadline_s`` route the request
+        through the bounded priority queue — :class:`SolveShedError` /
+        :class:`SolveDeadlineError` surface HERE (before any tensorize or
+        device work happened for the request); disabled, both are ignored
+        and the raw FIFO path is byte-identical to pre-admission."""
         fut: Future = Future()
         # queue-wait attribution: stamp the enqueue on the request's trace
         # clock here (RPC thread); the dispatcher closes the "window" span
@@ -153,6 +193,7 @@ class SolvePipeline:
         trace = kwargs.get("trace") or NULL_TRACE
         t_enq = trace.now()
         t_wall = time.perf_counter()
+        item = (kwargs, fut, t_enq, t_wall)
         # the stop-check and the put are one atomic step: a put that wins
         # the lock before stop()'s drain is guaranteed to be seen by the
         # drain; a put that loses sees _stop and refuses — either way no
@@ -161,7 +202,27 @@ class SolvePipeline:
         with self._submit_lock:
             if self._stop.is_set():
                 raise RuntimeError("solve pipeline stopped")
-            self._q.put((kwargs, fut, t_enq, t_wall))
+            if self._adm is not None:
+                pclass = parse_class(pclass or "")
+                # the dispatcher pops this back out before the scheduler
+                # sees kwargs (routing + slot-fill ordering read it)
+                kwargs["_pclass"] = pclass
+                t0 = trace.now()
+                # raises the typed shed/deadline error straight to the RPC
+                # thread — nothing was enqueued, nothing to clean up
+                ticket = self._adm.admit(item, pclass,
+                                         deadline_s=deadline_s)
+                trace.record(
+                    "admission", t0, trace.now(), priority_class=pclass,
+                    queued=len(self._adm.queue),
+                    brownout=self._adm.brownout.level,
+                    breaker=self._adm.breaker.state)
+                # every resolution path (finalize, shed, stop) returns the
+                # class's concurrency-quota slot exactly once
+                fut.add_done_callback(
+                    lambda _f, t=ticket: self._adm.release(t))
+            else:
+                self._q.put(item)
         return fut.result()
 
     def stop(self) -> None:
@@ -194,6 +255,13 @@ class SolvePipeline:
                 except queue.Empty:
                     break
                 _resolve(fut, exc=RuntimeError("solve pipeline stopped"))
+            if self._adm is not None:
+                # tickets still queued in the admission queue: FAIL them
+                # (same contract as the raw FIFO above — a blocked RPC
+                # thread waiting on an unresolved future pins process exit)
+                for ticket in self._adm.drain():
+                    _kwargs, fut, _t_enq, _t_wall = ticket.item
+                    _resolve(fut, exc=RuntimeError("solve pipeline stopped"))
 
     def _finalize(self, pending, fut: Future) -> None:
         try:
@@ -203,8 +271,10 @@ class SolvePipeline:
             # outcome; the exception is handed to the blocked RPC thread via
             # its future and re-raised there
             except BaseException as err:  # noqa: BLE001 — fan to the RPC
+                self._feed_breaker(fut, err)
                 _resolve(fut, exc=err)
                 return
+            self._feed_breaker(fut, None)
             _resolve(fut, result=result)
         finally:
             # resolved either way: out of the dispatcher's hand
@@ -212,6 +282,20 @@ class SolvePipeline:
                 self._in_hand.remove(fut)
             except ValueError:
                 pass  # already failed by a concurrent stop()
+
+    def _feed_breaker(self, fut: Future, err: Optional[BaseException]) -> None:
+        """Per-request outcome -> circuit-breaker probe accounting.  Host-
+        routed solves never touch the device, so their outcomes must not
+        close (or trip) the device-path breaker."""
+        if self._adm is None:
+            return
+        if fut in self._host_futs:
+            self._host_futs.discard(fut)
+            return
+        if err is None:
+            self._adm.breaker.record_success()
+        elif isinstance(err, DeviceHang):
+            self._adm.breaker.record_failure("device_hang")
 
     def _bucket_of(self, kwargs: dict):
         """Megabatch bucket probe — None routes the request down the classic
@@ -259,8 +343,26 @@ class SolvePipeline:
         # dispatches, and the megabatch is one); finalization order stays
         # FIFO because singles and megabatches share the one queue
         self._drain(self._inflight.push(("mega", list(zip(batch, pendings)))))
-        if self._q.empty() and not len(self._coal):
+        if self._inbound_idle() and not len(self._coal):
             self._drain(self._inflight.pop_to(0))
+
+    def _inbound_idle(self) -> bool:
+        """No request waiting to be picked up (whichever front door is
+        active: the admission queue or the raw FIFO)."""
+        if self._adm is not None:
+            return len(self._adm.queue) == 0
+        return self._q.empty()
+
+    def _host_scheduler(self) -> BatchScheduler:
+        """Lazily-built oracle (host FFD) scheduler for breaker-open /
+        brownout-routed solves.  Shares the pipeline's registry and the
+        main scheduler's tracer so routed solves stay observable."""
+        if self._host_sched is None:
+            self._host_sched = BatchScheduler(
+                backend="oracle", registry=self.registry,
+                tracer=getattr(self.scheduler, "tracer", None),
+            )
+        return self._host_sched
 
     def _unhand(self, fut: Future) -> None:
         try:
@@ -286,28 +388,66 @@ class SolvePipeline:
             # ktlint: allow[KT005] per-request failure fans to ITS RPC
             # thread only; batchmates still resolve
             except BaseException as err:  # noqa: BLE001
+                self._feed_breaker(fut, err)
                 _resolve(fut, exc=err)
             else:
+                self._feed_breaker(fut, None)
                 _resolve(fut, result=result)
             self._unhand(fut)
 
-    def _dispatch_single(self, kwargs: dict, fut: Future, t_enq, t_wall) -> None:
+    def _dispatch_single(self, kwargs: dict, fut: Future, t_enq, t_wall,
+                         scheduler: Optional[BatchScheduler] = None) -> None:
         try:
-            pending = self.scheduler.submit(
+            pending = (scheduler or self.scheduler).submit(
                 kwargs.pop("pods"), kwargs.pop("provisioners"),
                 kwargs.pop("instance_types"), **kwargs,
             )
         # ktlint: allow[KT005] submit failures fan to the waiting RPC
         # thread through its future; the dispatcher itself must live on
         except BaseException as err:  # noqa: BLE001
+            self._host_futs.discard(fut)
             _resolve(fut, exc=err)
             self._unhand(fut)
             return
         self._drain(self._inflight.push((pending, fut)))
-        if self._q.empty() and not len(self._coal):
+        if self._inbound_idle() and not len(self._coal):
             # no overlap work available: drain so this caller's latency
             # is one dispatch + one fence, exactly the unpipelined path
             self._drain(self._inflight.pop_to(0))
+
+    def _next_item(self, timeout: float):
+        """Pop the next request from whichever front door is active.
+        Admission path: priority-ordered pop + queue-delay accounting +
+        the pre-dispatch deadline check — an expired ticket is rejected
+        HERE, before any tensorize or device work happened for it."""
+        if self._adm is None:
+            return self._q.get(timeout=timeout)  # raises queue.Empty
+        while True:
+            ticket = self._adm.get(timeout=timeout)
+            if ticket is None:
+                raise queue.Empty
+            self._adm.observe_dispatch(ticket)
+            self._adm.breaker.poll()
+            kwargs, fut, t_enq, t_wall = ticket.item
+            if ticket.expired(self._adm.clock.now()):
+                _resolve(fut, exc=self._adm.expire(ticket))
+                timeout = 0.0  # deadline sheds must not reset the wait
+                continue
+            return kwargs, fut, t_enq, t_wall
+
+    def _apply_brownout(self) -> None:
+        """Dispatcher-owned knob application: the brownout ladder's first
+        two rungs act on the coalescer (stop holding batches open, bound
+        one flush's latency footprint).  Back at level 0 both revert."""
+        if self._adm is None:
+            return
+        self._coal.max_wait = self._adm.brownout.max_wait(self.max_wait)
+        self._coal.max_slots = self._adm.brownout.slot_cap(self.max_slots)
+
+    def _effective_max_wait(self) -> float:
+        if self._adm is None:
+            return self.max_wait
+        return self._adm.brownout.max_wait(self.max_wait)
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -317,13 +457,18 @@ class SolvePipeline:
             else:
                 timeout = 0.1
             try:
-                kwargs, fut, t_enq, t_wall = self._q.get(timeout=timeout)
+                kwargs, fut, t_enq, t_wall = self._next_item(timeout)
             except queue.Empty:
+                if self._adm is not None:
+                    # decay the brownout EWMA + poll the breaker feeds so
+                    # recovery doesn't need traffic to make progress
+                    self._adm.observe_idle()
                 for reason, _key, batch in self._coal.poll():
                     self._flush(batch, reason)
                 if not len(self._coal):
                     self._drain(self._inflight.pop_to(0))
                 continue
+            self._apply_brownout()
             # close the queue-wait phase on the request's trace: enqueue
             # (RPC thread) -> pickup (this dispatcher)
             trace = kwargs.get("trace") or NULL_TRACE
@@ -336,11 +481,26 @@ class SolvePipeline:
             # fut parked in _inflight is in the ledger too — stop() may
             # fail it twice (once per structure), which _resolve absorbs.
             self._in_hand.append(fut)
+            if self._adm is not None:
+                host_reason = self._adm.route_host(
+                    kwargs.pop("_pclass", "") or "")
+                if host_reason is not None:
+                    # breaker open / brownout rung 3+: this solve takes the
+                    # host FFD tier — flush anything held first so response
+                    # FIFO order survives, then dispatch on the single path
+                    trace.annotate(host_routed=host_reason)
+                    for reason, _key, batch in self._coal.flush("bucket"):
+                        self._flush(batch, reason)
+                    self._host_futs.add(fut)
+                    self._dispatch_single(kwargs, fut, t_enq, t_wall,
+                                          scheduler=self._host_scheduler())
+                    continue
             key = self._bucket_of(kwargs)
             for reason, _key, batch in self._coal.add(
                     key, (kwargs, fut, t_enq, t_wall)):
                 self._flush(batch, reason)
-            if len(self._coal) and self._q.empty() and self.max_wait <= 0.0:
+            if len(self._coal) and self._inbound_idle() \
+                    and self._effective_max_wait() <= 0.0:
                 # queue went idle with no wait configured: flush NOW so a
                 # lone request's latency matches the unbatched path; under
                 # real concurrency the queue is non-empty here and slots
@@ -371,6 +531,14 @@ class SolverService:
         self._schedulers = {"": self.scheduler}  # guarded-by: _direct_lock
         # KT_SOLVE_PIPELINE=0 falls back to direct, lock-serialized solves
         self._pipelined = os.environ.get("KT_SOLVE_PIPELINE", "1") != "0"
+        if not self._pipelined and admission_enabled():
+            # admission control rides the pipeline's queue; the direct
+            # debug path has none — say so loudly instead of letting the
+            # operator believe overload protection is active while inert
+            logging.getLogger(__name__).warning(
+                "KT_SOLVE_PIPELINE=0: direct solves bypass admission "
+                "control entirely (no priority queue, no deadline "
+                "shedding, no breaker/brownout — docs/ADMISSION.md)")
         self._pipelines: dict = {}               # guarded-by: _direct_lock
         self._closed = False                     # guarded-by: _direct_lock
         self._direct_lock = threading.Lock()
@@ -417,29 +585,64 @@ class SolverService:
             pipe.stop()
 
     # ---- RPC methods -----------------------------------------------------
+    @staticmethod
+    def _deadline_of(request: pb.SolveRequest, context) -> Optional[float]:
+        """The caller's remaining deadline budget, seconds: an explicit
+        ``deadline_ms`` wins, else the propagated gRPC deadline
+        (``context.time_remaining()``), else None — the admission policy's
+        ``KT_DEFAULT_DEADLINE_MS`` applies.  ``getattr`` fallbacks keep an
+        old-proto request (no new fields) decoding to 'no deadline'."""
+        ms = float(getattr(request, "deadline_ms", 0.0) or 0.0)
+        if ms > 0:
+            return ms / 1000.0
+        if context is not None:
+            remaining = getattr(context, "time_remaining", None)
+            if callable(remaining):
+                rem = remaining()
+                if rem is not None:
+                    return max(0.0, float(rem))
+        return None
+
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
         kwargs = codec.decode_request(request)
         sched = self._scheduler_for(request.backend)
+        pclass = parse_class(getattr(request, "priority_class", ""))
+        deadline_s = self._deadline_of(request, context)
         # one trace per RPC, threaded through the pipeline's dispatch/
         # finalize boundary via the kwargs dict (the dispatcher records the
         # queue-wait "window" span on it; the scheduler opens tensorize/
         # dispatch/fence/reseat under it); "respond" covers the encode back
         # onto the wire
-        with self.tracer.start(
-            "solve", rpc="Solve", backend=sched.backend,
-            n_pods=len(kwargs.get("pods", ())),
-        ) as trace:
-            kwargs["trace"] = trace
-            if self._pipelined:
-                result = self._pipeline_for(sched).solve(kwargs)
-            else:
-                with self._direct_lock:
-                    result = sched.solve(
-                        kwargs.pop("pods"), kwargs.pop("provisioners"),
-                        kwargs.pop("instance_types"), **kwargs,
-                    )
-            with trace.span("respond"):
-                resp = codec.encode_response(result)
+        try:
+            with self.tracer.start(
+                "solve", rpc="Solve", backend=sched.backend,
+                n_pods=len(kwargs.get("pods", ())), priority_class=pclass,
+            ) as trace:
+                kwargs["trace"] = trace
+                if self._pipelined:
+                    result = self._pipeline_for(sched).solve(
+                        kwargs, pclass=pclass, deadline_s=deadline_s)
+                else:
+                    with self._direct_lock:
+                        result = sched.solve(
+                            kwargs.pop("pods"), kwargs.pop("provisioners"),
+                            kwargs.pop("instance_types"), **kwargs,
+                        )
+                with trace.span("respond"):
+                    resp = codec.encode_response(result)
+        except SolveDeadlineError as err:
+            # shed BEFORE tensorize/dispatch: the wire contract is
+            # DEADLINE_EXCEEDED for expired budgets, RESOURCE_EXHAUSTED for
+            # everything else admission refused (client.py maps both back
+            # to the typed errors — no silent retry into an overloaded
+            # server).  Direct callers (context=None) get the typed raise.
+            if context is None:
+                raise
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(err))
+        except SolveShedError as err:
+            if context is None:
+                raise
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(err))
         return resp
 
     def Warm(self, request: pb.WarmRequest, context) -> pb.WarmResponse:
@@ -531,7 +734,28 @@ def main(argv=None) -> int:
                              "to skip even this across restarts")
     parser.add_argument("--small", action="store_true",
                         help="--warmup against the 20-type catalog")
+    parser.add_argument("--admission", choices=["on", "off"], default=None,
+                        help="admission control & overload protection "
+                             "(docs/ADMISSION.md): bounded priority queue, "
+                             "deadline shedding, circuit breaker, brownout "
+                             "(default KT_ADMISSION, on)")
+    parser.add_argument("--default-priority", default=None,
+                        choices=["critical", "batch", "best_effort"],
+                        help="priority class for requests that carry none "
+                             "(KT_DEFAULT_PRIORITY_CLASS; default batch)")
+    parser.add_argument("--default-deadline-ms", type=float, default=None,
+                        help="enqueue deadline applied when the RPC "
+                             "carries none (KT_DEFAULT_DEADLINE_MS; 0 = "
+                             "no deadline)")
     args = parser.parse_args(argv)
+    # admission knobs land in the env so every pipeline the service lazily
+    # constructs (per backend) picks them up uniformly
+    if args.admission is not None:
+        os.environ["KT_ADMISSION"] = "1" if args.admission == "on" else "0"
+    if args.default_priority is not None:
+        os.environ["KT_DEFAULT_PRIORITY_CLASS"] = args.default_priority
+    if args.default_deadline_ms is not None:
+        os.environ["KT_DEFAULT_DEADLINE_MS"] = str(args.default_deadline_ms)
     service = SolverService(BatchScheduler(backend=args.backend),
                             max_slots=args.max_slots,
                             max_wait_ms=args.max_wait_ms)
@@ -548,7 +772,12 @@ def main(argv=None) -> int:
         )
         print(f"warmup: {n} bucket programs compiled; serving", flush=True)
     server, port = make_server(service, port=args.port, host=args.host)
-    print(f"solver sidecar listening on {args.host}:{port} (backend={args.backend})")
+    # admission rides the pipeline: with KT_SOLVE_PIPELINE=0 it is inert,
+    # and the startup line must not claim otherwise
+    admission_live = admission_enabled() and service._pipelined
+    print(f"solver sidecar listening on {args.host}:{port} "
+          f"(backend={args.backend}, admission="
+          f"{'on' if admission_live else 'off'})")
     if args.obs_port:
         from ..obs import default_flight
         from ..obs.export import serve as obs_serve

@@ -149,3 +149,109 @@ def test_errored_prior_skipped(tmp_path):
          "tpu_nodes": 560, "cost_ratio_vs_ffd": 0.99},
         prior_dir=str(tmp_path))
     assert out["prior_round"] == "BENCH_r03.json"
+
+
+# --- ISSUE 5: overload budget gates (bench.py:check_budgets) ---------------
+
+OVERLOAD_OK = {
+    "admission_overhead_pct": 0.4,
+    "unloaded_critical_p99_ms": 90.0,
+    "overload_critical_p99_ms": 150.0,
+    "overload_critical_p99_ratio": 1.67,
+    "overload_critical_sheds": 0.0,
+    "overload_best_effort_sheds": 120.0,
+}
+
+
+def test_overload_budgets_clean():
+    assert benchmod.check_budgets(dict(OVERLOAD_OK)) == {}
+
+
+def test_critical_p99_blowout_flagged():
+    rec = dict(OVERLOAD_OK, overload_critical_p99_ratio=2.4)
+    flags = benchmod.check_budgets(rec)["budget_flags"]
+    assert any("critical p99 under 4x overload" in f for f in flags)
+
+
+def test_critical_shed_flagged():
+    rec = dict(OVERLOAD_OK, overload_critical_sheds=2.0)
+    flags = benchmod.check_budgets(rec)["budget_flags"]
+    assert any("critical" in f and "shed" in f for f in flags)
+
+
+def test_no_best_effort_sheds_flagged():
+    # zero sheds under overdrive means admission never engaged
+    rec = dict(OVERLOAD_OK, overload_best_effort_sheds=0.0)
+    flags = benchmod.check_budgets(rec)["budget_flags"]
+    assert any("did not engage" in f for f in flags)
+
+
+def test_admission_overhead_flagged():
+    rec = dict(OVERLOAD_OK, admission_overhead_pct=3.5)
+    flags = benchmod.check_budgets(rec)["budget_flags"]
+    assert any("admission budget" in f for f in flags)
+
+
+# --- ISSUE 5 satellite: backend-probe verdict cache ------------------------
+
+
+class TestBackendProbeCache:
+    def test_cache_hit_skips_the_probe(self, tmp_path, monkeypatch):
+        import subprocess as sp
+
+        cache = tmp_path / "probe.json"
+        benchmod._write_probe_cache(str(cache), "axon")
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+        def boom(*a, **k):
+            raise AssertionError("probe subprocess ran despite a fresh cache")
+
+        monkeypatch.setattr(sp, "run", boom)
+        assert benchmod.ensure_backend(cache_path=str(cache)) == "axon"
+
+    def test_cpu_verdict_pins_env(self, tmp_path, monkeypatch):
+        cache = tmp_path / "probe.json"
+        benchmod._write_probe_cache(str(cache), "cpu")
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        assert benchmod.ensure_backend(cache_path=str(cache)) == "cpu"
+        import os
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+
+    def test_stale_cache_reprobes_and_rewrites(self, tmp_path, monkeypatch):
+        import json as j
+        import subprocess as sp
+
+        cache = tmp_path / "probe.json"
+        cache.write_text(j.dumps({"backend": "axon", "at": 0}))  # 1970: stale
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+        class FakeDone:
+            returncode = 0
+            stdout = "cpu\n"
+            stderr = ""
+
+        calls = []
+        monkeypatch.setattr(sp, "run", lambda *a, **k: calls.append(1)
+                            or FakeDone())
+        assert benchmod.ensure_backend(cache_path=str(cache)) == "cpu"
+        assert calls  # the stale verdict forced a real probe
+        assert j.loads(cache.read_text())["backend"] == "cpu"
+
+    def test_env_cpu_short_circuits_everything(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        assert benchmod.ensure_backend(cache_path="/nonexistent/x.json") == "cpu"
+
+    def test_corrupt_cache_is_ignored(self, tmp_path, monkeypatch):
+        import subprocess as sp
+
+        cache = tmp_path / "probe.json"
+        cache.write_text("{not json")
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+        class FakeDone:
+            returncode = 0
+            stdout = "tpu\n"
+            stderr = ""
+
+        monkeypatch.setattr(sp, "run", lambda *a, **k: FakeDone())
+        assert benchmod.ensure_backend(cache_path=str(cache)) == "tpu"

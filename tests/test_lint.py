@@ -600,6 +600,78 @@ class TestKT008BucketGrid:
         assert {"zone_key", "ct_key"} <= BUCKET_GRID_STATICS
 
 
+class TestKT009UncountedShed:
+    RPC = "karpenter_tpu/service/handler.py"
+
+    def test_fires_on_raise_without_inc(self):
+        src = """
+        from karpenter_tpu.admission import SolveShedError
+
+        def admit(pclass):
+            raise SolveShedError("queue full", pclass=pclass,
+                                 reason="queue_full")
+        """
+        findings = lint(src, self.RPC)
+        assert rules_of(findings) == ["KT009"]
+        assert "karpenter_admission_shed_total" in findings[0].message
+
+    def test_fires_on_construction_for_a_future(self):
+        # the dispatcher resolving a future with the error (no raise) is
+        # still an RPC-path rejection
+        src = """
+        from karpenter_tpu.admission import SolveDeadlineError
+
+        def expire(fut, ticket):
+            fut.set_exception(SolveDeadlineError("expired"))
+        """
+        assert rules_of(lint(src, self.RPC)) == ["KT009"]
+
+    def test_quiet_with_counter_inc_in_same_function(self):
+        src = """
+        from karpenter_tpu.admission import SolveShedError
+        from karpenter_tpu.metrics import ADMISSION_SHED
+
+        def zero_init(registry):
+            registry.counter(ADMISSION_SHED).inc(
+                {"class": "batch", "reason": "queue_full"}, value=0.0)
+
+        def admit(registry, pclass):
+            registry.counter(ADMISSION_SHED).inc(
+                {"class": pclass, "reason": "queue_full"})
+            raise SolveShedError("queue full")
+        """
+        assert lint(src, self.RPC) == []
+
+    def test_quiet_with_accounting_helper(self):
+        src = """
+        from karpenter_tpu.admission import SolveShedError
+
+        def admit(self, pclass):
+            self._count_shed(pclass, "queue_full", "full")
+            raise SolveShedError("queue full")
+        """
+        assert lint(src, self.RPC) == []
+
+    def test_out_of_scope_files_are_quiet(self):
+        src = """
+        from karpenter_tpu.admission import SolveShedError
+
+        def poke():
+            raise SolveShedError("not an RPC path")
+        """
+        assert lint(src, "karpenter_tpu/controllers/provisioning.py") == []
+
+    def test_suppression_with_reason(self):
+        src = """
+        from karpenter_tpu.admission import SolveShedError
+
+        def remap(err):
+            # ktlint: allow[KT009] client-side re-map; serving side counted
+            raise SolveShedError(str(err))
+        """
+        assert lint(src, self.RPC) == []
+
+
 class TestSuppressionGrammar:
     SRC = """
     import time

@@ -147,3 +147,70 @@ class TestInterruptionSeries:
             assert series_exists(c, {"message_type": kind}), \
                 f"{INTERRUPTION_RECEIVED}{{message_type={kind}}} missing"
             assert c.get({"message_type": kind}) == 0.0
+
+
+class TestAdmissionSeries:
+    """ISSUE 5: the admission subsystem's full label population is born at
+    zero from AdmissionControl construction — classes x shed reasons,
+    classes x host-route reasons, per-class depth gauges, breaker
+    transition targets — and all of it survives into expose()."""
+
+    def test_every_admission_series_is_born_at_zero(self):
+        from karpenter_tpu.admission import (
+            HOST_ROUTE_REASONS,
+            PRIORITY_CLASSES,
+            SHED_REASONS,
+            AdmissionControl,
+        )
+        from karpenter_tpu.metrics import (
+            ADMISSION_ADMITTED,
+            ADMISSION_BREAKER_STATE,
+            ADMISSION_BREAKER_TRANSITIONS,
+            ADMISSION_BROWNOUT_LEVEL,
+            ADMISSION_HOST_ROUTED,
+            ADMISSION_QUEUE_DEPTH,
+            ADMISSION_SHED,
+        )
+
+        reg = Registry()
+        AdmissionControl(registry=reg)
+        for c in PRIORITY_CLASSES:
+            assert series_exists(reg.counter(ADMISSION_ADMITTED),
+                                 {"class": c})
+            assert reg.gauge(ADMISSION_QUEUE_DEPTH).has({"class": c})
+            for r in SHED_REASONS:
+                assert series_exists(reg.counter(ADMISSION_SHED),
+                                     {"class": c, "reason": r}), \
+                    f"shed{{class={c},reason={r}}} missing"
+            for r in HOST_ROUTE_REASONS:
+                assert series_exists(reg.counter(ADMISSION_HOST_ROUTED),
+                                     {"class": c, "reason": r})
+        for to in ("closed", "open", "half_open"):
+            assert series_exists(
+                reg.counter(ADMISSION_BREAKER_TRANSITIONS), {"to": to})
+        assert reg.gauge(ADMISSION_BREAKER_STATE).has()
+        assert reg.gauge(ADMISSION_BROWNOUT_LEVEL).has()
+        text = reg.expose()
+        assert ('karpenter_admission_shed_total'
+                '{class="best_effort",reason="queue_full"} 0') in text
+        assert 'karpenter_admission_breaker_state 0' in text
+
+    def test_pipeline_construction_registers_admission_series(self):
+        """The serving integration: a SolvePipeline (admission on) exposes
+        the shed series before the first request — no scrape gap."""
+        from karpenter_tpu.admission import AdmissionControl
+        from karpenter_tpu.metrics import ADMISSION_SHED
+        from karpenter_tpu.service.server import SolvePipeline
+
+        class StubScheduler:
+            backend = "oracle"
+
+        reg = Registry()
+        pipe = SolvePipeline(StubScheduler(), registry=reg,
+                             admission=AdmissionControl(registry=reg))
+        try:
+            assert series_exists(
+                reg.counter(ADMISSION_SHED),
+                {"class": "critical", "reason": "deadline"})
+        finally:
+            pipe.stop()
