@@ -1,9 +1,11 @@
 """ktlint rule modules.  Each module exposes ``ID``, ``TITLE``, ``HINT`` and
 ``check(files) -> list[Finding]``; the catalog lives in docs/ANALYSIS.md."""
 
-from . import kt001, kt002, kt003, kt004, kt005, kt006, kt007, kt008, kt009
+from . import (kt001, kt002, kt003, kt004, kt005, kt006, kt007, kt008, kt009,
+               kt010)
 
-ALL_RULES = (kt001, kt002, kt003, kt004, kt005, kt006, kt007, kt008, kt009)
+ALL_RULES = (kt001, kt002, kt003, kt004, kt005, kt006, kt007, kt008, kt009,
+             kt010)
 
 __all__ = ["ALL_RULES", "kt001", "kt002", "kt003", "kt004", "kt005", "kt006",
-           "kt007", "kt008", "kt009"]
+           "kt007", "kt008", "kt009", "kt010"]

@@ -133,6 +133,10 @@ class DeprovisioningController:
         self.phase_n: Dict[str, int] = {}
         self._single_cursor = 0  # rotating single-consolidation resume point
         self._last_eval_at = -1e18
+        # sweep metrics must exist from construction (KT003)
+        from ..solver.consolidation import zero_init_sweep_metrics
+
+        zero_init_sweep_metrics(self.registry)
         self._pending: Optional[PendingReplacement] = None
         self._proposed: Optional[Tuple[Action, float]] = None  # (action, validate_at)
         self._replace_backoff: Dict[str, float] = {}  # node -> retry-after
@@ -409,14 +413,28 @@ class DeprovisioningController:
                     return attempt
 
             if run_single:
+                from ..solver.consolidation import SWEEP_MAX_SLOTS
+
                 deletable_idx = {i for k, i in enumerate(cand_idx)
                                  if screen.deletable[k]}
-                for _, ns in cands:
-                    if idx_of.get(ns.node.name) not in deletable_idx:
-                        continue
-                    attempt = self._simulate([ns])
-                    if attempt is not None and attempt.kind == "delete":
-                        return attempt
+                screened = [ns for _, ns in cands
+                            if idx_of.get(ns.node.name) in deletable_idx]
+                # ONE vmapped dispatch per chunk confirms every screened
+                # single together (was: one full what-if round trip each);
+                # first confirmed delete in disruption order wins, exactly
+                # like the serial loop it replaces
+                for lo in range(0, len(screened), SWEEP_MAX_SLOTS):
+                    chunk = screened[lo:lo + SWEEP_MAX_SLOTS]
+                    t0 = time.perf_counter()
+                    attempts = self._simulate_batch(
+                        [[ns] for ns in chunk],
+                        stop_on=lambda a: a is not None
+                        and a.kind == "delete",
+                    )
+                    self._phase("screened_confirm", time.perf_counter() - t0)
+                    for attempt in attempts:
+                        if attempt is not None and attempt.kind == "delete":
+                            return attempt
                 # fall through: no screened single confirmed; try replace paths
 
         # 2b) multi-node: binary search the largest disruption-cost prefix
@@ -435,18 +453,28 @@ class DeprovisioningController:
         #    per reconcile while finding nothing on converged fleets
         t0 = time.perf_counter()
         try:
+            from ..solver.consolidation import SWEEP_MAX_SLOTS
+
             n = len(cands)
             start = self._single_cursor % n
+            budget = min(SINGLE_TRIES_PER_PASS, n)
+            window = [cands[(start + k) % n][1] for k in range(budget)]
+            # the rotating window rides the sweep: each chunk is one
+            # vmapped dispatch instead of up to SWEEP_MAX_SLOTS sequential
+            # what-ifs; the first candidate (in rotation order) whose
+            # what-if confirms wins, exactly like the serial loop
             tried = 0
-            for k in range(n):
-                if tried >= SINGLE_TRIES_PER_PASS:
-                    break
-                _, ns = cands[(start + k) % n]
-                tried += 1
-                attempt = self._simulate([ns])
-                if attempt is not None:
-                    self._single_cursor = start + k + 1
-                    return attempt
+            for lo in range(0, budget, SWEEP_MAX_SLOTS):
+                chunk = window[lo:lo + SWEEP_MAX_SLOTS]
+                attempts = self._simulate_batch(
+                    [[ns] for ns in chunk],
+                    stop_on=lambda a: a is not None,
+                )
+                for j, attempt in enumerate(attempts):
+                    if attempt is not None:
+                        self._single_cursor = start + lo + j + 1
+                        return attempt
+                tried += len(chunk)
             self._single_cursor = start + tried
             return None
         finally:
@@ -456,6 +484,9 @@ class DeprovisioningController:
         """Binary-search the largest disruption-cost prefix of ``cands`` that
         exact-confirms (delete, or delete + one replacement)."""
         best = None
+        # ktlint: allow[KT010] binary search is sequentially dependent —
+        # each probe's prefix size is chosen from the previous outcome, so
+        # the what-ifs cannot be batched into one dispatch
         while lo <= hi:
             mid = (lo + hi) // 2
             attempt = self._simulate([ns for _, ns in cands[:mid]])
@@ -564,11 +595,16 @@ class DeprovisioningController:
                 overflow, self.MAX_SUBSET_CONFIRMS,
             )
         self._last_confirm_drop = overflow
+        batch = []
         for _, subset in hits[: self.MAX_SUBSET_CONFIRMS]:
             targets = [ns_of[i] for i in subset if i in ns_of]
-            if len(targets) != len(subset):
-                continue
-            attempt = self._simulate(targets)
+            if len(targets) == len(subset):
+                batch.append(targets)
+        # all top hits confirm in one sweep dispatch; first (highest
+        # savings) confirmed delete wins, like the serial loop it replaces
+        for attempt in self._simulate_batch(
+            batch, stop_on=lambda a: a is not None and a.kind == "delete",
+        ):
             if attempt is not None and attempt.kind == "delete":
                 return attempt
         return None
@@ -582,8 +618,17 @@ class DeprovisioningController:
         t0 = time.perf_counter()
         result = self._solve_what_if(pods, target_names)
         self._phase("what_if_solve", time.perf_counter() - t0)
+        return self._action_from_what_if(targets, result)
+
+    def _action_from_what_if(
+        self, targets: Sequence[NodeState], result: SolveResult,
+    ) -> Optional[Action]:
+        """Map one what-if result to a consolidation action (shared by the
+        serial `_simulate` and the batched `_simulate_batch`, so decision
+        semantics cannot diverge between the two)."""
         if result.infeasible:
             return None
+        target_names = {ns.node.name for ns in targets}
         current_cost = sum(ns.node.price for ns in targets)
         new_cost = result.new_node_cost
         if new_cost <= 0:
@@ -599,6 +644,98 @@ class DeprovisioningController:
             "replace", "consolidation", sorted(target_names),
             replacement=result.nodes[0], savings=current_cost - new_cost,
         )
+
+    def _simulate_batch(
+        self, targets_list: Sequence[Sequence[NodeState]],
+        stop_on=None,
+    ) -> List[Optional[Action]]:
+        """Batched what-ifs: every candidate evaluated as one slot of a
+        single vmapped device dispatch (solver/consolidation.sweep_what_ifs
+        — one dispatch + one fence instead of one solver round trip per
+        candidate), with per-slot boxed exceptions so one poisoned
+        candidate skips itself instead of failing the pass.  Decisions are
+        identical to looping `_simulate` over the candidates (non-clean
+        slots re-solve through the identical serial path).
+
+        ``stop_on(action)`` — optional predicate matching the caller's
+        first-hit return condition: when the sweep degrades to the serial
+        path (oracle backend, cold shape, breaker open), the fill stops at
+        the first candidate whose action satisfies it — exactly where the
+        pre-sweep serial loop stopped — leaving later entries ``None``
+        instead of paying full what-if solves the caller never reads."""
+        if not targets_list:
+            return []
+        from ..solver.consolidation import sweep_what_ifs
+
+        out: List[Optional[Action]] = [None] * len(targets_list)
+        # volume pins must be current before simulating a move, and an
+        # unresolvable claim aborts that candidate — same contract as
+        # _solve_what_if, applied per candidate
+        vt = self.state.volume_topology
+        all_nodes = self.state.schedulable_nodes()
+        idx_of = {n.name: i for i, n in enumerate(all_nodes)}
+        cands: List[List[int]] = []
+        order: List[int] = []
+        for i, targets in enumerate(targets_list):
+            pods = [p for ns in targets for p in ns.node.pods
+                    if not p.is_daemon]
+            bad = False
+            for p in pods:
+                if p.volume_claims and vt.inject(p):
+                    bad = True
+                    break
+            if bad:
+                continue  # stays None: volume claim unresolvable
+            idxs = [idx_of[ns.node.name] for ns in targets
+                    if ns.node.name in idx_of]
+            if len(idxs) != len(targets):
+                continue  # a target left the schedulable set mid-pass
+            cands.append(idxs)
+            order.append(i)
+        if not cands:
+            return out
+        provisioners = [p.with_defaults()
+                        for p in self.state.provisioners.values()]
+        trace = self._eval_trace or NULL_TRACE
+        actions: dict = {}
+
+        def action_at(pos, res):
+            if pos not in actions:
+                actions[pos] = self._action_from_what_if(
+                    targets_list[order[pos]], res)
+            return actions[pos]
+
+        sweep_stop = None
+        if stop_on is not None:
+            def sweep_stop(pos, res):
+                if isinstance(res, BaseException):
+                    return False
+                return stop_on(action_at(pos, res))
+        t0 = time.perf_counter()
+        with trace.span("what_if_sweep", n_candidates=len(cands)):
+            sweep = sweep_what_ifs(
+                self.scheduler, all_nodes, cands,
+                provisioners=provisioners,
+                instance_types=self.cloud.get_instance_types(),
+                daemonsets=self.state.daemonsets,
+                unavailable=(self.unavailable.as_set()
+                             if self.unavailable else None),
+                registry=self.registry, trace=trace,
+                stop_on=sweep_stop,
+            )
+        self._phase("what_if_sweep", time.perf_counter() - t0)
+        for pos, i in enumerate(order):
+            res = sweep.results[pos]
+            if res is None:
+                continue  # past a stop_on early exit on the serial path
+            if isinstance(res, BaseException):
+                logger.warning(
+                    "what-if for %s failed; candidate skipped this pass: %r",
+                    sorted(ns.node.name for ns in targets_list[i]), res,
+                )
+                continue
+            out[i] = action_at(pos, res)
+        return out
 
     # ---- execution --------------------------------------------------------
     def _solve_what_if(self, pods: List[PodSpec], exclude: set):

@@ -178,6 +178,13 @@ ADMISSION_BREAKER_STATE = "karpenter_admission_breaker_state"
 ADMISSION_BREAKER_TRANSITIONS = "karpenter_admission_breaker_transitions_total"
 ADMISSION_BROWNOUT_LEVEL = "karpenter_admission_brownout_level"
 ADMISSION_HOST_ROUTED = "karpenter_admission_host_routed_total"
+WARMSTART_SOLVES = "karpenter_solver_warmstart_solves_total"
+WARMSTART_DURATION = "karpenter_solver_warmstart_duration_seconds"
+WARMSTART_DISPLACED = "karpenter_solver_warmstart_displaced_pods"
+CONSOLIDATION_SWEEPS = "karpenter_solver_consolidation_sweeps_total"
+CONSOLIDATION_SWEEP_SLOTS = "karpenter_solver_consolidation_sweep_slots"
+CONSOLIDATION_SWEEP_DURATION = (
+    "karpenter_solver_consolidation_sweep_duration_seconds")
 
 #: metric inventory: name -> (type, labels, help).  docs/METRICS.md is
 #: generated from this table (``karpenter-tpu metrics-doc``), mirroring the
@@ -367,6 +374,41 @@ INVENTORY = {
         "device path, by class and reason: 'breaker' (circuit open / "
         "half-open non-probe) or 'brownout' (degradation ladder rung 3+ "
         "for this class)."),
+    WARMSTART_SOLVES: (
+        "counter", ("mode",),
+        "Warm-start delta solves, by serving mode: 'noop' (removals only "
+        "— pure host bookkeeping), 'host' (unconstrained added pods "
+        "first-fit into surviving residual capacity, no device dispatch), "
+        "'scan' (the displaced subproblem ran the device scan seeded from "
+        "the previous assignment), 'full' (the perturbation exceeded "
+        "KT_DELTA_MAX_FRAC or a coupling guard tripped — full re-solve).  "
+        "A healthy steady-state chain is dominated by noop/host."),
+    WARMSTART_DURATION: (
+        "histogram", (),
+        "Wall time of one warm-start delta step (bookkeeping + any "
+        "subproblem solve), seconds — the bench gates its p50 at 1 ms on "
+        "the steady-state host path."),
+    WARMSTART_DISPLACED: (
+        "histogram", (),
+        "Pods the delta step had to (re-)place: added pods plus pods "
+        "displaced off reclaimed nodes."),
+    CONSOLIDATION_SWEEPS: (
+        "counter", ("path",),
+        "Consolidation what-if sweeps, by execution path: 'batched' "
+        "(every candidate served as a slot of a vmapped device dispatch — "
+        "one dispatch, one fence), 'serial' (every candidate on the "
+        "per-candidate fallback: non-device backend, cold sweep program, "
+        "or a candidate set the batch guards rejected), or 'mixed' (some "
+        "slots batched, the rest re-solved serially — infeasible / "
+        "needs-new-node slots and per-candidate carve-outs)."),
+    CONSOLIDATION_SWEEP_SLOTS: (
+        "histogram", (),
+        "Candidate what-ifs per batched sweep dispatch (the N that used "
+        "to cost N sequential solver round trips)."),
+    CONSOLIDATION_SWEEP_DURATION: (
+        "histogram", (),
+        "Wall time of one consolidation what-if sweep (all candidates, "
+        "either path), seconds."),
 }
 
 

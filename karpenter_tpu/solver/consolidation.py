@@ -24,6 +24,7 @@ what-if before acting.
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -32,8 +33,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..metrics import (
+    CONSOLIDATION_SWEEP_DURATION,
+    CONSOLIDATION_SWEEP_SLOTS,
+    CONSOLIDATION_SWEEPS,
+    Registry,
+)
 from ..models import labels as L
-from .types import SimNode, node_classes
+from ..obs.trace import NULL_TRACE
+from .types import SimNode, SolveResult, node_classes
+
+logger = logging.getLogger(__name__)
 
 _RESOURCES = (L.RESOURCE_CPU, L.RESOURCE_MEMORY, L.RESOURCE_PODS)
 
@@ -273,3 +283,353 @@ def compat_matrix(
         out[i] = ok_cls[dst_class]
         out[i, i] = False
     return out
+
+
+# ---------------------------------------------------------------------------
+# one-dispatch consolidation what-if sweeps
+# ---------------------------------------------------------------------------
+#
+# The deprovisioning controller used to pay one full scheduler round trip per
+# candidate what-if ("can this node's pods fit on the rest of the cluster
+# plus at most one new node?") — N candidates, N dispatches, N fences.  Every
+# candidate's what-if is a PERTURBATION of one base solution (the cluster
+# with all nodes active): same catalog tensors, same existing-node state,
+# only the member rows and the displaced pods differ.  The sweep exploits
+# that: ONE shared host-array build of the base cluster, per-candidate
+# derivations (deactivate the member rows, subtract their selector/limit
+# contributions, swap in the candidate's counts), and ONE vmapped device
+# dispatch + ONE fence for the whole sweep via TpuSolver.solve_many_prepared
+# (the megabatch path of solver/tpu.py).
+#
+# Exactness contract: a slot whose device answer is anything but a clean
+# "all pods fit on the survivors, no new node" is re-solved through the
+# serial scheduler path (full relaxation/residue/reseat ladder), so sweep
+# decisions are identical to the sequential what-if loop; per-slot boxed
+# exceptions keep one poisoned candidate from failing its batchmates.  The
+# sweep's vmapped program compiles behind (TpuSolver.warm_custom) — the
+# first sweeps of a shape serve serially, never stalling a reconcile on XLA.
+
+#: sweep candidates per vmapped dispatch (chunked above this)
+SWEEP_MAX_SLOTS = 16
+
+
+@dataclass
+class SweepOutcome:
+    """One consolidation what-if sweep: per-candidate results IN ORDER —
+    a SolveResult, the Exception that candidate alone raised, or None for
+    slots past a ``stop_on`` early exit (never evaluated)."""
+
+    results: List[object]
+    path: str                # "batched" | "serial" | "mixed"
+    wall_ms: float
+    n_batched: int = 0
+    n_serial: int = 0
+    dispatches: int = 0      # vmapped device dispatches (fences) paid
+
+
+#: sweep execution paths — the zero-inited label population of
+#: karpenter_solver_consolidation_sweeps_total (KT003)
+SWEEP_PATHS = ("batched", "mixed", "serial")
+
+
+def zero_init_sweep_metrics(registry: Registry) -> None:
+    """Register the sweep series at 0 (KT003)."""
+    for path in SWEEP_PATHS:
+        if not registry.counter(CONSOLIDATION_SWEEPS).has({"path": path}):
+            registry.counter(CONSOLIDATION_SWEEPS).inc(
+                {"path": path}, value=0.0)
+    registry.histogram(CONSOLIDATION_SWEEP_SLOTS)
+    registry.histogram(CONSOLIDATION_SWEEP_DURATION)
+
+
+def sweep_dims(st, NE: int, node_budget: int, track: bool = False) -> dict:
+    """What-if-sized padded dims: the standard :func:`tpu.solve_dims`
+    bucketing with FINE small-solve rungs on the G and NR axes.  A what-if
+    places a handful of groups against a known node count; the serving-path
+    rungs (G quantum 16, NR floor 512) would run the scan at 4-8x the
+    state the sweep needs.  Confined to the sweep's own compile ladder —
+    serving-path signatures are untouched."""
+    from .tpu import _rung, solve_dims
+
+    dims = solve_dims(st, NE=NE, node_budget=node_budget, track=track,
+                      full_nr=True)
+    if st.G <= 16:
+        dims["G"] = _rung(st.G, 4, 16)
+    if node_budget <= 512:
+        dims["NR"] = _rung(max(1, node_budget), 64, 512)
+    return dims
+
+
+def sweep_signature(st, dims: dict, slots: int) -> tuple:
+    """Compile signature of the sweep's vmapped program at a slot rung —
+    the key TpuSolver readiness/warm bookkeeping tracks for it."""
+    from .tpu import _dims_key, _mega_rung
+
+    return _dims_key(dims) + (
+        ("mega_slots", _mega_rung(slots)),
+        ("zk", st.vocab.key_id[L.ZONE]),
+        ("ck", st.vocab.key_id[L.CAPACITY_TYPE]),
+    )
+
+
+def build_sweep_entries(
+    solver,
+    sts: Sequence[object],
+    all_nodes: Sequence[SimNode],
+    members: Sequence[Sequence[int]],
+    dims: dict,
+    node_budget: int,
+    trace=None,
+) -> List[dict]:
+    """Derive one megabatch entry per candidate from ONE shared base build.
+
+    Every candidate's what-if shares the base cluster's host arrays
+    (residuals, compat, selector counts, provisioner usage over ALL nodes);
+    a candidate differs only by (a) its member node rows being deactivated
+    — an inactive row can never receive pods, which is exactly "this node
+    is deleted" — (b) its members' selector/zone/provisioner contributions
+    subtracted from the seeded counters, and (c) its own pods' counts
+    tensors.  All ``sts`` must share one group structure (the shape-tier
+    tensorize guarantee the caller groups by) and one ``dims`` bucket.
+    """
+    from .tpu import host_count_arrays
+
+    st0 = sts[0]
+    N = len(all_nodes)
+    track = bool(dims["track"])
+    np_consts0, feas0, np_init0, _ = solver._host_arrays(
+        st0, all_nodes, node_budget=node_budget,
+        track_assignments=track, full_nr=True, dims=dims,
+    )
+    (ex_res, ex_zone, row_dom, row_cand, ex_price, ex_sel, active0,
+     n_used0, zc0, tot0, prov_used0, infeas0) = np_init0
+    pad_g = dims["G"] - st0.G
+    Z = dims["Z"]
+    prov_index = {n: i for i, n in enumerate(st0.prov_names)}
+
+    entries: List[dict] = []
+    for st_k, member in zip(sts, members):
+        counts, _req, suffix_res, suffix_cnt = host_count_arrays(
+            st_k, pad_g, Z)
+        consts_k = dict(np_consts0, counts=counts, suffix_res=suffix_res,
+                        suffix_cnt=suffix_cnt)
+        active = active0.copy()
+        zc = zc0.copy()
+        tot = tot0.copy()
+        prov_used = prov_used0.copy()
+        for idx in member:
+            active[idx] = False
+            sel_row = ex_sel[idx]
+            if sel_row.size:
+                zc[:, ex_zone[idx]] -= sel_row
+                tot -= sel_row
+            node = all_nodes[idx]
+            pi = prov_index.get(node.provisioner)
+            if pi is not None:
+                prov_used[pi] = prov_used[pi] - st0.capacity_row(
+                    node.instance_type, node.allocatable)
+        init_k = (ex_res, ex_zone, row_dom, row_cand, ex_price, ex_sel,
+                  active, n_used0, zc, tot, prov_used, infeas0)
+        entries.append(dict(
+            r=dict(st=st_k, existing_nodes=(), max_nodes=node_budget,
+                   track_assignments=track, raise_on_exhaust=False,
+                   trace=trace or NULL_TRACE),
+            np_consts=consts_k, feas=feas0, np_init=init_k, dims=dims,
+            est_dims=dims, full_dims=dims, full_nr=True, NE=N,
+        ))
+    return entries
+
+
+# ktlint: fence the warm thunk's D2H read is the deliberate compile+fence of
+# the background sweep-program warm (discarded results, warm thread only)
+def _warm_sweep(solver, entries: List[dict], slots: int, sig: tuple) -> None:
+    """Background-compile the sweep's vmapped program (compile-behind:
+    the serving sweep never stalls on XLA)."""
+
+    def thunk():
+        pending = solver.solve_many_prepared(entries, min_slots=slots)
+        np.asarray(pending.carry_b[7])  # fence: the compile has landed
+        solver._mark_ready(sig)
+
+    solver.warm_custom(sig, thunk)
+
+
+def sweep_what_ifs(
+    scheduler,
+    all_nodes: Sequence[SimNode],
+    candidates: Sequence[Sequence[int]],
+    *,
+    provisioners,
+    instance_types,
+    daemonsets: Sequence = (),
+    unavailable=None,
+    max_new: int = 1,
+    registry: Optional[Registry] = None,
+    trace=None,
+    stop_on=None,
+) -> SweepOutcome:
+    """Evaluate every candidate's what-if ("delete these nodes; do their
+    pods fit on the rest plus at most ``max_new`` new nodes?") — batched as
+    slots of one vmapped device dispatch when the device path is warm,
+    serially through ``scheduler.solve`` otherwise.  ``candidates`` are
+    node-index subsets of ``all_nodes``.  Results are in candidate order;
+    decisions are identical to the sequential what-if loop by construction
+    (non-clean slots re-solve serially).
+
+    ``stop_on(k, result)`` — optional early exit for the SERIAL fill, for
+    callers that take the first confirming candidate in order (the loop
+    this sweep replaced stopped there too): evaluated on every slot in
+    candidate order — batched and serial alike — and once it returns True
+    the remaining unresolved slots stay ``None`` instead of paying a full
+    what-if solve each for answers the caller will never read.  Batched
+    slots themselves always resolve (they arrive together in the one
+    dispatch, already paid for)."""
+    t0 = time.perf_counter()
+    registry = registry or scheduler.registry
+    zero_init_sweep_metrics(registry)
+    trace = trace or NULL_TRACE
+    from ..models.tensorize import batch_needs_oracle, device_inexpressible
+    from .scheduler import _harden_preferences
+    from .tpu import _dims_key
+
+    K = len(candidates)
+    results: List[object] = [None] * K
+    n_batched = n_serial = dispatches = 0
+
+    def serial_one(k: int) -> object:
+        member = set(candidates[k])
+        others = [n for j, n in enumerate(all_nodes) if j not in member]
+        pods = [p for idx in candidates[k]
+                for p in all_nodes[idx].pods if not p.is_daemon]
+        try:
+            return scheduler.solve(
+                pods, provisioners, instance_types, existing_nodes=others,
+                daemonsets=daemonsets, unavailable=unavailable,
+                allow_new_nodes=True, max_new_nodes=max_new,
+                trace=trace,
+            )
+        # ktlint: allow[KT005] per-candidate boxed outcome: one poisoned
+        # what-if must not fail the sweep's batchmates; the controller
+        # re-raises or skips per candidate
+        except Exception as err:  # noqa: BLE001
+            return err
+
+    # whole-sweep device eligibility; per-candidate carve-outs below
+    device_ok = (
+        scheduler.backend in ("auto", "tpu")
+        and scheduler.mesh is None
+        and scheduler._tensorize_cache is not None
+        and (scheduler.backend == "tpu" or not scheduler._guard.enabled
+             or scheduler._guard.healthy)
+    )
+
+    N = len(all_nodes)
+    node_budget = N + (max_new if max_new is not None else 0)
+    buckets: Dict[tuple, List[int]] = {}
+    prepared: Dict[int, tuple] = {}   # k -> (st, dims, skey)
+    if device_ok:
+        for k in range(K):
+            pods = [p for idx in candidates[k]
+                    for p in all_nodes[idx].pods if not p.is_daemon]
+            if not pods:
+                # empty candidate: trivially deletable, same as the serial
+                # scheduler.solve([]) answer
+                results[k] = SolveResult(nodes=[], assignments={},
+                                         infeasible={})
+                continue
+            try:
+                hardened = [_harden_preferences(p) for p in pods]
+                if (batch_needs_oracle(hardened)
+                        or any(device_inexpressible(p) for p in hardened)):
+                    continue  # oracle-coupled shapes: serial path
+                st, _tier = scheduler._tensorize_cache.tensorize(
+                    hardened, provisioners, instance_types,
+                    daemonsets=daemonsets, unavailable=unavailable,
+                )
+                dims = sweep_dims(st, N, node_budget)
+                skey = tuple(g.key for g in st.groups)
+                bkey = (_dims_key(dims), st.vocab.key_id[L.ZONE],
+                        st.vocab.key_id[L.CAPACITY_TYPE])
+                prepared[k] = (st, dims, skey)
+                buckets.setdefault(bkey, []).append(k)
+            # ktlint: allow[KT005] an unbatchable candidate just solves on
+            # the serial path, where a real error surfaces with context
+            except Exception:  # noqa: BLE001
+                logger.debug("sweep candidate %d not batchable; serial",
+                             k, exc_info=True)
+
+    solver = scheduler._tpu if device_ok else None
+    for bkey, idxs in buckets.items():
+        for lo in range(0, len(idxs), SWEEP_MAX_SLOTS):
+            chunk = idxs[lo:lo + SWEEP_MAX_SLOTS]
+            st0, dims, _ = prepared[chunk[0]]
+            sig = sweep_signature(st0, dims, len(chunk))
+            if not solver.ready(sig) and solver.warm_pending(sig):
+                # compile-behind already in flight: this sweep serves
+                # serially anyway, so skip the shared-base host build
+                # (entries are only needed to dispatch or to SEED a warm)
+                continue
+            # one base build per group structure within the chunk
+            by_skey: Dict[tuple, List[int]] = {}
+            for k in chunk:
+                by_skey.setdefault(prepared[k][2], []).append(k)
+            entry_of: Dict[int, dict] = {}
+            for ks in by_skey.values():
+                entries = build_sweep_entries(
+                    solver, [prepared[k][0] for k in ks], all_nodes,
+                    [candidates[k] for k in ks], prepared[ks[0]][1],
+                    node_budget, trace=trace,
+                )
+                for k, e in zip(ks, entries):
+                    entry_of[k] = e
+            chunk_entries = [entry_of[k] for k in chunk]
+            if not solver.ready(sig):
+                # compile-behind: serve this sweep serially, warm the
+                # vmapped program in the background
+                _warm_sweep(solver, chunk_entries, len(chunk), sig)
+                continue
+            try:
+                with trace.span("sweep_dispatch", slots=len(chunk)):
+                    outs = solver.solve_many_prepared(
+                        chunk_entries, min_slots=len(chunk)).results()
+            # ktlint: allow[KT005] a failed sweep dispatch degrades the
+            # whole chunk to the proven serial path (decisions unchanged)
+            except Exception:  # noqa: BLE001
+                logger.warning("sweep dispatch failed; chunk served "
+                               "serially", exc_info=True)
+                continue
+            dispatches += 1
+            registry.histogram(CONSOLIDATION_SWEEP_SLOTS).observe(len(chunk))
+            for k, out in zip(chunk, outs):
+                if isinstance(out, BaseException):
+                    continue  # serial below (boxed per-slot degrade)
+                res = out.result
+                if res.infeasible or res.nodes:
+                    # not a clean "fits on the survivors" answer: the
+                    # serial path's repair ladder (residue waves, reseat,
+                    # replacement sizing) must judge it — exact parity
+                    continue
+                results[k] = res
+                n_batched += 1
+
+    for k in range(K):
+        if results[k] is None:
+            results[k] = serial_one(k)
+            n_serial += 1
+        # evaluated on EVERY slot in candidate order — batched slots too,
+        # so a dispatch-confirmed early candidate stops the serial fill
+        # before it pays for later unbatchable ones the caller won't read
+        if stop_on is not None and stop_on(k, results[k]):
+            break
+
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    # "serial" means serial FALLBACKS ran — a sweep resolved entirely by
+    # pre-dispatch shortcuts (no solve on either path) stays "batched" so
+    # the serial-fallback rate only counts real degradation
+    path = ("serial" if n_serial and not n_batched
+            else "mixed" if n_serial else "batched")
+    registry.counter(CONSOLIDATION_SWEEPS).inc({"path": path})
+    registry.histogram(CONSOLIDATION_SWEEP_DURATION).observe(wall_ms / 1000.0)
+    return SweepOutcome(results=results, path=path, wall_ms=wall_ms,
+                        n_batched=n_batched, n_serial=n_serial,
+                        dispatches=dispatches)

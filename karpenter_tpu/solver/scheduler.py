@@ -427,6 +427,10 @@ class BatchScheduler:
         for reason in ("full", "deadline", "bucket"):
             self.registry.counter(MEGABATCH_FLUSH).inc(
                 {"reason": reason}, value=0.0)
+        # warm-start delta series exist before the first solve_delta call
+        from .warmstart import zero_init_metrics as _ws_zero_init
+
+        _ws_zero_init(self.registry)
 
     def _device_health_changed(self, healthy: bool) -> None:
         self.registry.gauge(SOLVER_DEVICE_HEALTHY).set(1 if healthy else 0)
@@ -495,6 +499,46 @@ class BatchScheduler:
             existing_nodes=existing_nodes, daemonsets=daemonsets,
             unavailable=unavailable, allow_new_nodes=allow_new_nodes,
             max_new_nodes=max_new_nodes, trace=trace, dispatch=True,
+        )
+
+    def solve_delta(
+        self,
+        prev: SolveResult,
+        added: Sequence[PodSpec] = (),
+        removed: Sequence[str] = (),
+        iced: Sequence[object] = (),
+        *,
+        provisioners: Sequence[Provisioner],
+        instance_types: Sequence[InstanceType],
+        daemonsets: Sequence[PodSpec] = (),
+        unavailable: Optional[Set[tuple]] = None,
+        max_delta_frac: Optional[float] = None,
+        trace=None,
+    ):
+        """Warm-start delta solve through the full scheduler ladder (see
+        solver/warmstart.py): removals and unconstrained adds are host
+        bookkeeping; displaced pods that need a real solve go through
+        :meth:`solve` seeded with the surviving placements — preference
+        relaxation, oracle carve-outs, residue waves and the auto-policy
+        routing all apply to the subproblem exactly as they would to a
+        fresh batch.  Falls back to a full :meth:`solve` of the whole pod
+        set when the perturbation exceeds ``KT_DELTA_MAX_FRAC`` or a
+        coupling guard trips.  Consumes ``prev``; returns a
+        ``DeltaOutcome``."""
+        from . import warmstart
+
+        def _solve(pods, existing, unavail):
+            return self.solve(
+                pods, provisioners, instance_types,
+                existing_nodes=existing, daemonsets=daemonsets,
+                unavailable=unavail or None, trace=trace,
+            )
+
+        return warmstart.delta_solve(
+            prev, added, removed, iced,
+            solve_displaced=_solve, solve_full=_solve,
+            max_delta_frac=max_delta_frac, registry=self.registry,
+            unavailable=unavailable,
         )
 
     def submit_many(

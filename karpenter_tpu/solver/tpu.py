@@ -963,6 +963,52 @@ def _node_budget(st: SolveTensors, NE: int, max_nodes: Optional[int]) -> int:
     return max(1, max_nodes)
 
 
+def host_count_arrays(st: SolveTensors, pad_g: int, Z: int):
+    """The counts-dependent host tensors of one solve: padded counts +
+    requests and the PER-ZONE suffix projection of later-group demand
+    (suffix sums of count*request, distributed over each group's eligible
+    zones) — the backfill available to fill slack on nodes bought for the
+    current group, in resource units: 50 tiny pods cannot justify a big
+    node the way 50 same-sized pods can, and a later group zone-pinned (or
+    hard-spread) elsewhere cannot justify THIS zone's node at all.  The
+    sequential oracle gets this for free by replaying demand zone by zone
+    (designs/bin-packing.md:28-43); here the zone share is an even split
+    over the group's eligible zones (node_selector folds into group
+    requirements), which is exactly what a hard DoNotSchedule spread
+    commits and a conservative, pool-conserving estimate for flexible
+    groups.
+
+    Factored out of ``_host_arrays`` because these are the ONLY group-side
+    tensors that depend on the counts vector: the consolidation sweep
+    (solver/consolidation.py) derives every candidate what-if from one
+    shared base build and recomputes just this per candidate."""
+    G = st.G
+    np_counts = np.pad(st.counts, (0, pad_g), constant_values=0)
+    np_requests = np.pad(st.requests, ((0, pad_g), (0, 0)),
+                         constant_values=0)
+    demand = (np_counts[:, None] * np_requests).astype(np.float32)   # [G, R]
+    zone_share = np.zeros((G + pad_g, Z), dtype=np.float32)
+    for gi, grp in enumerate(st.groups):
+        vs = grp.requirements.get(L.ZONE)
+        ok = np.zeros(Z, dtype=bool)
+        for zi, zname in enumerate(st.zone_names):
+            ok[zi] = vs.contains(zname)
+        if not ok.any():
+            ok[:] = True
+        zone_share[gi] = ok.astype(np.float32) / float(ok.sum())
+    demand_z = demand[:, None, :] * zone_share[:, :, None]           # [G, Z, R]
+    count_z = np_counts[:, None].astype(np.float32) * zone_share     # [G, Z]
+    np_suffix_res = np.concatenate(
+        [np.cumsum(demand_z[::-1], axis=0)[::-1][1:],
+         np.zeros((1,) + demand_z.shape[1:])]
+    ).astype(np.float32)                                             # [G, Z, R]
+    np_suffix_cnt = np.concatenate(
+        [np.cumsum(count_z[::-1], axis=0)[::-1][1:],
+         np.zeros((1, Z))]
+    ).astype(np.float32)                                             # [G, Z]
+    return np_counts, np_requests, np_suffix_res, np_suffix_cnt
+
+
 class TpuSolver:
     """Builds and caches the jitted solve for a tensor shape signature.
 
@@ -1061,6 +1107,15 @@ class TpuSolver:
         with self._lock:
             return sig in self._compiling
 
+    def warm_pending(self, sig: tuple) -> bool:
+        """A warm for ``sig`` is already compiling, queued, or in its
+        failure backoff — admitting another would be refused, so callers
+        can skip preparing its (potentially expensive) inputs."""
+        with self._lock:
+            return (sig in self._compiling
+                    or any(s == sig for s, _ in self._queued)
+                    or self._clock.now() < self._failed_until.get(sig, 0.0))
+
     def compiles_in_flight(self) -> int:
         with self._lock:
             return len(self._compiling)
@@ -1123,6 +1178,18 @@ class TpuSolver:
             track_assignments=track_assignments, mesh=mesh, on_done=on_done,
             slots=slots,
         )
+        return self._admit_warm(sig, kwargs)
+
+    def warm_custom(self, sig, thunk, on_done=None) -> bool:
+        """Background-compile an arbitrary prepared device program on the
+        warm machinery (concurrency cap, bounded queue, failure backoff):
+        ``thunk()`` must run — and thereby compile + ``_mark_ready`` — the
+        program ``sig`` names.  The consolidation sweep uses this to warm
+        its shared-base vmapped what-if program while serving the first
+        sweeps serially (the compile-behind contract)."""
+        return self._admit_warm(sig, dict(on_done=on_done, thunk=thunk))
+
+    def _admit_warm(self, sig: tuple, kwargs: dict) -> bool:
         with self._lock:
             if self._stopped:
                 return False
@@ -1146,12 +1213,17 @@ class TpuSolver:
 
         on_done = kwargs.pop("on_done")
         slots = kwargs.pop("slots", None)
+        thunk = kwargs.pop("thunk", None)
 
         def work():
             t0 = time.perf_counter()
             err = None
             try:
-                if slots:
+                if thunk is not None:
+                    # custom prepared program (warm_custom): the thunk owns
+                    # compilation AND the _mark_ready of its signature
+                    thunk()
+                elif slots:
                     # megabatch warm: one request padded up to the slot rung
                     # compiles exactly the program a full batch will run
                     kwargs.pop("mesh", None)
@@ -1221,6 +1293,7 @@ class TpuSolver:
         full_nr: bool,
         a: int = 1,
         b: int = 1,
+        dims: Optional[dict] = None,
     ):
         """Pure-host (numpy) build of one solve's padded tensors: returns
         ``(np_consts, feas, np_init, dims)`` with every value a numpy array.
@@ -1229,7 +1302,11 @@ class TpuSolver:
         precompute) and :meth:`solve_many` (megabatch — slot-stacked arrays,
         feasibility inside the vmapped program) each consume this, so the
         two programs can never pad a batch differently.  No device ops run
-        here (``feas`` carries the feasibility INPUTS, not F)."""
+        here (``feas`` carries the feasibility INPUTS, not F).
+
+        ``dims`` overrides the :func:`solve_dims` bucketing with caller-
+        chosen padded dimensions (the consolidation sweep's fine-grained
+        small-solve rungs) — callers own the compile-ladder consequences."""
         G, C, D, R = st.G, max(1, st.C), st.D, st.R
         S, Z = st.S, max(1, st.n_zones)
         K, W = st.pm.shape[1], st.pm.shape[2]
@@ -1241,8 +1318,9 @@ class TpuSolver:
         # makes repeated controller solves hit the persistent jit cache
         # instead of paying a fresh XLA compile per batch shape, and keeps
         # the total rung ladder small enough to precompile (warm_async).
-        dims = solve_dims(st, NE=NE, node_budget=node_budget, a=a, b=b,
-                          track=track_assignments, full_nr=full_nr)
+        if dims is None:
+            dims = solve_dims(st, NE=NE, node_budget=node_budget, a=a, b=b,
+                              track=track_assignments, full_nr=full_nr)
         pad_g = dims["G"] - G
         pad_c = dims["C"] - C
         pad_s = dims["S"] - S
@@ -1255,40 +1333,8 @@ class TpuSolver:
             widths[axis] = (0, n)
             return np.pad(arr, widths, constant_values=value)
 
-        np_counts = _pad(st.counts, pad_g, 0, 0)
-        # PER-ZONE projection of later-group demand (suffix sums of
-        # count*request, distributed over each group's eligible zones):
-        # the backfill available to fill slack on nodes bought for the
-        # current group, in resource units — 50 tiny pods cannot justify a
-        # big node the way 50 same-sized pods can, and a later group
-        # zone-pinned (or hard-spread) elsewhere cannot justify THIS zone's
-        # node at all.  The sequential oracle gets this for free by
-        # replaying demand zone by zone (designs/bin-packing.md:28-43);
-        # here the zone share is an even split over the group's eligible
-        # zones (node_selector folds into group requirements), which is
-        # exactly what a hard DoNotSchedule spread commits and a
-        # conservative, pool-conserving estimate for flexible groups.
-        np_requests = _pad(st.requests, pad_g, 0, 0)
-        demand = (np_counts[:, None] * np_requests).astype(np.float32)   # [G, R]
-        zone_share = np.zeros((G + pad_g, Z), dtype=np.float32)
-        for gi, grp in enumerate(st.groups):
-            vs = grp.requirements.get(L.ZONE)
-            ok = np.zeros(Z, dtype=bool)
-            for zi, zname in enumerate(st.zone_names):
-                ok[zi] = vs.contains(zname)
-            if not ok.any():
-                ok[:] = True
-            zone_share[gi] = ok.astype(np.float32) / float(ok.sum())
-        demand_z = demand[:, None, :] * zone_share[:, :, None]           # [G, Z, R]
-        count_z = np_counts[:, None].astype(np.float32) * zone_share     # [G, Z]
-        np_suffix_res = np.concatenate(
-            [np.cumsum(demand_z[::-1], axis=0)[::-1][1:],
-             np.zeros((1,) + demand_z.shape[1:])]
-        ).astype(np.float32)                                             # [G, Z, R]
-        np_suffix_cnt = np.concatenate(
-            [np.cumsum(count_z[::-1], axis=0)[::-1][1:],
-             np.zeros((1, Z))]
-        ).astype(np.float32)                                             # [G, Z]
+        np_counts, np_requests, np_suffix_res, np_suffix_cnt = (
+            host_count_arrays(st, pad_g, Z))
         np_pm = _pad(st.pm, pad_g, 0, 0)
         np_gzs = _pad(st.g_zone_spread, pad_g, 0, -1)
         np_gzk = _pad(st.g_zone_skew, pad_g, 0, 1)
@@ -1752,6 +1798,50 @@ class TpuSolver:
                 dims=dims, est_dims=est_dims, full_dims=full_dims,
                 full_nr=full_nr, NE=NE,
             ))
+        return self._dispatch_prepared(entries, n_slots=n_slots, track=track,
+                                       zone_key=zone_key, ct_key=ct_key,
+                                       t0=t0)
+
+    def solve_many_prepared(
+        self,
+        entries: Sequence[dict],
+        *,
+        min_slots: Optional[int] = None,
+    ) -> "PendingMegaSolve":
+        """Dispatch PRE-BUILT megabatch entries as one vmapped device
+        program, without fencing — the consolidation sweep's entry point:
+        it derives every candidate's entry from ONE shared base build
+        (solver/consolidation.py build_sweep_entries) instead of paying a
+        per-request ``_host_arrays``.  Each entry carries the same fields
+        :meth:`solve_many_async` builds internally (``r``, ``np_consts``,
+        ``feas``, ``np_init``, ``dims``, ``est_dims``, ``full_dims``,
+        ``full_nr``, ``NE``); all entries must share one dims bucket."""
+        if not entries:
+            # typed like every other megabatch-construction failure (the
+            # collector degrades these to serial dispatches) — a bare
+            # assert vanishes under python -O and decays to an IndexError
+            raise MegaBucketMismatch("empty megabatch")
+        if len(entries) > MEGA_MAX_SLOTS:
+            raise MegaBucketMismatch(
+                f"{len(entries)} entries exceed MEGA_MAX_SLOTS="
+                f"{MEGA_MAX_SLOTS}")
+        t0 = time.perf_counter()
+        r0 = entries[0]["r"]
+        st0 = r0["st"]
+        return self._dispatch_prepared(
+            entries, n_slots=max(len(entries), min_slots or 1),
+            track=r0["track_assignments"],
+            zone_key=st0.vocab.key_id[L.ZONE],
+            ct_key=st0.vocab.key_id[L.CAPACITY_TYPE], t0=t0,
+        )
+
+    def _dispatch_prepared(
+        self, entries, *, n_slots: int, track: bool, zone_key: int,
+        ct_key: int, t0: float,
+    ) -> "PendingMegaSolve":
+        """Stack + dispatch prepared entries (shared by the request path and
+        :meth:`solve_many_prepared`); validates the one-bucket invariant."""
+        reqs = [e["r"] for e in entries]
         dims0 = entries[0]["dims"]
         if not all(e["dims"] == dims0 for e in entries) or any(
             r["st"].vocab.key_id[L.ZONE] != zone_key
@@ -1773,16 +1863,28 @@ class TpuSolver:
         B_pad = _mega_rung(n_slots)
         padded = entries + [entries[0]] * (B_pad - B)
 
+        def _stack(vals):
+            # slots built from one shared base (the consolidation sweep)
+            # carry the SAME array object in most positions — broadcast the
+            # batch axis instead of materializing B host copies (device_put
+            # makes it contiguous once, at transfer)
+            first = vals[0]
+            if all(v is first for v in vals[1:]):
+                arr = np.asarray(first)
+                return jnp.asarray(
+                    np.broadcast_to(arr, (len(vals),) + arr.shape))
+            return jnp.asarray(np.stack(vals))
+
         consts_b = {
-            k: jnp.asarray(np.stack([e["np_consts"][k] for e in padded]))
+            k: _stack([e["np_consts"][k] for e in padded])
             for k in entries[0]["np_consts"]
         }
         feas_b = {
-            k: jnp.asarray(np.stack([e["feas"][k] for e in padded]))
+            k: _stack([e["feas"][k] for e in padded])
             for k in entries[0]["feas"]
         }
         init_b = tuple(
-            jnp.asarray(np.stack([e["np_init"][i] for e in padded]))
+            _stack([e["np_init"][i] for e in padded])
             for i in range(len(entries[0]["np_init"]))
         )
 
@@ -1817,6 +1919,68 @@ class TpuSolver:
         if not requests:
             return []
         return self.solve_many_async(requests, min_slots=min_slots).results()
+
+    def solve_delta(
+        self,
+        prev: "SolveResult",
+        added: Sequence = (),
+        removed: Sequence[str] = (),
+        iced: Sequence[object] = (),
+        *,
+        provisioners,
+        instance_types,
+        daemonsets: Sequence = (),
+        unavailable=None,
+        max_delta_frac: Optional[float] = None,
+        tensorize_cache=None,
+        registry=None,
+        trace=None,
+    ):
+        """Warm-start delta solve: reuse ``prev``'s assignment and solve only
+        the displaced subproblem (see solver/warmstart.py for the tiering
+        and guards).  ``added`` are new pods, ``removed`` pod names leaving,
+        ``iced`` newly unavailable offerings or reclaimed node names.
+
+        The displaced-subproblem scan is SEEDED from the previous
+        assignment: the surviving nodes (pods seated) become the existing-
+        node tensors, so residual capacity, selector counts, zone counters
+        and provisioner usage all start from the previous solution.  Passing
+        a :class:`~karpenter_tpu.models.tensorize.TensorizeCache` reuses its
+        catalog-side :class:`TensorizeContext` across the chain — the
+        sub-millisecond tensorize the delta path rides.
+
+        Consumes ``prev`` (node objects and assignment dict are carried
+        forward, not copied).  Returns a ``DeltaOutcome``.  Device-
+        expressible batches only — scheduler-level callers use
+        :meth:`BatchScheduler.solve_delta`, which brings the full fallback
+        ladder."""
+        from ..models.tensorize import tensorize as _tensorize
+        from . import warmstart
+
+        def _tz(pods, unavail):
+            if tensorize_cache is not None:
+                st, _tier = tensorize_cache.tensorize(
+                    pods, provisioners, instance_types,
+                    daemonsets=daemonsets, unavailable=unavail,
+                )
+                return st
+            return _tensorize(pods, provisioners, instance_types,
+                              daemonsets=daemonsets, unavailable=unavail)
+
+        def _solve(pods, existing, unavail):
+            st = _tz(pods, unavail)
+            out = self.solve(
+                st, existing_nodes=existing,
+                max_nodes=len(existing) + len(pods), trace=trace,
+            )
+            return out.result
+
+        return warmstart.delta_solve(
+            prev, added, removed, iced,
+            solve_displaced=_solve, solve_full=_solve,
+            max_delta_frac=max_delta_frac, registry=registry,
+            unavailable=unavailable,
+        )
 
     # ---- result extraction ---------------------------------------------
     # ktlint: fence extraction reads the whole carry back to host — it runs

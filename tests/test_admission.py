@@ -1282,10 +1282,20 @@ print("BURST_OK")
 """
         env = dict(_os.environ, KT_SANITIZE="1", JAX_PLATFORMS="cpu",
                    KT_ADMIT_QUEUE_TOTAL="2")
-        p = _subprocess.run([_sys.executable, "-c", script],
-                            capture_output=True, text=True, timeout=240,
-                            env=env, cwd=_os.path.dirname(
-                                _os.path.dirname(_os.path.abspath(__file__))))
+        for attempt in range(2):
+            p = _subprocess.run([_sys.executable, "-c", script],
+                                capture_output=True, text=True, timeout=240,
+                                env=env, cwd=_os.path.dirname(
+                                    _os.path.dirname(
+                                        _os.path.abspath(__file__))))
+            if p.returncode == 0:
+                break
+            # confirm-on-breach: a loaded host can stagger the 40 client
+            # threads past the barrier enough that the bound-2 queue never
+            # overflows — that (and only that) outcome gets one retry;
+            # typed-error or sanitizer failures stay hard failures
+            if "nothing shed" not in p.stderr:
+                break
         assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
         assert "BURST_OK" in p.stdout
 

@@ -255,3 +255,88 @@ class TestBackendProbeCache:
 
         monkeypatch.setattr(sp, "run", lambda *a, **k: FakeDone())
         assert benchmod.ensure_backend(cache_path=str(cache)) == "tpu"
+
+
+class TestWarmstartAndSweepGates:
+    """ISSUE 6 budget gates: steady-state delta p50, warm-start cost
+    parity, and the consolidation sweep's speedup/one-dispatch/decision
+    contracts."""
+
+    GOOD = {"warmstart_p50_ms": 0.7, "warmstart_cost_ratio": 1.004,
+            "warmstart_full_fallbacks": 0,
+            "sweep_speedup": 5.6, "sweep_candidates": 16,
+            "sweep_dispatches": 1, "sweep_decisions_match": True}
+
+    def test_within_budgets_clean(self):
+        assert benchmod.check_budgets(dict(self.GOOD)) == {}
+
+    def test_delta_p50_over_budget_flagged(self):
+        out = benchmod.check_budgets(dict(self.GOOD, warmstart_p50_ms=1.4))
+        assert any("delta solve p50" in f for f in out["budget_flags"])
+
+    def test_warmstart_cost_ratio_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, warmstart_cost_ratio=1.05))
+        assert any("warm-start chain cost" in f for f in out["budget_flags"])
+
+    def test_steady_state_full_fallbacks_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, warmstart_full_fallbacks=3))
+        assert any("fell back" in f for f in out["budget_flags"])
+
+    def test_sweep_speedup_under_budget_flagged(self):
+        out = benchmod.check_budgets(dict(self.GOOD, sweep_speedup=3.1))
+        assert any("sweep speedup" in f for f in out["budget_flags"])
+
+    def test_sweep_decision_divergence_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, sweep_decisions_match=False))
+        assert any("diverged" in f for f in out["budget_flags"])
+
+    def test_sweep_multi_dispatch_flagged(self):
+        out = benchmod.check_budgets(dict(self.GOOD, sweep_dispatches=3))
+        assert any("one vmapped dispatch" in f for f in out["budget_flags"])
+
+
+@pytest.mark.slow
+def test_500k_pod_solve_stretch():
+    """ISSUE 6 stretch rung: the solve bench ceiling lifted from 50k
+    toward 500k pods.  10x the bench scenario's deployments through the
+    full device path; gates completion, feasibility, and FFD cost parity
+    at the 50k ceiling's 1.02 — run via `-m slow` only (the scan compile
+    and solve are minutes-scale on the CPU dev host)."""
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.instancetype import GIB
+    from karpenter_tpu.models.pod import (
+        LabelSelector,
+        PodSpec,
+        TopologySpreadConstraint,
+    )
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.models.tensorize import tensorize
+    from karpenter_tpu.solver import reference
+    from karpenter_tpu.solver.tpu import TpuSolver
+
+    catalog = generate_catalog(full=True)
+    pods = []
+    for d in range(200):
+        cpu = 0.25 * (1 + d % 8)
+        mem = (0.5 + (d % 6)) * GIB
+        sel = LabelSelector.of({"app": f"big{d}"})
+        for i in range(2500):
+            pods.append(PodSpec(
+                name=f"big{d}-{i}", labels={"app": f"big{d}"},
+                requests={"cpu": cpu, "memory": mem},
+                topology_spread=[TopologySpreadConstraint(
+                    1, L.ZONE, "DoNotSchedule", sel)],
+                owner_key=f"big{d}",
+            ))
+    assert len(pods) == 500_000
+    provs = [Provisioner(name="default").with_defaults()]
+    st = tensorize(pods, provs, catalog)
+    out = TpuSolver().solve(st, track_assignments=False)
+    assert not out.result.infeasible
+    oracle = reference.solve(pods, provs, catalog)
+    ratio = out.result.new_node_cost / oracle.new_node_cost
+    assert ratio <= 1.02, f"500k cost ratio {ratio:.4f}"

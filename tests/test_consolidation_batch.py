@@ -249,3 +249,299 @@ def test_compat_matrix_signature_is_lossless():
         # NotIn matches an absent label; Exists does not
         assert cm[idx["na"], 0], "NotIn pod must fit the unlabeled node"
         assert not cm[idx["nb"], 0], "Exists pod must NOT fit the unlabeled node"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: one-dispatch what-if sweeps + warm-start delta contracts
+# ---------------------------------------------------------------------------
+
+
+def _sweep_cluster(n_nodes, npods, cpu_alloc=8.0, pod_cpu=0.5):
+    nodes = []
+    for i in range(n_nodes):
+        node = mk_node(f"c{i}", cpu_alloc, [])
+        for j in range(npods):
+            node.pods.append(PodSpec(
+                name=f"c{i}-p{j}", requests={L.RESOURCE_CPU: pod_cpu},
+                owner_key=f"g{j % 3}"))
+        nodes.append(node)
+    return nodes
+
+
+class TestWhatIfSweep:
+    def _decision(self, res):
+        return (not res.infeasible, len(res.nodes),
+                round(res.new_node_cost, 9))
+
+    def test_batched_decisions_identical_to_serial_mixed_feasibility(
+            self, small_catalog):
+        """Mixed feasible/infeasible candidates: every sweep slot's decision
+        must equal the sequential scheduler.solve what-if on the same
+        backend — including the candidates the cluster cannot absorb."""
+        import time as _time
+
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.models.provisioner import Provisioner
+        from karpenter_tpu.solver.consolidation import sweep_what_ifs
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        prov = Provisioner(name="default").with_defaults()
+        # 6 lightly-loaded nodes (absorbable) + 2 nearly-full ones whose
+        # pods cannot fit on the survivors and cannot buy a new node
+        nodes = _sweep_cluster(6, 3, cpu_alloc=8.0, pod_cpu=0.5)
+        for i in range(2):
+            node = mk_node(f"full{i}", 8.0, [])
+            for j in range(12):
+                node.pods.append(PodSpec(
+                    name=f"full{i}-p{j}", requests={L.RESOURCE_CPU: 0.6},
+                    owner_key="heavy",
+                    # select a label no cluster node or catalog offering
+                    # carries: genuinely unmovable pods
+                    node_selector={"team": "gpu"},
+                ))
+            nodes.append(node)
+        reg = Registry()
+        sched = BatchScheduler(backend="tpu", registry=reg)
+        cands = [[i] for i in range(len(nodes))]
+
+        def run_sweep():
+            return sweep_what_ifs(
+                sched, nodes, cands, provisioners=[prov],
+                instance_types=small_catalog, registry=reg)
+
+        first = run_sweep()          # cold: serial + background warm
+        deadline = _time.perf_counter() + 600
+        while (not sched._tpu.warm_idle()
+               and _time.perf_counter() < deadline):
+            _time.sleep(0.2)
+        sweep = run_sweep()
+        assert sweep.n_batched > 0, "warm sweep did not ride the device path"
+
+        serial = []
+        for k in range(len(nodes)):
+            pods = [p for p in nodes[k].pods if not p.is_daemon]
+            others = [n for j, n in enumerate(nodes) if j != k]
+            serial.append(sched.solve(
+                pods, [prov], small_catalog, existing_nodes=others,
+                allow_new_nodes=True, max_new_nodes=1))
+        for k, (a, b) in enumerate(zip(sweep.results, serial)):
+            assert not isinstance(a, BaseException), (k, a)
+            assert self._decision(a) == self._decision(b), k
+        # the two engineered-full candidates really exercised the
+        # infeasible/serial-reconfirm arm
+        assert any(r.infeasible for r in serial), "no infeasible candidate"
+        assert first.n_serial == len(nodes)  # cold pass served serially
+
+        # stop_on rides the batched results too: candidate 0 confirms
+        # clean in the dispatch, so the engineered-full candidates (whose
+        # non-clean slots would re-solve serially) are never paid for
+        gated = sweep_what_ifs(
+            sched, nodes, cands, provisioners=[prov],
+            instance_types=small_catalog, registry=reg,
+            stop_on=lambda k, r: not isinstance(r, BaseException)
+            and not r.infeasible and not r.nodes)
+        assert gated.n_serial == 0
+        assert self._decision(gated.results[0]) == self._decision(serial[0])
+        assert any(r is None for r in gated.results)
+
+    def test_compile_window_skips_entry_build(self, small_catalog,
+                                              monkeypatch):
+        """While the sweep program's warm is in flight, a reconcile's
+        sweep serves serially WITHOUT paying the shared-base host build —
+        entries are only needed to dispatch or to seed the first warm."""
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.models.provisioner import Provisioner
+        from karpenter_tpu.solver import consolidation
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        prov = Provisioner(name="default").with_defaults()
+        nodes = _sweep_cluster(5, 2)
+        sched = BatchScheduler(backend="tpu", registry=Registry())
+        builds = []
+        real_build = consolidation.build_sweep_entries
+        monkeypatch.setattr(
+            consolidation, "build_sweep_entries",
+            lambda *a, **k: builds.append(1) or real_build(*a, **k))
+
+        # cold first encounter: entries ARE built (they seed the warm) —
+        # capture the warm instead of paying a real XLA compile
+        warms = []
+        monkeypatch.setattr(sched._tpu, "warm_custom",
+                            lambda sig, thunk, on_done=None:
+                            warms.append(sig) or True)
+        first = consolidation.sweep_what_ifs(
+            sched, nodes, [[0], [1]], provisioners=[prov],
+            instance_types=small_catalog, registry=Registry())
+        assert first.n_serial == 2 and builds and warms
+
+        # compile window: warm pending, program not ready -> no build
+        builds.clear()
+        monkeypatch.setattr(sched._tpu, "warm_pending", lambda sig: True)
+        during = consolidation.sweep_what_ifs(
+            sched, nodes, [[0], [1]], provisioners=[prov],
+            instance_types=small_catalog, registry=Registry())
+        assert during.n_serial == 2 and during.path == "serial"
+        assert builds == [], "entry build paid during the compile window"
+
+    def test_sweep_serial_fallback_on_oracle_backend(self, small_catalog):
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.models.provisioner import Provisioner
+        from karpenter_tpu.solver.consolidation import sweep_what_ifs
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        prov = Provisioner(name="default").with_defaults()
+        nodes = _sweep_cluster(5, 2)
+        reg = Registry()
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        sweep = sweep_what_ifs(sched, nodes, [[0], [1]], provisioners=[prov],
+                               instance_types=small_catalog, registry=reg)
+        assert sweep.path == "serial"
+        assert sweep.dispatches == 0
+        assert all(not isinstance(r, BaseException) for r in sweep.results)
+
+    def test_empty_candidate_is_trivially_deletable(self, small_catalog):
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.models.provisioner import Provisioner
+        from karpenter_tpu.solver.consolidation import sweep_what_ifs
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        prov = Provisioner(name="default").with_defaults()
+        nodes = _sweep_cluster(3, 2)
+        nodes.append(mk_node("empty", 8.0, []))
+        sched = BatchScheduler(backend="tpu", registry=Registry())
+        sweep = sweep_what_ifs(sched, nodes, [[3]], provisioners=[prov],
+                               instance_types=small_catalog,
+                               registry=Registry())
+        res = sweep.results[0]
+        assert not res.infeasible and not res.nodes
+
+
+class TestControllerSimulateBatch:
+    def _controller(self, small_catalog):
+        from karpenter_tpu.cloud.fake import FakeCloudProvider
+        from karpenter_tpu.controllers.deprovisioning import (
+            DeprovisioningController,
+        )
+        from karpenter_tpu.controllers.state import ClusterState
+        from karpenter_tpu.controllers.termination import TerminationController
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+        from karpenter_tpu.utils.clock import FakeClock
+
+        from karpenter_tpu.models.provisioner import Provisioner
+
+        clock = FakeClock()
+        state = ClusterState(clock=clock)
+        state.apply_provisioner(Provisioner(
+            name="default", consolidation_enabled=True))
+        cloud = FakeCloudProvider(small_catalog, clock=clock)
+        reg = Registry()
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        term = TerminationController(state, cloud, registry=reg, clock=clock)
+        return DeprovisioningController(
+            state, cloud, term, scheduler=sched, registry=reg, clock=clock,
+            deprovisioning_ttl=0.0,
+        ), state
+
+    def test_batch_matches_serial_simulate(self, small_catalog):
+        deprov, state = self._controller(small_catalog)
+        for node in _sweep_cluster(6, 3):
+            state.add_node(node).initialized = True
+        targets = [[state.nodes[f"c{i}"]] for i in range(6)]
+        serial = [deprov._simulate(t) for t in targets]
+        batch = deprov._simulate_batch(targets)
+        assert len(batch) == len(serial)
+        assert any(a is not None and a.kind == "delete" for a in serial)
+        for a, b in zip(batch, serial):
+            if a is None or b is None:
+                assert a == b
+            else:
+                assert (a.kind, a.nodes, round(a.savings, 9)) == (
+                    b.kind, b.nodes, round(b.savings, 9))
+
+    def test_boxed_exception_skips_only_its_candidate(
+            self, small_catalog, monkeypatch):
+        deprov, state = self._controller(small_catalog)
+        for node in _sweep_cluster(4, 2):
+            state.add_node(node).initialized = True
+        targets = [[state.nodes[f"c{i}"]] for i in range(4)]
+        real_solve = deprov.scheduler.solve
+
+        def poisoned(pods, *a, **kw):
+            if any(p.name.startswith("c2-") for p in pods):
+                raise RuntimeError("injected what-if failure")
+            return real_solve(pods, *a, **kw)
+
+        monkeypatch.setattr(deprov.scheduler, "solve", poisoned)
+        batch = deprov._simulate_batch(targets)
+        assert batch[2] is None           # the poisoned candidate skipped
+        others = [batch[i] for i in (0, 1, 3)]
+        assert all(a is not None and a.kind == "delete" for a in others)
+
+    def test_stop_on_halts_serial_fill_at_first_confirm(
+            self, small_catalog, monkeypatch):
+        """On the serial fallback path (oracle backend here) the sweep must
+        stop paying what-if solves at the caller's first-hit point, exactly
+        like the pre-sweep serial loop — not fill every slot the caller
+        will never read."""
+        deprov, state = self._controller(small_catalog)
+        for node in _sweep_cluster(6, 3):
+            state.add_node(node).initialized = True
+        targets = [[state.nodes[f"c{i}"]] for i in range(6)]
+
+        calls = []
+        real_solve = deprov.scheduler.solve
+
+        def counting(pods, *a, **kw):
+            calls.append([p.name for p in pods])
+            return real_solve(pods, *a, **kw)
+
+        monkeypatch.setattr(deprov.scheduler, "solve", counting)
+        serial_first = deprov._simulate(targets[0])
+        assert serial_first is not None and serial_first.kind == "delete"
+        calls.clear()
+
+        batch = deprov._simulate_batch(
+            targets, stop_on=lambda a: a is not None and a.kind == "delete")
+        # one what-if solve, not six: the first candidate confirmed
+        assert len(calls) == 1
+        assert batch[0] is not None and batch[0].kind == "delete"
+        assert (batch[0].kind, batch[0].nodes,
+                round(batch[0].savings, 9)) == (
+            serial_first.kind, serial_first.nodes,
+            round(serial_first.savings, 9))
+        # slots past the stop point were never solved
+        assert all(a is None for a in batch[1:])
+
+
+class TestDeltaContractsRideAlong:
+    """The warm-start delta contracts the issue pins alongside the sweep
+    (full coverage in tests/test_warmstart.py)."""
+
+    def _prev(self, small_catalog):
+        from karpenter_tpu.models.provisioner import Provisioner
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        prov = Provisioner(name="default").with_defaults()
+        pods = [PodSpec(name=f"w-{i}", requests={L.RESOURCE_CPU: 0.5},
+                        owner_key=f"g{i % 3}") for i in range(40)]
+        sched = BatchScheduler(backend="oracle")
+        return sched, prov, sched.solve(pods, [prov], small_catalog)
+
+    def test_empty_delta_no_op(self, small_catalog):
+        sched, prov, prev = self._prev(small_catalog)
+        before = dict(prev.assignments)
+        out = sched.solve_delta(prev, provisioners=[prov],
+                                instance_types=small_catalog)
+        assert out.mode == "noop"
+        assert out.result.assignments == before
+
+    def test_delta_exceeds_threshold_falls_back(self, small_catalog):
+        sched, prov, prev = self._prev(small_catalog)
+        big = [PodSpec(name=f"x-{i}", requests={L.RESOURCE_CPU: 0.5},
+                       owner_key="x") for i in range(30)]
+        out = sched.solve_delta(prev, added=big, provisioners=[prov],
+                                instance_types=small_catalog,
+                                max_delta_frac=0.05)
+        assert out.mode == "full" and out.fell_back
+        assert not out.result.infeasible

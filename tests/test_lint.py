@@ -748,3 +748,117 @@ class TestPackageGate:
         bad.write_text("import time\n\ndef f():\n    return time.time()\n")
         assert main([str(bad), "--select", "KT005"]) == 0
         assert main([str(bad), "--select", "KT002"]) == 1
+
+
+class TestKT010LoopOfDispatch:
+    CTRL = "karpenter_tpu/controllers/deprovisioning.py"
+
+    def test_fires_on_simulate_in_for_loop(self):
+        src = """
+        def pass_(self, cands):
+            for ns in cands:
+                attempt = self._simulate([ns])
+                if attempt is not None:
+                    return attempt
+        """
+        findings = lint(src, self.CTRL)
+        assert rules_of(findings) == ["KT010"]
+        assert "per iteration" in findings[0].message
+
+    def test_fires_on_scheduler_solve_in_while_loop(self):
+        src = """
+        def pass_(self, queue):
+            while queue:
+                req = queue.pop()
+                self.scheduler.solve(req.pods, req.provs, req.types)
+        """
+        assert rules_of(lint(src, self.CTRL)) == ["KT010"]
+
+    def test_fires_on_solve_what_if_in_loop(self):
+        src = """
+        def pass_(self, cands):
+            results = []
+            for names in cands:
+                results.append(self._solve_what_if([], names))
+            return results
+        """
+        assert rules_of(lint(src, self.CTRL)) == ["KT010"]
+
+    def test_fires_on_simulate_in_comprehension(self):
+        # a comprehension is the for-loop-of-dispatch spelled on one line
+        src = """
+        def pass_(self, cands):
+            return [self._simulate([ns]) for ns in cands]
+        """
+        assert rules_of(lint(src, self.CTRL)) == ["KT010"]
+
+    def test_fires_on_solve_in_generator_expression(self):
+        src = """
+        def pass_(self, cands):
+            return any(self.scheduler.solve(c.pods, c.provs, c.types)
+                       for c in cands)
+        """
+        assert rules_of(lint(src, self.CTRL)) == ["KT010"]
+
+    def test_allow_on_comprehension_line(self):
+        src = """
+        def pass_(self, cands):
+            return [self._simulate([ns]) for ns in cands]  # ktlint: allow[KT010] cands has one entry by contract
+        """
+        assert lint(src, self.CTRL) == []
+
+    def test_quiet_outside_a_loop(self):
+        src = """
+        def one(self, ns):
+            return self._simulate([ns])
+        """
+        assert lint(src, self.CTRL) == []
+
+    def test_quiet_outside_controllers(self):
+        src = """
+        def sweep(self, cands):
+            for c in cands:
+                self.scheduler.solve(c.pods, c.provs, c.types)
+        """
+        assert lint(src, "karpenter_tpu/solver/consolidation.py") == []
+
+    def test_quiet_when_loop_body_is_a_deferred_callable(self):
+        # a closure built per iteration is not a per-iteration dispatch —
+        # the collector pattern batches them into one device call later
+        src = """
+        def collect(self, cands):
+            thunks = []
+            for c in cands:
+                thunks.append(lambda c=c: self._simulate([c]))
+            return thunks
+        """
+        assert lint(src, self.CTRL) == []
+
+    def test_allow_on_call_line(self):
+        src = """
+        def search(self, cands, lo, hi):
+            while lo <= hi:
+                mid = (lo + hi) // 2
+                a = self._simulate(cands[:mid])  # ktlint: allow[KT010] binary search is sequential
+                lo, hi = (mid + 1, hi) if a else (lo, mid - 1)
+        """
+        assert lint(src, self.CTRL) == []
+
+    def test_allow_on_loop_header_comment(self):
+        src = """
+        def search(self, cands, lo, hi):
+            # ktlint: allow[KT010] each probe depends on the previous answer
+            while lo <= hi:
+                mid = (lo + hi) // 2
+                a = self._simulate(cands[:mid])
+                lo, hi = (mid + 1, hi) if a else (lo, mid - 1)
+        """
+        assert lint(src, self.CTRL) == []
+
+    def test_reasonless_allow_is_malformed(self):
+        src = """
+        def pass_(self, cands):
+            for ns in cands:
+                self._simulate([ns])  # ktlint: allow[KT010]
+        """
+        assert "KT000" in rules_of(lint(src, self.CTRL))

@@ -214,3 +214,51 @@ class TestAdmissionSeries:
                 {"class": "critical", "reason": "deadline"})
         finally:
             pipe.stop()
+
+
+class TestWarmstartAndSweepSeries:
+    """ISSUE 6: warm-start delta and consolidation-sweep series are born at
+    zero (modes x paths) and survive into expose()."""
+
+    def test_warmstart_modes_born_at_zero(self):
+        from karpenter_tpu.metrics import WARMSTART_SOLVES
+        from karpenter_tpu.solver.warmstart import (
+            DELTA_MODES,
+            zero_init_metrics,
+        )
+
+        reg = Registry()
+        zero_init_metrics(reg)
+        for mode in DELTA_MODES:
+            assert series_exists(reg.counter(WARMSTART_SOLVES),
+                                 {"mode": mode}), f"mode={mode} missing"
+        text = reg.expose()
+        assert ('karpenter_solver_warmstart_solves_total'
+                '{mode="host"} 0') in text
+
+    def test_sweep_paths_born_at_zero_from_controller_construction(self):
+        from karpenter_tpu.cloud.fake import FakeCloudProvider
+        from karpenter_tpu.controllers.deprovisioning import (
+            DeprovisioningController,
+        )
+        from karpenter_tpu.controllers.state import ClusterState
+        from karpenter_tpu.controllers.termination import (
+            TerminationController,
+        )
+        from karpenter_tpu.metrics import CONSOLIDATION_SWEEPS
+        from karpenter_tpu.models.catalog import generate_catalog
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        state = ClusterState(clock=clock)
+        cloud = FakeCloudProvider(generate_catalog(full=False), clock=clock)
+        reg = Registry()
+        term = TerminationController(state, cloud, registry=reg, clock=clock)
+        DeprovisioningController(state, cloud, term, registry=reg,
+                                 clock=clock)
+        for path in ("batched", "mixed", "serial"):
+            assert series_exists(reg.counter(CONSOLIDATION_SWEEPS),
+                                 {"path": path}), f"path={path} missing"
+        text = reg.expose()
+        assert ('karpenter_solver_consolidation_sweeps_total'
+                '{path="batched"} 0') in text

@@ -1,0 +1,451 @@
+"""Warm-start delta solving (ISSUE 6): steady-state reconcile as an
+incremental update — tiering (noop/host/scan/full), parity guards, and the
+ownership/bookkeeping contracts of solver/warmstart.py."""
+
+import pytest
+
+from karpenter_tpu.metrics import WARMSTART_SOLVES, Registry
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import (
+    LabelSelector,
+    PodSpec,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.tensorize import TensorizeCache
+from karpenter_tpu.solver.scheduler import BatchScheduler
+from karpenter_tpu.solver.tpu import TpuSolver
+from karpenter_tpu.solver.warmstart import DELTA_MODES
+
+
+def mk_pods(n, tag="d", groups=4, cpu=0.5):
+    out = []
+    for i in range(n):
+        g = i % groups
+        out.append(PodSpec(
+            name=f"{tag}-{i}", labels={"app": f"{tag}{g}"},
+            requests={"cpu": cpu * (1 + g % 2), "memory": 1.0 * 2**30},
+            owner_key=f"{tag}{g}",
+        ))
+    return out
+
+
+@pytest.fixture()
+def solved(small_catalog):
+    prov = Provisioner(name="default").with_defaults()
+    solver = TpuSolver()
+    cache = TensorizeCache()
+    pods = mk_pods(120)
+    st, _ = cache.tensorize(pods, [prov], small_catalog)
+    prev = solver.solve(st).result
+    assert not prev.infeasible
+    return dict(solver=solver, cache=cache, prov=prov, pods=pods, prev=prev,
+                catalog=small_catalog)
+
+
+def delta(ctx, prev=None, **kw):
+    kw.setdefault("provisioners", [ctx["prov"]])
+    kw.setdefault("instance_types", ctx["catalog"])
+    kw.setdefault("tensorize_cache", ctx["cache"])
+    kw.setdefault("registry", Registry())
+    kw.setdefault("max_delta_frac", 0.5)
+    return ctx["solver"].solve_delta(prev or ctx["prev"], **kw)
+
+
+class TestTiers:
+    def test_empty_delta_is_a_noop(self, solved):
+        prev = solved["prev"]
+        before = dict(prev.assignments)
+        out = delta(solved)
+        assert out.mode == "noop"
+        assert out.displaced == 0 and out.removed == 0
+        assert out.result.assignments == before
+        assert out.solve_ms < 50  # pure bookkeeping, no device dispatch
+
+    def test_disjoint_add_keeps_untouched_assignments_byte_identical(
+            self, solved):
+        prev = solved["prev"]
+        before = dict(prev.assignments)
+        add = mk_pods(4, "x")
+        out = delta(solved, added=add)
+        assert out.mode in ("host", "scan")
+        for name, node in before.items():
+            assert out.result.assignments[name] == node
+        for p in add:
+            assert p.name in out.result.assignments
+        assert not out.result.infeasible
+
+    def test_removal_is_pure_bookkeeping(self, solved):
+        rm = [p.name for p in solved["pods"][:6]]
+        out = delta(solved, removed=rm)
+        assert out.mode == "noop"
+        for name in rm:
+            assert name not in out.result.assignments
+        assert out.total_pods == 120 - 6
+        # capacity actually freed: nodes no longer hold the removed pods
+        seated = {p.name for n in out.result.existing_nodes + out.result.nodes
+                  for p in n.pods}
+        assert not seated & set(rm)
+
+    def test_removal_prunes_emptied_proposal_nodes(self, solved):
+        prev = solved["prev"]
+        node = prev.nodes[0]
+        rm = [p.name for p in node.pods]
+        out = delta(solved, removed=rm)
+        assert node.name not in {n.name for n in out.result.nodes}
+
+    def test_threshold_exceeded_falls_back_to_full(self, solved):
+        out = delta(solved, added=mk_pods(80, "big"), max_delta_frac=0.05)
+        assert out.mode == "full"
+        assert out.fell_back
+        assert not out.result.infeasible
+
+    def test_chain_carries_meta(self, solved):
+        o1 = delta(solved, added=mk_pods(3, "a"))
+        o2 = delta(solved, prev=o1.result, added=mk_pods(3, "b"))
+        assert o2.mode in ("host", "scan")
+        assert o2.total_pods == 126
+        # full fallback drops the chain bookkeeping
+        o3 = delta(solved, prev=o2.result, added=mk_pods(80, "c"),
+                   max_delta_frac=0.05)
+        assert getattr(o3.result, "_warmstart_meta", None) is None
+
+
+class TestGuards:
+    def test_spread_matched_removal_falls_back(self, small_catalog):
+        """Removing a pod a spread selector watches can leave the band
+        unrestorable incrementally — must re-solve fully."""
+        prov = Provisioner(name="default").with_defaults()
+        sel = LabelSelector.of({"app": "s"})
+        pods = [PodSpec(
+            name=f"s-{i}", labels={"app": "s"},
+            requests={"cpu": 0.5},
+            topology_spread=[TopologySpreadConstraint(
+                1, L.ZONE, "DoNotSchedule", sel)],
+            owner_key="s",
+        ) for i in range(12)]
+        sched = BatchScheduler(backend="oracle")
+        prev = sched.solve(pods, [prov], small_catalog)
+        assert not prev.infeasible
+        out = sched.solve_delta(
+            prev, removed=["s-0"], provisioners=[prov],
+            instance_types=small_catalog, max_delta_frac=0.9,
+        )
+        assert out.mode == "full"
+        assert not out.result.infeasible
+
+    def test_foreign_selector_coupling_falls_back(self, small_catalog):
+        """An added pod matched by a DIFFERENT group's constraint selector
+        cannot be placed incrementally (that constraint is invisible to
+        the subproblem)."""
+        prov = Provisioner(name="default").with_defaults()
+        sel = LabelSelector.of({"team": "x"})
+        spread = [PodSpec(
+            name=f"sp-{i}", labels={"team": "x", "role": "spread"},
+            requests={"cpu": 0.25},
+            topology_spread=[TopologySpreadConstraint(
+                1, L.ZONE, "DoNotSchedule", sel)],
+            owner_key="sp",
+        ) for i in range(6)]
+        plain = mk_pods(30, "p")
+        sched = BatchScheduler(backend="oracle")
+        prev = sched.solve(spread + plain, [prov], small_catalog)
+        assert not prev.infeasible
+        # label-only pod the spread selector matches, no constraint of its
+        # own and a different group
+        intruder = PodSpec(name="intruder", labels={"team": "x"},
+                           requests={"cpu": 0.25}, owner_key="other")
+        out = sched.solve_delta(
+            prev, added=[intruder], provisioners=[prov],
+            instance_types=small_catalog, max_delta_frac=0.9,
+        )
+        assert out.mode == "full"
+        assert "intruder" in out.result.assignments
+
+    def test_own_constraint_add_takes_scan_not_host(self, small_catalog):
+        prov = Provisioner(name="default").with_defaults()
+        sched = BatchScheduler(backend="oracle")
+        plain = mk_pods(40, "p")
+        prev = sched.solve(plain, [prov], small_catalog)
+        sel = LabelSelector.of({"app": "z"})
+        zpod = PodSpec(
+            name="z-0", labels={"app": "z"}, requests={"cpu": 0.25},
+            topology_spread=[TopologySpreadConstraint(
+                1, L.ZONE, "DoNotSchedule", sel)],
+            owner_key="z",
+        )
+        out = sched.solve_delta(
+            prev, added=[zpod], provisioners=[prov],
+            instance_types=small_catalog, max_delta_frac=0.9,
+        )
+        assert out.mode == "scan"
+        assert "z-0" in out.result.assignments
+
+
+class TestIced:
+    def test_per_call_unavailable_accumulates_on_warm_chain(self, solved):
+        """`unavailable=` passed on a step AFTER the chain is warm must
+        merge into the chain bookkeeping like an `iced` offering — not be
+        silently dropped because build_meta already ran."""
+        o1 = delta(solved, added=mk_pods(2, "a"))
+        assert getattr(o1.result, "_warmstart_meta", None) is not None
+        offering = ("m5.xlarge", "zone-1a", "spot")
+        o2 = delta(solved, prev=o1.result, added=mk_pods(2, "b"),
+                   unavailable={offering})
+        assert offering in o2.result._warmstart_meta.unavailable
+
+    def test_iced_offering_is_remembered_on_the_chain(self, solved):
+        o1 = delta(solved, iced=[("m5.large", "zone-1a", "on-demand")])
+        assert o1.mode == "noop"
+        meta = o1.result._warmstart_meta
+        assert ("m5.large", "zone-1a", "on-demand") in meta.unavailable
+
+    def test_reclaimed_node_displaces_its_pods(self, solved):
+        prev = solved["prev"]
+        node = prev.nodes[0]
+        seated = [p.name for p in node.pods]
+        out = delta(solved, iced=[node.name])
+        assert out.mode in ("host", "scan", "full")
+        assert node.name not in {n.name for n in out.result.nodes}
+        for name in seated:  # displaced pods were re-placed somewhere else
+            assert out.result.assignments[name] != node.name
+
+    def test_unplaced_pods_reoffered_after_removal(self, small_catalog):
+        """A pod that could not place stays tracked; a removal that frees
+        capacity re-offers it (a full solve would schedule it too)."""
+        # limit admits exactly ONE *.large node (2.0 cpu capacity); three
+        # 0.6-cpu pods fill its 1.83 allocatable to 1.8
+        prov = Provisioner(
+            name="default",
+            limits={"cpu": 2.0},
+        ).with_defaults()
+        sched = BatchScheduler(backend="oracle")
+        pods = [PodSpec(name=f"p-{i}", requests={"cpu": 0.6}, owner_key="p")
+                for i in range(3)]
+        prev = sched.solve(pods, [prov], small_catalog)
+        assert not prev.infeasible
+        big = PodSpec(name="later", requests={"cpu": 0.6}, owner_key="later")
+        o1 = sched.solve_delta(prev, added=[big], provisioners=[prov],
+                               instance_types=small_catalog,
+                               max_delta_frac=0.9)
+        assert "later" in o1.result.infeasible  # limit exhausted
+        o2 = sched.solve_delta(o1.result, removed=["p-0", "p-1"],
+                               provisioners=[prov],
+                               instance_types=small_catalog,
+                               max_delta_frac=0.9)
+        assert "later" in o2.result.assignments
+        assert "later" not in o2.result.infeasible
+
+
+class TestMetrics:
+    def test_modes_counted_and_zero_inited(self, solved):
+        reg = Registry()
+        delta(solved, registry=reg)
+        c = reg.counter(WARMSTART_SOLVES)
+        for mode in DELTA_MODES:
+            assert c.has({"mode": mode})
+        assert c.get({"mode": "noop"}) == 1.0
+
+
+class TestReviewRegressions:
+    """Review-round fixes: unplaced pods survive a full fallback; daemon
+    pods never displace as workload on node reclaim."""
+
+    def test_unplaced_pod_survives_full_fallback(self, small_catalog):
+        prov = Provisioner(name="default", limits={"cpu": 2.0}).with_defaults()
+        sched = BatchScheduler(backend="oracle")
+        pods = [PodSpec(name=f"p-{i}", requests={"cpu": 0.6}, owner_key="p")
+                for i in range(3)]
+        prev = sched.solve(pods, [prov], small_catalog)
+        assert not prev.infeasible
+        stuck = PodSpec(name="stuck", requests={"cpu": 0.6}, owner_key="s")
+        o1 = sched.solve_delta(prev, added=[stuck], provisioners=[prov],
+                               instance_types=small_catalog,
+                               max_delta_frac=0.9)
+        assert "stuck" in o1.result.infeasible
+        # a pure-add perturbation big enough to trip the threshold: the
+        # full repack must still see (and account for) the stuck pod
+        flood = [PodSpec(name=f"f-{i}", requests={"cpu": 0.1},
+                         owner_key="f") for i in range(10)]
+        o2 = sched.solve_delta(o1.result, added=flood, provisioners=[prov],
+                               instance_types=small_catalog,
+                               max_delta_frac=0.05)
+        assert o2.mode == "full"
+        tracked = (set(o2.result.assignments) | set(o2.result.infeasible))
+        assert "stuck" in tracked, "unplaced pod dropped by full fallback"
+
+    def test_reclaim_does_not_displace_daemon_pods(self, solved):
+        prev = solved["prev"]
+        node = prev.nodes[0]
+        daemon = PodSpec(name="ds-pod", requests={"cpu": 0.1},
+                         is_daemon=True)
+        node.pods.append(daemon)
+        out = delta(solved, iced=[node.name])
+        assert "ds-pod" not in out.result.assignments
+        seated = {p.name for n in (out.result.existing_nodes
+                                   + out.result.nodes) for p in n.pods}
+        assert "ds-pod" not in seated
+
+    def test_scan_adopted_node_residual_not_double_subtracted(self, solved):
+        """A scan step that buys one new node for several displaced pods:
+        the adopted node's residual row comes from node.remaining() (which
+        already accounts for every pod the solver seated), so the per-pod
+        subtraction must skip it — a double-subtract would understate the
+        node's slack for the rest of the chain and push later host-tier
+        deltas onto the device scan."""
+        import numpy as np
+
+        # big pods the packed cluster's slack cannot absorb: the scan must
+        # buy new capacity, seating several of them per bought node
+        big = mk_pods(12, "big", cpu=3.0)
+        out = delta(solved, added=big)
+        assert out.mode == "scan"
+        assert not out.result.infeasible
+        meta = out.result._warmstart_meta
+        prev_names = {n.name for n in solved["prev"].existing_nodes}
+        adopted = [n for n in meta.nodes if n.name not in prev_names
+                   and any(p.name.startswith("big-") for p in n.pods)]
+        assert adopted, "scenario did not buy a new node"
+        assert any(
+            sum(p.name.startswith("big-") for p in n.pods) >= 2
+            for n in adopted
+        ), "scenario did not seat >=2 displaced pods on one adopted node"
+        # the chain invariant: every residual row is exactly the node's
+        # recomputed remaining capacity
+        for i, n in enumerate(meta.nodes):
+            rem = n.remaining()
+            expect = [rem.get(k, 0.0) for k in meta.res_names]
+            assert np.allclose(meta.residual[i], expect), n.name
+
+    def test_scan_soft_constraint_pods_not_double_seated(self, small_catalog):
+        """BatchScheduler hardens ScheduleAnyway-spread pods via copy
+        before seating them, so the scan-path bookkeeping must match
+        seated pods by NAME — an identity check misses the copy,
+        re-appends the original (double-seating the pod) and
+        double-subtracts the node's residual."""
+        import numpy as np
+
+        prov = Provisioner(name="default").with_defaults()
+        sched = BatchScheduler(backend="oracle")
+        base = [PodSpec(name=f"d-{i}",
+                        requests={"cpu": 0.5, "memory": 1.0 * 2**30},
+                        owner_key="d") for i in range(40)]
+        prev = sched.solve(base, [prov], small_catalog)
+        assert not prev.infeasible
+        sel = LabelSelector.of({"app": "soft"})
+        soft = [PodSpec(
+            name=f"s-{i}", labels={"app": "soft"},
+            requests={"cpu": 3.0, "memory": 1.0 * 2**30},
+            owner_key="soft",
+            topology_spread=[TopologySpreadConstraint(
+                1, L.ZONE, "ScheduleAnyway", sel)],
+        ) for i in range(10)]
+        out = sched.solve_delta(prev, added=soft, provisioners=[prov],
+                                instance_types=small_catalog,
+                                max_delta_frac=0.9)
+        assert out.mode == "scan"
+        assert not out.result.infeasible
+        meta = out.result._warmstart_meta
+        for n in meta.nodes:
+            names = [p.name for p in n.pods]
+            assert len(names) == len(set(names)), (n.name, names)
+        for i, n in enumerate(meta.nodes):
+            rem = n.remaining()
+            expect = [rem.get(k, 0.0) for k in meta.res_names]
+            assert np.allclose(meta.residual[i], expect), n.name
+
+    def test_reoffered_unplaced_pod_not_double_seated(self, small_catalog):
+        """A caller may re-offer a still-unplaced pod in `added` in the
+        same step as the removal that frees room for it: the retention
+        re-offer must dedupe against the adds, and a pod that places must
+        leave the retention dict — else it enters the subproblem (and the
+        cluster) twice."""
+        prov = Provisioner(name="default", limits={"cpu": 2.0}).with_defaults()
+        sched = BatchScheduler(backend="oracle")
+        pods = [PodSpec(name=f"p-{i}", requests={"cpu": 0.6}, owner_key="p")
+                for i in range(3)]
+        prev = sched.solve(pods, [prov], small_catalog)
+        assert not prev.infeasible
+        stuck = PodSpec(name="stuck", requests={"cpu": 0.6}, owner_key="s")
+        o1 = sched.solve_delta(prev, added=[stuck], provisioners=[prov],
+                               instance_types=small_catalog,
+                               max_delta_frac=0.9)
+        assert "stuck" in o1.result.infeasible
+        # the removal frees limit headroom; the caller re-offers stuck too
+        o2 = sched.solve_delta(o1.result, added=[stuck], removed=["p-0"],
+                               provisioners=[prov],
+                               instance_types=small_catalog,
+                               max_delta_frac=0.9)
+        assert "stuck" in o2.result.assignments
+        seatings = [p.name for n in (o2.result.existing_nodes
+                                     + o2.result.nodes)
+                    for p in n.pods].count("stuck")
+        assert seatings == 1
+        assert o2.total_pods == 3
+        meta = o2.result._warmstart_meta
+        if meta is not None:
+            assert "stuck" not in meta.unplaced
+
+    def test_preseated_pod_removal_is_booked(self, small_catalog):
+        """Removing a pod that was PRE-SEATED on an existing node (never
+        in prev.assignments) must unseat it and credit its capacity back
+        — a silent no-op diverges the chain's residual from the
+        cluster."""
+        from karpenter_tpu.solver.types import SimNode
+
+        prov = Provisioner(name="default").with_defaults()
+        sched = BatchScheduler(backend="oracle")
+        pre = PodSpec(name="pre-0", requests={"cpu": 15.0}, owner_key="pre")
+        node = SimNode(
+            instance_type="m5.4xlarge", provisioner="default",
+            zone="zone-1a", capacity_type="on-demand", price=0.768,
+            allocatable={L.RESOURCE_CPU: 16.0,
+                         L.RESOURCE_MEMORY: 64 * 2**30,
+                         L.RESOURCE_PODS: 110.0},
+            existing=True, name="ex-0",
+        )
+        node.stamp_labels()
+        node.pods.append(pre)
+        w = PodSpec(name="w-0", requests={"cpu": 0.5}, owner_key="w")
+        prev = sched.solve([w], [prov], small_catalog,
+                           existing_nodes=[node])
+        assert not prev.infeasible
+        o1 = sched.solve_delta(prev, removed=["pre-0"], provisioners=[prov],
+                               instance_types=small_catalog,
+                               max_delta_frac=0.9)
+        assert o1.removed == 1
+        seated = [p.name for n in (o1.result.existing_nodes
+                                   + o1.result.nodes) for p in n.pods]
+        assert "pre-0" not in seated
+        # capacity really credited: a 15-cpu add must host-fit back onto
+        # the freed existing node instead of buying a new one
+        big = PodSpec(name="big-0", requests={"cpu": 15.0}, owner_key="big")
+        o2 = sched.solve_delta(o1.result, added=[big], provisioners=[prov],
+                               instance_types=small_catalog,
+                               max_delta_frac=0.9)
+        assert not o2.result.infeasible
+        assert o2.result.assignments.get("big-0") == "ex-0"
+
+    def test_sel_terms_dedup_one_entry_per_selector_group(
+            self, small_catalog):
+        """5k-replica spread deployments must contribute ONE coupling-guard
+        entry, not one per pod — the guard scan is per displaced pod and
+        would otherwise blow the 1 ms steady-state budget linearly with
+        constraint-pod count."""
+        prov = Provisioner(name="default").with_defaults()
+        sched = BatchScheduler(backend="oracle")
+        sel = LabelSelector.of({"app": "spread"})
+        pods = [PodSpec(
+            name=f"sp-{i}", labels={"app": "spread"},
+            requests={"cpu": 0.1}, owner_key="spread",
+            topology_spread=[TopologySpreadConstraint(
+                50, L.ZONE, "DoNotSchedule", sel)],
+        ) for i in range(40)]
+        prev = sched.solve(pods, [prov], small_catalog)
+        out = sched.solve_delta(prev, added=mk_pods(2, "x"),
+                                provisioners=[prov],
+                                instance_types=small_catalog,
+                                max_delta_frac=0.9)
+        meta = out.result._warmstart_meta
+        assert meta is not None
+        assert len(meta.sel_terms) == 1
