@@ -461,6 +461,20 @@ def check_budgets(rec):
         flags.append(
             f"admitted-path single-solve overhead {adm_ov:.2f}% exceeds "
             f"the {ADMISSION_OVERHEAD_BUDGET_PCT:.0f}% admission budget")
+    # sharded megabatch gates (ISSUE 7): a meshed pipeline must serve
+    # coalesced flushes strictly above its serial-dispatch baseline, and
+    # the coalescer must not tax a lone meshed request
+    ss = rec.get("sharded_megabatch_speedup")
+    if ss is not None and ss <= 1.0:
+        flags.append(
+            f"meshed megabatch throughput is {ss:.2f}x the meshed serial "
+            "baseline — the sharded slot axis is not paying for itself")
+    slr = rec.get("sharded_single_latency_ratio")
+    if slr is not None and slr > SINGLE_LATENCY_REGRESSION_MAX:
+        flags.append(
+            f"meshed single-request latency with the coalescer on is "
+            f"{slr:.2f}x the coalescer-off path (budget "
+            f"{SINGLE_LATENCY_REGRESSION_MAX}x)")
     # warm-start delta gates (ISSUE 6)
     wp50 = rec.get("warmstart_p50_ms")
     if wp50 is not None and wp50 > WARMSTART_P50_BUDGET_MS:
@@ -697,6 +711,127 @@ def measure_throughput(duration_s: float = 4.0, max_slots: int = 8):
         "single_latency_off_ms": round(lat_off, 2),
         "single_latency_on_ms": round(lat_on, 2),
         "single_latency_ratio": round(lat_on / max(lat_off, 1e-9), 3),
+    }
+
+
+_SHARDED_SNIPPET = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count={n_dev}").strip()
+import importlib.util, threading, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+spec = importlib.util.spec_from_file_location("benchmod", {bench!r})
+b = importlib.util.module_from_spec(spec); spec.loader.exec_module(b)
+from karpenter_tpu.metrics import MEGABATCH_SLOTS, Registry
+from karpenter_tpu.models.catalog import generate_catalog
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.parallel.mesh import make_mesh
+from karpenter_tpu.service.server import SolvePipeline
+from karpenter_tpu.solver.scheduler import BatchScheduler
+
+n_dev = {n_dev}
+catalog = generate_catalog(full=False)
+provs = [Provisioner(name="default").with_defaults()]
+mesh = make_mesh(n_dev)
+reg = Registry()
+sched = BatchScheduler(backend="tpu", registry=reg, mesh=mesh)
+client_pods = [b._serving_pods(c) for c in range(2 * n_dev)]
+st, _ = sched._tensorize_cache.tensorize(client_pods[0], provs, catalog)
+# compile the two meshed programs inline (the probe process pays it once;
+# production rides precompile_buckets' sharded rungs)
+sched._tpu.solve(st, mesh=mesh)
+outs = sched._tpu.solve_many([dict(st=st)], min_slots=n_dev, mesh=mesh)
+assert not isinstance(outs[0], Exception), outs[0]
+
+
+def phase(concurrency, slots, duration):
+    pipe = SolvePipeline(sched, registry=reg, max_slots=slots)
+    try:
+        h = reg.histogram(MEGABATCH_SLOTS)
+        occ0 = (sum(h.sums.values()), sum(h.totals.values()))
+        counts = [0] * concurrency
+        stop_at = time.perf_counter() + duration
+        start = threading.Barrier(concurrency + 1)
+
+        def client(ci):
+            start.wait()
+            while time.perf_counter() < stop_at:
+                pipe.solve(dict(pods=client_pods[ci], provisioners=provs,
+                                instance_types=catalog))
+                counts[ci] += 1
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(concurrency)]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        start.wait()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        occ1 = (sum(h.sums.values()), sum(h.totals.values()))
+        d_sum, d_n = occ1[0] - occ0[0], occ1[1] - occ0[1]
+        return sum(counts) / max(elapsed, 1e-9), (
+            (d_sum / d_n) if d_n else -1.0)
+    finally:
+        pipe.stop()
+
+
+dur = {duration}
+serial_c1, _ = phase(1, 1, dur)        # meshed serial, lone request
+coal_c1, _ = phase(1, n_dev, dur)      # lone request, coalescer armed
+serial_cN, _ = phase(2 * n_dev, 1, dur)   # meshed serial under load
+mega_cN, occ = phase(2 * n_dev, n_dev, dur)  # sharded megabatch under load
+print("SHARDED", serial_c1, coal_c1, serial_cN, mega_cN, occ)
+"""
+
+
+def measure_sharded_throughput(n_dev: int = 8, duration_s: float = 3.0):
+    """Closed-loop MESHED-serving throughput (ISSUE 7): a subprocess forces
+    ``n_dev`` virtual CPU devices (the MULTICHIP dryrun environment — the
+    bench parent's jax is already initialized without them), builds a
+    mesh-configured scheduler, and drives the SolvePipeline closed-loop at
+    the same offered concurrency twice: max_slots=1 (every request = one
+    sharded single-solve dispatch — the meshed SERIAL baseline, the only
+    path meshed schedulers had before this round) vs max_slots=n_dev (the
+    sharded megabatch: one dispatch + one fence per flush, slot axis
+    one-per-chip).  Two c1 phases gate the lone-request latency tax.
+    Returns the record fragment; gates in :func:`check_budgets` require
+    meshed megabatch > meshed serial and latency ratio <= 1.10x."""
+    import subprocess
+
+    env = dict(os.environ)
+    # the snippet forces its own device count BEFORE importing jax
+    env.pop("XLA_FLAGS", None)
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             _SHARDED_SNIPPET.format(bench=os.path.abspath(__file__),
+                                     n_dev=n_dev, duration=duration_s)],
+            capture_output=True, text=True, timeout=1500, env=env,
+        )
+    except Exception as e:  # timeout etc.
+        return {"sharded_error": f"{type(e).__name__}: {e}"[:300]}
+    line = None
+    for ln in p.stdout.splitlines():
+        if ln.startswith("SHARDED "):
+            line = ln
+    if line is None:
+        return {"sharded_error": (f"rc={p.returncode}: "
+                                  f"{(p.stderr or '').strip()[-300:]}")}
+    _tag, s1, c1, s_n, m_n, occ = line.split()
+    s1, c1, s_n, m_n, occ = map(float, (s1, c1, s_n, m_n, occ))
+    return {
+        "sharded_devices": n_dev,
+        "sharded_serial_per_sec": round(s_n, 2),
+        "sharded_mega_per_sec": round(m_n, 2),
+        "sharded_megabatch_speedup": round(m_n / max(s_n, 1e-9), 3),
+        "sharded_single_latency_ratio": round(s1 / max(c1, 1e-9), 3),
+        "sharded_batch_occupancy": None if occ < 0 else round(occ, 2),
     }
 
 
@@ -1240,6 +1375,7 @@ def run_bench():
     cold_ms, cold_nodes, cold_infeasible, cold_err = measure_coldstart()
     trace_overhead_pct, trace_off_ms, trace_on_ms = measure_trace_overhead()
     throughput = measure_throughput()
+    sharded = measure_sharded_throughput()
     overload = measure_overload()
     warmstart = measure_warmstart()
     sweep = measure_consolidation_sweep()
@@ -1278,6 +1414,7 @@ def run_bench():
         "trace_solve_off_ms": trace_off_ms,
         "trace_solve_on_ms": trace_on_ms,
         **throughput,
+        **sharded,
         **overload,
         **warmstart,
         **sweep,

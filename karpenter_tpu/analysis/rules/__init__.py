@@ -2,10 +2,10 @@
 ``check(files) -> list[Finding]``; the catalog lives in docs/ANALYSIS.md."""
 
 from . import (kt001, kt002, kt003, kt004, kt005, kt006, kt007, kt008, kt009,
-               kt010)
+               kt010, kt011)
 
 ALL_RULES = (kt001, kt002, kt003, kt004, kt005, kt006, kt007, kt008, kt009,
-             kt010)
+             kt010, kt011)
 
 __all__ = ["ALL_RULES", "kt001", "kt002", "kt003", "kt004", "kt005", "kt006",
-           "kt007", "kt008", "kt009", "kt010"]
+           "kt007", "kt008", "kt009", "kt010", "kt011"]

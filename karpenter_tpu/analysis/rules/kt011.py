@@ -1,0 +1,102 @@
+"""KT011 — sharding/layout objects constructed on the per-call serving path.
+
+The KT008 precedent, applied to device LAYOUT: ``jax.sharding.Mesh`` /
+``NamedSharding`` construction and raw ``device_put`` calls belong at
+program-BUILD time, not inside per-flush serving functions.  A sharding
+object rebuilt per solve is re-hashed into every ``device_put`` and every
+jit-cache lookup on the hot path, and — worse — makes it easy to drift the
+layout between the program that compiled and the flush that dispatches
+(two ``NamedSharding(mesh, P(...))`` built at different sites are equal
+today and silently diverge the day one spec changes).  PR 7's sharded
+megabatch made layout part of the compile signature, so the construction
+sites must be as disciplined as the jit sites KT008 pinned.
+
+``parallel/`` is the sanctioned home: ``parallel/mesh.py`` owns the cached
+factories (``slot_mesh`` / ``slot_sharding`` / ``axis_sharding`` — built
+once per (mesh, spec), hashable-mesh-keyed) and ``parallel/distributed.py``
+owns the multi-process-safe ``put_sharded``.  Serving code imports those;
+it never constructs layout inline.
+
+Scope: the serving-path packages (``solver/``, ``ops/``, ``service/``)
+plus ``batcher.py``.  Module-level construction (a constant layout next to
+a module-level jit) is fine; genuinely per-call uses off the steady-state
+path (measurement branches, dryrun validation) carry
+``# ktlint: allow[KT011] <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..ktlint import Finding, dotted_name, iter_functions
+
+ID = "KT011"
+TITLE = "sharding/layout construction on the per-call serving path"
+HINT = ("build layout once: use the cached factories in parallel/mesh.py "
+        "(slot_mesh / slot_sharding / axis_sharding) and "
+        "parallel/distributed.put_sharded instead of constructing "
+        "Mesh/NamedSharding or calling device_put inside a serving "
+        "function; sharding objects are program-build-time state, exactly "
+        "like the module-level jits KT008 pins")
+
+#: serving-path scope (package-relative path prefixes / exact files);
+#: parallel/ is deliberately absent — it is the sanctioned construction home
+SERVING_DIRS = (
+    "karpenter_tpu/solver/",
+    "karpenter_tpu/ops/",
+    "karpenter_tpu/service/",
+)
+SERVING_FILES = ("karpenter_tpu/batcher.py",)
+
+#: layout-object constructors whose per-call invocation the rule flags
+LAYOUT_CTORS = frozenset({
+    "Mesh", "NamedSharding", "PositionalSharding", "GSPMDSharding",
+    "SingleDeviceSharding",
+})
+#: raw placement calls (the helpers in parallel/ wrap these once)
+PLACEMENT_CALLS = frozenset({"device_put"})
+
+
+def _in_scope(path: str) -> bool:
+    return (any(path.startswith(d) for d in SERVING_DIRS)
+            or path in SERVING_FILES)
+
+
+def _offender(node: ast.AST) -> Optional[str]:
+    """The flagged callee name if ``node`` is a layout construction or a
+    raw placement call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf in LAYOUT_CTORS or leaf in PLACEMENT_CALLS:
+        return name
+    return None
+
+
+def check(files) -> List[Finding]:
+    out: List[Finding] = []
+    for f in files:
+        if not _in_scope(f.path):
+            continue
+        for qual, fn, nested in iter_functions(f.tree):
+            if nested:
+                continue  # closures walk with their enclosing function
+            for stmt in fn.body:
+                for n in ast.walk(stmt):
+                    name = _offender(n)
+                    if name is None:
+                        continue
+                    kind = ("raw device_put"
+                            if name.rsplit(".", 1)[-1] in PLACEMENT_CALLS
+                            else f"`{name}` construction")
+                    out.append(Finding(
+                        ID, f.path, n.lineno,
+                        f"{kind} inside `{qual}` — layout objects are "
+                        "rebuilt (and re-hashed) per call on the serving "
+                        "path; build them once via the parallel/ factories",
+                        hint=HINT))
+    return out

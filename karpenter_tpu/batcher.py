@@ -136,8 +136,14 @@ class SlotCoalescer(Generic[T]):
     SolvePipeline drives it between the RPC queue and the device dispatch).
 
     Items arrive tagged with a *bucket key* (the megabatch compile-signature
-    bucket; ``None`` = cannot ride a megabatch).  Consecutive same-key items
-    accumulate into one batch of up to ``max_slots``; a batch flushes when
+    bucket; ``None`` = cannot ride a megabatch).  The key is opaque here,
+    but by contract it carries everything that picks the compiled program —
+    including the scheduler's MESH signature (``TpuSolver.mega_signature``):
+    a meshed scheduler's sharded flushes and a single-device scheduler's
+    flushes are different buckets, so requests against different device
+    layouts can never coalesce into one dispatch.  Consecutive same-key
+    items accumulate into one batch of up to ``max_slots``; a batch flushes
+    when
 
     - **full** — it reached ``max_slots``,
     - **bucket** — an arriving item carries a different (or None) key,

@@ -161,6 +161,12 @@ REMOTE_FALLBACK_SOLVES = "karpenter_solver_remote_fallback_solves_total"
 REMOTE_DEGRADED = "karpenter_solver_remote_degraded"
 MEGABATCH_SLOTS = "karpenter_solver_megabatch_slots"
 MEGABATCH_FLUSH = "karpenter_solver_megabatch_flush_total"
+#: the full flush-reason label population (KT003 zero-init source shared by
+#: BatchScheduler and SolvePipeline): coalescer boundaries (full/deadline/
+#: bucket) plus 'mesh_serial' — a mesh-configured scheduler serving a
+#: would-be sharded megabatch serially (cold sharded rung, unshardable
+#: mesh, or a degraded flush)
+MEGABATCH_FLUSH_REASONS = ("full", "deadline", "bucket", "mesh_serial")
 PRECOMPILE_DURATION = "karpenter_solver_precompile_duration_seconds"
 TENSORIZE_CACHE_HITS = "karpenter_solver_tensorize_cache_hits_total"
 TENSORIZE_CACHE_MISSES = "karpenter_solver_tensorize_cache_misses_total"
@@ -278,7 +284,11 @@ INVENTORY = {
         "'deadline' (max-wait expired, or the inbound queue went idle with "
         "no wait configured), 'bucket' (an arriving request's shape bucket "
         "differed from the held batch's, or the request cannot ride a "
-        "megabatch at all)."),
+        "megabatch at all), 'mesh_serial' (a mesh-configured scheduler "
+        "served a would-be sharded megabatch serially — the sharded "
+        "slot-rung program was still compiling behind, the mesh's device "
+        "count exceeds the slot-rung ladder, or the flush degraded; "
+        "steady-state meshed serving should hold this near zero)."),
     PRECOMPILE_DURATION: (
         "histogram", (),
         "Wall time of one blocking ahead-of-time bucket-grid precompile "

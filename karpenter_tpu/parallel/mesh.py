@@ -14,10 +14,17 @@ the O(G*C*K) hot tensor contraction.  The packing scan's per-step vector math
 shards over node slots; XLA inserts the prefix-sum collectives.  Consolidation
 what-if evaluation (solver/consolidation.py) shards candidate subsets over
 ``pods`` x ``types`` jointly — embarrassingly parallel batched solves.
+
+- ``slots`` — the megabatch request-slot axis (:func:`slot_mesh`): a 1-D
+  re-view of the SAME devices, one independent solve request per chip.  The
+  cross-request megabatch (solver/tpu.py ``_run_scan_many``) shards its
+  leading slot axis here — per-slot feasibility+scan stay fully local, so
+  the whole mesh serves one coalesced flush with zero collectives.
 """
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -26,6 +33,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 POD_AXIS = "pods"
 TYPE_AXIS = "types"
+#: the megabatch request-slot axis: a 1-D re-view of the SAME devices the
+#: (pods, types) mesh spans — see :func:`slot_mesh`
+SLOT_AXIS = "slots"
 
 
 def _host_major(devices: Sequence) -> np.ndarray:
@@ -100,5 +110,55 @@ def feasibility_shardings(mesh: Mesh):
 
 def replicate(mesh: Mesh, tree):
     """Place a pytree fully replicated on the mesh."""
-    sh = NamedSharding(mesh, P())
-    return jax.device_put(tree, sh)
+    return jax.device_put(tree, axis_sharding(mesh))
+
+
+# ---------------------------------------------------------------------------
+# cached sharding construction (the KT011 discipline)
+# ---------------------------------------------------------------------------
+# Sharding objects (Mesh, NamedSharding) belong at program-build time: a
+# NamedSharding constructed inside a per-flush serving function is rebuilt —
+# and re-hashed into every device_put and jit-cache lookup — on every solve
+# (the KT008 precedent, applied to layout objects).  These factories are the
+# sanctioned construction sites; ``jax.sharding.Mesh`` is hashable, so the
+# caches key on the mesh object itself and hit for the process-lifetime mesh
+# every serving path holds.
+
+
+@lru_cache(maxsize=64)
+def slot_mesh(mesh: Mesh) -> Mesh:
+    """1-D ``('slots',)`` re-view of a ``(pods, types)`` mesh's devices.
+
+    The megabatch request-slot axis is data-parallel by construction (vmap
+    introduces no cross-slot ops), so the highest-throughput layout puts one
+    slot's whole program on one chip: flatten the 2-D mesh and shard the
+    slot axis over ALL devices.  The flatten is row-major over the
+    host-major ``(pods, types)`` array — on multi-host topologies the pods
+    axis walks hosts in order (:func:`_host_major`), so each host's slots
+    stay CONTIGUOUS: a slot never splits across DCN, and a multi-process
+    flush places whole slots on one host's chips."""
+    return Mesh(mesh.devices.reshape(-1), (SLOT_AXIS,))
+
+
+@lru_cache(maxsize=64)
+def slot_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis (dim 0 = request slot) sharding over :func:`slot_mesh`;
+    trailing axes replicated — i.e. fully local per slot."""
+    return NamedSharding(slot_mesh(mesh), P(SLOT_AXIS))
+
+
+@lru_cache(maxsize=256)
+def axis_sharding(mesh: Mesh, *names: str) -> NamedSharding:
+    """Cached ``NamedSharding(mesh, P(*names))`` — no names = replicated."""
+    return NamedSharding(mesh, P(*names))
+
+
+def mesh_signature(mesh: Optional[Mesh]) -> tuple:
+    """Hashable (axis, size) fingerprint of a mesh for compile-bucket keys:
+    two schedulers over different meshes run different partitioned programs,
+    so their megabatch bucket keys must never collide (``()`` for None)."""
+    if mesh is None:
+        return ()
+    return tuple(
+        (str(a), int(s)) for a, s in zip(mesh.axis_names, mesh.devices.shape)
+    )

@@ -32,6 +32,7 @@ from ..batcher import InflightQueue, SlotCoalescer
 from ..metrics import (
     INFLIGHT_DEPTH,
     MEGABATCH_FLUSH,
+    MEGABATCH_FLUSH_REASONS,
     MEGABATCH_SLOTS,
     Registry,
     registry as default_registry,
@@ -40,7 +41,7 @@ from ..obs import tracer_for
 from ..obs.trace import NULL_TRACE, Tracer
 from ..solver.guard import DeviceHang
 from ..solver.scheduler import BatchScheduler
-from ..solver.tpu import MEGA_MAX_SLOTS
+from ..solver.tpu import MEGA_MAX_SLOTS, max_mega_slots, mesh_shardable
 from ..utils.clock import Clock
 from . import codec
 from . import solver_pb2 as pb
@@ -85,7 +86,7 @@ class SolvePipeline:
       device dispatch; the dispatcher tensorizes batch N+1 while batch N
       executes, fencing via the in-flight queue.  Serves the low-concurrency
       regime.
-    - **Cross-request megabatching** (this round): a deadline-aware
+    - **Cross-request megabatching** (PR 4): a deadline-aware
       :class:`~karpenter_tpu.batcher.SlotCoalescer` drains concurrent RPCs
       into request slots (flush on max-slots, max-wait, or shape-bucket
       change) and ``scheduler.submit_many`` solves the whole flush in ONE
@@ -93,6 +94,14 @@ class SolvePipeline:
       one solve per device round trip.  Engages exactly when requests
       queue; a lone request flushes immediately (``max_wait=0`` default),
       so single-request latency matches the unbatched path.
+
+    Mesh-configured schedulers ride the same path SHARDED: the flush's
+    slot axis spreads one-slot-per-chip over the scheduler's (pods, types)
+    mesh (solver/tpu.py ``solve_many_async(mesh=...)``), so a multi-chip
+    host serves coalesced flushes at full device count — the pipeline
+    floors ``max_slots`` at the mesh's device count so sharded flushes
+    fill every chip.  Bucket keys carry the mesh signature, so requests
+    against different meshes never coalesce.
 
     Responses keep arrival order (singles and megabatches share ONE
     FIFO in-flight queue), and every megabatched response carries the
@@ -115,6 +124,31 @@ class SolvePipeline:
             max_wait_ms = float(os.environ.get("KT_MAX_WAIT_MS",
                                                str(DEFAULT_MAX_WAIT_MS)))
         self.max_slots = max(1, min(MEGA_MAX_SLOTS, max_slots))
+        # meshed scheduler: the sharded megabatch pads its slot axis to the
+        # mesh's device count (one slot per chip), so floor the flush size
+        # there — a smaller cap would flush half-empty shards and serve the
+        # mesh below its chip count — and CAP it at the mesh's largest
+        # in-ladder rung (awkward device counts: 20 chips top out at a
+        # 20-slot rung, so a 32-entry flush would overflow the sharded
+        # program and degrade to serial on every full flush).
+        # max_slots=1 (batching disabled) is honored; an unshardable mesh
+        # (device count past the slot-rung ladder) keeps the configured
+        # cap and rides the serial path.
+        mesh = getattr(scheduler, "mesh", None)
+        if mesh is not None and self.max_slots > 1:
+            n_dev = int(mesh.devices.size)
+            if n_dev <= MEGA_MAX_SLOTS:
+                self.max_slots = min(max(self.max_slots, n_dev),
+                                     max_mega_slots(mesh))
+        #: an unshardable mesh on a megabatching backend serves every
+        #: request as its own single-request serial flush (bucket_key
+        #: rejects before any other probe): count those flushes under
+        #: mesh_serial, not 'bucket', so degradation stays visible in
+        #: flush units (bucket_key itself only logs — counting per probe
+        #: there would double-count each request and mix units)
+        self._mesh_unshardable = (
+            mesh is not None and not mesh_shardable(mesh)
+            and getattr(scheduler, "backend", None) in ("auto", "tpu"))
         self.max_wait = max(0.0, max_wait_ms) / 1000.0
         self._clock = clock or Clock()
         self._q: "queue.Queue" = queue.Queue()
@@ -145,7 +179,7 @@ class SolvePipeline:
         # zero-init every flush-reason series (KT003: a counter born at its
         # first increment loses that increment to rate()/increase())
         flush = self.registry.counter(MEGABATCH_FLUSH)
-        for reason in ("full", "deadline", "bucket"):
+        for reason in MEGABATCH_FLUSH_REASONS:
             flush.inc({"reason": reason}, value=0.0)
         self.registry.histogram(MEGABATCH_SLOTS)
         # admission control (docs/ADMISSION.md): the bounded priority queue
@@ -325,16 +359,36 @@ class SolvePipeline:
         enqueue→respond solve_ms at finalization."""
         if not batch:
             return
-        self.registry.counter(MEGABATCH_FLUSH).inc({"reason": reason})
+        if reason == "bucket" and len(batch) == 1 and self._mesh_unshardable:
+            # the coalescer resolved an unshardable-mesh rejection (None
+            # bucket key) into this single-request serial flush — the
+            # mesh is WHY it rides alone, so label it honestly
+            reason = "mesh_serial"
         if len(batch) == 1:
+            self.registry.counter(MEGABATCH_FLUSH).inc({"reason": reason})
             self._dispatch_single(*batch[0])
             return
+        # a scheduler that can degrade a meshed flush to serial owns the
+        # flush count (it incs mesh_serial OR our reason at dispatch, so
+        # the labels partition flushes); facades/doubles without the
+        # capability keep the upfront count here
+        delegated = getattr(self.scheduler, "counts_flush_reason", False)
+        if not delegated:
+            self.registry.counter(MEGABATCH_FLUSH).inc({"reason": reason})
         try:
             pendings = self.scheduler.submit_many(
-                [kw for kw, _f, _t, _w in batch])
+                [kw for kw, _f, _t, _w in batch],
+                **({"flush_reason": reason} if delegated else {}))
         # ktlint: allow[KT005] submit failures fan to every waiting RPC
         # thread through their futures; the dispatcher itself must live on
         except BaseException as err:  # noqa: BLE001
+            if delegated:
+                # a registration-phase raise never reached the collector's
+                # end-of-dispatch count — the flush still happened, and an
+                # uncounted failing flush is the one an operator most
+                # wants visible in the partition
+                self.registry.counter(MEGABATCH_FLUSH).inc(
+                    {"reason": reason})
             for _kw, fut, _t, _w in batch:
                 _resolve(fut, exc=err)
                 self._unhand(fut)

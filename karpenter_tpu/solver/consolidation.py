@@ -166,6 +166,9 @@ def screen_subset_deletes(
         times = []
         for _ in range(3):
             res_i = residual + rng.uniform(0.0, 1e-5, residual.shape).astype(np.float32)
+            # ktlint: allow[KT011] measure=True benchmark branch only: the
+            # perturbed re-placement defeats the runtime's execution memo;
+            # the serving path (measure=False) never reaches this
             args_i = (jax.device_put(res_i),) + args[1:]
             jax.block_until_ready(args_i[0])
             t1 = time.perf_counter()
@@ -360,15 +363,17 @@ def sweep_dims(st, NE: int, node_budget: int, track: bool = False) -> dict:
     return dims
 
 
-def sweep_signature(st, dims: dict, slots: int) -> tuple:
+def sweep_signature(st, dims: dict, slots: int, mesh=None) -> tuple:
     """Compile signature of the sweep's vmapped program at a slot rung —
-    the key TpuSolver readiness/warm bookkeeping tracks for it."""
-    from .tpu import _dims_key, _mega_rung
+    the key TpuSolver readiness/warm bookkeeping tracks for it.  With a
+    ``mesh``, the SHARDED sweep program: slot rung floored at the device
+    count, mesh fingerprint in the key (the shared ``_mega_key_tail``
+    format ``_dispatch_prepared`` keys dispatches with)."""
+    from .tpu import _dims_key, _mega_key_tail
 
-    return _dims_key(dims) + (
-        ("mega_slots", _mega_rung(slots)),
-        ("zk", st.vocab.key_id[L.ZONE]),
-        ("ck", st.vocab.key_id[L.CAPACITY_TYPE]),
+    return _dims_key(dims) + _mega_key_tail(
+        slots, st.vocab.key_id[L.ZONE], st.vocab.key_id[L.CAPACITY_TYPE],
+        mesh,
     )
 
 
@@ -442,12 +447,15 @@ def build_sweep_entries(
 
 # ktlint: fence the warm thunk's D2H read is the deliberate compile+fence of
 # the background sweep-program warm (discarded results, warm thread only)
-def _warm_sweep(solver, entries: List[dict], slots: int, sig: tuple) -> None:
-    """Background-compile the sweep's vmapped program (compile-behind:
-    the serving sweep never stalls on XLA)."""
+def _warm_sweep(solver, entries: List[dict], slots: int, sig: tuple,
+                mesh=None) -> None:
+    """Background-compile the sweep's vmapped program — the SHARDED one for
+    a meshed scheduler (compile-behind: the serving sweep never stalls on
+    XLA)."""
 
     def thunk():
-        pending = solver.solve_many_prepared(entries, min_slots=slots)
+        pending = solver.solve_many_prepared(entries, min_slots=slots,
+                                             mesh=mesh)
         np.asarray(pending.carry_b[7])  # fence: the compile has landed
         solver._mark_ready(sig)
 
@@ -514,10 +522,17 @@ def sweep_what_ifs(
         except Exception as err:  # noqa: BLE001
             return err
 
-    # whole-sweep device eligibility; per-candidate carve-outs below
+    # whole-sweep device eligibility; per-candidate carve-outs below.
+    # Meshed schedulers sweep SHARDED (slot axis over the mesh's chips,
+    # one dispatch + one fence, same as single-device); only a mesh whose
+    # device count exceeds the slot-rung ladder keeps the serial path —
+    # explicitly metriced via the existing path="serial" label.
+    from .tpu import mesh_shardable
+
+    mesh = scheduler.mesh
     device_ok = (
         scheduler.backend in ("auto", "tpu")
-        and scheduler.mesh is None
+        and mesh_shardable(mesh)
         and scheduler._tensorize_cache is not None
         and (scheduler.backend == "tpu" or not scheduler._guard.enabled
              or scheduler._guard.healthy)
@@ -563,7 +578,7 @@ def sweep_what_ifs(
         for lo in range(0, len(idxs), SWEEP_MAX_SLOTS):
             chunk = idxs[lo:lo + SWEEP_MAX_SLOTS]
             st0, dims, _ = prepared[chunk[0]]
-            sig = sweep_signature(st0, dims, len(chunk))
+            sig = sweep_signature(st0, dims, len(chunk), mesh=mesh)
             if not solver.ready(sig) and solver.warm_pending(sig):
                 # compile-behind already in flight: this sweep serves
                 # serially anyway, so skip the shared-base host build
@@ -586,12 +601,14 @@ def sweep_what_ifs(
             if not solver.ready(sig):
                 # compile-behind: serve this sweep serially, warm the
                 # vmapped program in the background
-                _warm_sweep(solver, chunk_entries, len(chunk), sig)
+                _warm_sweep(solver, chunk_entries, len(chunk), sig,
+                            mesh=mesh)
                 continue
             try:
                 with trace.span("sweep_dispatch", slots=len(chunk)):
                     outs = solver.solve_many_prepared(
-                        chunk_entries, min_slots=len(chunk)).results()
+                        chunk_entries, min_slots=len(chunk),
+                        mesh=mesh).results()
             # ktlint: allow[KT005] a failed sweep dispatch degrades the
             # whole chunk to the proven serial path (decisions unchanged)
             except Exception:  # noqa: BLE001

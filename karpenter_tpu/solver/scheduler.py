@@ -19,6 +19,7 @@ import logging
 
 from ..metrics import (
     MEGABATCH_FLUSH,
+    MEGABATCH_FLUSH_REASONS,
     MEGABATCH_SLOTS,
     PRECOMPILE_DURATION,
     SCHEDULING_DURATION,
@@ -50,7 +51,13 @@ from ..obs import tracer_for
 from ..obs.trace import NULL_TRACE, Tracer
 from .guard import DeviceGuard, DeviceHang
 from .reference import solve as oracle_solve
-from .tpu import MEGA_MAX_SLOTS, SlotsExhausted, TpuSolver
+from .tpu import (
+    MEGA_MAX_SLOTS,
+    SlotsExhausted,
+    TpuSolver,
+    _mesh_size,
+    mesh_shardable,
+)
 from .types import SimNode, SolveResult
 
 logger = logging.getLogger(__name__)
@@ -224,11 +231,28 @@ class _MegaCollector:
     the pipeline's dispatcher thread (the submit_many contract)."""
 
     def __init__(self, solver: TpuSolver, guard=None, registry=None,
-                 warm=None) -> None:
+                 warm=None, mesh=None, on_mesh_serial=None,
+                 flush_reason: Optional[str] = None) -> None:
         self.solver = solver
         self.guard = guard
         self.registry = registry
         self.warm = warm
+        #: the owning scheduler's device mesh: flushes dispatch the SHARDED
+        #: megabatch program (slot axis over the flattened mesh) and the
+        #: serial fallback dispatches the sharded single-solve program
+        self.mesh = mesh
+        #: scheduler hook counting/logging a meshed flush that degraded to
+        #: serial dispatches (MEGABATCH_FLUSH{reason="mesh_serial"})
+        self.on_mesh_serial = on_mesh_serial
+        #: the pipeline's coalescer reason for this flush, or None for
+        #: direct submit_many callers.  When set, the collector owns the
+        #: flush count and incs exactly ONE reason at dispatch —
+        #: "mesh_serial" if the meshed flush degraded to serial, else this
+        #: reason — so the counter's labels stay a partition of flushes
+        #: (counting upfront at the pipeline AND again on degradation
+        #: would double-count every degraded meshed flush)
+        self.flush_reason = flush_reason
+        self._degraded = False
         self.entries: List[dict] = []
         #: per-slot resolver state after dispatch():
         #: ("mega", PendingMegaSolve, pos) | ("single", PendingTpuSolve)
@@ -246,22 +270,44 @@ class _MegaCollector:
     def _guarded(self, fn):
         return self.guard.run(fn) if self.guard else fn()
 
+    def _mesh_serial(self, detail: str) -> None:
+        if self.mesh is None:
+            return
+        first_degrade = not self._degraded
+        self._degraded = True
+        if self.on_mesh_serial is not None:
+            # the counter is in FLUSH units: pipeline-owned flushes count
+            # at end of dispatch() instead, and a direct caller's flush
+            # counts on its FIRST degraded group only (a flush spanning
+            # two cold buckets is still one degraded flush)
+            self.on_mesh_serial(
+                detail,
+                count=self.flush_reason is None and first_degrade)
+
     def dispatch(self) -> None:
         self._slots = [None] * len(self.entries)
         groups: Dict[tuple, List[int]] = {}
         for i, e in enumerate(self.entries):
             key = self.solver.mega_signature(
                 e["st"], existing_nodes=e["existing_nodes"],
-                max_nodes=e["max_nodes"], slots=1,
+                max_nodes=e["max_nodes"], slots=1, mesh=self.mesh,
             )
             groups.setdefault(key, []).append(i)
         for idxs in groups.values():
-            use_mega = len(idxs) > 1
+            use_mega = len(idxs) > 1 and mesh_shardable(self.mesh)
+            if len(idxs) > 1 and not mesh_shardable(self.mesh):
+                # device count past the slot-rung ladder: this mesh cannot
+                # pad a batch to one-slot-per-chip (bucket_key already
+                # rejects these; direct submit_many callers land here)
+                self._mesh_serial(
+                    f"{_mesh_size(self.mesh)}-device mesh exceeds the "
+                    f"{MEGA_MAX_SLOTS}-slot rung ladder")
             if use_mega:
                 first = self.entries[idxs[0]]
                 mega_sig = self.solver.mega_signature(
                     first["st"], existing_nodes=first["existing_nodes"],
                     max_nodes=first["max_nodes"], slots=len(idxs),
+                    mesh=self.mesh,
                 )
                 if not self.solver.ready(mega_sig):
                     # callers must never eat a cold compile (the compile-
@@ -270,6 +316,8 @@ class _MegaCollector:
                     if self.warm is not None:
                         self.warm(first, len(idxs))
                     use_mega = False
+                    self._mesh_serial("sharded slot-rung program still "
+                                      "compiling behind")
             if use_mega:
                 reqs = [
                     dict(
@@ -283,7 +331,8 @@ class _MegaCollector:
                 ]
                 try:
                     handle = self._guarded(
-                        lambda reqs=reqs: self.solver.solve_many_async(reqs))
+                        lambda reqs=reqs: self.solver.solve_many_async(
+                            reqs, mesh=self.mesh))
                 except DeviceHang as err:
                     # hang at H2D dispatch: fan to every slot — each
                     # request's _finish_mega degrades to the warm tier
@@ -298,6 +347,8 @@ class _MegaCollector:
                     logger.warning(
                         "megabatch dispatch failed; serving the flush "
                         "serially", exc_info=True)
+                    self._mesh_serial("megabatch construction failed; "
+                                      "flush degraded")
                     self._dispatch_serial(idxs)
                     continue
                 self._observe_slots(len(idxs))
@@ -305,11 +356,16 @@ class _MegaCollector:
                     self._slots[i] = ("mega", handle, pos)
             else:
                 self._dispatch_serial(idxs)
+        if self.flush_reason is not None and self.registry is not None:
+            # pipeline-owned flush count: exactly one reason per flush
+            reason = "mesh_serial" if self._degraded else self.flush_reason
+            self.registry.counter(MEGABATCH_FLUSH).inc({"reason": reason})
 
     def _dispatch_serial(self, idxs: List[int]) -> None:
-        """Per-request async dispatches on the single-solve program: still
-        one enqueue per request before any fence (the cold-rung and
-        degraded-flush path)."""
+        """Per-request async dispatches on the single-solve program (the
+        SHARDED single program for a meshed collector): still one enqueue
+        per request before any fence (the cold-rung and degraded-flush
+        path)."""
         for i in idxs:
             e = self.entries[i]
             self._observe_slots(1)
@@ -317,7 +373,7 @@ class _MegaCollector:
                 pending = self._guarded(
                     lambda e=e: self.solver.solve_async(
                         e["st"], existing_nodes=e["existing_nodes"],
-                        max_nodes=e["max_nodes"],
+                        max_nodes=e["max_nodes"], mesh=self.mesh,
                         raise_on_exhaust=e["raise_on_exhaust"],
                         trace=e["trace"],
                     ))
@@ -424,9 +480,13 @@ class BatchScheduler:
         # re-zero-inits too, for facade schedulers without this init)
         self.registry.histogram(MEGABATCH_SLOTS)
         self.registry.histogram(PRECOMPILE_DURATION)
-        for reason in ("full", "deadline", "bucket"):
+        for reason in MEGABATCH_FLUSH_REASONS:
             self.registry.counter(MEGABATCH_FLUSH).inc(
                 {"reason": reason}, value=0.0)
+        # a meshed scheduler degrading a would-be sharded megabatch to
+        # serial dispatches logs once per process (the metric carries the
+        # ongoing count; the log explains the first occurrence)
+        self._mesh_serial_logged = False  # guarded-by: _cold_lock
         # warm-start delta series exist before the first solve_delta call
         from .warmstart import zero_init_metrics as _ws_zero_init
 
@@ -541,8 +601,15 @@ class BatchScheduler:
             unavailable=unavailable,
         )
 
+    #: capability probe for SolvePipeline._flush: this scheduler's
+    #: submit_many accepts flush_reason= and owns the MEGABATCH_FLUSH
+    #: count for the flush (facades/test doubles without it keep the
+    #: pipeline-side upfront count)
+    counts_flush_reason = True
+
     def submit_many(
         self, requests: Sequence[dict],
+        flush_reason: Optional[str] = None,
     ) -> List["PendingScheduleResult"]:
         """Cross-request megabatch entry (service/server.py SolvePipeline's
         coalescer flushes here): each request is a kwargs dict (``pods``,
@@ -554,11 +621,17 @@ class BatchScheduler:
         Returns per-request handles IN ORDER — ``result()`` runs that
         request's own epilogues (relaxation ladder, residue waves, reseat)
         against its own result only; requests share nothing but the device
-        dispatch.  Same single-thread contract as :meth:`submit`."""
+        dispatch.  Same single-thread contract as :meth:`submit`.
+        ``flush_reason`` (the pipeline's coalescer reason) transfers the
+        MEGABATCH_FLUSH count here: the flush incs exactly one reason —
+        "mesh_serial" when a meshed flush degraded to serial, else
+        ``flush_reason`` — keeping the labels a partition of flushes."""
         guarded = self.backend == "auto" and self._guard.enabled
         collector = _MegaCollector(
             self._tpu, guard=self._guard if guarded else None,
-            registry=self.registry, warm=self._warm_mega,
+            registry=self.registry, warm=self._warm_mega, mesh=self.mesh,
+            on_mesh_serial=self._note_mesh_serial,
+            flush_reason=flush_reason,
         )
         self._mega_collect = collector
         try:
@@ -576,17 +649,58 @@ class BatchScheduler:
         collector.dispatch()
         return pendings
 
+    def _note_mesh_serial(self, detail: str, count: bool = True) -> None:
+        """A mesh-configured scheduler served (or will serve) a would-be
+        sharded megabatch serially: count it so meshed-serving degradation
+        is visible (the acceptance dashboards watch this stay near zero),
+        log the first occurrence with the why.  ``count=False`` logs only —
+        used when the count is owned elsewhere: bucket_key's per-REQUEST
+        unshardable-mesh rejections are counted in FLUSH units by the
+        pipeline (each None key resolves into its own single-request
+        serial flush), and a pipeline-owned submit_many flush
+        (flush_reason=) counts once at collector dispatch — counting here
+        too would double-count and mix units with the per-flush
+        full/deadline/bucket reasons."""
+        if count:
+            self.registry.counter(MEGABATCH_FLUSH).inc(
+                {"reason": "mesh_serial"})
+        # ktlint: allow[KT004] deliberate lock-free fast path: bucket_key
+        # calls this per queued request on unshardable-mesh schedulers —
+        # after the first log there is nothing left to do, and taking
+        # _cold_lock here would contend with cold-compile bookkeeping on
+        # the dispatcher's hot path (the flag only ever flips False→True
+        # under the lock below; a stale read costs one duplicate log)
+        if self._mesh_serial_logged:
+            return
+        with self._cold_lock:
+            first = not self._mesh_serial_logged
+            self._mesh_serial_logged = True
+        if first:
+            logger.info(
+                "meshed scheduler served a megabatch flush serially (%s); "
+                "counted under karpenter_solver_megabatch_flush_total"
+                "{reason=\"mesh_serial\"}", detail)
+
     def bucket_key(self, kwargs: dict) -> Optional[tuple]:
         """Megabatch shape bucket of one queued solve request, or None when
         it cannot ride a megabatch (non-device backend, oracle routing,
-        device carve-outs, cold shape, unhealthy device, cache disabled).
+        device carve-outs, cold shape, unhealthy device, cache disabled,
+        or a mesh whose device count exceeds the slot-rung ladder).
+        Meshed schedulers bucket like single-device ones since the sharded
+        megabatch round — the key carries the mesh signature, so requests
+        against different meshes can never coalesce.
         Pipeline-dispatcher-only, like submit: the tensorize it performs
         lands in the cache, so the real solve's tensorize is a hit."""
         if self.backend not in ("auto", "tpu"):
             return None
-        if self.mesh is not None:
-            return None  # megabatch programs are single-device; a meshed
-            # scheduler must keep its sharded single-solve path
+        if not mesh_shardable(self.mesh):
+            # the slot axis cannot pad to one-slot-per-chip on this mesh;
+            # the request keeps the sharded single-solve path (log only —
+            # the pipeline counts the resulting single-request flush)
+            self._note_mesh_serial(
+                f"{_mesh_size(self.mesh)}-device mesh exceeds the "
+                f"{MEGA_MAX_SLOTS}-slot rung ladder", count=False)
+            return None
         if self._tensorize_cache is None:
             return None  # bucketing leans on cached tensorize; without it
             # the probe would pay a full host build per queued request
@@ -618,6 +732,7 @@ class BatchScheduler:
                 return None  # cold shapes keep the compile-behind path
             return self._tpu.mega_signature(
                 st, existing_nodes=existing, max_nodes=max_slots, slots=1,
+                mesh=self.mesh,
             )
         # ktlint: allow[KT005] the bucket probe must never fail a request —
         # an unbucketable request just solves on the classic single path,
@@ -629,14 +744,15 @@ class BatchScheduler:
 
     def _warm_mega(self, entry: dict, slots: int) -> None:
         """Background-compile the megabatch program for a bucket whose flush
-        just fell back to serial dispatches (cold slot rung)."""
+        just fell back to serial dispatches (cold slot rung) — the SHARDED
+        rung program for a meshed scheduler."""
         if not self.compile_behind or not self._guard.healthy:
             return
         started = self._tpu.warm_async(
             entry["st"],
             existing_nodes=[n.snapshot() for n in entry["existing_nodes"]],
             max_nodes=entry["max_nodes"], slots=max(2, slots),
-            on_done=self._warm_done,
+            mesh=self.mesh, on_done=self._warm_done,
         )
         if started:
             self.registry.gauge(SOLVER_COMPILE_IN_PROGRESS).set(
@@ -1211,7 +1327,12 @@ class BatchScheduler:
             existing_nodes=existing_nodes, profiles=profiles,
         )
         if (self.backend in ("auto", "tpu") and self.compile_behind
-                and self._guard.healthy and self.mesh is None):
+                and self._guard.healthy and mesh_shardable(self.mesh)):
+            # meshed schedulers warm the SHARDED rung ladder: warm_async
+            # resolves each requested slot count to its sharded rung (floor
+            # = device count), and signature dedupe collapses requests that
+            # land on the same rung — the default (2, 4, 8) grid on an
+            # 8-device mesh warms exactly the 8-slot sharded program
             rungs = sorted({
                 s for s in (mega_slots or self.WARM_MEGA_SLOTS)
                 if 2 <= s <= MEGA_MAX_SLOTS
@@ -1221,7 +1342,7 @@ class BatchScheduler:
                 for s in rungs:
                     if self._tpu.warm_async(
                         st, existing_nodes=existing_nodes, slots=s,
-                        on_done=self._warm_done,
+                        mesh=self.mesh, on_done=self._warm_done,
                     ):
                         started += 1
         if wait and started:
@@ -1579,11 +1700,11 @@ class BatchScheduler:
         raise_on_exhaust = self.backend == "auto" and self.compile_behind
 
         collector = self._mega_collect
-        if (dispatch and not degraded and collector is not None
-                and self.mesh is None):
+        if dispatch and not degraded and collector is not None:
             # megabatch registration (submit_many): the first device wave
             # joins the collector's pending batch instead of dispatching;
-            # ONE vmapped device call later serves every slot.  The fallback
+            # ONE vmapped device call later serves every slot (SHARDED over
+            # the mesh's chips for a meshed scheduler).  The fallback
             # ladder at fence time is identical to the single async path —
             # per REQUEST, so one exhausted/hung slot degrades itself only.
             slot = collector.add(

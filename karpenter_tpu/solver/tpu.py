@@ -887,15 +887,70 @@ def _run_scan(consts, init, NR: int, Z: int, track: bool):
 MEGA_MAX_SLOTS = 32
 
 
-def _mega_rung(n: int) -> int:
+def _mega_rung(n: int, n_dev: int = 1) -> int:
     """Pad the request-slot axis to a power-of-two rung (1,2,4,...,32): the
     megabatch kernel compiles per (dims, B) signature, so bucketing B keeps
     the compile ladder log-bounded and AOT-precompilable, exactly like the
-    tensor-axis rungs of :func:`_rung`."""
-    r = 1
-    while r < min(max(1, n), MEGA_MAX_SLOTS):
+    tensor-axis rungs of :func:`_rung`.
+
+    ``n_dev`` > 1 is the SHARDED megabatch (slot axis data-parallel over the
+    flattened mesh — parallel/mesh.py slot_mesh): the rung ladder floors at
+    the device count and doubles from there (8 devices -> 8, 16, 32), so the
+    slot axis always divides evenly over the chips and every rung keeps the
+    whole mesh lit — a 3-slot flush on an 8-chip mesh pads to 8 (padding
+    slots replicate request 0 and are discarded; idle chips would cost the
+    same wall time and serve nothing).  The result never exceeds
+    MEGA_MAX_SLOTS: a non-power-of-two device count whose next double would
+    cross the cap stops at its largest in-ladder rung (24 devices -> {24},
+    6 -> {6, 12, 24}) — callers cap their flush size at that rung
+    (:func:`max_mega_slots`), so no off-ladder program is ever compiled."""
+    r = max(1, n_dev)
+    while r < min(max(1, n), MEGA_MAX_SLOTS) and r * 2 <= MEGA_MAX_SLOTS:
         r *= 2
     return r
+
+
+def max_mega_slots(mesh) -> int:
+    """Largest megabatch flush this mesh can serve on the sharded rung
+    ladder (= MEGA_MAX_SLOTS when unmeshed or the devices divide it evenly;
+    smaller for awkward device counts — 24 chips cap flushes at 24), or 0
+    for an unshardable mesh (device count past the ladder): no sharded
+    megabatch program exists to size a flush for, and returning the raw
+    device count would let a trusting caller build a flush that
+    solve_many_async can only reject."""
+    if not mesh_shardable(mesh):
+        return 0
+    return _mega_rung(MEGA_MAX_SLOTS, _mesh_size(mesh))
+
+
+def _mesh_size(mesh) -> int:
+    return 1 if mesh is None else int(mesh.devices.size)
+
+
+def _mega_key_tail(slots: int, zone_key: int, ct_key: int, mesh) -> tuple:
+    """The megabatch compile-key suffix: slot rung + zone/ct vocab
+    positions (+ the mesh fingerprint when sharded).  The SINGLE source of
+    this format — ``mega_signature``, ``_dispatch_prepared`` and the
+    consolidation sweep's ``sweep_signature`` all append exactly this, so
+    readiness/warm bookkeeping can never drift from what dispatch keys."""
+    tail = (
+        ("mega_slots", _mega_rung(slots, _mesh_size(mesh))),
+        ("zk", zone_key),
+        ("ck", ct_key),
+    )
+    if mesh is not None:
+        from ..parallel.mesh import mesh_signature
+
+        tail += (("mesh", mesh_signature(mesh)),)
+    return tail
+
+
+def mesh_shardable(mesh) -> bool:
+    """True when the megabatch slot axis can shard over ``mesh``: the
+    device count must fit inside the slot-rung ladder (a 64-chip mesh
+    cannot pad a <=32-slot batch to one slot per chip — such schedulers
+    keep the sharded single-solve path and count mesh_serial flushes)."""
+    return _mesh_size(mesh) <= MEGA_MAX_SLOTS
 
 
 @partial(jax.jit, static_argnames=("NR", "Z", "track", "zone_key", "ct_key"))
@@ -910,7 +965,15 @@ def _run_scan_many(consts_b, feas_b, init_b, NR: int, Z: int, track: bool,
     function of that slot's inputs (tests/test_megabatch.py pins per-request
     byte parity with serial solves and adversarial cross-tenant isolation).
     Feasibility runs inside the program (not eagerly per request) so the
-    whole megabatch costs one dispatch + one fence."""
+    whole megabatch costs one dispatch + one fence.
+
+    SHARDED megabatches need no kernel change: when the caller commits the
+    stacked inputs with the slot-axis sharding (``_dispatch_prepared``
+    with a mesh — dim 0 one-slot-per-chip, parallel/mesh.py slot_mesh),
+    GSPMD partitions this very program on the batch dimension; the
+    independence argument above is also why the partitioning introduces
+    zero collectives (tests/test_megabatch_sharded.py pins parity and the
+    every-chip placement)."""
 
     def one(consts, feas, init):
         F, dom_ok = compute_feasibility(
@@ -1083,20 +1146,27 @@ class TpuSolver:
         max_nodes: Optional[int] = None,
         track_assignments: bool = True,
         slots: int = 2,
+        mesh=None,
     ) -> tuple:
         """Compile signature of the megabatch program that would serve a
         ``slots``-request batch of this shape: the single-solve dims key plus
         the padded request-slot rung and the vocab positions of the zone/ct
         keys (static args of the vmapped kernel — two catalogs interning the
-        keys differently are different programs AND different buckets)."""
+        keys differently are different programs AND different buckets).
+
+        ``mesh`` is the SHARDED megabatch: per-slot dims stay the
+        single-device ones (each slot runs whole on one chip — the slot
+        axis, not the tensor axes, is what shards), the slot rung floors at
+        the device count, and the mesh's (axis, size) fingerprint joins the
+        key — the partitioned program is a different XLA binary AND a
+        different coalescer bucket than the single-device one."""
         base = self.signature(
             st, existing_nodes=existing_nodes, max_nodes=max_nodes,
             track_assignments=track_assignments,
         )
-        return base + (
-            ("mega_slots", _mega_rung(slots)),
-            ("zk", st.vocab.key_id[L.ZONE]),
-            ("ck", st.vocab.key_id[L.CAPACITY_TYPE]),
+        return base + _mega_key_tail(
+            slots, st.vocab.key_id[L.ZONE], st.vocab.key_id[L.CAPACITY_TYPE],
+            mesh,
         )
 
     def ready(self, sig: tuple) -> bool:
@@ -1160,12 +1230,12 @@ class TpuSolver:
         failure backoff, or the queue is full.  ``on_done(sig, seconds,
         error)`` fires from the worker thread when the warm ends.
         ``slots`` > 1 warms the MEGABATCH program at that request-slot rung
-        instead of the single-solve program (mesh must be None)."""
+        instead of the single-solve program; with ``mesh`` that is the
+        SHARDED megabatch program (slot axis over the flattened mesh)."""
         if slots and slots > 1:
-            assert mesh is None, "megabatch programs are single-device"
             sig = self.mega_signature(
                 st, existing_nodes=existing_nodes, max_nodes=max_nodes,
-                track_assignments=track_assignments, slots=slots,
+                track_assignments=track_assignments, slots=slots, mesh=mesh,
             )
         else:
             slots = None
@@ -1226,8 +1296,10 @@ class TpuSolver:
                 elif slots:
                     # megabatch warm: one request padded up to the slot rung
                     # compiles exactly the program a full batch will run
-                    kwargs.pop("mesh", None)
-                    outs = self.solve_many([dict(kwargs)], min_slots=slots)
+                    # (with a mesh, the SHARDED rung program)
+                    warm_mesh = kwargs.pop("mesh", None)
+                    outs = self.solve_many([dict(kwargs)], min_slots=slots,
+                                           mesh=warm_mesh)
                     if isinstance(outs[0], Exception):
                         raise outs[0]
                 else:
@@ -1474,12 +1546,13 @@ class TpuSolver:
 
         if mesh is not None:
             from ..parallel.distributed import put_sharded
-            from ..parallel.mesh import POD_AXIS, TYPE_AXIS
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..parallel.mesh import POD_AXIS, TYPE_AXIS, axis_sharding
 
-            sg = NamedSharding(mesh, P(POD_AXIS))      # group axis
-            sc = NamedSharding(mesh, P(TYPE_AXIS))     # candidate axis
-            sr = NamedSharding(mesh, P())              # replicated
+            # cached construction (parallel/mesh.py): sharding objects are
+            # built once per (mesh, spec), not once per solve (KT011)
+            sg = axis_sharding(mesh, POD_AXIS)     # group axis
+            sc = axis_sharding(mesh, TYPE_AXIS)    # candidate axis
+            sr = axis_sharding(mesh)               # replicated
             place = {
                 "counts": sg, "requests": sg, "suffix_res": sg,
                 "suffix_cnt": sg,
@@ -1537,11 +1610,10 @@ class TpuSolver:
         init = tuple(jnp.asarray(v) for v in np_init)
         if mesh is not None:
             from ..parallel.distributed import put_sharded
-            from ..parallel.mesh import POD_AXIS
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..parallel.mesh import POD_AXIS, axis_sharding
 
-            sn = NamedSharding(mesh, P(POD_AXIS))   # node-slot axis
-            sr = NamedSharding(mesh, P())
+            sn = axis_sharding(mesh, POD_AXIS)   # node-slot axis
+            sr = axis_sharding(mesh)
             shardings = (sn, sn, sn, sn, sn, sn, sn, sr, sr, sr, sr, sr)
             init = tuple(put_sharded(a, s) for a, s in zip(init, shardings))
 
@@ -1737,6 +1809,7 @@ class TpuSolver:
         requests: Sequence[dict],
         *,
         min_slots: Optional[int] = None,
+        mesh=None,
     ) -> "PendingMegaSolve":
         """Dispatch B independent, signature-compatible solve requests as
         ONE vmapped device program over padded request slots, WITHOUT
@@ -1754,7 +1827,14 @@ class TpuSolver:
         warm path compiles the full-batch program from one request); padding
         slots replicate request 0 and their outputs are discarded — vmap
         slots are independent by construction, so padding can never leak
-        into a real request's result."""
+        into a real request's result.
+
+        ``mesh`` serves the batch SHARDED: the slot axis becomes a
+        data-parallel dimension over the flattened mesh (one slot per chip,
+        parallel/mesh.py slot_mesh), so a mesh-configured scheduler's
+        coalesced flush lights every device — still ONE dispatch and ONE
+        batch-wide fence.  Per-slot programs are the single-device ones
+        (results byte-identical to unmeshed serial solves)."""
         assert requests, "empty megabatch"
         if len(requests) > MEGA_MAX_SLOTS:
             # a silent truncation would compile at shape B while marking the
@@ -1800,13 +1880,14 @@ class TpuSolver:
             ))
         return self._dispatch_prepared(entries, n_slots=n_slots, track=track,
                                        zone_key=zone_key, ct_key=ct_key,
-                                       t0=t0)
+                                       t0=t0, mesh=mesh)
 
     def solve_many_prepared(
         self,
         entries: Sequence[dict],
         *,
         min_slots: Optional[int] = None,
+        mesh=None,
     ) -> "PendingMegaSolve":
         """Dispatch PRE-BUILT megabatch entries as one vmapped device
         program, without fencing — the consolidation sweep's entry point:
@@ -1832,12 +1913,12 @@ class TpuSolver:
             entries, n_slots=max(len(entries), min_slots or 1),
             track=r0["track_assignments"],
             zone_key=st0.vocab.key_id[L.ZONE],
-            ct_key=st0.vocab.key_id[L.CAPACITY_TYPE], t0=t0,
+            ct_key=st0.vocab.key_id[L.CAPACITY_TYPE], t0=t0, mesh=mesh,
         )
 
     def _dispatch_prepared(
         self, entries, *, n_slots: int, track: bool, zone_key: int,
-        ct_key: int, t0: float,
+        ct_key: int, t0: float, mesh=None,
     ) -> "PendingMegaSolve":
         """Stack + dispatch prepared entries (shared by the request path and
         :meth:`solve_many_prepared`); validates the one-bucket invariant."""
@@ -1854,14 +1935,41 @@ class TpuSolver:
             # never an opaque crash fanned to every RPC in the batch
             raise MegaBucketMismatch("requests span megabatch buckets")
         NR, Z = dims0["NR"], dims0["Z"]
-        mega_key = _dims_key(dims0) + (
-            ("mega_slots", _mega_rung(n_slots)),
-            ("zk", zone_key), ("ck", ct_key),
-        )
+        n_dev = _mesh_size(mesh)
+        if not mesh_shardable(mesh):
+            # padding one-slot-per-chip would compile a program past the
+            # rung ladder; the scheduler gates these meshes onto the serial
+            # path (mesh_serial), so only a direct caller can land here
+            raise MegaBucketMismatch(
+                f"{n_dev}-device mesh exceeds MEGA_MAX_SLOTS="
+                f"{MEGA_MAX_SLOTS}; sharded megabatch unavailable")
+        mega_key = _dims_key(dims0) + _mega_key_tail(
+            n_slots, zone_key, ct_key, mesh)
 
         B = len(entries)
-        B_pad = _mega_rung(n_slots)
+        B_pad = _mega_rung(n_slots, n_dev)
+        if B > B_pad:
+            # an awkward device count's largest in-ladder rung can sit
+            # below the caller's flush size (24 chips cap at 24 slots) —
+            # a mis-sized flush must degrade to serial, not under-pad
+            raise MegaBucketMismatch(
+                f"{B} entries exceed the {B_pad}-slot sharded rung of a "
+                f"{n_dev}-device mesh")
         padded = entries + [entries[0]] * (B_pad - B)
+
+        if mesh is not None:
+            # sharded megabatch: the slot axis (dim 0 of every stacked
+            # array) shards one-slot-per-chip over the flattened mesh
+            # (parallel/mesh.py slot_mesh); trailing axes replicate, so a
+            # slot's feasibility+scan run entirely on its own device — the
+            # jitted kernel partitions from this input placement alone, no
+            # cross-slot collectives by construction.  put_sharded keeps
+            # the multi-process case honest (each host contributes only
+            # its addressable — contiguous, host-major — slot shards).
+            from ..parallel.distributed import put_sharded
+            from ..parallel.mesh import slot_sharding
+
+            slot_sh = slot_sharding(mesh)
 
         def _stack(vals):
             # slots built from one shared base (the consolidation sweep)
@@ -1871,9 +1979,12 @@ class TpuSolver:
             first = vals[0]
             if all(v is first for v in vals[1:]):
                 arr = np.asarray(first)
-                return jnp.asarray(
-                    np.broadcast_to(arr, (len(vals),) + arr.shape))
-            return jnp.asarray(np.stack(vals))
+                out = np.broadcast_to(arr, (len(vals),) + arr.shape)
+            else:
+                out = np.stack(vals)
+            if mesh is not None:
+                return put_sharded(out, slot_sh)
+            return jnp.asarray(out)
 
         consts_b = {
             k: _stack([e["np_consts"][k] for e in padded])
@@ -1899,7 +2010,7 @@ class TpuSolver:
         return PendingMegaSolve(
             solver=self, entries=entries, carry_b=carry_b, ys_b=ys_b,
             t0=t0, t_starts=t_starts, track=track, B=B, B_pad=B_pad,
-            mega_key=mega_key,
+            mega_key=mega_key, mesh=mesh,
         )
 
     def solve_many(
@@ -1907,6 +2018,7 @@ class TpuSolver:
         requests: Sequence[dict],
         *,
         min_slots: Optional[int] = None,
+        mesh=None,
     ) -> List[object]:
         """Synchronous megabatch: :meth:`solve_many_async` + the one
         batch-wide fence.  Returns one entry per request IN ORDER: a
@@ -1918,7 +2030,8 @@ class TpuSolver:
         SolvePipeline does)."""
         if not requests:
             return []
-        return self.solve_many_async(requests, min_slots=min_slots).results()
+        return self.solve_many_async(
+            requests, min_slots=min_slots, mesh=mesh).results()
 
     def solve_delta(
         self,
@@ -2158,7 +2271,7 @@ class PendingMegaSolve:
     ``solve_many``."""
 
     def __init__(self, solver, entries, carry_b, ys_b, t0, t_starts, track,
-                 B, B_pad, mega_key) -> None:
+                 B, B_pad, mega_key, mesh=None) -> None:
         self.solver = solver
         self.entries = entries
         self.carry_b = carry_b
@@ -2169,6 +2282,11 @@ class PendingMegaSolve:
         self.B = B
         self.B_pad = B_pad
         self.mega_key = mega_key
+        #: the dispatch's mesh: the per-slot exhausted retry must re-solve
+        #: on the MESHED full-budget program (the only one the meshed warm
+        #: ladder covers), like the sibling retry sites in solve() and
+        #: PendingTpuSolve
+        self.mesh = mesh
         self._outputs: Optional[List[object]] = None
 
     # ktlint: fence the megabatch handle's one D2H read completes ALL
@@ -2201,7 +2319,7 @@ class PendingMegaSolve:
                         r["st"], existing_nodes=r["existing_nodes"],
                         max_nodes=r["max_nodes"],
                         track_assignments=r["track_assignments"],
-                        full_nr=True,
+                        mesh=self.mesh, full_nr=True,
                     ),
                 )
             # ktlint: allow[KT005] per-slot boxed outcome: the exhausted

@@ -192,6 +192,46 @@ def test_admission_overhead_flagged():
     assert any("admission budget" in f for f in flags)
 
 
+# --- ISSUE 7: sharded (meshed) megabatch gates -----------------------------
+
+
+SHARDED_OK = {
+    "sharded_devices": 8,
+    "sharded_serial_per_sec": 2.5,
+    "sharded_mega_per_sec": 39.8,
+    "sharded_megabatch_speedup": 15.9,
+    "sharded_single_latency_ratio": 0.95,
+    "sharded_batch_occupancy": 8.0,
+}
+
+
+def test_sharded_budgets_clean():
+    assert benchmod.check_budgets(dict(SHARDED_OK)) == {}
+
+
+def test_sharded_megabatch_not_beating_serial_flagged():
+    # the acceptance bar: meshed megabatch must be STRICTLY above the
+    # meshed serial baseline (<=1.0 means the unlock regressed away)
+    rec = dict(SHARDED_OK, sharded_megabatch_speedup=0.97)
+    flags = benchmod.check_budgets(rec)["budget_flags"]
+    assert any("meshed serial baseline" in f for f in flags)
+    rec = dict(SHARDED_OK, sharded_megabatch_speedup=1.0)
+    assert any("meshed serial baseline" in f
+               for f in benchmod.check_budgets(rec)["budget_flags"])
+
+
+def test_sharded_single_latency_tax_flagged():
+    rec = dict(SHARDED_OK, sharded_single_latency_ratio=1.2)
+    flags = benchmod.check_budgets(rec)["budget_flags"]
+    assert any("meshed single-request latency" in f for f in flags)
+
+
+def test_sharded_phase_missing_not_flagged():
+    # a host that cannot run the 8-device subprocess reports sharded_error
+    # and no gate keys — absent keys must not fail other rounds' budgets
+    assert benchmod.check_budgets({"sharded_error": "rc=1: boom"}) == {}
+
+
 # --- ISSUE 5 satellite: backend-probe verdict cache ------------------------
 
 

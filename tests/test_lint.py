@@ -862,3 +862,100 @@ class TestKT010LoopOfDispatch:
                 self._simulate([ns])  # ktlint: allow[KT010]
         """
         assert "KT000" in rules_of(lint(src, self.CTRL))
+
+
+class TestKT011ShardingConstruction:
+    HOT = "karpenter_tpu/solver/newdispatch.py"
+
+    def test_named_sharding_inside_function_fires(self):
+        src = """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def dispatch(mesh, arrays):
+            sh = NamedSharding(mesh, P("slots"))
+            return [a for a in arrays]
+        """
+        findings = lint(src, self.HOT)
+        assert rules_of(findings) == ["KT011"]
+        assert "NamedSharding" in findings[0].message
+
+    def test_mesh_construction_inside_function_fires(self):
+        src = """
+        from jax.sharding import Mesh
+
+        def flush(devices):
+            return Mesh(devices, ("slots",))
+        """
+        assert rules_of(lint(src, self.HOT)) == ["KT011"]
+
+    def test_raw_device_put_fires(self):
+        src = """
+        import jax
+
+        def stack(vals, sh):
+            return jax.device_put(vals, sh)
+        """
+        findings = lint(src, self.HOT)
+        assert rules_of(findings) == ["KT011"]
+        assert "device_put" in findings[0].message
+
+    def test_nested_closure_walks_with_enclosing(self):
+        src = """
+        import jax
+
+        def dispatch(mesh, vals, sh):
+            def stack(v):
+                return jax.device_put(v, sh)
+            return [stack(v) for v in vals]
+        """
+        assert rules_of(lint(src, self.HOT)) == ["KT011"]
+
+    def test_module_level_layout_is_clean(self):
+        src = """
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        MESH = Mesh(jax.devices(), ("slots",))
+        SHARDING = NamedSharding(MESH, P("slots"))
+        """
+        assert lint(src, self.HOT) == []
+
+    def test_parallel_factories_are_clean(self):
+        src = """
+        from karpenter_tpu.parallel.distributed import put_sharded
+        from karpenter_tpu.parallel.mesh import slot_sharding
+
+        def dispatch(mesh, vals):
+            sh = slot_sharding(mesh)
+            return [put_sharded(v, sh) for v in vals]
+        """
+        assert lint(src, self.HOT) == []
+
+    def test_parallel_package_out_of_scope(self):
+        # the sanctioned construction home: the cached factories themselves
+        src = """
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def slot_mesh(mesh):
+            return Mesh(mesh.devices.reshape(-1), ("slots",))
+        """
+        assert lint(src, "karpenter_tpu/parallel/mesh.py") == []
+
+    def test_batcher_in_scope(self):
+        src = """
+        import jax
+
+        def coalesce(vals, sh):
+            return jax.device_put(vals, sh)
+        """
+        assert rules_of(lint(src, "karpenter_tpu/batcher.py")) == ["KT011"]
+
+    def test_suppression_with_reason(self):
+        src = """
+        import jax
+
+        def measure(args, res_i):
+            # ktlint: allow[KT011] benchmark-only perturbed re-placement
+            return (jax.device_put(res_i),) + args[1:]
+        """
+        assert lint(src, self.HOT) == []

@@ -1,0 +1,569 @@
+"""Sharded cross-request megabatching (ISSUE 7): the megabatch slot axis
+composed with the pods/types mesh — a mesh-configured scheduler serves
+coalesced flushes at full chip count.
+
+Five surfaces, all over the 8-device virtual CPU mesh conftest forces (the
+same GSPMD-partitioned programs a real multi-chip host runs):
+
+1. **Per-slot parity** — every slot of a sharded megabatch is byte-identical
+   (node plans, assignments, infeasible, cost) to the same request solved
+   serially on a single device; padding slots (B below the sharded rung)
+   never leak, and the dispatch provably lights every chip.
+2. **Boxed per-slot exceptions across shards** — one slot's SlotsExhausted
+   comes back in its own slot while batchmates on other devices resolve.
+3. **Meshed scheduler wiring** — submit_many on a mesh-configured scheduler
+   rides ONE sharded vmapped dispatch (parity vs single-device serial
+   solves); a cold sharded rung falls back to the sharded SINGLE program
+   per request, warms the sharded rung behind, and counts
+   megabatch_flush_total{reason="mesh_serial"}.
+4. **Pipeline + metrics** — SolvePipeline floors max_slots at the mesh's
+   device count; an unshardable mesh buckets to None (serial) and counts;
+   the mesh_serial series exists at 0 from construction (KT003).
+5. **Precompile + sweep composition** — precompile_buckets on a meshed
+   scheduler targets the SHARDED mega rungs; a meshed consolidation sweep
+   warms the SHARDED sweep program instead of gating off the batch path.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.metrics import (
+    MEGABATCH_FLUSH,
+    MEGABATCH_FLUSH_REASONS,
+    MEGABATCH_SLOTS,
+    Registry,
+)
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.instancetype import GIB
+from karpenter_tpu.models.pod import (
+    LabelSelector,
+    PodSpec,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.models.tensorize import tensorize
+from karpenter_tpu.parallel.mesh import make_mesh, mesh_signature
+from karpenter_tpu.solver.scheduler import BatchScheduler
+from karpenter_tpu.solver.tpu import (
+    MEGA_MAX_SLOTS,
+    SlotsExhausted,
+    TpuSolver,
+    _mega_rung,
+    mesh_shardable,
+)
+from karpenter_tpu.solver.types import SimNode, SolveResult
+
+TENANTS = ("acme", "bravo", "cyan", "delta")
+
+
+def tenant_batch(tenant: str, n_groups: int = 4, per: int = 10):
+    """Same-shape, disjoint-content tenant batches (one compile bucket) —
+    mirrors tests/test_megabatch.py so full-suite runs share the jit cache."""
+    shift = sum(ord(c) for c in tenant) % 5
+    pods = []
+    for gi in range(n_groups):
+        sel = LabelSelector.of({"app": f"{tenant}-g{gi}"})
+        tsc = [TopologySpreadConstraint(1, L.ZONE, "DoNotSchedule", sel)]
+        for i in range(per):
+            pods.append(PodSpec(
+                name=f"{tenant}-g{gi}-{i}", labels={"app": f"{tenant}-g{gi}"},
+                requests={"cpu": 0.25 * (1 + (gi + shift) % 6),
+                          "memory": float(1 + (gi + shift) % 3) * GIB},
+                topology_spread=list(tsc),
+                owner_key=f"{tenant}-g{gi}",
+            ))
+    return pods
+
+
+def plan(result: SolveResult):
+    return sorted(
+        (n.instance_type, n.zone, n.capacity_type, round(n.price, 6),
+         tuple(sorted(p.name for p in n.pods)))
+        for n in result.nodes
+    )
+
+
+def assert_same_solve(a: SolveResult, b: SolveResult):
+    assert plan(a) == plan(b)
+    assert a.infeasible == b.infeasible
+    assert set(a.assignments) == set(b.assignments)
+    assert abs(a.new_node_cost - b.new_node_cost) < 1e-9
+
+
+@pytest.fixture(scope="module")
+def sharded_env(small_catalog):
+    """One solver + the module's three compiled programs: the single-device
+    solve, and the SHARDED 8-slot megabatch over the (4, 2) mesh — built
+    once so every test here reuses them."""
+    provs = [Provisioner(name="default").with_defaults()]
+    mesh = make_mesh(8)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "pods": 4, "types": 2}
+    solver = TpuSolver()
+    sts = {t: tensorize(tenant_batch(t), provs, small_catalog)
+           for t in TENANTS}
+    assert len({solver.signature(st) for st in sts.values()}) == 1
+    # single-device serial references (the byte-parity baseline)
+    solos = {t: solver.solve(sts[t]) for t in TENANTS}
+    # the sharded dispatch: 4 real slots pad to the 8-slot sharded rung
+    pending = solver.solve_many_async(
+        [dict(st=sts[t]) for t in TENANTS], min_slots=8, mesh=mesh)
+    device_ids = sorted(d.id for d in pending.carry_b[7].sharding.device_set)
+    outs = pending.results()
+    return dict(mesh=mesh, provs=provs, solver=solver, sts=sts,
+                solos=solos, outs=outs, device_ids=device_ids)
+
+
+class TestShardedParity:
+    def test_per_slot_parity_and_padding_isolation(self, sharded_env):
+        """4 real slots pad to the 8-slot sharded rung: slots 4-7 are
+        padding replicas of slot 0 whose outputs are discarded — per-slot
+        byte parity with the single-device serial solves proves both the
+        sharding and the padding leaked nothing."""
+        assert _mega_rung(4, 8) == 8
+        for t, out in zip(TENANTS, sharded_env["outs"]):
+            assert not isinstance(out, Exception), (t, out)
+            assert_same_solve(out.result, sharded_env["solos"][t].result)
+
+    def test_every_chip_lit(self, sharded_env):
+        """The dispatched carry is sharded over ALL 8 devices — the whole
+        point of the round: one flush, every chip."""
+        assert sharded_env["device_ids"] == list(range(8))
+
+    def test_tenant_isolation_across_shards(self, sharded_env):
+        """Slots live on different devices; a slot's result references only
+        its own tenant's pods."""
+        for t, out in zip(TENANTS, sharded_env["outs"]):
+            names = set(out.result.assignments) | set(out.result.infeasible)
+            assert names and not {n for n in names
+                                  if not n.startswith(f"{t}-")}
+
+    def test_sharded_signature_mesh_keyed_and_ready(self, sharded_env):
+        solver, mesh = sharded_env["solver"], sharded_env["mesh"]
+        st = sharded_env["sts"]["acme"]
+        sig = solver.mega_signature(st, slots=4, mesh=mesh)
+        assert ("mesh", mesh_signature(mesh)) in sig
+        assert dict(kv for kv in sig if isinstance(kv, tuple)
+                    and kv[0] == "mega_slots")["mega_slots"] == 8
+        assert solver.ready(sig)  # compiled by the fixture dispatch
+        # the single-device signature is a DIFFERENT bucket
+        assert sig != solver.mega_signature(st, slots=4)
+
+    def test_rung_floors_at_device_count(self):
+        assert _mega_rung(1, 8) == 8
+        assert _mega_rung(3, 8) == 8
+        assert _mega_rung(9, 8) == 16
+        assert _mega_rung(20, 8) == 32
+        assert _mega_rung(3, 1) == 4  # unmeshed ladder unchanged
+        assert mesh_shardable(None)
+
+    def test_boxed_slot_exception_crosses_shard_boundary(
+            self, sharded_env, monkeypatch):
+        """One slot's SlotsExhausted (raised under the compile-behind
+        contract at fence time) is boxed into ITS slot; batchmates on the
+        other devices still resolve byte-identically."""
+        solver, mesh, sts = (sharded_env["solver"], sharded_env["mesh"],
+                             sharded_env["sts"])
+        orig = solver._maybe_retry_exhausted
+
+        def fake(carry, est_dims, full_dims, full_nr, raise_on_exhaust,
+                 retry):
+            if raise_on_exhaust:
+                raise SlotsExhausted(("injected",))
+            return orig(carry, est_dims, full_dims, full_nr,
+                        raise_on_exhaust, retry)
+
+        monkeypatch.setattr(solver, "_maybe_retry_exhausted", fake)
+        reqs = [dict(st=sts[t], raise_on_exhaust=(t == "bravo"))
+                for t in TENANTS]
+        outs = solver.solve_many(reqs, min_slots=8, mesh=mesh)
+        assert isinstance(outs[1], SlotsExhausted)
+        for i, t in enumerate(TENANTS):
+            if t == "bravo":
+                continue
+            assert not isinstance(outs[i], Exception), (t, outs[i])
+            assert_same_solve(outs[i].result, sharded_env["solos"][t].result)
+
+
+class TestMeshedScheduler:
+    def test_submit_many_rides_sharded_megabatch(self, sharded_env,
+                                                 small_catalog):
+        """The acceptance path: a mesh-configured scheduler serves a 4-slot
+        flush through ONE sharded vmapped dispatch, per-request results
+        byte-identical to single-device serial solves, zero mesh_serial."""
+        reg = Registry()
+        sched = BatchScheduler(backend="tpu", registry=reg,
+                               mesh=sharded_env["mesh"])
+        sched._tpu = sharded_env["solver"]  # reuse the warm sharded program
+        serial = BatchScheduler(backend="tpu", registry=Registry())
+        serial._tpu = sharded_env["solver"]
+        provs = sharded_env["provs"]
+        pendings = sched.submit_many([
+            dict(pods=tenant_batch(t), provisioners=provs,
+                 instance_types=small_catalog) for t in TENANTS
+        ])
+        results = [p.result() for p in pendings]
+        for t, res in zip(TENANTS, results):
+            solo = serial.solve(tenant_batch(t), provs, small_catalog)
+            assert_same_solve(res, solo)
+        h = reg.histogram(MEGABATCH_SLOTS)
+        assert sum(h.totals.values()) >= 1
+        assert max(h.sums.values()) >= 4.0
+        assert reg.counter(MEGABATCH_FLUSH).get(
+            {"reason": "mesh_serial"}) == 0.0
+
+    def test_cold_sharded_rung_serial_fallback_counts_mesh_serial(
+            self, sharded_env, small_catalog, monkeypatch):
+        """A meshed flush whose sharded rung is cold serves serially on the
+        sharded SINGLE program (mesh kwarg preserved), warms the sharded
+        rung behind, and counts one mesh_serial flush + logs once."""
+        solver, mesh = sharded_env["solver"], sharded_env["mesh"]
+        reg = Registry()
+        sched = BatchScheduler(backend="tpu", registry=reg, mesh=mesh)
+        sched._tpu = solver
+        monkeypatch.setattr(solver, "ready", lambda sig: False)
+        warmed = []
+        monkeypatch.setattr(solver, "warm_async",
+                            lambda *a, **kw: warmed.append(kw) or False)
+        captured = []
+        orig_async = TpuSolver.solve_async
+
+        def fake_async(st, **kw):
+            captured.append(dict(kw))
+            # serve from the warm single-device program (the test budget
+            # does not fund a meshed-single compile; the kwarg capture
+            # above is what pins the sharded-single contract)
+            kw.pop("mesh", None)
+            return orig_async(solver, st, **kw)
+
+        monkeypatch.setattr(solver, "solve_async", fake_async)
+        provs = sharded_env["provs"]
+        pendings = sched.submit_many([
+            dict(pods=tenant_batch(t), provisioners=provs,
+                 instance_types=small_catalog) for t in ("acme", "bravo")
+        ])
+        results = [p.result() for p in pendings]
+        assert reg.counter(MEGABATCH_FLUSH).get(
+            {"reason": "mesh_serial"}) == 1.0
+        assert warmed and warmed[0]["slots"] >= 2
+        assert warmed[0]["mesh"] is mesh  # warms the SHARDED rung
+        # the serial fallback dispatched the SHARDED single program
+        assert captured and all(kw.get("mesh") is mesh for kw in captured)
+        # parity vs an unmeshed scheduler (same epilogue ladder)
+        serial = BatchScheduler(backend="tpu", registry=Registry())
+        serial._tpu = solver
+        for t, res in zip(("acme", "bravo"), results):
+            solo = serial.solve(tenant_batch(t), provs, small_catalog)
+            assert_same_solve(res, solo)
+
+    def test_pipeline_owned_flush_counts_exactly_one_reason(
+            self, sharded_env, small_catalog, monkeypatch):
+        """flush_reason= (the pipeline's coalescer reason) transfers flush-
+        count ownership to the collector: a degraded meshed flush incs
+        mesh_serial INSTEAD of the coalescer reason — never both — and a
+        healthy flush incs the coalescer reason alone, so summing the
+        label population counts each flush exactly once."""
+        solver, mesh = sharded_env["solver"], sharded_env["mesh"]
+        provs = sharded_env["provs"]
+
+        def total(reg):
+            return sum(reg.counter(MEGABATCH_FLUSH).get({"reason": r})
+                       for r in MEGABATCH_FLUSH_REASONS)
+
+        # healthy sharded flush: counts the handed reason, not mesh_serial
+        reg = Registry()
+        sched = BatchScheduler(backend="tpu", registry=reg, mesh=mesh)
+        sched._tpu = solver
+        for p in sched.submit_many(
+                [dict(pods=tenant_batch(t), provisioners=provs,
+                      instance_types=small_catalog) for t in TENANTS],
+                flush_reason="full"):
+            p.result()
+        assert reg.counter(MEGABATCH_FLUSH).get({"reason": "full"}) == 1.0
+        assert total(reg) == 1.0
+
+        # degraded meshed flush (cold sharded rung): ONE count, relabeled
+        reg2 = Registry()
+        sched2 = BatchScheduler(backend="tpu", registry=reg2, mesh=mesh)
+        sched2._tpu = solver
+        monkeypatch.setattr(solver, "ready", lambda sig: False)
+        monkeypatch.setattr(solver, "warm_async", lambda *a, **kw: False)
+        orig_async = TpuSolver.solve_async
+
+        def fake_async(st, **kw):
+            kw.pop("mesh", None)
+            return orig_async(solver, st, **kw)
+
+        monkeypatch.setattr(solver, "solve_async", fake_async)
+        for p in sched2.submit_many(
+                [dict(pods=tenant_batch(t), provisioners=provs,
+                      instance_types=small_catalog)
+                 for t in ("acme", "bravo")],
+                flush_reason="full"):
+            p.result()
+        assert reg2.counter(MEGABATCH_FLUSH).get(
+            {"reason": "mesh_serial"}) == 1.0
+        assert reg2.counter(MEGABATCH_FLUSH).get({"reason": "full"}) == 0.0
+        assert total(reg2) == 1.0
+
+    def test_precompile_covers_sharded_rungs(self, sharded_env,
+                                             small_catalog, monkeypatch):
+        """precompile_buckets on a meshed scheduler warms every SHARDED
+        mega rung reachable from the default slot grid — each requested
+        rung resolves to its device-count-floored sharded signature."""
+        mesh = sharded_env["mesh"]
+        reg = Registry()
+        sched = BatchScheduler(backend="tpu", registry=reg, mesh=mesh)
+        warmed = []
+        monkeypatch.setattr(
+            sched._tpu, "warm_async",
+            lambda *a, **kw: warmed.append(kw) or True)
+        provs = sharded_env["provs"]
+        n = sched.precompile_buckets(provs, small_catalog)
+        assert n == len(warmed) and n > 0
+        mega = [kw for kw in warmed if kw.get("slots")]
+        assert mega, "no sharded mega rungs warmed"
+        assert all(kw["mesh"] is mesh for kw in mega)
+        # the default (2, 4, 8) grid all floors to the 8-slot sharded rung
+        rungs = {_mega_rung(kw["slots"], 8) for kw in mega}
+        assert rungs == {8}
+        # single-solve warms keep the meshed single program covered too
+        singles = [kw for kw in warmed if not kw.get("slots")]
+        assert singles and all(kw["mesh"] is mesh for kw in singles)
+
+
+class _StubSched:
+    backend = "stub"
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+
+    def bucket_key(self, kwargs):
+        return None
+
+
+class TestMeshedPipelineAndMetrics:
+    def test_pipeline_floors_max_slots_at_device_count(self, sharded_env):
+        from karpenter_tpu.service.server import SolvePipeline
+
+        pipe = SolvePipeline(_StubSched(sharded_env["mesh"]),
+                             registry=Registry(), max_slots=2)
+        try:
+            assert pipe.max_slots == 8
+        finally:
+            pipe.stop()
+
+    def test_pipeline_caps_max_slots_at_mesh_rung(self):
+        """An awkward device count's largest in-ladder rung can sit below
+        MEGA_MAX_SLOTS (20 chips -> a 20-slot rung): the pipeline must cap
+        the flush size there — a 32-entry flush would overflow the sharded
+        program (MegaBucketMismatch) and degrade EVERY full flush to
+        serial, exactly under the load the megabatch exists for."""
+        import types
+
+        from karpenter_tpu.service.server import SolvePipeline
+        from karpenter_tpu.solver.tpu import max_mega_slots
+
+        awkward = types.SimpleNamespace(
+            devices=np.empty((20,), dtype=object), axis_names=("pods",))
+        assert mesh_shardable(awkward)
+        assert max_mega_slots(awkward) == 20
+        unshard = types.SimpleNamespace(
+            devices=np.empty((MEGA_MAX_SLOTS * 2,), dtype=object),
+            axis_names=("pods",))
+        assert max_mega_slots(unshard) == 0  # no sharded program to size
+        pipe = SolvePipeline(_StubSched(awkward), registry=Registry(),
+                             max_slots=MEGA_MAX_SLOTS)
+        try:
+            assert pipe.max_slots == 20
+        finally:
+            pipe.stop()
+
+    def test_pipeline_honors_disabled_batching(self, sharded_env):
+        from karpenter_tpu.service.server import SolvePipeline
+
+        pipe = SolvePipeline(_StubSched(sharded_env["mesh"]),
+                             registry=Registry(), max_slots=1)
+        try:
+            assert pipe.max_slots == 1
+        finally:
+            pipe.stop()
+
+    def test_delegated_flush_error_path_still_counted(self):
+        """A delegated submit_many that raises during registration never
+        reaches the collector's end-of-dispatch count: the pipeline must
+        count the flush on the error path — an uncounted FAILING flush is
+        the one an operator most wants visible in the partition."""
+        import threading
+
+        from karpenter_tpu.service.server import SolvePipeline
+
+        class _RaisingSched:
+            backend = "tpu"
+            mesh = None
+            counts_flush_reason = True
+
+            def bucket_key(self, kwargs):
+                return "bucket-k"
+
+            def submit_many(self, reqs, flush_reason=None):
+                raise RuntimeError("registration boom")
+
+        reg = Registry()
+        pipe = SolvePipeline(_RaisingSched(), registry=reg, max_slots=2,
+                             max_wait_ms=60_000.0)
+        try:
+            errs = []
+
+            def call():
+                try:
+                    pipe.solve(dict(pods=[], provisioners=[],
+                                    instance_types=[]))
+                except RuntimeError as e:
+                    errs.append(e)
+
+            threads = [threading.Thread(target=call) for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10.0)
+            assert len(errs) == 2
+        finally:
+            pipe.stop()
+        assert reg.counter(MEGABATCH_FLUSH).get({"reason": "full"}) == 1.0
+
+    def test_unshardable_mesh_buckets_none_and_counts(self, small_catalog):
+        """A mesh whose device count exceeds the slot-rung ladder cannot
+        pad one-slot-per-chip: bucket_key rejects WITHOUT counting (the
+        probe only logs — counting per probe would double-count each
+        request against the per-flush full/deadline/bucket reasons) and
+        the PIPELINE counts the resulting single-request serial flush
+        under mesh_serial, in flush units."""
+        import types
+
+        big = types.SimpleNamespace(
+            devices=np.empty((MEGA_MAX_SLOTS * 2,), dtype=object),
+            axis_names=("pods",),
+        )
+        assert not mesh_shardable(big)
+        reg = Registry()
+        sched = BatchScheduler(backend="tpu", registry=reg, mesh=big)
+        key = sched.bucket_key(dict(
+            pods=tenant_batch("acme"),
+            provisioners=[Provisioner(name="default").with_defaults()],
+            instance_types=small_catalog))
+        assert key is None
+        assert reg.counter(MEGABATCH_FLUSH).get(
+            {"reason": "mesh_serial"}) == 0.0
+
+        from karpenter_tpu.service.server import SolvePipeline
+
+        class _Pending:
+            def result(self, *a, **kw):
+                return "serial-ok"
+
+        class _UnshardableSched:
+            backend = "tpu"
+            mesh = big
+
+            def bucket_key(self, kwargs):
+                return None
+
+            def submit(self, pods, provisioners, instance_types, **kw):
+                return _Pending()
+
+        reg2 = Registry()
+        pipe = SolvePipeline(_UnshardableSched(), registry=reg2,
+                             max_slots=8)
+        try:
+            out = pipe.solve(dict(
+                pods=tenant_batch("acme"),
+                provisioners=[Provisioner(name="default").with_defaults()],
+                instance_types=small_catalog))
+            assert out == "serial-ok"
+        finally:
+            pipe.stop()
+        flush = reg2.counter(MEGABATCH_FLUSH)
+        assert flush.get({"reason": "mesh_serial"}) == 1.0
+        assert flush.get({"reason": "bucket"}) == 0.0
+
+    def test_mesh_serial_zero_initialized(self):
+        """KT003: the full flush-reason population — mesh_serial included —
+        exists at 0 from scheduler AND pipeline construction."""
+        from karpenter_tpu.service.server import SolvePipeline
+
+        assert "mesh_serial" in MEGABATCH_FLUSH_REASONS
+        reg = Registry()
+        BatchScheduler(backend="oracle", registry=reg)
+        for reason in MEGABATCH_FLUSH_REASONS:
+            assert reg.counter(MEGABATCH_FLUSH).get(
+                {"reason": reason}) == 0.0
+        reg2 = Registry()
+        pipe = SolvePipeline(_StubSched(), registry=reg2)
+        try:
+            for reason in MEGABATCH_FLUSH_REASONS:
+                assert reg2.counter(MEGABATCH_FLUSH).get(
+                    {"reason": reason}) == 0.0
+        finally:
+            pipe.stop()
+        assert 'reason="mesh_serial"' in reg.expose()
+
+
+def mk_node(name, cpu_alloc, pods_cpu, zone="zone-1a"):
+    node = SimNode(
+        instance_type="m5.xlarge", provisioner="default", zone=zone,
+        capacity_type="on-demand", price=0.192,
+        allocatable={L.RESOURCE_CPU: cpu_alloc,
+                     L.RESOURCE_MEMORY: 64 * 2**30,
+                     L.RESOURCE_PODS: 50.0},
+        labels={L.ZONE: zone},
+        name=name,
+    )
+    for i, c in enumerate(pods_cpu):
+        node.pods.append(
+            PodSpec(name=f"{name}-p{i}", requests={L.RESOURCE_CPU: c}))
+    return node
+
+
+class TestMeshedSweep:
+    def test_sweep_signature_carries_mesh(self, sharded_env, small_catalog):
+        from karpenter_tpu.solver.consolidation import (
+            sweep_dims,
+            sweep_signature,
+        )
+
+        st = sharded_env["sts"]["acme"]
+        dims = sweep_dims(st, 4, 8)
+        mesh = sharded_env["mesh"]
+        sig = sweep_signature(st, dims, 3, mesh=mesh)
+        assert ("mesh", mesh_signature(mesh)) in sig
+        assert dict(kv for kv in sig if isinstance(kv, tuple)
+                    and kv[0] == "mega_slots")["mega_slots"] == 8
+        assert sig != sweep_signature(st, dims, 3)
+
+    def test_meshed_sweep_warms_sharded_program_not_gated_off(
+            self, sharded_env, small_catalog, monkeypatch):
+        """ROADMAP item 4 follow-on: a meshed scheduler's consolidation
+        sweep takes the batched path (cold: serve serially, warm the
+        SHARDED sweep program behind) instead of silently losing PR 6's
+        one-dispatch sweeps."""
+        from karpenter_tpu.solver.consolidation import sweep_what_ifs
+
+        mesh = sharded_env["mesh"]
+        reg = Registry()
+        sched = BatchScheduler(backend="auto", registry=reg, mesh=mesh)
+        warmed = []
+        monkeypatch.setattr(
+            sched._tpu, "warm_custom",
+            lambda sig, thunk, on_done=None: warmed.append(sig) or True)
+        prov = Provisioner(name="default").with_defaults()
+        nodes = [mk_node(f"n{i}", 8.0, [0.5] * 3) for i in range(4)]
+        cands = [[i] for i in range(len(nodes))]
+        sweep = sweep_what_ifs(sched, nodes, cands, provisioners=[prov],
+                               instance_types=small_catalog, registry=reg)
+        # cold pass serves serially (oracle for these small batches) and
+        # the warm targets the SHARDED sweep program
+        assert sweep.path == "serial"
+        assert all(not isinstance(r, BaseException) for r in sweep.results)
+        assert warmed, "meshed sweep must warm, not gate off the batch path"
+        assert all(("mesh", mesh_signature(mesh)) in sig for sig in warmed)
