@@ -1,7 +1,11 @@
-"""Machine-checked solver invariants: the ktlint static analyzer (KT001-KT006)
-plus the runtime lock-discipline sanitizer (``KT_SANITIZE=1``).
+"""Machine-checked solver invariants: the ktlint static analyzer — the
+function-local rules KT001-KT011 plus the whole-program call-graph passes
+KT012-KT014 (``analysis/callgraph.py``) — and the runtime lock-discipline
++ lock-order sanitizer (``KT_SANITIZE=1``).
 
-Run the analyzer: ``python -m karpenter_tpu.analysis`` (``make lint``).
+Run the analyzer: ``python -m karpenter_tpu.analysis`` (``make lint``);
+``--format json`` for machine-readable findings, ``--lock-order`` for the
+KT012-derived global lock-acquisition order.
 Rule catalog and annotation grammar: docs/ANALYSIS.md.
 
 ``sanitize`` is deliberately NOT imported here — the analyzer is pure stdlib
@@ -10,6 +14,11 @@ the solver stack and is loaded on demand by ``karpenter_tpu.__init__`` when
 ``KT_SANITIZE=1``.
 """
 
+from .callgraph import (  # noqa: F401
+    Project,
+    SummaryCache,
+    build_project,
+)
 from .ktlint import (  # noqa: F401
     Finding,
     analyze_files,

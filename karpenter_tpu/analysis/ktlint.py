@@ -71,6 +71,11 @@ class Finding:
             out += f"\n    hint: {self.hint}"
         return out
 
+    def to_json(self) -> dict:
+        """The ``--format json`` shape (schema: docs/ANALYSIS.md)."""
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "hint": self.hint}
+
 
 @dataclasses.dataclass
 class SourceFile:
@@ -183,12 +188,25 @@ def all_rules():
 
 
 def analyze_files(
-    files: Sequence[SourceFile], rules=None,
+    files: Sequence[SourceFile], rules=None, cache=None,
 ) -> Tuple[List[Finding], List[Finding]]:
-    """Run every rule over ``files``; returns ``(active, suppressed)``."""
+    """Run every rule over ``files``; returns ``(active, suppressed)``.
+
+    Whole-program rules (``WHOLE_PROGRAM = True``) share ONE linked
+    :class:`~karpenter_tpu.analysis.callgraph.Project`, built lazily and —
+    when ``cache`` is a :class:`~karpenter_tpu.analysis.callgraph
+    .SummaryCache` — from content-hash-cached per-file summaries."""
     raw: List[Finding] = []
+    project = None
     for rule in rules if rules is not None else all_rules():
-        raw.extend(rule.check(files))
+        if getattr(rule, "WHOLE_PROGRAM", False):
+            if project is None:
+                from .callgraph import Project
+
+                project = Project.build(files, cache=cache)
+            raw.extend(rule.check(files, project=project))
+        else:
+            raw.extend(rule.check(files))
     by_path = {f.path: f for f in files}
     for f in files:
         for line in f.malformed:
@@ -246,11 +264,19 @@ def collect_package_files(root: Optional[Path] = None) -> List[SourceFile]:
 
 
 def analyze_package(
-    root: Optional[Path] = None, rules=None,
+    root: Optional[Path] = None, rules=None, cache=None,
 ) -> Tuple[List[Finding], List[Finding], int]:
-    """Analyze the whole package; ``(active, suppressed, n_files)``."""
+    """Analyze the whole package; ``(active, suppressed, n_files)``.
+
+    Package runs default to the persistent summary cache (``KT_LINT_CACHE``
+    to relocate, ``KT_LINT_CACHE=0`` to disable) so the warm whole-program
+    run stays inside the tests/test_lint.py speed gate."""
+    if cache is None:
+        from .callgraph import SummaryCache
+
+        cache = SummaryCache.default()
     files = collect_package_files(root)
-    active, suppressed = analyze_files(files, rules=rules)
+    active, suppressed = analyze_files(files, rules=rules, cache=cache)
     return active, suppressed, len(files)
 
 
@@ -267,6 +293,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         metavar="KT00X", help="run only these rule IDs")
     parser.add_argument("--show-suppressed", action="store_true",
                         help="also print suppressed findings")
+    parser.add_argument("--format", choices=["text", "json"], default="text",
+                        help="output format (json schema: docs/ANALYSIS.md)")
+    parser.add_argument("--lock-order", action="store_true",
+                        help="print the KT012-derived global lock-"
+                             "acquisition order and exit")
     args = parser.parse_args(argv)
 
     rules = all_rules()
@@ -285,11 +316,53 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 files.extend(collect_package_files(p))
             else:
                 files.append(load_source(p.read_text(), str(p)))
-        active, suppressed = analyze_files(files, rules=rules)
-        n_files = len(files)
     else:
-        active, suppressed, n_files = analyze_package(rules=rules)
+        files = collect_package_files()
 
+    if args.lock_order:
+        from .callgraph import SummaryCache
+        from .rules import kt012
+
+        project = None
+        if not args.paths:
+            from .callgraph import Project
+
+            project = Project.build(files, cache=SummaryCache.default())
+        graph = kt012.lock_graph(files, project)
+        _nodes, edges, kinds = graph
+        order = kt012.lock_order(files, project, graph=graph)
+        if args.format == "json":
+            import json
+
+            print(json.dumps({
+                "order": order,
+                "kinds": {k: v for k, v in sorted(kinds.items())},
+                "edges": sorted(f"{s} -> {d}" for (s, d) in edges),
+            }, indent=2))
+        else:
+            print("global lock-acquisition order (outer first; "
+                  "sanitize.LOCK_ORDER must stay a linear extension):")
+            for i, lock in enumerate(order, 1):
+                print(f"  {i:2d}. {lock}  [{kinds.get(lock) or 'unknown'}]")
+            for (s, d), e in sorted(edges.items()):
+                print(f"  edge {s} -> {d}: {e.witness()}")
+        return 0
+
+    from .callgraph import SummaryCache
+
+    cache = SummaryCache.default() if not args.paths else None
+    active, suppressed = analyze_files(files, rules=rules, cache=cache)
+    n_files = len(files)
+
+    if args.format == "json":
+        import json
+
+        print(json.dumps({
+            "findings": [fi.to_json() for fi in active],
+            "suppressed": [fi.to_json() for fi in suppressed],
+            "files": n_files,
+        }, indent=2))
+        return 1 if active else 0
     for fi in active:
         print(fi.format())
     if args.show_suppressed:

@@ -1,0 +1,452 @@
+"""KT014 — compile-surface audit: runtime-constructible signatures must be
+a subset of what the AOT precompile warms.
+
+The no-compile serving contract (KT008's premise) has a global half KT008
+cannot see: the rung/dims vocabulary the runtime can *construct* — the
+``solve_dims`` single-solve ladder, the ``_mega_rung`` megabatch slot rungs
+(including the sharded mesh device-count floor), the ``sweep_dims`` fine
+rungs, the mesh-signature key tail — and the set ``precompile_buckets``
+actually *warms* live in different modules and drift independently.  A new
+ladder rung added on one side silently reintroduces inline compiles on the
+serving path; nothing fails until a latency SLO does.  This pass proves the
+subset relation statically, cross-module:
+
+1. **dims-key vocabulary sync** — the dict keys ``solve_dims`` returns
+   (plus the kernel statics and the ``_mega_key_tail`` names) must match
+   KT008's ``BUCKET_GRID_STATICS`` registry in BOTH directions: an
+   unregistered key would make KT008 flag the solver's own kernels; a
+   stale registry entry would let an off-grid name hide under a recycled
+   key.
+2. **megabatch rung coverage** — for every shardable device-count floor,
+   the slot rungs constructible under ``DEFAULT_MAX_SLOTS`` (through the
+   ``_mega_rung`` ladder: floor at the device count, double to
+   ``MEGA_MAX_SLOTS``) must be covered by the rungs ``WARM_MEGA_SLOTS``
+   resolves to.  Bumping the default slot cap without extending the warm
+   grid is THE silent-compile regression; dead warm entries (outside the
+   ladder) are flagged too.  The rule mirrors the ladder math;
+   tests/test_lint.py pins the mirror against the real ``_mega_rung`` over
+   the full domain, so the mirror cannot drift silently either.
+3. **single-source key tail** — the ``("mega_slots", ...)`` compile-key
+   tail may only be constructed by ``_mega_key_tail``; signature builders
+   (``mega_signature``, ``_dispatch_prepared``, ``sweep_signature``) must
+   call it rather than hand-rolling the tuple.
+4. **plumbing** — ``precompile_buckets`` must bound its rung filter by
+   ``MEGA_MAX_SLOTS`` (not a literal that can rot), ``sweep_dims`` must
+   delegate to ``solve_dims`` (its fine rungs override axes, never invent
+   keys), and the ``serve --warmup`` blocking precompile must pass an
+   explicit ``mega_slots`` grid so a configured ``--max-slots`` above the
+   default is warmed, not discovered at the first full flush.
+
+Every check degrades gracefully: it runs only when the module owning its
+anchor is in the analyzed set, and an anchor that has *moved* (function
+renamed, constant no longer a literal) is itself a finding — the audit
+surface must never silently shrink.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..callgraph import Project, build_project
+from ..ktlint import Finding, SourceFile
+from .kt008 import BUCKET_GRID_STATICS
+
+ID = "KT014"
+TITLE = "runtime-constructible compile signature not covered by precompile"
+WHOLE_PROGRAM = True
+HINT = ("the runtime vocabulary (solve_dims keys, _mega_rung slot rungs, "
+        "_mega_key_tail) and the warmed set (precompile_buckets, "
+        "WARM_MEGA_SLOTS, BUCKET_GRID_STATICS) must move together — "
+        "extend the warm grid / registry in the same PR that extends the "
+        "ladder; `scripts/profile_solve.py --lint-surface` dumps both "
+        "sides for human diffing")
+
+#: kernel vocab-position statics (KT008's registry carries them alongside
+#: the dims keys; they are compile-signature axes of the vmapped kernel)
+KERNEL_STATICS = frozenset({"zone_key", "ct_key"})
+
+TPU = "solver/tpu.py"
+SCHED = "solver/scheduler.py"
+SERVER = "service/server.py"
+SWEEP = "solver/consolidation.py"
+KT008_FILE = "rules/kt008.py"
+
+
+def mega_rung(n: int, n_dev: int, cap: int) -> int:
+    """Mirror of ``solver/tpu.py _mega_rung`` with the cap explicit.
+    tests/test_lint.py pins this mirror against the real function over the
+    whole (n, n_dev) domain — the audit must never model a ladder the
+    solver does not climb."""
+    r = max(1, n_dev)
+    while r < min(max(1, n), cap) and r * 2 <= cap:
+        r *= 2
+    return r
+
+
+# ---- tiny AST extractors -------------------------------------------------
+
+
+def _file(files, suffix: str) -> Optional[SourceFile]:
+    for f in files:
+        if f.path.endswith(suffix):
+            return f
+    return None
+
+
+def _func_def(tree: ast.AST, name: str) -> Optional[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _int_const(tree: ast.AST, name: str) -> Optional[Tuple[int, int]]:
+    """(value, lineno) of a module/class-level ``NAME = <int>``."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, int):
+                    return node.value.value, node.lineno
+    return None
+
+
+def _int_tuple(tree: ast.AST, name: str) -> Optional[Tuple[Tuple[int, ...], int]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name \
+                        and isinstance(node.value, (ast.Tuple, ast.List)):
+                    vals = []
+                    for el in node.value.elts:
+                        if not (isinstance(el, ast.Constant)
+                                and isinstance(el.value, int)):
+                            return None
+                        vals.append(el.value)
+                    return tuple(vals), node.lineno
+    return None
+
+
+def _dict_return_keys(fn: ast.AST) -> Optional[Tuple[Set[str], int]]:
+    """Keys of a ``return dict(...)`` (keyword form) inside ``fn``."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Call):
+            call = node.value
+            if isinstance(call.func, ast.Name) and call.func.id == "dict":
+                keys = {kw.arg for kw in call.keywords if kw.arg is not None}
+                if keys:
+                    return keys, node.lineno
+    return None
+
+
+def _calls_name(fn: ast.AST, name: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == name:
+                return True
+            if isinstance(f, ast.Attribute) and f.attr == name:
+                return True
+    return False
+
+
+def _uses_name(fn: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(fn))
+
+
+def _moved(out: List[Finding], path: str, what: str) -> None:
+    out.append(Finding(
+        ID, path, 1,
+        f"compile-surface audit anchor {what} not found — the surface "
+        "this rule proves moved; update analysis/rules/kt014.py in the "
+        "same PR so the subset proof keeps covering the serving path",
+        hint=HINT,
+    ))
+
+
+# ---- the checks ----------------------------------------------------------
+
+
+def check(files, project: Optional[Project] = None) -> List[Finding]:
+    out: List[Finding] = []
+    tpu = _file(files, TPU)
+    sched = _file(files, SCHED)
+    server = _file(files, SERVER)
+    sweep = _file(files, SWEEP)
+    kt008f = _file(files, KT008_FILE)
+
+    dims_keys: Optional[Set[str]] = None
+    dims_line = 1
+    mega_max: Optional[int] = None
+    tail_keys: Set[str] = set()
+
+    # staleness guard vs fixture tolerance: a file with NONE of its anchors
+    # is a test fixture or partial run and is skipped wholesale; a file
+    # with SOME anchors is the real one, and each missing anchor is a
+    # finding (the audit surface moved under the rule).  The package gate
+    # in tests/test_lint.py separately pins that the real tree yields every
+    # anchor, so wholesale renames cannot silently shrink the audit either.
+    if tpu is not None:
+        fn = _func_def(tpu.tree, "solve_dims")
+        mm = _int_const(tpu.tree, "MEGA_MAX_SLOTS")
+        tailfn = _func_def(tpu.tree, "_mega_key_tail")
+        if fn is None and mm is None and tailfn is None:
+            tpu = None
+    if tpu is not None:
+        got = _dict_return_keys(fn) if fn is not None else None
+        if got is None:
+            _moved(out, tpu.path, "`solve_dims` returning `dict(...)`")
+        else:
+            dims_keys, dims_line = got
+        if mm is None:
+            _moved(out, tpu.path, "`MEGA_MAX_SLOTS` as an int literal")
+        else:
+            mega_max = mm[0]
+        if tailfn is None:
+            _moved(out, tpu.path, "`_mega_key_tail`")
+        else:
+            for node in ast.walk(tailfn):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str):
+                    tail_keys.add(node.value)
+        # (1) vocabulary sync, both directions
+        if dims_keys is not None:
+            vocab = dims_keys | KERNEL_STATICS
+            for key in sorted(vocab - BUCKET_GRID_STATICS):
+                out.append(Finding(
+                    ID, tpu.path, dims_line,
+                    f"solve_dims emits dims key `{key}` that KT008's "
+                    "BUCKET_GRID_STATICS does not register — the rule "
+                    "would flag the solver's own kernels as off-grid",
+                    hint=HINT,
+                ))
+            stale = BUCKET_GRID_STATICS - vocab - tail_keys
+            if stale and kt008f is not None:
+                line = 1
+                for node in ast.walk(kt008f.tree):
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name) \
+                                    and t.id == "BUCKET_GRID_STATICS":
+                                line = node.lineno
+                for key in sorted(stale):
+                    out.append(Finding(
+                        ID, kt008f.path, line,
+                        f"BUCKET_GRID_STATICS entry `{key}` matches no "
+                        "solve_dims key, kernel static, or key-tail name — "
+                        "a stale registry entry lets an off-grid "
+                        "static_argname hide under a recycled name",
+                        hint=HINT,
+                    ))
+        # (3) single-source key tail: "mega_slots" literal outside
+        # _mega_key_tail anywhere in the serving tree
+        for f in files:
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Constant) \
+                        and node.value == "mega_slots":
+                    if f is tpu and tailfn is not None \
+                            and tailfn.lineno <= node.lineno \
+                            <= getattr(tailfn, "end_lineno", tailfn.lineno):
+                        continue
+                    if f.path.endswith(("test_lint.py", "kt014.py")):
+                        continue
+                    out.append(Finding(
+                        ID, f.path, node.lineno,
+                        "`\"mega_slots\"` compile-key tail constructed "
+                        "outside `_mega_key_tail` — two construction sites "
+                        "drift apart the day one spec changes (the tail is "
+                        "single-source by contract)",
+                        hint=HINT,
+                    ))
+        # (3b) the signature builders must route through _mega_key_tail
+        for fname in ("mega_signature", "_dispatch_prepared"):
+            f2 = _func_def(tpu.tree, fname)
+            if f2 is None:
+                _moved(out, tpu.path, f"`{fname}`")
+            elif not _calls_name(f2, "_mega_key_tail"):
+                out.append(Finding(
+                    ID, tpu.path, f2.lineno,
+                    f"`{fname}` does not call `_mega_key_tail` — its "
+                    "compile key can drift from what readiness/warm "
+                    "bookkeeping tracks",
+                    hint=HINT,
+                ))
+
+    warm_slots: Optional[Tuple[int, ...]] = None
+    warm_line = 1
+    if sched is not None:
+        ws = _int_tuple(sched.tree, "WARM_MEGA_SLOTS")
+        pcb = _func_def(sched.tree, "precompile_buckets")
+        if ws is None and pcb is None:
+            sched = None
+    if sched is not None:
+        if ws is None:
+            _moved(out, sched.path, "`WARM_MEGA_SLOTS` as an int tuple")
+        else:
+            warm_slots, warm_line = ws
+        if pcb is None:
+            _moved(out, sched.path, "`precompile_buckets`")
+        elif not _uses_name(pcb, "MEGA_MAX_SLOTS"):
+            out.append(Finding(
+                ID, sched.path, pcb.lineno,
+                "`precompile_buckets` does not bound its slot-rung filter "
+                "by `MEGA_MAX_SLOTS` — a literal bound rots the day the "
+                "ladder cap moves",
+                hint=HINT,
+            ))
+
+    default_max: Optional[int] = None
+    if server is not None:
+        dm = _int_const(server.tree, "DEFAULT_MAX_SLOTS")
+        has_pcb_call = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "precompile_buckets"
+            for n in ast.walk(server.tree))
+        if dm is None and not has_pcb_call:
+            server = None
+    if server is not None:
+        if dm is None:
+            _moved(out, server.path, "`DEFAULT_MAX_SLOTS` as an int literal")
+        else:
+            default_max = dm[0]
+        # (4) serve --warmup: the blocking precompile must name its grid
+        for node in ast.walk(server.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "precompile_buckets":
+                kwargs = {kw.arg for kw in node.keywords}
+                blocking = any(
+                    kw.arg == "wait" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True for kw in node.keywords)
+                if blocking and "mega_slots" not in kwargs:
+                    out.append(Finding(
+                        ID, server.path, node.lineno,
+                        "blocking `precompile_buckets(wait=True)` without "
+                        "an explicit `mega_slots` grid — a configured "
+                        "--max-slots above the default warms nothing past "
+                        "the default rungs, and the first full flush pays "
+                        "the compile inline",
+                        hint=HINT,
+                    ))
+
+    # (2) megabatch rung coverage over every shardable device-count floor
+    if mega_max is not None and warm_slots is not None \
+            and default_max is not None:
+        live = [s for s in warm_slots if 2 <= s <= mega_max]
+        for s in warm_slots:
+            if not 2 <= s <= mega_max:
+                out.append(Finding(
+                    ID, sched.path, warm_line,
+                    f"WARM_MEGA_SLOTS entry {s} is outside the megabatch "
+                    f"ladder [2, {mega_max}] — precompile_buckets filters "
+                    "it out, so it warms nothing (dead config)",
+                    hint=HINT,
+                ))
+        for n_dev in range(1, mega_max + 1):
+            warm_rungs = {mega_rung(s, n_dev, mega_max) for s in live}
+            eff_cap = min(max(default_max, n_dev),
+                          mega_rung(mega_max, n_dev, mega_max))
+            runtime_rungs = {mega_rung(n, n_dev, mega_max)
+                             for n in range(2, eff_cap + 1)}
+            missing = sorted(runtime_rungs - warm_rungs)
+            if missing:
+                out.append(Finding(
+                    ID, sched.path, warm_line,
+                    f"megabatch slot rung(s) {missing} are constructible "
+                    f"at runtime (device floor {n_dev}, slot cap "
+                    f"{eff_cap}) but WARM_MEGA_SLOTS={tuple(live)} never "
+                    "warms them — the first flush at that occupancy "
+                    "compiles inline on the serving path",
+                    hint=HINT,
+                ))
+                break  # one floor's witness is enough; don't spam 32 rows
+
+    # (4b) sweep_dims: fine rungs may override axes, never invent keys
+    if sweep is not None:
+        sd = _func_def(sweep.tree, "sweep_dims")
+        ss = _func_def(sweep.tree, "sweep_signature")
+        if sd is None and ss is None:
+            sweep = None
+    if sweep is not None:
+        if sd is None:
+            _moved(out, sweep.path, "`sweep_dims`")
+        else:
+            if not _calls_name(sd, "solve_dims"):
+                out.append(Finding(
+                    ID, sweep.path, sd.lineno,
+                    "`sweep_dims` does not delegate to `solve_dims` — the "
+                    "sweep's compile signatures would fork from the single "
+                    "source of the bucketing math",
+                    hint=HINT,
+                ))
+            if dims_keys is not None:
+                for node in ast.walk(sd):
+                    if isinstance(node, ast.Assign) and node.targets \
+                            and isinstance(node.targets[0], ast.Subscript):
+                        sub = node.targets[0]
+                        if isinstance(sub.slice, ast.Constant) \
+                                and isinstance(sub.slice.value, str) \
+                                and sub.slice.value not in dims_keys:
+                            out.append(Finding(
+                                ID, sweep.path, node.lineno,
+                                f"`sweep_dims` writes dims key "
+                                f"`{sub.slice.value}` that `solve_dims` "
+                                "never emits — an invented key is a "
+                                "compile-signature axis no rung ladder "
+                                "bounds",
+                                hint=HINT,
+                            ))
+        if ss is None:
+            _moved(out, sweep.path, "`sweep_signature`")
+        elif not _calls_name(ss, "_mega_key_tail"):
+            out.append(Finding(
+                ID, sweep.path, ss.lineno,
+                "`sweep_signature` does not call `_mega_key_tail` — the "
+                "sweep's compile key can drift from what dispatch keys",
+                hint=HINT,
+            ))
+    return out
+
+
+# ---- the --lint-surface dump (scripts/profile_solve.py) ------------------
+
+
+def surface(files) -> Dict[str, object]:
+    """The two sides of the subset proof as data, for human diffing when
+    the ladder changes (``scripts/profile_solve.py --lint-surface``)."""
+    tpu = _file(files, TPU)
+    sched = _file(files, SCHED)
+    server = _file(files, SERVER)
+    out: Dict[str, object] = {
+        "bucket_grid_statics": sorted(BUCKET_GRID_STATICS),
+        "kernel_statics": sorted(KERNEL_STATICS),
+    }
+    if tpu is not None:
+        fn = _func_def(tpu.tree, "solve_dims")
+        got = _dict_return_keys(fn) if fn is not None else None
+        out["solve_dims_keys"] = sorted(got[0]) if got else None
+        mm = _int_const(tpu.tree, "MEGA_MAX_SLOTS")
+        out["mega_max_slots"] = mm[0] if mm else None
+    ws = _int_tuple(sched.tree, "WARM_MEGA_SLOTS") if sched is not None \
+        else None
+    dm = _int_const(server.tree, "DEFAULT_MAX_SLOTS") if server is not None \
+        else None
+    out["warm_mega_slots"] = list(ws[0]) if ws else None
+    out["default_max_slots"] = dm[0] if dm else None
+    mega_max = out.get("mega_max_slots")
+    if mega_max and ws and dm:
+        rungs: Dict[str, Dict[str, List[int]]] = {}
+        for n_dev in range(1, int(mega_max) + 1):
+            warm = sorted({mega_rung(s, n_dev, int(mega_max))
+                           for s in ws[0] if 2 <= s <= int(mega_max)})
+            eff_cap = min(max(dm[0], n_dev),
+                          mega_rung(int(mega_max), n_dev, int(mega_max)))
+            runtime = sorted({mega_rung(n, n_dev, int(mega_max))
+                              for n in range(2, eff_cap + 1)})
+            rungs[str(n_dev)] = {"warmed": warm, "runtime": runtime}
+        out["mega_rungs_by_device_floor"] = rungs
+    return out

@@ -959,3 +959,776 @@ class TestKT011ShardingConstruction:
             return (jax.device_put(res_i),) + args[1:]
         """
         assert lint(src, self.HOT) == []
+
+
+# ---------------------------------------------------------------------------
+# whole-program engine (ISSUE 9): call graph + KT012/KT013/KT014
+# ---------------------------------------------------------------------------
+
+
+def sources(*pairs):
+    return [load_source(textwrap.dedent(src), path) for path, src in pairs]
+
+
+def lint_files(pairs, rules):
+    active, _ = analyze_files(sources(*pairs), rules=rules)
+    return active
+
+
+class TestCallGraphCore:
+    """The project symbol table + call graph the whole-program rules share:
+    resolution through facades, graceful degradation on unresolved calls,
+    recursion termination, and the content-hash summary cache."""
+
+    def test_facade_boundary_edge_resolves(self):
+        from karpenter_tpu.analysis.callgraph import build_project
+
+        files = sources(
+            ("karpenter_tpu/pipe.py", """
+             from .sched import BatchScheduler
+
+             class SolvePipeline:
+                 def __init__(self, scheduler: BatchScheduler):
+                     self.scheduler = scheduler
+
+                 def drive(self):
+                     return self.scheduler.solve()
+             """),
+            ("karpenter_tpu/sched.py", """
+             class BatchScheduler:
+                 def solve(self):
+                     return 1
+             """),
+        )
+        project = build_project(files)
+        node = project.funcs["karpenter_tpu.pipe:SolvePipeline.drive"]
+        assert [c for _l, c, _n in node.edges] == [
+            "karpenter_tpu.sched:BatchScheduler.solve"]
+
+    def test_constructor_attr_and_local_var_types_resolve(self):
+        from karpenter_tpu.analysis.callgraph import build_project
+
+        files = sources(("karpenter_tpu/m.py", """
+            class Inner:
+                def grab(self):
+                    return 1
+
+            class Outer:
+                def __init__(self, inner=None):
+                    self.inner = inner or Inner()
+
+                def via_attr(self):
+                    return self.inner.grab()
+
+            def via_local():
+                x = Inner()
+                return x.grab()
+            """))
+        project = build_project(files)
+        grab = "karpenter_tpu.m:Inner.grab"
+        assert [c for _l, c, _n in
+                project.funcs["karpenter_tpu.m:Outer.via_attr"].edges] == [grab]
+        assert grab in [c for _l, c, _n in
+                        project.funcs["karpenter_tpu.m:via_local"].edges]
+
+    def test_unresolved_calls_degrade_gracefully(self):
+        from karpenter_tpu.analysis.callgraph import build_project
+
+        files = sources(("karpenter_tpu/m.py", """
+            def f(anything):
+                anything.method()
+                getattr(anything, "x")()
+                unknown_name(1)
+            """))
+        project = build_project(files)   # must not raise
+        assert project.funcs["karpenter_tpu.m:f"].edges == []
+        assert any(name == "anything.method"
+                   for _fid, _line, name in project.unresolved)
+
+    def test_base_class_method_resolution(self):
+        from karpenter_tpu.analysis.callgraph import build_project
+
+        files = sources(("karpenter_tpu/m.py", """
+            class Base:
+                def shared(self):
+                    return 1
+
+            class Child(Base):
+                def go(self):
+                    return self.shared()
+            """))
+        project = build_project(files)
+        assert [c for _l, c, _n in
+                project.funcs["karpenter_tpu.m:Child.go"].edges] == [
+            "karpenter_tpu.m:Base.shared"]
+
+    def test_summary_cache_hit_path(self, tmp_path):
+        from karpenter_tpu.analysis.callgraph import (
+            Project, SummaryCache, build_project)
+
+        files = sources(
+            ("karpenter_tpu/a.py", "def f():\n    return g()\n\ndef g():\n    return 1\n"),
+            ("karpenter_tpu/b.py", "def h():\n    return 2\n"),
+        )
+        cache_file = tmp_path / "cache.json"
+        c1 = SummaryCache(path=cache_file)
+        p1 = Project.build(files, cache=c1)
+        assert (c1.hits, c1.misses) == (0, 2)
+        assert cache_file.exists()
+        # same content -> every file served from the persisted cache
+        c2 = SummaryCache(path=cache_file)
+        p2 = Project.build(files, cache=c2)
+        assert (c2.hits, c2.misses) == (2, 0)
+        assert sorted(p2.funcs) == sorted(p1.funcs)
+        # content change -> that file re-extracts, the other still hits
+        files2 = sources(
+            ("karpenter_tpu/a.py", "def f():\n    return 3\n"),
+            ("karpenter_tpu/b.py", "def h():\n    return 2\n"),
+        )
+        c3 = SummaryCache(path=cache_file)
+        Project.build(files2, cache=c3)
+        assert (c3.hits, c3.misses) == (1, 1)
+
+    def test_corrupt_cache_is_discarded(self, tmp_path):
+        from karpenter_tpu.analysis.callgraph import Project, SummaryCache
+
+        cache_file = tmp_path / "cache.json"
+        cache_file.write_text("{not json")
+        files = sources(("karpenter_tpu/a.py", "def f():\n    return 1\n"))
+        cache = SummaryCache(path=cache_file)
+        project = Project.build(files, cache=cache)  # must not raise
+        assert "karpenter_tpu.a:f" in project.funcs
+
+
+class TestKT012LockOrder:
+    from karpenter_tpu.analysis.rules import kt012 as RULE
+
+    CYCLE = ("karpenter_tpu/m.py", """
+        import threading
+
+        class A:
+            def __init__(self, b=None):
+                self._lock = threading.Lock()
+                self.b = b or B()
+
+            def outer(self):
+                with self._lock:
+                    self.b.grab()
+
+            def inner(self):
+                with self._lock:
+                    pass
+
+        class B:
+            def __init__(self, a: "A" = None):
+                self._lock = threading.Lock()
+                self.a = a
+
+            def grab(self):
+                with self._lock:
+                    pass
+
+            def outer(self):
+                with self._lock:
+                    self.a.inner()
+        """)
+
+    def test_interprocedural_cycle_fires_with_witnesses(self):
+        findings = lint_files([self.CYCLE], [self.RULE])
+        assert rules_of(findings) == ["KT012"]
+        msg = findings[0].message
+        assert "A._lock" in msg and "B._lock" in msg
+        assert "witness" in msg and "A.outer" in msg and "B.outer" in msg
+
+    def test_consistent_order_is_quiet(self):
+        src = ("karpenter_tpu/m.py", """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.b = B()
+
+            def outer(self):
+                with self._lock:
+                    self.b.grab()
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def grab(self):
+                with self._lock:
+                    pass
+        """)
+        assert lint_files([src], [self.RULE]) == []
+
+    def test_self_nesting_of_plain_lock_fires(self):
+        src = ("karpenter_tpu/m.py", """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.helper()
+
+            def helper(self):
+                with self._lock:
+                    pass
+        """)
+        findings = lint_files([src], [self.RULE])
+        assert rules_of(findings) == ["KT012"]
+        assert "non-reentrant" in findings[0].message
+
+    def test_reentrant_self_nesting_is_quiet(self):
+        src = ("karpenter_tpu/m.py", """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._cond = threading.Condition()
+
+            def outer(self):
+                with self._lock:
+                    self.helper()
+
+            def helper(self):
+                with self._lock:
+                    pass
+
+            def put(self):
+                with self._cond:
+                    self.bump()
+
+            def bump(self):
+                with self._cond:
+                    pass
+        """)
+        assert lint_files([src], [self.RULE]) == []
+
+    def test_recursion_terminates(self):
+        src = ("karpenter_tpu/m.py", """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self, n):
+                with self._lock:
+                    pass
+                return self.g(n)
+
+            def g(self, n):
+                return self.f(n - 1) if n else 0
+        """)
+        assert lint_files([src], [self.RULE]) == []
+
+    def test_closure_acquisitions_contribute_no_edge(self):
+        # a callback body runs where it is CALLED, not where it is written:
+        # static edges from closures would cry wolf (the runtime watcher
+        # covers the real callback nestings)
+        src = ("karpenter_tpu/m.py", """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    return lambda: self.takes_other()
+
+            def takes_other(self):
+                with self._other:
+                    self.back()
+
+            def back(self):
+                with self._lock:
+                    pass
+        """)
+        # _other -> _lock exists (takes_other), but _lock -> _other only
+        # via the lambda, which must NOT edge: no cycle, no finding
+        assert lint_files([src], [self.RULE]) == []
+
+    def test_suppression_with_reason(self):
+        path, src = self.CYCLE
+        src = src.replace(
+            "            def outer(self):\n                with self._lock:\n                    self.b.grab()",
+            "            def outer(self):\n                # ktlint: allow[KT012] B is always a fresh private instance here\n                with self._lock:\n                    self.b.grab()",
+            1)
+        assert lint_files([(path, src)], [self.RULE]) == []
+
+    def test_lock_order_is_a_linear_extension(self):
+        from karpenter_tpu.analysis.rules.kt012 import lock_graph, lock_order
+
+        files = sources(self.CYCLE[:1] + (self.CYCLE[1].replace(
+            "def outer(self):\n                with self._lock:\n                    self.a.inner()",
+            "def outer(self):\n                pass", 1),))
+        order = lock_order(files)
+        _nodes, edges, _kinds = lock_graph(files)
+        idx = {n: i for i, n in enumerate(order)}
+        for (s, d) in edges:
+            if s != d:
+                assert idx[s] < idx[d]
+
+
+class TestKT013FenceReachability:
+    from karpenter_tpu.analysis.rules import kt013 as RULE
+
+    def test_reachable_sync_fires_with_chain(self):
+        files = [("karpenter_tpu/solver/scheduler.py", """
+        import numpy as np
+
+        class BatchScheduler:
+            def solve(self, run, init):
+                return finish(run, init)
+
+        def finish(run, init):
+            carry, ys = run(init)
+            return np.asarray(carry[7])
+        """)]
+        findings = lint_files(files, [self.RULE])
+        assert rules_of(findings) == ["KT013"]
+        assert "BatchScheduler.solve -> finish" in findings[0].message
+
+    def test_fence_on_the_path_absorbs(self):
+        files = [("karpenter_tpu/solver/scheduler.py", """
+        import numpy as np
+
+        class BatchScheduler:
+            def solve(self, run, init):
+                return finish(run, init)
+
+        # ktlint: fence the one-RTT D2H read IS this helper's job
+        def finish(run, init):
+            carry, ys = run(init)
+            return np.asarray(carry[7])
+        """)]
+        assert lint_files(files, [self.RULE]) == []
+
+    def test_host_numpy_stays_quiet_interprocedurally(self):
+        files = [("karpenter_tpu/solver/scheduler.py", """
+        import numpy as np
+
+        class BatchScheduler:
+            def solve(self, st):
+                return estimate(st)
+
+        def estimate(st):
+            counts = np.asarray(st.counts)
+            return float(counts.sum())
+        """)]
+        assert lint_files(files, [self.RULE]) == []
+
+    def test_jitted_call_readback_fires_across_modules(self):
+        """The PR 6/7 review-round bug class: a controller tick reaching an
+        eager kernel-readback helper (np.asarray over a jitted call) in
+        another module with no fence on the path — the shape
+        screen_subset_deletes had before its fence annotation."""
+        files = [
+            ("karpenter_tpu/controllers/deprovisioning.py", """
+             from ..solver.consolidation import screen
+
+             class DeprovisioningController:
+                 def reconcile(self):
+                     return screen([1])
+             """),
+            ("karpenter_tpu/solver/consolidation.py", """
+             import jax
+             import numpy as np
+             from functools import partial
+
+             @partial(jax.jit)
+             def _kernel(x):
+                 return x
+
+             def screen(args):
+                 return np.asarray(_kernel(args))
+             """),
+        ]
+        findings = lint_files(files, [self.RULE])
+        assert rules_of(findings) == ["KT013"]
+        assert "DeprovisioningController.reconcile -> screen" \
+            in findings[0].message
+
+    def test_fence_annotation_fixes_the_jitted_readback(self):
+        files = [
+            ("karpenter_tpu/controllers/deprovisioning.py", """
+             from ..solver.consolidation import screen
+
+             class DeprovisioningController:
+                 def reconcile(self):
+                     return screen([1])
+             """),
+            ("karpenter_tpu/solver/consolidation.py", """
+             import jax
+             import numpy as np
+             from functools import partial
+
+             @partial(jax.jit)
+             def _kernel(x):
+                 return x
+
+             # ktlint: fence the screen IS the sync point by design
+             def screen(args):
+                 return np.asarray(_kernel(args))
+             """),
+        ]
+        assert lint_files(files, [self.RULE]) == []
+
+    def test_recursive_call_chain_terminates(self):
+        files = [("karpenter_tpu/solver/scheduler.py", """
+        class BatchScheduler:
+            def solve(self, n):
+                return helper(n)
+
+        def helper(n):
+            return helper(n - 1) if n else other(n)
+
+        def other(n):
+            return helper(n)
+        """)]
+        assert lint_files(files, [self.RULE]) == []
+
+    def test_stale_entry_point_fires_when_class_remains(self):
+        files = [("karpenter_tpu/solver/scheduler.py", """
+        class BatchScheduler:
+            def solve_renamed(self):
+                return 1
+        """)]
+        findings = lint_files(files, [self.RULE])
+        assert "KT013" in rules_of(findings)
+        assert "ENTRY_POINTS" in findings[0].message
+
+    def test_fixture_without_the_class_stays_quiet(self):
+        files = [("karpenter_tpu/solver/scheduler.py", """
+        def unrelated():
+            return 1
+        """)]
+        assert lint_files(files, [self.RULE]) == []
+
+    def test_suppression_on_the_sync_line(self):
+        files = [("karpenter_tpu/solver/scheduler.py", """
+        import numpy as np
+
+        class BatchScheduler:
+            def solve(self, run, init):
+                carry, ys = run(init)
+                return np.asarray(carry[7])  # ktlint: allow[KT013] cold path by contract
+        """)]
+        assert lint_files(files, [self.RULE]) == []
+
+    def test_every_entry_point_resolves_in_the_real_package(self):
+        """The anti-staleness gate the per-file finding cannot give: a
+        class-level rename must fail HERE, not silently shrink the audited
+        surface."""
+        from karpenter_tpu.analysis.callgraph import build_project
+        from karpenter_tpu.analysis.ktlint import collect_package_files
+        from karpenter_tpu.analysis.rules.kt013 import ENTRY_POINTS
+
+        project = build_project(collect_package_files())
+        missing = [f"{s}:{q}" for s, q in ENTRY_POINTS
+                   if project.find_function(s, q) is None]
+        assert missing == []
+
+
+class TestKT014CompileSurface:
+    from karpenter_tpu.analysis.rules import kt014 as RULE
+
+    TPU_OK = ("karpenter_tpu/solver/tpu.py", """
+        MEGA_MAX_SLOTS = 32
+
+        def solve_dims(st):
+            return dict(G=1, C=1, NR=1, NE_pad=1, S=1, P=1, D=1, R=1,
+                        Z=1, K=1, W=1, track=True, a=1, b=1)
+
+        def _mega_key_tail(slots, zone_key, ct_key, mesh):
+            return (("mega_slots", slots), ("zk", zone_key),
+                    ("ck", ct_key))
+
+        def mega_signature(st):
+            return _mega_key_tail(2, 0, 1, None)
+
+        def _dispatch_prepared(st):
+            return _mega_key_tail(2, 0, 1, None)
+        """)
+    SCHED_OK = ("karpenter_tpu/solver/scheduler.py", """
+        from .tpu import MEGA_MAX_SLOTS
+
+        class BatchScheduler:
+            WARM_MEGA_SLOTS = (2, 4, 8)
+
+            def precompile_buckets(self, mega_slots=None):
+                return [s for s in (mega_slots or self.WARM_MEGA_SLOTS)
+                        if 2 <= s <= MEGA_MAX_SLOTS]
+        """)
+    SERVER_OK = ("karpenter_tpu/service/server.py", """
+        DEFAULT_MAX_SLOTS = 8
+
+        def main(service):
+            return service.scheduler.precompile_buckets(
+                mega_slots=(2, 4, 8), wait=True)
+        """)
+
+    def test_consistent_surface_is_quiet(self):
+        assert lint_files(
+            [self.TPU_OK, self.SCHED_OK, self.SERVER_OK], [self.RULE]) == []
+
+    def test_mirror_matches_the_real_rung_ladder(self):
+        """The rule's mirrored ladder math vs solver/tpu.py's _mega_rung
+        over the whole (n, n_dev) domain — the audit must never model a
+        ladder the solver does not climb."""
+        from karpenter_tpu.analysis.rules.kt014 import mega_rung
+        from karpenter_tpu.solver.tpu import MEGA_MAX_SLOTS, _mega_rung
+
+        for n in range(1, MEGA_MAX_SLOTS + 1):
+            for n_dev in range(1, MEGA_MAX_SLOTS + 1):
+                assert mega_rung(n, n_dev, MEGA_MAX_SLOTS) == \
+                    _mega_rung(n, n_dev), (n, n_dev)
+
+    def test_raised_default_cap_without_warm_rungs_fires(self):
+        server = ("karpenter_tpu/service/server.py", """
+        DEFAULT_MAX_SLOTS = 16
+
+        def main(service):
+            return service.scheduler.precompile_buckets(
+                mega_slots=(2, 4, 8), wait=True)
+        """)
+        findings = lint_files(
+            [self.TPU_OK, self.SCHED_OK, server], [self.RULE])
+        assert rules_of(findings) == ["KT014"]
+        assert "[16]" in findings[0].message
+        assert findings[0].path.endswith("solver/scheduler.py")
+
+    def test_unregistered_dims_key_fires(self):
+        tpu = (self.TPU_OK[0],
+               self.TPU_OK[1].replace("track=True, a=1, b=1",
+                                      "track=True, a=1, b=1, batch_hint=1"))
+        findings = lint_files([tpu], [self.RULE])
+        assert any("batch_hint" in f.message for f in findings)
+
+    def test_blocking_warmup_without_mega_slots_fires(self):
+        """Regression for the real finding this pass surfaced: serve
+        --warmup precompiled only the default rungs, so a configured
+        --max-slots above them hit its first full flush cold."""
+        server = ("karpenter_tpu/service/server.py", """
+        DEFAULT_MAX_SLOTS = 8
+
+        def main(service):
+            return service.scheduler.precompile_buckets(wait=True)
+        """)
+        findings = lint_files([server], [self.RULE])
+        assert rules_of(findings) == ["KT014"]
+        assert "mega_slots" in findings[0].message
+
+    def test_hand_rolled_key_tail_fires(self):
+        tpu = (self.TPU_OK[0], self.TPU_OK[1] + """
+        def rogue(slots):
+            return (("mega_slots", slots),)
+        """)
+        findings = lint_files([tpu], [self.RULE])
+        assert rules_of(findings) == ["KT014"]
+        assert "single-source" in findings[0].message
+
+    def test_signature_builder_bypassing_tail_fires(self):
+        tpu = (self.TPU_OK[0], self.TPU_OK[1].replace(
+            "def mega_signature(st):\n            return _mega_key_tail(2, 0, 1, None)",
+            "def mega_signature(st):\n            return ()"))
+        findings = lint_files([tpu], [self.RULE])
+        assert any("mega_signature" in f.message for f in findings)
+
+    def test_sweep_dims_must_delegate_and_not_invent_keys(self):
+        sweep = ("karpenter_tpu/solver/consolidation.py", """
+        def sweep_dims(st):
+            dims = {}
+            dims["Q"] = 4
+            return dims
+
+        def sweep_signature(st):
+            from .tpu import _mega_key_tail
+            return _mega_key_tail(2, 0, 1, None)
+        """)
+        findings = lint_files([self.TPU_OK, sweep], [self.RULE])
+        msgs = " | ".join(f.message for f in findings)
+        assert "does not delegate to `solve_dims`" in msgs
+        assert "`Q`" in msgs
+
+    def test_fixtures_without_anchors_stay_quiet(self):
+        # the KT001 fixtures reuse the real hot-path suffixes; a file with
+        # NONE of the audit anchors is a fixture, not a moved surface
+        files = [("karpenter_tpu/solver/tpu.py", """
+        def hot_path(x):
+            return x
+        """)]
+        assert lint_files(files, [self.RULE]) == []
+
+    def test_moved_anchor_fires_when_siblings_remain(self):
+        tpu = (self.TPU_OK[0], self.TPU_OK[1].replace(
+            "def solve_dims(st):", "def solve_dims_renamed(st):"))
+        findings = lint_files([tpu], [self.RULE])
+        assert any("solve_dims" in f.message and "moved" in f.message
+                   for f in findings)
+
+    def test_package_surface_yields_every_anchor(self):
+        from karpenter_tpu.analysis.ktlint import collect_package_files
+        from karpenter_tpu.analysis.rules.kt014 import surface
+
+        s = surface(collect_package_files())
+        assert s["solve_dims_keys"], s
+        assert s["mega_max_slots"] and s["warm_mega_slots"] \
+            and s["default_max_slots"], s
+        assert s["mega_rungs_by_device_floor"]["1"]["runtime"], s
+        for floor, sides in s["mega_rungs_by_device_floor"].items():
+            assert set(sides["runtime"]) <= set(sides["warmed"]), floor
+
+
+class TestWholeProgramGates:
+    def test_package_zero_findings_for_new_rules(self):
+        from karpenter_tpu.analysis.rules import kt012, kt013, kt014
+
+        active, _supp, n_files = analyze_package(
+            rules=[kt012, kt013, kt014])
+        assert n_files > 60
+        assert active == [], "\n".join(f.format() for f in active)
+
+    def test_speed_gate(self, tmp_path):
+        """The whole-package v2 run must stay tier-1-cheap: < 5 s cold,
+        and the whole-program engine < 1 s once the summary cache is warm
+        (the per-file AST summaries are content-hash cached)."""
+        import time
+
+        from karpenter_tpu.analysis.callgraph import Project, SummaryCache
+        from karpenter_tpu.analysis.ktlint import collect_package_files
+
+        cache_file = tmp_path / "cache.json"
+        t0 = time.perf_counter()
+        active, _supp, _n = analyze_package(
+            cache=SummaryCache(path=cache_file))
+        cold = time.perf_counter() - t0
+        assert active == []
+        assert cold < 5.0, f"cold whole-package lint took {cold:.2f}s"
+        files = collect_package_files()
+        warm_cache = SummaryCache(path=cache_file)
+        t1 = time.perf_counter()
+        Project.build(files, cache=warm_cache)
+        warm = time.perf_counter() - t1
+        assert warm_cache.misses == 0, "warm run must serve from the cache"
+        assert warm < 1.0, f"warm whole-program build took {warm:.2f}s"
+
+    def test_json_format_and_exit_codes(self, tmp_path, capsys):
+        import json
+
+        bad = tmp_path / "karpenter_tpu" / "bad.py"
+        bad.parent.mkdir()
+        bad.write_text("import time\n\ndef f():\n    return time.time()\n")
+        assert main([str(bad), "--format", "json"]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["files"] == 1
+        assert [f["rule"] for f in out["findings"]] == ["KT002"]
+        assert {"rule", "path", "line", "message", "hint"} <= set(
+            out["findings"][0])
+        good = tmp_path / "karpenter_tpu" / "good.py"
+        good.write_text("def f():\n    return 1\n")
+        assert main([str(good), "--format", "json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["findings"] == []
+
+    def test_lock_order_cli(self, capsys):
+        assert main(["--lock-order"]) == 0
+        out = capsys.readouterr().out
+        assert "TpuSolver._lock" in out
+        assert "global lock-acquisition order" in out
+
+    def test_lock_order_cli_json(self, capsys):
+        import json
+
+        assert main(["--lock-order", "--format", "json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert "TpuSolver._lock" in out["order"]
+        assert any("->" in e for e in out["edges"])
+
+    def test_static_order_consistent_with_sanitizer_table(self):
+        """The KT012 static acquisition-order graph and the runtime
+        watcher's LOCK_ORDER cross-validate: every static edge between
+        tracked locks must agree with the table, and every tracked lock
+        that appears in static edges must BE in the table."""
+        from karpenter_tpu.analysis.callgraph import build_project
+        from karpenter_tpu.analysis.ktlint import collect_package_files
+        from karpenter_tpu.analysis.rules.kt012 import lock_graph
+        from karpenter_tpu.analysis.sanitize import LOCK_ORDER
+
+        files = collect_package_files()
+        project = build_project(files)
+        _nodes, edges, _kinds = lock_graph(files, project)
+        idx = {n: i for i, n in enumerate(LOCK_ORDER)}
+        for (src, dst), edge in edges.items():
+            if src == dst or src not in idx or dst not in idx:
+                continue
+            assert idx[src] < idx[dst], (
+                f"static edge {src} -> {dst} contradicts "
+                f"sanitize.LOCK_ORDER ({edge.witness()})")
+
+    def test_same_line_with_items_and_one_line_bodies_edge(self):
+        """`with self._a, self._b:` and `with self._lock: self.callee()`
+        put both acquisitions (or the call) on the with's own line — the
+        span checks must still see the nesting, or a real cycle written in
+        either style ships undetected."""
+        from karpenter_tpu.analysis.rules import kt012
+
+        src = ("karpenter_tpu/m.py", """
+        import threading
+
+        class A:
+            def __init__(self, b=None):
+                self._lock = threading.Lock()
+                self.b = b or B()
+
+            def outer(self):
+                with self._lock: self.b.grab()
+
+        class B:
+            def __init__(self, a: "A" = None):
+                self._lock = threading.Lock()
+                self.a = a
+
+            def grab(self):
+                with self._lock:
+                    pass
+
+            def outer(self):
+                with self._lock, self.a._lock:
+                    pass
+        """)
+        findings = lint_files([src], [kt012])
+        assert rules_of(findings) == ["KT012"]
+        assert "A._lock" in findings[0].message \
+            and "B._lock" in findings[0].message
+
+    def test_circular_reexport_resolves_to_none_not_recursion(self):
+        """A circular `from . import f` alias pair (a typo'd re-export
+        with no real def) must degrade to an unresolved call, never
+        recurse the lint run to death."""
+        from karpenter_tpu.analysis.callgraph import build_project
+
+        files = sources(
+            ("karpenter_tpu/pkg/__init__.py", """
+             from .b import f
+             """),
+            ("karpenter_tpu/pkg/b.py", """
+             from . import f
+             """),
+            ("karpenter_tpu/pkg/user.py", """
+             from . import f
+
+             def g():
+                 return f()
+             """),
+        )
+        project = build_project(files)   # must not raise RecursionError
+        assert project.funcs["karpenter_tpu.pkg.user:g"].edges == []
