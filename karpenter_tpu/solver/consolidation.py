@@ -98,6 +98,9 @@ def _screen_kernel(residual, member, pods, src, compat):
     return jax.vmap(one_subset)(member, pods, src)
 
 
+# ktlint: fence the screen IS the sync point — one dispatch + one D2H read
+# whose result gates which candidates enter the sweep; the deprovisioning
+# tick blocks on it by design (KT013: the fence bounds the whole screen)
 def screen_subset_deletes(
     nodes: Sequence[SimNode],
     subsets: Sequence[Sequence[int]],   # K subsets of node indices
