@@ -819,9 +819,23 @@ def main(argv=None) -> int:
 
         print("warmup: AOT bucket-grid precompile running "
               "(single ladder + megabatch rungs)...", flush=True)
+        # warm the slot cap this server will actually SERVE: a configured
+        # --max-slots / KT_MAX_SLOTS above the default rung grid would
+        # otherwise hit its first full flush cold and pay the megabatch
+        # compile inline (KT014 pins this plumbing)
+        cap = args.max_slots if args.max_slots is not None else int(
+            os.environ.get("KT_MAX_SLOTS", str(DEFAULT_MAX_SLOTS)))
+        cap = max(1, min(MEGA_MAX_SLOTS, cap))
+        # the doubling ladder up to the cap, derived — not a literal that
+        # rots the day MEGA_MAX_SLOTS moves (the KT014 drift class)
+        grid, r = {cap}, 2
+        while r < cap:
+            grid.add(r)
+            r *= 2
         n = service.scheduler.precompile_buckets(
             [Provisioner(name="default").with_defaults()],
             generate_catalog(full=not args.small),
+            mega_slots=tuple(sorted(grid)),
             wait=True,
         )
         print(f"warmup: {n} bucket programs compiled; serving", flush=True)
