@@ -46,7 +46,35 @@ _originals: Dict[Tuple[type, str], object] = {}
 
 
 class SanitizerError(AssertionError):
-    """Two threads entered a non-reentrant section of one object."""
+    """Two threads entered a non-reentrant section of one object, or one
+    thread acquired two tracked locks against the global order."""
+
+
+#: The ONE global lock-acquisition order (outer first): a thread may
+#: acquire a lock only while holding locks that appear EARLIER in this
+#: tuple; the runtime watcher below raises SanitizerError on an inversion.
+#: This is the linear extension of the KT012 static acquisition-order
+#: graph (`python -m karpenter_tpu.analysis --lock-order` prints the
+#: derived edges; tests/test_lint.py cross-validates that every static
+#: edge is consistent with this table — the static pass and the sanitizer
+#: check the same order from opposite sides: the pass proves what the
+#: source CAN do, the watcher observes what threads actually DO, including
+#: the closure/callback nestings no static pass can see, e.g. the
+#: admission queue's token-bucket gate running under the queue condition).
+LOCK_ORDER: Tuple[str, ...] = (
+    "Operator._reconcile_lock",
+    "SolverService._direct_lock",
+    "SolvePipeline._submit_lock",
+    "AdmissionControl._lock",
+    "AdmissionQueue._cond",
+    "RateLimiter._lock",        # the put() gate runs under the queue cond
+    "CircuitBreaker._lock",
+    "BatchScheduler._cold_lock",
+    "TpuSolver._lock",
+    "DeviceGuard._lock",
+    "InMemoryLeaseStore._lock",
+    "ThreadCoalescer._lock",
+)
 
 
 def _notify_flight(obj, detail: str) -> None:
@@ -103,15 +131,190 @@ def _wrap(cls: type, name: str, group: str):
     setattr(cls, name, guarded)
 
 
+# ---------------------------------------------------------------------------
+# runtime lock-order confirmation (the KT012 cross-check)
+# ---------------------------------------------------------------------------
+
+#: per-thread stack of (rank, name) for currently-held tracked locks
+_held = threading.local()
+
+#: gates checking/recording only — push/pop always maintain the held
+#: stack so proxies surviving an uninstall keep it truthful, while their
+#: order assertions and edge recording go silent (install() re-arms them)
+_watch_enabled = False
+
+#: (outer name, inner name) pairs actually observed nested at runtime —
+#: tests assert every observed edge is consistent with LOCK_ORDER, which
+#: is how the dynamic side cross-validates the static table
+_observed_edges: set = set()
+
+_init_originals: Dict[type, object] = {}
+
+
+def observed_lock_edges() -> set:
+    """Snapshot of the (outer, inner) nestings threads actually performed."""
+    with _STATE_LOCK:
+        return set(_observed_edges)
+
+
+class _OrderedLock:
+    """Order-asserting proxy around one tracked component lock.
+
+    ``acquire`` checks the acquiring thread's held stack against
+    :data:`LOCK_ORDER` and raises :class:`SanitizerError` on an inversion
+    — the deadlock's FIRST half becomes a deterministic exception at the
+    acquisition site instead of a wedged process under load.  Re-acquiring
+    the same proxy (RLock / Condition re-entry, condition-wait wakeups) is
+    always legal.  All other attributes (``wait``, ``notify``, ...)
+    delegate, so a wrapped Condition keeps its full surface."""
+
+    def __init__(self, inner, name: str):
+        self._kt_inner = inner
+        self._kt_name = name
+        self._kt_rank = LOCK_ORDER.index(name) if name in LOCK_ORDER \
+            else len(LOCK_ORDER)
+
+    def _kt_check(self) -> None:
+        if not _watch_enabled:
+            return  # uninstalled: surviving proxies delegate silently
+        stack = getattr(_held, "stack", None)
+        if not stack:
+            return
+        if any(name == self._kt_name for _rank, name in stack):
+            # re-entry of an already-held lock (RLock/Condition), however
+            # deep in the stack: the lock's own business, never an edge —
+            # the thread cannot deadlock on a lock it already owns
+            return
+        # the binding constraint is the HIGHEST-ranked distinct held lock,
+        # not the top of the stack: a legal re-entry of an early lock can
+        # sit on top with a low rank and must not mask a real inversion
+        # against a later-ranked lock still held beneath it
+        top_rank, top_name = max(stack, key=lambda e: e[0])
+        if top_rank > self._kt_rank:
+            # raise BEFORE recording: an acquisition that raises never
+            # happened, and the inverted pair must not poison the
+            # observed-edge set the cross-validation tests assert over
+            raise SanitizerError(
+                f"KT_SANITIZE: lock-order inversion — "
+                f"{threading.current_thread().name!r} acquiring "
+                f"`{self._kt_name}` while holding `{top_name}`; the global "
+                f"order (analysis/sanitize.py LOCK_ORDER, KT012) puts "
+                f"`{self._kt_name}` BEFORE `{top_name}` — two threads "
+                "taking opposite routes deadlock"
+            )
+        with _STATE_LOCK:
+            _observed_edges.add((top_name, self._kt_name))
+
+    def _kt_push(self) -> None:
+        if not hasattr(_held, "stack"):
+            _held.stack = []
+        _held.stack.append((self._kt_rank, self._kt_name))
+
+    def _kt_pop(self) -> None:
+        stack = getattr(_held, "stack", None)
+        if stack:
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][1] == self._kt_name:
+                    del stack[i]
+                    break
+
+    def acquire(self, *args, **kwargs):
+        self._kt_check()
+        got = self._kt_inner.acquire(*args, **kwargs)
+        if got:
+            self._kt_push()
+        return got
+
+    def release(self):
+        self._kt_pop()
+        return self._kt_inner.release()
+
+    def __enter__(self):
+        self._kt_check()
+        got = self._kt_inner.__enter__()
+        self._kt_push()
+        return got
+
+    def __exit__(self, *exc):
+        self._kt_pop()
+        return self._kt_inner.__exit__(*exc)
+
+    def __getattr__(self, name):
+        return getattr(self._kt_inner, name)
+
+
+def _wrap_locks(cls: type, attrs: Tuple[str, ...]) -> None:
+    """Post-``__init__`` hook replacing the instance's lock attributes with
+    order-asserting proxies (idempotent; uninstall restores __init__ — live
+    instances keep their proxies, which is harmless: a proxy without the
+    watcher installed still delegates)."""
+    if cls in _init_originals:
+        return
+    orig = cls.__init__
+    _init_originals[cls] = orig
+
+    @functools.wraps(orig)
+    def __init__(self, *args, **kwargs):
+        orig(self, *args, **kwargs)
+        for attr in attrs:
+            inner = getattr(self, attr, None)
+            if inner is not None and not isinstance(inner, _OrderedLock):
+                setattr(self, attr, _OrderedLock(
+                    inner, f"{cls.__name__}.{attr}"))
+
+    cls.__init__ = __init__
+
+
 def installed() -> bool:
     return bool(_originals)
 
 
 def install() -> None:
-    """Wrap the solver-path classes in lock-assertion proxies (idempotent)."""
-    from ..batcher import InflightQueue
+    """Wrap the solver-path classes in lock-assertion proxies and their
+    declared locks in order-asserting proxies (idempotent)."""
+    from ..admission import AdmissionControl, CircuitBreaker, RateLimiter
+    from ..admission.queue import AdmissionQueue
+    from ..batcher import InflightQueue, ThreadCoalescer
     from ..models.tensorize import TensorizeCache
+    from ..solver.guard import DeviceGuard
     from ..solver.scheduler import BatchScheduler
+    from ..solver.tpu import TpuSolver
+
+    # runtime confirmation of the KT012 static lock order: every tracked
+    # component lock becomes an order-asserting proxy; an acquisition that
+    # inverts LOCK_ORDER raises at the site (the deadlock's first half,
+    # made deterministic), and the nestings threads actually perform are
+    # recorded for the cross-validation tests
+    lock_plan: List[Tuple[type, Tuple[str, ...]]] = [
+        (BatchScheduler, ("_cold_lock",)),
+        (TpuSolver, ("_lock",)),
+        (DeviceGuard, ("_lock",)),
+        (AdmissionControl, ("_lock",)),
+        (AdmissionQueue, ("_cond",)),
+        (RateLimiter, ("_lock",)),
+        (CircuitBreaker, ("_lock",)),
+        (ThreadCoalescer, ("_lock",)),
+    ]
+    try:
+        from ..service.server import SolvePipeline as _SP
+        from ..service.server import SolverService as _SS
+    except ImportError:
+        pass  # grpc-less install: the in-process locks still watched
+    else:
+        lock_plan.append((_SP, ("_submit_lock",)))
+        lock_plan.append((_SS, ("_direct_lock",)))
+    try:
+        from ..operator import InMemoryLeaseStore as _LS
+        from ..operator import Operator as _Op
+    except ImportError:
+        pass  # keep the solver-side locks watched regardless
+    else:
+        lock_plan.append((_Op, ("_reconcile_lock",)))
+        lock_plan.append((_LS, ("_lock",)))
+    for cls, attrs in lock_plan:
+        _wrap_locks(cls, attrs)
+    global _watch_enabled
+    _watch_enabled = True
 
     plan: List[Tuple[type, str, str]] = [
         (BatchScheduler, "solve", "dispatch"),
@@ -138,7 +341,17 @@ def install() -> None:
 
 
 def uninstall() -> None:
-    """Restore the original methods (test teardown)."""
+    """Restore the original methods (test teardown).  Instances built while
+    installed keep their _OrderedLock proxies, but with the watch disabled
+    they delegate without checking or recording — sanitizer state cannot
+    leak into 'sanitizer off' test phases; new instances get plain locks."""
+    global _watch_enabled
+    _watch_enabled = False
     for (cls, name), fn in _originals.items():
         setattr(cls, name, fn)
     _originals.clear()
+    for cls, init in _init_originals.items():
+        cls.__init__ = init
+    _init_originals.clear()
+    with _STATE_LOCK:
+        _observed_edges.clear()

@@ -237,3 +237,192 @@ class TestWiring:
                            "_kt_sanitized", False)
         if pre:  # battletest mode: leave the proxies the way we found them
             sanitize.install()
+
+
+class TestLockOrderWatcher:
+    """Runtime confirmation of the KT012 static lock order (ISSUE 9): the
+    tracked component locks become order-asserting proxies under
+    KT_SANITIZE=1; acquiring against sanitize.LOCK_ORDER raises at the
+    site — the deadlock's first half, made deterministic — and the
+    nestings threads actually perform are recorded so the dynamic side
+    cross-validates the static table (the static pass proves what the
+    source CAN do; this watcher sees the closure/callback nestings it
+    can't, e.g. the admission token-bucket gate under the queue cond)."""
+
+    def test_legal_nesting_passes_and_is_recorded(self, sanitizer):
+        sched = BatchScheduler(backend="oracle", registry=Registry())
+        with sched._cold_lock:
+            with sched._tpu._lock:
+                pass
+        assert ("BatchScheduler._cold_lock", "TpuSolver._lock") \
+            in sanitize.observed_lock_edges()
+
+    def test_inversion_raises_at_the_acquisition_site(self, sanitizer):
+        sched = BatchScheduler(backend="oracle", registry=Registry())
+        with pytest.raises(SanitizerError, match="lock-order inversion"):
+            with sched._tpu._lock:
+                with sched._cold_lock:
+                    pass
+        # both stacks unwound: the legal order is clean again afterwards
+        with sched._cold_lock:
+            with sched._tpu._lock:
+                pass
+
+    def test_admission_gate_nesting_is_observed_in_order(self, sanitizer):
+        """The nesting NO static pass can see: AdmissionQueue.put runs the
+        rate-limiter gate inside its condition's critical section (the
+        token must be spent only after every capacity check).  The watcher
+        must observe it AND find it consistent with LOCK_ORDER."""
+        from karpenter_tpu.admission import AdmissionControl
+        from karpenter_tpu.admission.policy import (AdmissionPolicy,
+                                                    ClassQuota)
+        from karpenter_tpu.utils.clock import FakeClock
+
+        adm = AdmissionControl(
+            # a real token bucket: rate 0 short-circuits before its lock
+            policy=AdmissionPolicy(
+                quotas={"batch": ClassQuota(rate=100.0, burst=10.0)}),
+            registry=Registry(), clock=FakeClock())
+        ticket = adm.admit(("item", None), "batch")
+        adm.release(ticket)
+        edges = sanitize.observed_lock_edges()
+        assert ("AdmissionQueue._cond", "RateLimiter._lock") in edges
+        order = {n: i for i, n in enumerate(sanitize.LOCK_ORDER)}
+        for outer, inner in edges:
+            if outer in order and inner in order and outer != inner:
+                assert order[outer] < order[inner], (outer, inner)
+
+    def test_condition_reentry_and_wait_survive_the_proxy(self, sanitizer):
+        """AdmissionQueue._bump re-acquires the Condition under put() (the
+        lexical-discipline pattern KT004 wants) and get() waits on it —
+        both must work through the order-asserting proxy."""
+        from karpenter_tpu.admission.queue import AdmissionQueue
+        from karpenter_tpu.utils.clock import FakeClock
+
+        q = AdmissionQueue(clock=FakeClock())
+        ticket, reason, preempted = q.put(("x", None), "batch")
+        assert reason is None and not preempted
+        got = q.get(timeout=0.01)
+        assert got is ticket
+        assert q.get(timeout=0.0) is None
+
+    def test_uninstall_restores_plain_locks(self):
+        pre = sanitize.installed()
+        sanitize.install()
+        sanitize.uninstall()
+        try:
+            sched = BatchScheduler(backend="oracle", registry=Registry())
+            assert type(sched._cold_lock).__name__ != "_OrderedLock"
+            assert sanitize.observed_lock_edges() == set()
+        finally:
+            if pre:
+                sanitize.install()
+
+    def test_lock_order_table_names_real_locks(self):
+        """Every LOCK_ORDER entry must name a lock that actually exists
+        (class attr declared somewhere in the package) — a stale table row
+        would silently watch nothing."""
+        from karpenter_tpu.analysis.callgraph import build_project
+        from karpenter_tpu.analysis.ktlint import collect_package_files
+
+        project = build_project(collect_package_files())
+        declared = set()
+        for cid, cs in project.classes.items():
+            for attr in cs.locks:
+                declared.add(f"{cs.name}.{attr}")
+        missing = [n for n in sanitize.LOCK_ORDER if n not in declared]
+        assert missing == []
+
+    def test_deep_reentry_of_held_reentrant_lock_is_legal(self, sanitizer):
+        """Re-acquiring an already-held RLock while a LATER-ranked lock
+        sits on top of the stack is deadlock-free (the thread owns it) and
+        must neither raise nor record an inverted edge."""
+        from karpenter_tpu.admission import CircuitBreaker
+        from karpenter_tpu.utils.clock import FakeClock
+
+        br = CircuitBreaker(clock=FakeClock(), registry=Registry())
+        sched = BatchScheduler(backend="oracle", registry=Registry())
+        with br._lock:                  # rank 7 (RLock)
+            with sched._cold_lock:      # rank 8
+                with br._lock:          # re-entry under a later rank: legal
+                    pass
+        assert ("BatchScheduler._cold_lock", "CircuitBreaker._lock") \
+            not in sanitize.observed_lock_edges()
+
+    def test_inverted_acquisition_records_no_edge(self, sanitizer):
+        """An acquisition that RAISES never happened: the inverted pair
+        must not poison the observed-edge set (under battletest's
+        process-wide KT_SANITIZE=1 the set is long-lived, and a poisoned
+        entry would fail the order cross-validation in a later test)."""
+        sched = BatchScheduler(backend="oracle", registry=Registry())
+        with pytest.raises(SanitizerError, match="lock-order inversion"):
+            with sched._tpu._lock:
+                with sched._cold_lock:
+                    pass
+        assert ("TpuSolver._lock", "BatchScheduler._cold_lock") \
+            not in sanitize.observed_lock_edges()
+
+    def test_reentry_on_top_does_not_mask_inversion_beneath(self, sanitizer):
+        """A legal re-entry pushes a LOW rank on top of the stack; the
+        watcher must still judge new acquisitions against the highest-
+        ranked lock held beneath it, or real inversions go unreported."""
+        from karpenter_tpu.admission import CircuitBreaker
+        from karpenter_tpu.utils.clock import FakeClock
+
+        br = CircuitBreaker(clock=FakeClock(), registry=Registry())
+        sched = BatchScheduler(backend="oracle", registry=Registry())
+        with pytest.raises(SanitizerError, match="lock-order inversion"):
+            with br._lock:                  # rank 7 (RLock)
+                with sched._tpu._lock:      # rank 9
+                    with br._lock:          # legal re-entry: top is now 7
+                        with sched._cold_lock:  # rank 8 < held 9: inversion
+                            pass
+
+    def test_every_lock_order_entry_is_proxied(self, sanitizer):
+        """docs/ANALYSIS.md promises every LOCK_ORDER lock becomes an
+        order-asserting proxy; an unwrapped table row would silently
+        watch nothing (the operator-side locks regressed this once)."""
+        from karpenter_tpu.admission import (AdmissionControl,
+                                             CircuitBreaker, RateLimiter)
+        from karpenter_tpu.admission.queue import AdmissionQueue
+        from karpenter_tpu.batcher import ThreadCoalescer
+        from karpenter_tpu.operator import InMemoryLeaseStore, Operator
+        from karpenter_tpu.service.server import (SolvePipeline,
+                                                  SolverService)
+        from karpenter_tpu.solver.guard import DeviceGuard
+        from karpenter_tpu.solver.tpu import TpuSolver
+        from karpenter_tpu.utils.clock import FakeClock
+
+        reg = Registry()
+        seen = {}
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        seen["BatchScheduler._cold_lock"] = sched._cold_lock
+        seen["TpuSolver._lock"] = sched._tpu._lock
+        seen["DeviceGuard._lock"] = DeviceGuard()._lock
+        adm = AdmissionControl(registry=reg, clock=FakeClock())
+        seen["AdmissionControl._lock"] = adm._lock
+        seen["AdmissionQueue._cond"] = adm.queue._cond
+        seen["RateLimiter._lock"] = RateLimiter(rate=1.0,
+                                                clock=FakeClock())._lock
+        seen["CircuitBreaker._lock"] = adm.breaker._lock
+        seen["ThreadCoalescer._lock"] = ThreadCoalescer(lambda r: [])._lock
+        svc = SolverService(sched, registry=reg)
+        seen["SolverService._direct_lock"] = svc._direct_lock
+        pipe = SolvePipeline(sched, registry=reg, max_slots=1)
+        seen["SolvePipeline._submit_lock"] = pipe._submit_lock
+        seen["InMemoryLeaseStore._lock"] = InMemoryLeaseStore()._lock
+        try:
+            unwrapped = [n for n in sanitize.LOCK_ORDER
+                         if n in seen
+                         and type(seen[n]).__name__ != "_OrderedLock"]
+            assert unwrapped == []
+            missing = [n for n in sanitize.LOCK_ORDER
+                       if n not in seen and n != "Operator._reconcile_lock"]
+            assert missing == []   # table rows this test forgot to build
+        finally:
+            pipe.stop()
+        # Operator itself is heavyweight to construct; assert its __init__
+        # is hooked instead (the hook is what installs the proxy)
+        assert Operator.__init__.__name__ == "__init__"
+        from karpenter_tpu.analysis.sanitize import _init_originals
+        assert Operator in _init_originals
