@@ -1259,6 +1259,11 @@ def client(i):
                     owner_key=f"c{i}") for j in range(200)]
     req = codec.encode_request(pods, provs, catalog,
                                priority="best_effort")
+    # warm the HTTP/2 channel BEFORE the barrier: a cold channel's connect
+    # handshake staggers the burst by tens of ms per client on a loaded
+    # host — enough for the bound-2 queue to drain between arrivals and
+    # shed nothing (the exact outcome the retry below exists for)
+    c.health()
     start.wait()
     try:
         c.solve_raw(req)
@@ -1280,8 +1285,15 @@ assert len(ok) > 0, "nothing served"
 assert len(shed) > 0, "nothing shed under a 40-client simultaneous burst"
 print("BURST_OK")
 """
+        # the queue bound sheds when arrivals cluster; the class token
+        # bucket (rate 5/s, burst 2) sheds on burst VOLUME — 40 arrivals
+        # within any few-second window overdraw it no matter how much a
+        # loaded host's GIL staggers the clients, so the shed assertion no
+        # longer races the dispatcher's drain speed (both reasons map to
+        # the same typed RESOURCE_EXHAUSTED surface this test pins)
         env = dict(_os.environ, KT_SANITIZE="1", JAX_PLATFORMS="cpu",
-                   KT_ADMIT_QUEUE_TOTAL="2")
+                   KT_ADMIT_QUEUE_TOTAL="2", KT_ADMIT_RATE="5",
+                   KT_ADMIT_BURST="2")
         for attempt in range(2):
             p = _subprocess.run([_sys.executable, "-c", script],
                                 capture_output=True, text=True, timeout=240,
@@ -1290,10 +1302,10 @@ print("BURST_OK")
                                         _os.path.abspath(__file__))))
             if p.returncode == 0:
                 break
-            # confirm-on-breach: a loaded host can stagger the 40 client
-            # threads past the barrier enough that the bound-2 queue never
-            # overflows — that (and only that) outcome gets one retry;
-            # typed-error or sanitizer failures stay hard failures
+            # confirm-on-breach: a pathologically loaded host could still
+            # stagger the 40 clients past the bucket's refill horizon —
+            # that (and only that) outcome gets one retry; typed-error or
+            # sanitizer failures stay hard failures
             if "nothing shed" not in p.stderr:
                 break
         assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
