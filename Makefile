@@ -20,7 +20,10 @@ test:
 	$(PYTHON) -m pytest tests/ -x -q
 
 # ktlint: the repo-specific AST analyzer (rule catalog in docs/ANALYSIS.md);
-# exits non-zero on any unsuppressed KT001-KT006 finding
+# exits non-zero on any unsuppressed KT001-KT014 finding — the v2 suite
+# includes the whole-program call-graph passes (KT012 lock-order deadlocks,
+# KT013 interprocedural fence reachability, KT014 compile-surface audit);
+# tests/test_lint.py speed-gates the full run (<5s cold, <1s warm cache)
 lint:
 	$(PYTHON) -m karpenter_tpu.analysis
 
@@ -29,7 +32,9 @@ lint:
 # sweep and the suite, both under KT_SANITIZE=1 — the lock-discipline
 # sanitizer (analysis/sanitize.py) wraps BatchScheduler / SolvePipeline /
 # InflightQueue / TensorizeCache in lock-assertion proxies that raise on
-# cross-thread re-entrancy (the -race analog for our threading contracts)
+# cross-thread re-entrancy, and every tracked component lock in an
+# order-asserting proxy that raises on a runtime inversion of the KT012
+# global lock order (the -race analog for our threading contracts)
 battletest: lint
 	KT_SANITIZE=1 KT_BATTLE_SEEDS=24 KT_FUZZ_SEEDS=40 $(PYTHON) -m pytest tests/test_battle.py tests/test_fuzz_parity.py -q
 	KT_SANITIZE=1 $(PYTHON) -m pytest tests/ -q

@@ -130,9 +130,24 @@ def main(argv=None) -> int:
                     help="also profile the warm-start delta chain "
                          "(steady-state churn p50/p99 + mode mix) and the "
                          "batched consolidation sweep")
+    ap.add_argument("--lint-surface", action="store_true",
+                    help="dump the KT014 compile-surface audit as JSON — "
+                         "the runtime-constructible signature vocabulary "
+                         "(solve_dims keys, megabatch rungs per device "
+                         "floor) next to the precompile grid — for human "
+                         "diffing when the ladder changes; pure stdlib, "
+                         "no jax, exits immediately")
     args = ap.parse_args(argv)
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    if args.lint_surface:
+        from karpenter_tpu.analysis.ktlint import collect_package_files
+        from karpenter_tpu.analysis.rules.kt014 import surface
+
+        print(json.dumps(surface(collect_package_files()), indent=2))
+        return 0
+
     from bench import build_scenario
 
     import jax
