@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..events import Event
 from ..metrics import (
@@ -145,12 +145,18 @@ class AdmissionControl:
                 message=f"[{reason}] {message}", event_type="Warning"))
 
     # ---- admit (RPC threads) --------------------------------------------
-    def admit(self, item: object, pclass: str,
-              deadline_s: Optional[float] = None) -> AdmissionTicket:
-        """Admit one request into the bounded priority queue or raise the
-        typed shed error.  ``deadline_s`` is the caller's remaining budget
-        (gRPC deadline / explicit ``deadline_ms``); None falls back to the
-        policy default (``KT_DEFAULT_DEADLINE_MS``)."""
+    def _admit_posture(self, pclass: str,
+                       deadline_s: Optional[float]) -> Tuple[float,
+                                                             Optional[float]]:
+        """The class POSTURE shared by :meth:`admit` and
+        :meth:`admit_inline` — expired-deadline shed, brownout-rung shed,
+        and the atomic concurrency check-AND-reserve (two concurrent
+        admits at quota-1 must not both pass; the slot is counted BEFORE
+        the ticket can possibly be preempted/released, or a racing
+        release() that decrements first would leak a slot forever).
+        Raises the typed shed errors; on return the concurrency slot is
+        RESERVED — every later rejection path must roll it back.
+        Returns ``(now, effective_deadline_s)``."""
         if deadline_s is None:
             deadline_s = self.policy.default_deadline_s
         now = self.clock.now()
@@ -165,12 +171,6 @@ class AdmissionControl:
             self._count_shed(pclass, "brownout", msg)
             raise SolveShedError(msg, pclass=pclass, reason="brownout")
         quota = self.policy.quota(pclass)
-        # atomic check-AND-reserve: two concurrent admits at quota-1 must
-        # not both pass (check-then-increment under separate acquisitions
-        # overshoots), and the slot must be counted BEFORE the ticket can
-        # possibly be preempted — a preempting thread's release() runs the
-        # moment put() returns, so reserving after put would leak a slot
-        # forever when release decrements first
         with self._lock:
             inflight = self._inflight.get(pclass, 0)
             over = (quota.max_concurrency > 0
@@ -182,6 +182,16 @@ class AdmissionControl:
                    f"quota {quota.max_concurrency}")
             self._count_shed(pclass, "concurrency", msg)
             raise SolveShedError(msg, pclass=pclass, reason="concurrency")
+        return now, deadline_s
+
+    def admit(self, item: object, pclass: str,
+              deadline_s: Optional[float] = None) -> AdmissionTicket:
+        """Admit one request into the bounded priority queue or raise the
+        typed shed error.  ``deadline_s`` is the caller's remaining budget
+        (gRPC deadline / explicit ``deadline_ms``); None falls back to the
+        policy default (``KT_DEFAULT_DEADLINE_MS``)."""
+        now, deadline_s = self._admit_posture(pclass, deadline_s)
+        quota = self.policy.quota(pclass)
         deadline = None if deadline_s is None else now + deadline_s
         # the token bucket runs as put()'s LAST gate, inside the queue's
         # critical section after every capacity check: a request the queue
@@ -217,6 +227,32 @@ class AdmissionControl:
             raise SolveShedError(msg, pclass=pclass, reason=reason)
         self.registry.counter(ADMISSION_ADMITTED).inc({"class": pclass})
         return ticket
+
+    def admit_inline(self, pclass: str,
+                     deadline_s: Optional[float] = None) -> AdmissionTicket:
+        """Admission for a request served INLINE on its own RPC thread —
+        the delta fast path's idle-pipeline shortcut (service/server.py
+        ``SolvePipeline._solve_inline``).  The class POSTURE applies
+        exactly as at :meth:`admit`: expired deadlines shed, the brownout
+        ladder's shed rung sheds (a best_effort delta under L4 is refused
+        here like any other request), the concurrency quota reserves
+        atomically, and the token bucket spends last — but the ticket
+        never enters the queue (it dispatches the same instant), so
+        queue-depth quotas and preemption don't apply.  Pair with
+        :meth:`release` like any admitted ticket."""
+        now, deadline_s = self._admit_posture(pclass, deadline_s)
+        quota = self.policy.quota(pclass)
+        if not self.limiters[pclass].allow():
+            with self._lock:  # the reservation was for a refused ticket
+                self._inflight[pclass] = max(
+                    0, self._inflight.get(pclass, 0) - 1)
+            msg = f"{pclass} shed: class rate limit {quota.rate:g}/s exceeded"
+            self._count_shed(pclass, "rate_limited", msg)
+            raise SolveShedError(msg, pclass=pclass, reason="rate_limited")
+        self.registry.counter(ADMISSION_ADMITTED).inc({"class": pclass})
+        return AdmissionTicket(
+            item=None, pclass=pclass, enqueued_at=now,
+            deadline=None if deadline_s is None else now + deadline_s)
 
     def release(self, ticket: AdmissionTicket) -> None:
         """The ticket's request resolved (result, failure, shed, or stop):

@@ -65,10 +65,12 @@ LOCK_ORDER: Tuple[str, ...] = (
     "Operator._reconcile_lock",
     "SolverService._direct_lock",
     "SolvePipeline._submit_lock",
+    "SolvePipeline._sched_lock",  # held across dispatch/finalize + inline
     "AdmissionControl._lock",
     "AdmissionQueue._cond",
     "RateLimiter._lock",        # the put() gate runs under the queue cond
     "CircuitBreaker._lock",
+    "DeltaSessionTable._lock",  # table dict only; never held across solves
     "BatchScheduler._cold_lock",
     "TpuSolver._lock",
     "DeviceGuard._lock",
@@ -296,13 +298,15 @@ def install() -> None:
         (ThreadCoalescer, ("_lock",)),
     ]
     try:
+        from ..service.delta import DeltaSessionTable as _DT
         from ..service.server import SolvePipeline as _SP
         from ..service.server import SolverService as _SS
     except ImportError:
         pass  # grpc-less install: the in-process locks still watched
     else:
-        lock_plan.append((_SP, ("_submit_lock",)))
+        lock_plan.append((_SP, ("_submit_lock", "_sched_lock")))
         lock_plan.append((_SS, ("_direct_lock",)))
+        lock_plan.append((_DT, ("_lock",)))
     try:
         from ..operator import InMemoryLeaseStore as _LS
         from ..operator import Operator as _Op

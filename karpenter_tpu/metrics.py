@@ -184,6 +184,24 @@ ADMISSION_BREAKER_STATE = "karpenter_admission_breaker_state"
 ADMISSION_BREAKER_TRANSITIONS = "karpenter_admission_breaker_transitions_total"
 ADMISSION_BROWNOUT_LEVEL = "karpenter_admission_brownout_level"
 ADMISSION_HOST_ROUTED = "karpenter_admission_host_routed_total"
+DELTA_RPC = "karpenter_solver_delta_rpc_total"
+#: the full session-RPC outcome label population (KT003 zero-init source —
+#: service/delta.DeltaSessionTable and the pipeline both init from it):
+#: 'delta' (an incremental warm-start tier served the step), 'fallback_full'
+#: (a warm-start guard tripped and the step re-solved from the stripped
+#: base — the session survives), 'establish' (a full solve created or
+#: replaced the session chain), 'reseed' (a catalog/price epoch bump
+#: re-solved the chain from the stripped base server-side instead of
+#: cold-starting the client), 'session_unknown' (no live chain for the
+#: client's (session, epoch) — the client re-establishes with ONE full
+#: solve)
+DELTA_RPC_OUTCOMES = ("delta", "fallback_full", "establish", "reseed",
+                      "session_unknown")
+DELTA_RPC_DURATION = "karpenter_solver_delta_rpc_duration_seconds"
+DELTA_SESSIONS = "karpenter_solver_delta_sessions"
+DELTA_EVICTIONS = "karpenter_solver_delta_session_evictions_total"
+#: eviction-reason label population (KT003)
+DELTA_EVICT_REASONS = ("ttl", "capacity", "stop", "error")
 WARMSTART_SOLVES = "karpenter_solver_warmstart_solves_total"
 WARMSTART_DURATION = "karpenter_solver_warmstart_duration_seconds"
 WARMSTART_DISPLACED = "karpenter_solver_warmstart_displaced_pods"
@@ -384,6 +402,37 @@ INVENTORY = {
         "device path, by class and reason: 'breaker' (circuit open / "
         "half-open non-probe) or 'brownout' (degradation ladder rung 3+ "
         "for this class)."),
+    DELTA_RPC: (
+        "counter", ("outcome",),
+        "Session-routed Solve RPCs (delta serving, docs/ARCHITECTURE.md "
+        "round 14), by outcome: 'delta' (an incremental warm-start tier "
+        "served the step — the sub-ms fast path), 'fallback_full' (a "
+        "warm-start guard tripped and the step re-solved from the stripped "
+        "base; the session survives), 'establish' (a full solve created or "
+        "replaced the session chain), 'reseed' (a catalog/price epoch bump "
+        "re-solved the chain server-side from the stripped base), "
+        "'session_unknown' (no live chain for the client's (session, "
+        "epoch); the client re-establishes with one full solve).  A "
+        "healthy steady-state fleet is dominated by 'delta'; sustained "
+        "'session_unknown' means the table is too small or the TTL too "
+        "short (KT_DELTA_SESSIONS / KT_DELTA_TTL_S)."),
+    DELTA_RPC_DURATION: (
+        "histogram", (),
+        "Server-side wall time of one session-routed RPC dispatch "
+        "(session lookup + warm-start step + reply snapshot), seconds."),
+    DELTA_SESSIONS: (
+        "gauge", (),
+        "Live delta sessions currently held in the per-pipeline session "
+        "table (bounded by KT_DELTA_SESSIONS; TTL KT_DELTA_TTL_S)."),
+    DELTA_EVICTIONS: (
+        "counter", ("reason",),
+        "Delta sessions evicted from the table, by reason: 'ttl' (idle "
+        "past KT_DELTA_TTL_S), 'capacity' (LRU eviction at "
+        "KT_DELTA_SESSIONS), 'stop' (pipeline shutdown), 'error' (a "
+        "delta step raised mid-apply — the half-mutated chain must not "
+        "serve another epoch, so the session dies and the client "
+        "re-establishes).  An evicted session costs its client ONE "
+        "re-establishing full solve."),
     WARMSTART_SOLVES: (
         "counter", ("mode",),
         "Warm-start delta solves, by serving mode: 'noop' (removals only "

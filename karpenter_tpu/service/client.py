@@ -15,9 +15,11 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set
 
 import grpc
+
+from collections import OrderedDict
 
 from ..admission import SolveDeadlineError, SolveShedError, parse_class
 from ..metrics import Registry, registry as default_registry
@@ -29,6 +31,7 @@ from ..solver.scheduler import BatchScheduler
 from ..solver.types import SimNode, SolveResult
 from . import codec
 from . import solver_pb2 as pb
+from .delta import DeltaSessionUnknown, delta_enabled
 from .server import SERVICE
 
 logger = logging.getLogger(__name__)
@@ -355,3 +358,408 @@ class RemoteScheduler:
 
     def close(self) -> None:
         self.client.close()
+
+
+class DeltaSession:
+    """Session-stateful delta client over the Solve RPC — warm start over
+    the wire (docs/ARCHITECTURE.md round 14).
+
+    ``solve()`` establishes the session with one classic full solve;
+    ``solve_delta()`` then ships only the PERTURBATION (pod adds/removes,
+    ICE'd offerings, node reclaims, catalog-epoch bumps) and merges the
+    server's delta-shaped reply into a local ledger — steady-state churn
+    costs O(delta) on the wire and sub-milliseconds on the server instead
+    of re-shipping and re-solving the cluster.
+
+    Divergence safety: the server acks an epoch per applied step, and the
+    client sends its last ack as ``base_epoch``.  Any mismatch — evicted
+    session, server restart, a response lost to a deadline — is answered
+    ``session_state="unknown"``, and the client transparently re-sends the
+    full cluster AT MOST ONCE per call (no retry loop against a flapping
+    server; the full solve re-establishes the session).  Unacked
+    perturbations accumulate until a step is acked, so a shed/deadline'd
+    delta is simply retried cumulatively on the next call — never lost,
+    never double-applied.
+
+    Shed posture (the PR-5 typed surface): ``RESOURCE_EXHAUSTED`` maps to
+    :class:`SolveShedError` and a budgeted ``DEADLINE_EXCEEDED`` to
+    :class:`SolveDeadlineError` WITHOUT consuming the session — the
+    sidecar is protecting itself, not forgetting the chain; back off and
+    call again.  Transport failures drop the session (the next call
+    re-establishes against whatever replaced the sidecar).
+
+    ``KT_DELTA=0`` (client-side) turns the facade into a plain full-solve
+    client: every call re-ships the cluster with NO session fields on the
+    wire — byte-identical requests to pre-delta serving.
+
+    Results are VIEWS: the returned :class:`SolveResult` shares the
+    session's ledger containers (same ownership contract as
+    ``solver/warmstart.delta_solve`` consuming ``prev``); snapshot before
+    mutating.  Single-threaded by contract, like the scheduler facades.
+    """
+
+    def __init__(self, target: str, *, session_id: Optional[str] = None,
+                 timeout: float = 60.0, backend: str = "",
+                 priority: str = "", deadline_s: Optional[float] = None,
+                 client: Optional[SolverClient] = None) -> None:
+        import uuid
+
+        self.client = client or SolverClient(target, timeout=timeout)
+        self.session_id = session_id or uuid.uuid4().hex
+        self.backend = backend
+        self.priority = parse_class(priority) if priority else ""
+        self.deadline_s = deadline_s
+        self.enabled = delta_enabled()
+        # --- cluster ledger (ground truth the caller has asserted) ---
+        self._pods: Optional[Dict[str, PodSpec]] = None  # None: no solve yet
+        self._provisioners: List[Provisioner] = []
+        self._instance_types: List[InstanceType] = []
+        self._existing: List[SimNode] = []
+        #: pod name -> existing-node NAME for pods pre-seated on shipped
+        #: existing nodes (never in _pods/_assignments): removals of those
+        #: pods must unseat them from the _existing ledger too, or a later
+        #: re-establish ships phantom pods as seated ground truth
+        self._preseated: Dict[str, str] = {}
+        self._existing_by_name: Dict[str, SimNode] = {}
+        self._daemonsets: List[PodSpec] = []
+        self._unavailable: set = set()
+        self._allow_new_nodes = True
+        self._max_new_nodes: Optional[int] = None
+        self._it_by_name: Dict[str, InstanceType] = {}
+        self._catalog_epoch = 0
+        # --- solution ledger (merged from replies) ---
+        self._assignments: Dict[str, str] = {}
+        self._infeasible: Dict[str, str] = {}
+        self._nodes: "OrderedDict[str, SimNode]" = OrderedDict()
+        self._last_ms = 0.0
+        # --- session wire state ---
+        self._established = False
+        self._epoch = 0
+        # --- unacked perturbation (cumulative since the last ack; kept
+        # across typed sheds so nothing is lost, cleared on ack) ---
+        self._pend_add: Dict[str, PodSpec] = {}
+        self._pend_rm: Dict[str, None] = {}
+        self._pend_reclaim: List[str] = []
+        self._pend_ice: set = set()
+        self._catalog_dirty = False
+        #: full-solve resends this session performed (tests pin the
+        #: at-most-once-per-call contract on it)
+        self.full_resends = 0
+        #: delta RPCs attempted (ack'd or not)
+        self.delta_rpcs = 0
+
+    @property
+    def established(self) -> bool:
+        return self._established
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    # ---- public API -----------------------------------------------------
+    def solve(
+        self,
+        pods: Sequence[PodSpec],
+        provisioners: Sequence[Provisioner],
+        instance_types: Sequence[InstanceType],
+        *,
+        existing_nodes: Sequence[SimNode] = (),
+        daemonsets: Sequence[PodSpec] = (),
+        unavailable: Optional[Set[tuple]] = None,
+        allow_new_nodes: bool = True,
+        max_new_nodes: Optional[int] = None,
+        catalog_epoch: int = 0,
+    ) -> SolveResult:
+        """(Re-)establish the session: full solve, full cluster on the
+        wire, ledger reset to the arguments."""
+        self._pods = {p.name: p for p in pods}
+        self._provisioners = list(provisioners)
+        self._instance_types = list(instance_types)
+        self._it_by_name = {it.name: it for it in self._instance_types}
+        self._existing = list(existing_nodes)
+        self._existing_by_name = {n.name: n for n in self._existing}
+        self._preseated = {p.name: n.name
+                           for n in self._existing for p in n.pods}
+        self._daemonsets = list(daemonsets)
+        self._unavailable = set(unavailable or ())
+        self._allow_new_nodes = allow_new_nodes
+        self._max_new_nodes = max_new_nodes
+        self._catalog_epoch = int(catalog_epoch)
+        self._clear_pending()
+        return self._reestablish()
+
+    def solve_delta(
+        self,
+        added: Sequence[PodSpec] = (),
+        removed: Sequence[str] = (),
+        iced: Sequence[object] = (),
+        *,
+        catalog_epoch: Optional[int] = None,
+        provisioners: Optional[Sequence[Provisioner]] = None,
+        instance_types: Optional[Sequence[InstanceType]] = None,
+    ) -> SolveResult:
+        """One churn step: ``added`` pods join, ``removed`` pod names
+        leave, ``iced`` entries are offering tuples newly unavailable or
+        node NAMES reclaimed (their pods re-place).  A ``catalog_epoch``
+        bump (price/catalog change) must ship the new ``instance_types``;
+        the server then re-seeds the chain from the stripped base instead
+        of cold-starting the session."""
+        if self._pods is None:
+            raise DeltaSessionUnknown(
+                "DeltaSession.solve() must establish the session before "
+                "solve_delta()")
+        # 1. fold the perturbation into the cluster ledger + pending set.
+        # Removals BEFORE adds, matching the server's apply order
+        # (warmstart unseats removals first, then places adds), so a
+        # same-call replace (removed=[X], added=[X']) keeps both halves.
+        for name in removed:
+            self._pods.pop(name, None)
+            if name in self._pend_add:
+                del self._pend_add[name]  # the server never saw the add
+            else:
+                self._pend_rm[name] = None
+        for p in added:
+            self._pods[p.name] = p
+            self._pend_add[p.name] = p
+            # a pending REMOVAL of the same name stays pending: the
+            # server's old pod is still seated until the removal lands,
+            # and dropping it here would double-book the old node with
+            # a silently diverging chain (the server applies removed
+            # before added, so sending both is exactly right)
+        for entry in iced:
+            if isinstance(entry, str):
+                self._reclaim_locally(entry)
+                self._pend_reclaim.append(entry)
+            else:
+                self._unavailable.add(tuple(entry))
+                self._pend_ice.add(tuple(entry))
+        if catalog_epoch is not None and catalog_epoch != self._catalog_epoch:
+            if instance_types is None:
+                raise ValueError(
+                    "a catalog_epoch bump must carry the new instance_types")
+            self._catalog_epoch = int(catalog_epoch)
+            self._instance_types = list(instance_types)
+            self._it_by_name = {it.name: it for it in self._instance_types}
+            if provisioners is not None:
+                self._provisioners = list(provisioners)
+            self._catalog_dirty = True
+        # 2. dispatch: delta when the session is live, else ONE full solve
+        if not self.enabled or not self._established:
+            return self._reestablish()
+        req = codec.encode_request(
+            list(self._pend_add.values()),
+            self._provisioners if self._catalog_dirty else (),
+            self._instance_types if self._catalog_dirty else (),
+            unavailable=set(self._pend_ice),
+            backend=self.backend, priority=self.priority,
+            deadline_ms=(self.deadline_s * 1000.0
+                         if self.deadline_s else None),
+            session_id=self.session_id, base_epoch=self._epoch, delta=True,
+            removed_pods=list(self._pend_rm),
+            reclaimed_nodes=list(self._pend_reclaim),
+            catalog_epoch=self._catalog_epoch,
+        )
+        self.delta_rpcs += 1
+        reply = codec.decode_delta_reply(self._rpc(req))
+        if reply.state != "ok":
+            # SESSION_UNKNOWN (evicted / epoch mismatch / delta-off
+            # server): exactly ONE transparent full resend re-establishes
+            # — never a retry loop, never a silently diverged chain
+            self._established = False
+            return self._reestablish()
+        self._epoch = reply.epoch
+        if reply.full:
+            self._apply_full(reply)
+        else:
+            self._apply_delta(reply)
+        self._clear_pending()
+        self._last_ms = reply.solve_ms
+        return self.result()
+
+    def result(self) -> SolveResult:
+        """The session's current solution VIEW (shared containers — valid
+        until the next call; snapshot to keep)."""
+        return SolveResult(
+            nodes=list(self._nodes.values()),
+            assignments=self._assignments,
+            infeasible=self._infeasible,
+            existing_nodes=list(self._existing),
+            solve_ms=self._last_ms,
+        )
+
+    def close(self) -> None:
+        self.client.close()
+
+    # ---- internals ------------------------------------------------------
+    def _clear_pending(self) -> None:
+        self._pend_add.clear()
+        self._pend_rm.clear()
+        self._pend_reclaim = []
+        self._pend_ice = set()
+        self._catalog_dirty = False
+
+    def _reclaim_locally(self, name: str) -> None:
+        """A node reclaim mutates the cluster ledger NOW (the node is
+        gone, that is ground truth); its displaced pods become offered
+        pods so a later full re-establish still schedules them.  The
+        SOLUTION ledger only changes when a reply is acked."""
+        kept = []
+        for n in self._existing:
+            if n.name == name:
+                for p in n.pods:
+                    self._preseated.pop(p.name, None)
+                    if not p.is_daemon:
+                        self._pods[p.name] = p
+            else:
+                kept.append(n)
+        self._existing = kept
+        self._existing_by_name.pop(name, None)
+
+    def _rpc(self, req: pb.SolveRequest) -> pb.SolveResponse:
+        """solve_raw with the PR-5 typed shed surface.  Typed sheds do NOT
+        consume the session (pending perturbation + epoch survive for the
+        next call); transport failures drop it (the next call re-
+        establishes against whatever replaced the sidecar)."""
+        rpc_timeout = (min(self.client.timeout, self.deadline_s)
+                       if self.deadline_s else None)
+        try:
+            return self.client.solve_raw(req, timeout=rpc_timeout)
+        except grpc.RpcError as err:
+            code = (err.code()
+                    if callable(getattr(err, "code", None)) else None)
+            detail = getattr(err, "details", lambda: "")() or ""
+            if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                # ktlint: allow[KT009] client-side re-map of a shed the
+                # serving side already counted in karpenter_admission_shed_total
+                raise SolveShedError(
+                    f"solver sidecar shed this delta solve: {detail}",
+                    pclass=self.priority, reason="remote_shed") from err
+            if (code == grpc.StatusCode.DEADLINE_EXCEEDED
+                    and self.deadline_s is not None):
+                # ktlint: allow[KT009] client-side re-map of a deadline the
+                # serving side already counted
+                raise SolveDeadlineError(
+                    f"solve deadline budget ({self.deadline_s:g}s) spent: "
+                    f"{detail}", pclass=self.priority,
+                    reason="deadline") from err
+            # transport failure: the channel may be wedged in backoff
+            # (see SolverClient.reset) and the sidecar may have restarted
+            # without our chain — drop the session, rebuild the channel
+            self._established = False
+            self.client.reset()
+            raise
+
+    def _reestablish(self) -> SolveResult:
+        """ONE full solve from the cluster ledger; establishes the session
+        when both sides have delta serving on."""
+        session_kw = {}
+        if self.enabled:
+            session_kw = dict(session_id=self.session_id, delta=False,
+                              catalog_epoch=self._catalog_epoch)
+        req = codec.encode_request(
+            list(self._pods.values()), self._provisioners,
+            self._instance_types,
+            existing_nodes=self._existing, daemonsets=self._daemonsets,
+            unavailable=self._unavailable or None,
+            allow_new_nodes=self._allow_new_nodes,
+            max_new_nodes=self._max_new_nodes,
+            backend=self.backend, priority=self.priority,
+            deadline_ms=(self.deadline_s * 1000.0
+                         if self.deadline_s else None),
+            **session_kw,
+        )
+        self.full_resends += 1
+        reply = codec.decode_delta_reply(self._rpc(req))
+        self._established = reply.state == "ok"
+        self._epoch = reply.epoch
+        self._apply_full(reply)
+        self._clear_pending()
+        self._last_ms = reply.solve_ms
+        return self.result()
+
+    def _attach(self, node: SimNode) -> SimNode:
+        """Re-attach the ledger's real PodSpecs (the wire carries names)
+        and re-hydrate node fidelity from the ledger's catalog: the wire's
+        NewNode is placement-only (type/zone/ct/price/pod names), but
+        callers — and the ground-truth validator — read allocatable and
+        labels off the session's view."""
+        node.pods = [self._pods.get(p.name, p) for p in node.pods]
+        it = self._it_by_name.get(node.instance_type)
+        if it is not None and not node.allocatable:
+            node.allocatable = dict(it.allocatable)
+        node.stamp_labels()
+        return node
+
+    def _apply_full(self, reply) -> None:
+        self._assignments = dict(reply.assignments)
+        self._infeasible = dict(reply.infeasible)
+        self._nodes = OrderedDict(
+            (n.name, self._attach(n)) for n in reply.nodes)
+
+    def _apply_delta(self, reply) -> None:
+        """Merge one acked incremental step into the solution ledger, in
+        the same order the server applied it: removals unseat, reclaims
+        and pruned proposals drop nodes, new nodes appear, then the
+        step's (re)placements land."""
+        # removals: targeted scan-and-delete of the ONE departing pod per
+        # node (the merge runs on every delta RPC — a full pods-list
+        # rebuild per removal would cost O(delta x node width))
+        for name in self._pend_rm:
+            old = self._assignments.pop(name, None)
+            self._infeasible.pop(name, None)
+            if old is None:
+                # a pod PRE-SEATED on a shipped existing node (never in
+                # assignments): unseat it from the _existing ledger too —
+                # a re-establish ships those pods as seated ground truth,
+                # and a phantom would make the server pack around
+                # capacity the departed pod no longer uses
+                old = self._preseated.pop(name, None)
+                node = (self._existing_by_name.get(old)
+                        if old is not None else None)
+            else:
+                node = self._nodes.get(old)
+            if node is not None:
+                for i, p in enumerate(node.pods):
+                    if p.name == name:
+                        del node.pods[i]
+                        break
+        for rname in self._pend_reclaim:
+            node = self._nodes.pop(rname, None)
+            for p in (node.pods if node is not None else ()):
+                self._assignments.pop(p.name, None)
+            # a reclaimed EXISTING node left the ledger at call time; any
+            # OTHER placement that pointed at it (a delta-placed pod) is
+            # superseded by this reply — every displaced pod arrives in
+            # reply.assignments or reply.infeasible (the server's watch
+            # set), so no O(cluster) sweep of the assignments dict is
+            # needed here
+        for rname in reply.removed_nodes:
+            self._nodes.pop(rname, None)
+        for node in reply.nodes:
+            self._nodes[node.name] = self._attach(node)
+        # the step's placements: every watch pod was UNSEATED before this
+        # step placed it (adds were never seated, re-offers were
+        # infeasible, reclaim-displaced pods lost their node above, and
+        # the incremental tiers never move any other pod), and a node
+        # arriving in reply.nodes already carries its pods — so appends
+        # below need no membership scan
+        new_names = {n.name for n in reply.nodes}
+        for name, target in reply.assignments.items():
+            old = self._assignments.get(name)
+            if old is not None and old != target:
+                onode = self._nodes.get(old)  # robustness: never expected
+                if onode is not None:
+                    onode.pods = [p for p in onode.pods if p.name != name]
+            self._assignments[name] = target
+            self._infeasible.pop(name, None)
+            if target not in new_names:
+                tnode = self._nodes.get(target)
+                if tnode is not None:
+                    tnode.pods.append(
+                        self._pods.get(name, PodSpec(name=name)))
+        for name, why in reply.infeasible.items():
+            if name in self._pods:
+                self._infeasible[name] = why
+                # a pod that WAS placed and is now unplaceable (its node
+                # reclaimed, nowhere to go) must not keep a stale entry
+                self._assignments.pop(name, None)

@@ -145,14 +145,29 @@ def encode_request(
     backend: str = "",
     priority: str = "",
     deadline_ms: Optional[float] = None,
+    session_id: str = "",
+    base_epoch: int = 0,
+    delta: bool = False,
+    removed_pods: Sequence[str] = (),
+    reclaimed_nodes: Sequence[str] = (),
+    catalog_epoch: int = 0,
 ) -> pb.SolveRequest:
     # admission fields (docs/ADMISSION.md): "" / 0 are the backward-
     # compatible wire defaults — the server folds them into its configured
     # default class / deadline, so an old client is indistinguishable from
-    # one that sent nothing
+    # one that sent nothing.  The delta-session fields (ARCHITECTURE.md
+    # round 14) default the same way: an empty session_id is a classic
+    # full solve; delta=True reuses `pods` for the ADDED pods and
+    # `unavailable` for the newly ICE'd offerings.
     req = pb.SolveRequest(allow_new_nodes=allow_new_nodes, backend=backend,
                           priority_class=priority or "",
-                          deadline_ms=float(deadline_ms or 0.0))
+                          deadline_ms=float(deadline_ms or 0.0),
+                          session_id=session_id or "",
+                          base_epoch=int(base_epoch or 0),
+                          delta=bool(delta),
+                          catalog_epoch=int(catalog_epoch or 0))
+    req.removed_pods.extend(removed_pods)
+    req.reclaimed_nodes.extend(reclaimed_nodes)
     req.pods.extend(encode_pod(p) for p in pods)
     req.provisioners.extend(encode_provisioner(p) for p in provisioners)
     req.instance_types.extend(encode_instance_type(t) for t in instance_types)
@@ -323,6 +338,83 @@ def decode_request(req: pb.SolveRequest):
         unavailable={(u.instance_type, u.zone, u.capacity_type) for u in req.unavailable},
         allow_new_nodes=req.allow_new_nodes,
         max_new_nodes=req.max_new_nodes if req.has_max_new_nodes else None,
+    )
+
+
+def decode_delta_fields(req: pb.SolveRequest) -> Optional[dict]:
+    """The delta-session envelope of a SolveRequest, or None for a classic
+    (sessionless) solve.  Kept OUT of :func:`decode_request`'s dict — that
+    dict feeds ``scheduler.solve(**kwargs)`` verbatim, and an old decoder
+    reading new-field defaults must keep behaving like a plain solve."""
+    sid = getattr(req, "session_id", "")
+    if not sid:
+        return None
+    return dict(
+        session_id=sid,
+        base_epoch=int(getattr(req, "base_epoch", 0)),
+        delta=bool(getattr(req, "delta", False)),
+        removed=list(getattr(req, "removed_pods", ())),
+        reclaimed=list(getattr(req, "reclaimed_nodes", ())),
+        catalog_epoch=int(getattr(req, "catalog_epoch", 0)),
+    )
+
+
+def encode_delta_reply(reply) -> pb.SolveResponse:
+    """service/delta.DeltaReply -> wire.  Incremental replies carry only
+    the step's changes; ``session_state``/``session_epoch``/``delta_mode``
+    tell the client how to merge (service/client.DeltaSession)."""
+    out = pb.SolveResponse(
+        solve_ms=reply.solve_ms,
+        session_epoch=int(reply.epoch),
+        session_state=reply.state,
+        delta_mode=reply.mode,
+    )
+    for n in reply.nodes:
+        out.nodes.append(pb.NewNode(
+            name=n.name, instance_type=n.instance_type,
+            provisioner=n.provisioner, zone=n.zone,
+            capacity_type=n.capacity_type, price=n.price,
+            pod_names=[p.name for p in n.pods],
+        ))
+    for k, v in reply.assignments.items():
+        out.assignments[k] = v
+    for k, v in reply.infeasible.items():
+        out.infeasible[k] = v
+    out.removed_nodes.extend(reply.removed_nodes)
+    return out
+
+
+#: delta_mode values whose reply carries the WHOLE solution (the client
+#: replaces its ledger wholesale instead of merging the step's changes)
+FULL_REPLY_MODES = ("establish", "reseed", "full", "")
+
+
+def decode_delta_reply(resp: pb.SolveResponse):
+    """wire -> service/delta.DeltaReply (node pods are name-stub PodSpecs,
+    like :func:`decode_response`; DeltaSession re-attaches its ledger's
+    real objects)."""
+    from .delta import DeltaReply
+
+    nodes = []
+    for n in resp.nodes:
+        node = SimNode(
+            instance_type=n.instance_type, provisioner=n.provisioner,
+            zone=n.zone, capacity_type=n.capacity_type, price=n.price,
+            allocatable={}, name=n.name,
+        )
+        node.pods = [PodSpec(name=pn) for pn in n.pod_names]
+        nodes.append(node)
+    mode = getattr(resp, "delta_mode", "")
+    return DeltaReply(
+        state=getattr(resp, "session_state", ""),
+        epoch=int(getattr(resp, "session_epoch", 0)),
+        mode=mode,
+        full=mode in FULL_REPLY_MODES,
+        assignments=dict(resp.assignments),
+        infeasible=dict(resp.infeasible),
+        nodes=nodes,
+        removed_nodes=list(getattr(resp, "removed_nodes", ())),
+        solve_ms=resp.solve_ms,
     )
 
 

@@ -1,0 +1,247 @@
+"""Delta serving — the session-stateful side of warm-start over the wire.
+
+PR 6 made steady-state reconcile a sub-millisecond incremental update
+(``solver/warmstart.delta_solve``), but every gRPC ``Solve`` still
+re-shipped the full cluster and re-solved from scratch.  This module holds
+the server-side session state that closes that gap (ISSUE 10; the serving
+protocol itself lives in ``service/server.py`` ``SolvePipeline``
+``_dispatch_delta`` and the client facade in ``service/client.py``
+``DeltaSession``):
+
+- :class:`DeltaSessionTable` — a bounded, TTL-evicted table of live
+  warm-start chains, one per client session: each :class:`SessionEntry`
+  carries the previous :class:`~karpenter_tpu.solver.types.SolveResult`
+  (whose ``_warmstart_meta`` IS the incremental chain), the catalog the
+  chain was packed against, and the epoch counter the wire protocol acks.
+- :class:`DeltaReply` — the dispatcher-built, DETACHED response view: the
+  session chain is mutated by the next delta the moment the dispatcher
+  moves on, so everything the RPC thread encodes is snapshotted here
+  first (O(delta) per incremental step; O(cluster) only on the rare
+  establish/reseed/full-shaped replies).
+- :class:`DeltaSessionUnknown` — the typed "no live chain for your
+  (session, epoch)" outcome; the wire maps it to
+  ``session_state="unknown"`` and the client re-establishes with ONE full
+  solve (never a retry loop, never silent divergence).
+
+Epoch contract: the server acks ``epoch`` after applying each step; a
+client must send ``base_epoch`` equal to the last ack.  Any mismatch —
+lost response, evicted session, server restart — is answered ``unknown``,
+so an ambiguous outcome can only ever cost one re-establishing full
+solve, never a diverged chain.
+
+Knobs: ``KT_DELTA`` (default on; 0 disables the whole path and the wire
+behaves byte-identically to pre-delta serving), ``KT_DELTA_SESSIONS``
+(table capacity, default 64), ``KT_DELTA_TTL_S`` (idle TTL, default 900).
+
+Known limitation (documented, bounded): session ESTABLISHMENTS are full
+solves served synchronously on the fast path (held batches are flushed
+first, so other traffic proceeds between them), not coalesced into
+megabatches — after a restart wipes the table, N re-establishing clients
+serialize N full solves.  The cost is bounded by ``KT_DELTA_SESSIONS`` x
+one full solve and paid once per restart; routing establishes through
+the coalescer while seeding the table from finalization is the follow-on
+if restart storms ever dominate (ROADMAP item 2's fleet story).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..metrics import (
+    DELTA_EVICT_REASONS,
+    DELTA_EVICTIONS,
+    DELTA_RPC,
+    DELTA_RPC_DURATION,
+    DELTA_RPC_OUTCOMES,
+    DELTA_SESSIONS,
+    Registry,
+    registry as default_registry,
+)
+from ..solver.types import SimNode, SolveResult
+from ..utils.clock import Clock
+
+#: default live-session capacity per pipeline (KT_DELTA_SESSIONS); LRU past
+#: it — an evicted session costs its client one re-establishing full solve
+DEFAULT_SESSIONS = 64
+#: default idle TTL, seconds (KT_DELTA_TTL_S): a reconcile loop ticks every
+#: few seconds, so 15 idle minutes means the client is gone
+DEFAULT_TTL_S = 900.0
+
+
+def delta_enabled() -> bool:
+    """KT_DELTA=0 turns delta serving off entirely: session fields on the
+    wire are ignored, every Solve takes the classic full path — byte-
+    identical to pre-delta behavior."""
+    return os.environ.get("KT_DELTA", "1") != "0"
+
+
+class DeltaSessionUnknown(Exception):
+    """The server holds no live chain for the client's (session, epoch) —
+    evicted, never established, epoch mismatch after a lost response, or
+    a catalog-epoch bump the request did not carry the new catalog for.
+    The client's contract: re-establish with ONE full solve."""
+
+
+@dataclass
+class SessionEntry:
+    """One live warm-start chain.  Dispatcher-owned after table lookup —
+    only the pipeline's single dispatcher thread ever reads or mutates the
+    chain state; the table lock below guards only the table itself."""
+
+    session_id: str
+    prev: SolveResult            # carries _warmstart_meta across the chain
+    epoch: int                   # acked after every applied step
+    catalog_epoch: int
+    provisioners: Sequence
+    instance_types: Sequence
+    daemonsets: Sequence = ()
+    #: every offering ever ICE'd onto this chain (establishment set + each
+    #: step's wire set): re-passed on every step so a guard-trip full
+    #: fallback — which drops the chain meta — cannot forget an ICE
+    unavailable: set = field(default_factory=set)
+    last_used: float = 0.0
+
+
+@dataclass
+class DeltaReply:
+    """Detached response view the dispatcher hands the RPC thread.
+
+    ``full`` replies (establish / reseed / guard-trip fallback) carry the
+    whole solution; incremental replies carry ONLY the step's changes —
+    (re)placed watch pods in ``assignments``/``infeasible``, nodes the
+    step created in ``nodes``, proposal nodes it pruned in
+    ``removed_nodes`` — and the client merges them into its ledger.
+    Every container here is a copy: the session chain mutates under the
+    next delta while the RPC thread is still encoding this one."""
+
+    state: str                    # "ok" | "unknown" | "" (delta off)
+    epoch: int = 0
+    mode: str = ""                # noop|host|scan|full|establish|reseed
+    full: bool = True             # replace-wholesale vs merge
+    assignments: Dict[str, str] = field(default_factory=dict)
+    infeasible: Dict[str, str] = field(default_factory=dict)
+    nodes: List[SimNode] = field(default_factory=list)
+    removed_nodes: List[str] = field(default_factory=list)
+    solve_ms: float = 0.0
+
+
+class DeltaSessionTable:
+    """Bounded, TTL-evicted map of live delta sessions (one per pipeline).
+
+    Locking: the table dict is touched from the dispatcher (every
+    session-routed RPC) and shutdown (``clear``), so every ``_sessions``
+    access sits under ``_lock`` — ktlint KT015 pins this discipline and
+    the KT_SANITIZE runtime watcher proxies the lock into the global
+    order (analysis/sanitize.py LOCK_ORDER).  Entry CONTENTS are
+    dispatcher-owned and never touched under the lock: holding it across
+    a solve would serialize eviction behind device work."""
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 clock: Optional[Clock] = None,
+                 capacity: Optional[int] = None,
+                 ttl_s: Optional[float] = None) -> None:
+        self.registry = registry or default_registry
+        self.clock = clock or Clock()
+        if capacity is None:
+            capacity = int(os.environ.get("KT_DELTA_SESSIONS",
+                                          str(DEFAULT_SESSIONS)))
+        if ttl_s is None:
+            ttl_s = float(os.environ.get("KT_DELTA_TTL_S",
+                                         str(DEFAULT_TTL_S)))
+        self.capacity = max(1, capacity)
+        self.ttl_s = max(0.0, ttl_s)
+        self._lock = threading.Lock()
+        #: LRU order: oldest first  # guarded-by: _lock
+        self._sessions: "OrderedDict[str, SessionEntry]" = OrderedDict()
+        zero_init_metrics(self.registry)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def _gauge_locked(self) -> None:
+        self.registry.gauge(DELTA_SESSIONS).set(len(self._sessions))
+
+    def _evict_expired_locked(self, now: float) -> None:
+        if self.ttl_s <= 0:
+            return
+        dead = [sid for sid, e in self._sessions.items()
+                if now - e.last_used > self.ttl_s]
+        for sid in dead:
+            del self._sessions[sid]
+        if dead:
+            self.registry.counter(DELTA_EVICTIONS).inc(
+                {"reason": "ttl"}, value=float(len(dead)))
+
+    def get(self, session_id: str) -> Optional[SessionEntry]:
+        """Look up a live session (touches its TTL + LRU position); expired
+        entries are evicted on the way."""
+        now = self.clock.now()
+        with self._lock:
+            self._evict_expired_locked(now)
+            entry = self._sessions.get(session_id)
+            if entry is not None:
+                entry.last_used = now
+                self._sessions.move_to_end(session_id)
+            self._gauge_locked()
+            return entry
+
+    def put(self, entry: SessionEntry) -> None:
+        """Insert or replace a session; LRU-evicts past capacity."""
+        now = self.clock.now()
+        entry.last_used = now
+        with self._lock:
+            self._evict_expired_locked(now)
+            self._sessions[entry.session_id] = entry
+            self._sessions.move_to_end(entry.session_id)
+            evicted = 0
+            while len(self._sessions) > self.capacity:
+                self._sessions.popitem(last=False)
+                evicted += 1
+            if evicted:
+                self.registry.counter(DELTA_EVICTIONS).inc(
+                    {"reason": "capacity"}, value=float(evicted))
+            self._gauge_locked()
+
+    def drop(self, session_id: str, reason: str = "error") -> None:
+        """Evict one session.  The error path: a delta step that raised
+        mid-apply leaves the chain half-mutated at an UNCHANGED epoch —
+        the client's cumulative retry would pass the epoch check and
+        re-apply onto a corrupted base, so the only safe outcome is
+        eviction (the client re-establishes with one full solve)."""
+        with self._lock:
+            if self._sessions.pop(session_id, None) is not None:
+                self.registry.counter(DELTA_EVICTIONS).inc(
+                    {"reason": reason})
+            self._gauge_locked()
+
+    def clear(self, reason: str = "stop") -> None:
+        with self._lock:
+            n = len(self._sessions)
+            self._sessions.clear()
+            if n:
+                self.registry.counter(DELTA_EVICTIONS).inc(
+                    {"reason": reason}, value=float(n))
+            self._gauge_locked()
+
+
+def zero_init_metrics(registry: Registry) -> None:
+    """Register every delta-serving series at 0 from construction (KT003:
+    a counter born at its first increment loses that increment to
+    rate()/increase())."""
+    rpc = registry.counter(DELTA_RPC)
+    for outcome in DELTA_RPC_OUTCOMES:
+        if not rpc.has({"outcome": outcome}):
+            rpc.inc({"outcome": outcome}, value=0.0)
+    evict = registry.counter(DELTA_EVICTIONS)
+    for reason in DELTA_EVICT_REASONS:
+        if not evict.has({"reason": reason}):
+            evict.inc({"reason": reason}, value=0.0)
+    gauge = registry.gauge(DELTA_SESSIONS)
+    if not gauge.has():
+        gauge.set(0)
+    registry.histogram(DELTA_RPC_DURATION)

@@ -30,6 +30,8 @@ from ..admission import (
 )
 from ..batcher import InflightQueue, SlotCoalescer
 from ..metrics import (
+    DELTA_RPC,
+    DELTA_RPC_DURATION,
     INFLIGHT_DEPTH,
     MEGABATCH_FLUSH,
     MEGABATCH_FLUSH_REASONS,
@@ -45,6 +47,12 @@ from ..solver.tpu import MEGA_MAX_SLOTS, max_mega_slots, mesh_shardable
 from ..utils.clock import Clock
 from . import codec
 from . import solver_pb2 as pb
+from .delta import (
+    DeltaReply,
+    DeltaSessionTable,
+    SessionEntry,
+    delta_enabled,
+)
 
 SERVICE = "karpenter.tpu.Solver"
 
@@ -72,6 +80,21 @@ def _resolve(fut: Future, result=None, exc: Optional[BaseException] = None) -> N
                 fut.set_result(result)
     except futures.InvalidStateError:
         pass  # the other side resolved it first
+
+
+def _full_reply(result, epoch: int, mode: str, state: str = "ok") -> DeltaReply:
+    """Full-shaped, DETACHED DeltaReply (establish / reseed / guard-trip
+    fallback): the client replaces its ledger wholesale.  Copies are taken
+    HERE, on the dispatcher, because the session chain these containers
+    belong to mutates under the very next delta while the RPC thread is
+    still encoding."""
+    return DeltaReply(
+        state=state, epoch=epoch, mode=mode, full=True,
+        assignments=dict(result.assignments),
+        infeasible=dict(result.infeasible),
+        nodes=[n.snapshot() for n in result.nodes],
+        solve_ms=result.solve_ms,
+    )
 
 
 class SolvePipeline:
@@ -154,6 +177,15 @@ class SolvePipeline:
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._submit_lock = threading.Lock()  # makes stop-check + put atomic
+        # scheduler-OWNERSHIP lock: every section that touches the (non-
+        # re-entrant) scheduler or fences in-flight device work holds it —
+        # the dispatcher's dispatch/finalize sections, and the delta fast
+        # path's INLINE shortcut (_solve_inline: an idle pipeline serves a
+        # sub-ms delta RPC directly on its RPC thread, skipping both
+        # queue-handoff context switches).  Uncontended acquisition costs
+        # the dispatcher ~1us per dispatch; re-entrant because _flush
+        # nests _dispatch_single/_finalize under one flush.
+        self._sched_lock = threading.RLock()
         #: futures the dispatcher has popped (from _q or _inflight) but not
         #: yet resolved — the dispatcher's hand.  Written by the dispatcher
         #: only; stop() snapshots it after the join times out so a wedge at
@@ -198,6 +230,15 @@ class SolvePipeline:
             # a preemption happens on the PREEMPTING request's RPC thread;
             # the victim's blocked RPC thread is unblocked right there
             self._adm.on_shed = lambda t, exc: _resolve(t.item[1], exc=exc)
+        # delta serving (docs/ARCHITECTURE.md round 14): the bounded,
+        # TTL-evicted table of live warm-start chains behind the session-
+        # stateful SolveDelta protocol.  KT_DELTA=0 leaves it None and
+        # every session-carrying request degrades to the classic full
+        # path — byte-identical to pre-delta serving.  Table entries are
+        # dispatcher-owned; the table's own lock only guards the dict.
+        self._delta_tab: Optional[DeltaSessionTable] = (
+            DeltaSessionTable(registry=self.registry, clock=self._clock)
+            if delta_enabled() else None)
         #: lazily-built host FFD scheduler for breaker-open / brownout
         #: routed solves (device capacity stays reserved for the classes
         #: that keep the device path)
@@ -218,7 +259,6 @@ class SolvePipeline:
         :class:`SolveDeadlineError` surface HERE (before any tensorize or
         device work happened for the request); disabled, both are ignored
         and the raw FIFO path is byte-identical to pre-admission."""
-        fut: Future = Future()
         # queue-wait attribution: stamp the enqueue on the request's trace
         # clock here (RPC thread); the dispatcher closes the "window" span
         # when it picks the request up — the cross-thread phase is recorded
@@ -227,6 +267,21 @@ class SolvePipeline:
         trace = kwargs.get("trace") or NULL_TRACE
         t_enq = trace.now()
         t_wall = time.perf_counter()
+        if "_delta" in kwargs and self._inline_ok():
+            # delta fast path, idle-pipeline shortcut: serve the sub-ms
+            # incremental step ON THIS RPC THREAD under the scheduler-
+            # ownership lock — no queue handoff, no dispatcher wakeup, no
+            # future wake: two context switches gone from the steady-state
+            # path.  Non-blocking acquire: a busy dispatcher (or another
+            # inline solve) sends the request down the normal queue path,
+            # so class ordering under load is untouched.
+            if self._sched_lock.acquire(blocking=False):
+                try:
+                    return self._solve_inline(kwargs, pclass, deadline_s,
+                                              trace, t_enq, t_wall)
+                finally:
+                    self._sched_lock.release()
+        fut: Future = Future()
         item = (kwargs, fut, t_enq, t_wall)
         # the stop-check and the put are one atomic step: a put that wins
         # the lock before stop()'s drain is guaranteed to be seen by the
@@ -296,6 +351,11 @@ class SolvePipeline:
                 for ticket in self._adm.drain():
                     _kwargs, fut, _t_enq, _t_wall = ticket.item
                     _resolve(fut, exc=RuntimeError("solve pipeline stopped"))
+        if self._delta_tab is not None:
+            # session chains die with the pipeline; clients re-establish
+            # against the replacement (counted so a restart storm is
+            # visible as eviction reason "stop", not mystery unknowns)
+            self._delta_tab.clear("stop")
 
     def _finalize(self, pending, fut: Future) -> None:
         try:
@@ -469,6 +529,259 @@ class SolvePipeline:
             # is one dispatch + one fence, exactly the unpipelined path
             self._drain(self._inflight.pop_to(0))
 
+    def delta_live(self) -> bool:
+        """Whether session-routed requests have somewhere to land (KT_DELTA
+        on).  Service-side routing probes this before tagging kwargs."""
+        return self._delta_tab is not None
+
+    def _inline_ok(self) -> bool:
+        """Inline-shortcut eligibility: the pipeline is COMPLETELY idle —
+        nothing queued, coalesced, in flight, or in the dispatcher's hand.
+        Best-effort reads from the RPC thread (dispatcher-owned state);
+        CORRECTNESS never rests on them — only _sched_lock serializes
+        scheduler access — the check protects class ORDERING: an inline
+        delta must not overtake work already queued ahead of it."""
+        return (not self._stop.is_set()
+                and not self._in_hand
+                and not len(self._inflight)
+                and not len(self._coal)
+                and self._inbound_idle())
+
+    def _solve_inline(self, kwargs: dict, pclass, deadline_s,
+                      trace, t_enq, t_wall):
+        """Serve one session-routed request on its own RPC thread (caller
+        holds _sched_lock).  Admission posture applies in full via
+        admit_inline — brownout-rung sheds, concurrency quota, rate limit
+        all raise the same typed errors the queue path maps to the wire;
+        only queue residency (depth quotas, preemption, deadline expiry
+        while queued) is moot because dispatch is immediate."""
+        ticket = None
+        if self._adm is not None:
+            pclass = parse_class(pclass or "")
+            t0a = trace.now()
+            ticket = self._adm.admit_inline(pclass, deadline_s=deadline_s)
+            trace.record(
+                "admission", t0a, trace.now(), priority_class=pclass,
+                queued=0, inline=True,
+                brownout=self._adm.brownout.level,
+                breaker=self._adm.breaker.state)
+        try:
+            trace.record("window", t_enq, trace.now(), inflight=0,
+                         coalesced=0, inline=True)
+            info = kwargs.pop("_delta")
+            kwargs.pop("_pclass", None)
+            t0 = trace.now()
+            wall0 = time.perf_counter()
+            reply, outcome = self._serve_delta(kwargs, info, trace)
+            self.registry.histogram(DELTA_RPC_DURATION).observe(
+                time.perf_counter() - wall0)
+            trace.record("delta", t0, trace.now(),
+                         session=info["session_id"], outcome=outcome,
+                         mode=reply.mode, epoch=reply.epoch, inline=True)
+            # no observe_idle here: the dispatcher's own idle ticks (every
+            # 100ms regardless of inline traffic) keep the brownout EWMA
+            # decaying and the breaker feeds polled — paying a breaker
+            # counter sweep per sub-ms RPC would tax exactly the path this
+            # shortcut exists to strip
+            reply.solve_ms = (time.perf_counter() - t_wall) * 1000.0
+            return reply
+        finally:
+            if ticket is not None:
+                self._adm.release(ticket)
+
+    def _dispatch_delta(self, kwargs: dict, fut: Future, t_enq, t_wall) -> None:
+        """Session-routed dispatch — the delta fast path.
+
+        Bypasses the megabatch coalescer entirely: a sub-millisecond
+        incremental step must not wait out ``KT_MAX_WAIT_MS`` in a slot
+        queue, and it could never share a compiled bucket with full solves
+        anyway.  Anything already held is flushed FIRST, so coalesced
+        batchmates are never delayed behind session traffic.  Host routing
+        (breaker open / brownout rung 3) is deliberately skipped: the
+        incremental tiers never dispatch to the device, and the scan/full
+        subsolves run through ``scheduler.solve``, which owns the device-
+        health fallback ladder — guards err toward latency, never
+        correctness (the PR-6 contract).  Admission is NOT skipped: the
+        request was admitted as a normal ticket in its class before it
+        got here (brownout L4 sheds best_effort deltas like any other)."""
+        for reason, _key, batch in self._coal.flush("bucket"):
+            self._flush(batch, reason)
+        info = kwargs.pop("_delta")
+        trace = kwargs.get("trace") or NULL_TRACE
+        t0 = trace.now()
+        wall0 = time.perf_counter()
+        try:
+            reply, outcome = self._serve_delta(kwargs, info, trace)
+        # ktlint: allow[KT005] a failing step fans to its RPC thread via
+        # the future; the dispatcher itself must live on
+        except BaseException as err:  # noqa: BLE001
+            _resolve(fut, exc=err)
+            self._unhand(fut)
+            return
+        self.registry.histogram(DELTA_RPC_DURATION).observe(
+            time.perf_counter() - wall0)
+        trace.record("delta", t0, trace.now(),
+                     session=info["session_id"], outcome=outcome,
+                     mode=reply.mode, epoch=reply.epoch)
+        # honest per-request latency: enqueue -> respond wall time
+        reply.solve_ms = (time.perf_counter() - t_wall) * 1000.0
+        _resolve(fut, result=reply)
+        self._unhand(fut)
+
+    def _serve_delta(self, kwargs: dict, info: dict, trace):
+        """One session-routed request -> (DeltaReply, outcome label).
+
+        Runs on the dispatcher thread; the chain entry is dispatcher-owned
+        end to end, so everything handed back for encoding is DETACHED
+        (DeltaReply snapshots) — the next delta may mutate the chain while
+        the RPC thread is still serializing this reply."""
+        tab = self._delta_tab
+        sid = info["session_id"]
+        pods = kwargs.pop("pods")
+        provisioners = kwargs.pop("provisioners")
+        instance_types = kwargs.pop("instance_types")
+
+        def _counted(reply: DeltaReply, outcome: str):
+            # every outcome — incremental, fallback, establish, unknown —
+            # is counted HERE, in the function that runs the solves:
+            # ktlint KT015 pins that no delta-path full solve can ship
+            # without its outcome landing in karpenter_solver_delta_rpc_total
+            self.registry.counter(DELTA_RPC).inc({"outcome": outcome})
+            return reply, outcome
+
+        if not info["delta"]:
+            # establish (or re-establish): ONE classic full solve, and the
+            # result becomes the session's chain base
+            result = self.scheduler.solve(
+                pods, provisioners, instance_types,
+                existing_nodes=kwargs.get("existing_nodes", ()),
+                daemonsets=kwargs.get("daemonsets", ()),
+                unavailable=kwargs.get("unavailable") or None,
+                allow_new_nodes=kwargs.get("allow_new_nodes", True),
+                max_new_nodes=kwargs.get("max_new_nodes"),
+                trace=trace,
+            )
+            if tab is None:
+                # delta serving off: answer like a plain solve ("" state
+                # tells the client no session was retained)
+                return _counted(_full_reply(result, 0, "", state=""), "establish")
+            tab.put(SessionEntry(
+                session_id=sid, prev=result, epoch=1,
+                catalog_epoch=info["catalog_epoch"],
+                provisioners=provisioners, instance_types=instance_types,
+                daemonsets=kwargs.get("daemonsets") or (),
+                unavailable=set(kwargs.get("unavailable") or ()),
+            ))
+            return _counted(_full_reply(result, 1, "establish"), "establish")
+        # ---- incremental step -------------------------------------------
+        entry = tab.get(sid) if tab is not None else None
+        if entry is None or entry.epoch != info["base_epoch"]:
+            # evicted / never established / epoch mismatch after a lost
+            # response: the only safe answer is "re-establish" — applying
+            # a delta onto the wrong base would silently diverge
+            return _counted(DeltaReply(state="unknown", full=False),
+                            "session_unknown")
+        reseed = info["catalog_epoch"] != entry.catalog_epoch
+        if reseed and not instance_types:
+            # the catalog/price epoch moved and the new catalog is not
+            # on the wire: every price the chain packed against is
+            # stale, and there is nothing to re-pack with
+            return _counted(DeltaReply(state="unknown", full=False),
+                            "session_unknown")
+        try:
+            return self._apply_delta_step(
+                entry, info, pods, provisioners, instance_types,
+                kwargs, reseed, trace, _counted)
+        # ktlint: allow[KT005] re-raised after eviction: the RPC thread
+        # gets the real error, the poisoned chain never serves again
+        except BaseException:
+            # the step raised MID-APPLY: the chain may be half-mutated at
+            # an unchanged epoch, and the client's cumulative retry would
+            # pass the epoch check and re-apply onto a corrupted base —
+            # evict, so the client re-establishes from scratch
+            tab.drop(sid, "error")
+            raise
+
+    def _apply_delta_step(self, entry: SessionEntry, info: dict, pods,
+                          provisioners, instance_types, kwargs: dict,
+                          reseed: bool, trace, _counted):
+        """Apply one incremental step onto a live chain (dispatcher- or
+        inline-thread, under _sched_lock either way).  Mutates the entry;
+        the caller owns eviction if anything below raises."""
+        if reseed:
+            entry.instance_types = instance_types
+            if provisioners:
+                entry.provisioners = provisioners
+            entry.catalog_epoch = info["catalog_epoch"]
+        prev = entry.prev
+        # the step's watch set — every pod whose placement can change:
+        # the adds, the removals, everything previously unplaced (removals
+        # free capacity and re-offer them), and pods displaced off
+        # reclaimed nodes.  The incremental tiers never move any other
+        # pod (warmstart.py's by-construction contract), so the reply
+        # only has to carry these.
+        watch = {p.name for p in pods}
+        watch.update(info["removed"])
+        watch.update(prev.infeasible)
+        meta = getattr(prev, "_warmstart_meta", None)
+        if meta is not None:
+            watch.update(meta.unplaced)
+        if info["reclaimed"]:
+            by_name = {n.name: n
+                       for n in list(prev.existing_nodes) + list(prev.nodes)}
+            for rname in info["reclaimed"]:
+                node = by_name.get(rname)
+                if node is not None:
+                    watch.update(p.name for p in node.pods)
+        # ICE'd offerings accumulate on the ENTRY, not just the chain meta:
+        # a guard-trip full fallback drops the meta, and the rebuild must
+        # not forget offerings iced three steps ago
+        entry.unavailable.update(tuple(u)
+                                 for u in kwargs.get("unavailable") or ())
+        outcome = self.scheduler.solve_delta(
+            prev, added=pods, removed=info["removed"],
+            iced=list(info["reclaimed"]),
+            provisioners=entry.provisioners,
+            instance_types=entry.instance_types,
+            daemonsets=entry.daemonsets,
+            unavailable=set(entry.unavailable) or None,
+            force_full=reseed, trace=trace,
+        )
+        entry.prev = outcome.result
+        entry.epoch += 1
+        if reseed:
+            return _counted(
+                _full_reply(outcome.result, entry.epoch, "reseed"), "reseed")
+        if outcome.fell_back:
+            # a warm-start guard tripped (KT_DELTA_MAX_FRAC, constraint
+            # coupling, vocabulary miss): the step was served by the full
+            # re-solve from the stripped base — correct, slower, and the
+            # session survives; the reply is full-shaped
+            return _counted(_full_reply(outcome.result, entry.epoch, "full"),
+                            "fallback_full")
+        res = outcome.result
+        # node churn comes from the outcome's INCREMENTAL bookkeeping
+        # (warmstart maintains created/pruned per step) — never a diff
+        # over the fleet's node set, which would put an O(cluster) scan
+        # on every sub-ms RPC
+        meta2 = getattr(res, "_warmstart_meta", None)
+        created = []
+        if meta2 is not None:
+            created = [meta2.nodes[meta2.node_idx[nm]].snapshot()
+                       for nm in outcome.created_nodes
+                       if nm in meta2.node_idx]
+        reply = DeltaReply(
+            state="ok", epoch=entry.epoch, mode=outcome.mode, full=False,
+            assignments={n: res.assignments[n] for n in watch
+                         if n in res.assignments},
+            infeasible={n: res.infeasible[n] for n in watch
+                        if n in res.infeasible},
+            nodes=created,
+            removed_nodes=list(outcome.pruned_nodes),
+            solve_ms=outcome.solve_ms,
+        )
+        return _counted(reply, "delta")
+
     def _next_item(self, timeout: float):
         """Pop the next request from whichever front door is active.
         Admission path: priority-ordered pop + queue-delay accounting +
@@ -517,53 +830,76 @@ class SolvePipeline:
                     # decay the brownout EWMA + poll the breaker feeds so
                     # recovery doesn't need traffic to make progress
                     self._adm.observe_idle()
-                for reason, _key, batch in self._coal.poll():
-                    self._flush(batch, reason)
-                if not len(self._coal):
-                    self._drain(self._inflight.pop_to(0))
-                continue
-            self._apply_brownout()
-            # close the queue-wait phase on the request's trace: enqueue
-            # (RPC thread) -> pickup (this dispatcher)
-            trace = kwargs.get("trace") or NULL_TRACE
-            trace.record("window", t_enq, trace.now(),
-                         inflight=len(self._inflight),
-                         coalesced=len(self._coal))
-            # in hand from pop to resolution (_flush/_finalize remove it);
-            # coalescer-held requests stay in the ledger so a stop() mid-
-            # hold fails them instead of stranding their RPC threads.  A
-            # fut parked in _inflight is in the ledger too — stop() may
-            # fail it twice (once per structure), which _resolve absorbs.
-            self._in_hand.append(fut)
-            if self._adm is not None:
-                host_reason = self._adm.route_host(
-                    kwargs.pop("_pclass", "") or "")
-                if host_reason is not None:
-                    # breaker open / brownout rung 3+: this solve takes the
-                    # host FFD tier — flush anything held first so response
-                    # FIFO order survives, then dispatch on the single path
-                    trace.annotate(host_routed=host_reason)
-                    for reason, _key, batch in self._coal.flush("bucket"):
+                with self._sched_lock:
+                    for reason, _key, batch in self._coal.poll():
                         self._flush(batch, reason)
-                    self._host_futs.add(fut)
-                    self._dispatch_single(kwargs, fut, t_enq, t_wall,
-                                          scheduler=self._host_scheduler())
+                    if not len(self._coal):
+                        self._drain(self._inflight.pop_to(0))
+                continue
+            # in hand from pop to resolution (_flush/_finalize remove
+            # it); coalescer-held requests stay in the ledger so a
+            # stop() mid-hold fails them instead of stranding their
+            # RPC threads.  A fut parked in _inflight is in the ledger
+            # too — stop() may fail it twice (once per structure),
+            # which _resolve absorbs.  Appended BEFORE acquiring the
+            # ownership lock: the inline shortcut's _inline_ok reads
+            # _in_hand, and appending later would open a window where a
+            # just-popped request is invisible and an arriving delta
+            # could overtake it.
+            self._in_hand.append(fut)
+            # every scheduler-touching section of an iteration holds the
+            # ownership lock (the blocking queue wait above deliberately
+            # does NOT): while the dispatcher works, the delta fast path's
+            # inline shortcut cannot acquire and routes through the queue
+            with self._sched_lock:
+                self._apply_brownout()
+                # close the queue-wait phase on the request's trace:
+                # enqueue (RPC thread) -> pickup (this dispatcher)
+                trace = kwargs.get("trace") or NULL_TRACE
+                trace.record("window", t_enq, trace.now(),
+                             inflight=len(self._inflight),
+                             coalesced=len(self._coal))
+                if "_delta" in kwargs:
+                    # session-routed request: the delta fast path (bypasses
+                    # the coalescer AND host routing — see _dispatch_delta;
+                    # admission already ticketed it in its class)
+                    kwargs.pop("_pclass", None)
+                    self._dispatch_delta(kwargs, fut, t_enq, t_wall)
+                    if self._inbound_idle() and not len(self._coal):
+                        self._drain(self._inflight.pop_to(0))
                     continue
-            key = self._bucket_of(kwargs)
-            for reason, _key, batch in self._coal.add(
-                    key, (kwargs, fut, t_enq, t_wall)):
-                self._flush(batch, reason)
-            if len(self._coal) and self._inbound_idle() \
-                    and self._effective_max_wait() <= 0.0:
-                # queue went idle with no wait configured: flush NOW so a
-                # lone request's latency matches the unbatched path; under
-                # real concurrency the queue is non-empty here and slots
-                # keep filling
-                for reason, _key, batch in self._coal.flush("deadline"):
+                if self._adm is not None:
+                    host_reason = self._adm.route_host(
+                        kwargs.pop("_pclass", "") or "")
+                    if host_reason is not None:
+                        # breaker open / brownout rung 3+: this solve takes
+                        # the host FFD tier — flush anything held first so
+                        # response FIFO order survives, then dispatch on
+                        # the single path
+                        trace.annotate(host_routed=host_reason)
+                        for reason, _key, batch in self._coal.flush("bucket"):
+                            self._flush(batch, reason)
+                        self._host_futs.add(fut)
+                        self._dispatch_single(
+                            kwargs, fut, t_enq, t_wall,
+                            scheduler=self._host_scheduler())
+                        continue
+                key = self._bucket_of(kwargs)
+                for reason, _key, batch in self._coal.add(
+                        key, (kwargs, fut, t_enq, t_wall)):
                     self._flush(batch, reason)
-        for reason, _key, batch in self._coal.flush("deadline"):
-            self._flush(batch, reason)
-        self._drain(self._inflight.pop_to(0))
+                if len(self._coal) and self._inbound_idle() \
+                        and self._effective_max_wait() <= 0.0:
+                    # queue went idle with no wait configured: flush NOW so
+                    # a lone request's latency matches the unbatched path;
+                    # under real concurrency the queue is non-empty here
+                    # and slots keep filling
+                    for reason, _key, batch in self._coal.flush("deadline"):
+                        self._flush(batch, reason)
+        with self._sched_lock:
+            for reason, _key, batch in self._coal.flush("deadline"):
+                self._flush(batch, reason)
+            self._drain(self._inflight.pop_to(0))
 
 
 class SolverService:
@@ -659,6 +995,7 @@ class SolverService:
 
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
         kwargs = codec.decode_request(request)
+        sess = codec.decode_delta_fields(request)
         sched = self._scheduler_for(request.backend)
         pclass = parse_class(getattr(request, "priority_class", ""))
         deadline_s = self._deadline_of(request, context)
@@ -671,19 +1008,45 @@ class SolverService:
             with self.tracer.start(
                 "solve", rpc="Solve", backend=sched.backend,
                 n_pods=len(kwargs.get("pods", ())), priority_class=pclass,
+                delta=bool(sess and sess["delta"]),
             ) as trace:
                 kwargs["trace"] = trace
                 if self._pipelined:
-                    result = self._pipeline_for(sched).solve(
-                        kwargs, pclass=pclass, deadline_s=deadline_s)
+                    pipe = self._pipeline_for(sched)
+                    if sess is not None and pipe.delta_live():
+                        # session-routed: the pipeline's delta fast path
+                        # resolves with a DeltaReply (still one admission
+                        # ticket in its class — sheds surface here exactly
+                        # like classic solves)
+                        kwargs["_delta"] = sess
+                        result = pipe.solve(kwargs, pclass=pclass,
+                                            deadline_s=deadline_s)
+                    elif sess is not None and sess["delta"]:
+                        # delta request against a delta-off server: there
+                        # is no chain to apply it to — tell the client to
+                        # fall back to full solves (KT_DELTA=0 contract:
+                        # no session state, no behavior change otherwise)
+                        result = DeltaReply(state="unknown", full=False)
+                    else:
+                        result = pipe.solve(kwargs, pclass=pclass,
+                                            deadline_s=deadline_s)
                 else:
-                    with self._direct_lock:
-                        result = sched.solve(
-                            kwargs.pop("pods"), kwargs.pop("provisioners"),
-                            kwargs.pop("instance_types"), **kwargs,
-                        )
+                    if sess is not None and sess["delta"]:
+                        # the direct debug path (KT_SOLVE_PIPELINE=0) has
+                        # no dispatcher and therefore no session table
+                        result = DeltaReply(state="unknown", full=False)
+                    else:
+                        with self._direct_lock:
+                            result = sched.solve(
+                                kwargs.pop("pods"),
+                                kwargs.pop("provisioners"),
+                                kwargs.pop("instance_types"), **kwargs,
+                            )
                 with trace.span("respond"):
-                    resp = codec.encode_response(result)
+                    if isinstance(result, DeltaReply):
+                        resp = codec.encode_delta_reply(result)
+                    else:
+                        resp = codec.encode_response(result)
         except SolveDeadlineError as err:
             # shed BEFORE tensorize/dispatch: the wire contract is
             # DEADLINE_EXCEEDED for expired budgets, RESOURCE_EXHAUSTED for
@@ -729,6 +1092,11 @@ def make_server(
     max_workers: int = MEGA_MAX_SLOTS + 4,
     host: str = "127.0.0.1",
 ) -> "tuple[grpc.Server, int]":
+    """``host`` may also be a ``unix:`` address (``unix:/run/kt/solver.sock``)
+    — the same-pod sidecar topology: a reconciler sharing the pod dials the
+    socket instead of paying TCP loopback per RPC (the delta fast path's
+    steady-state RPCs are sub-millisecond, so transport RTT is a visible
+    fraction of them).  Unix binds return port 0; dial the address itself."""
     service = service or SolverService()
     handlers = {
         "Solve": grpc.unary_unary_rpc_method_handler(
@@ -755,7 +1123,11 @@ def make_server(
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE, handlers),)
     )
-    bound = server.add_insecure_port(f"{host}:{port}")
+    if host.startswith("unix:"):
+        server.add_insecure_port(host)
+        bound = 0  # no TCP port; clients dial the unix address
+    else:
+        bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
     return server, bound
 
@@ -843,9 +1215,11 @@ def main(argv=None) -> int:
     # admission rides the pipeline: with KT_SOLVE_PIPELINE=0 it is inert,
     # and the startup line must not claim otherwise
     admission_live = admission_enabled() and service._pipelined
+    delta_live = delta_enabled() and service._pipelined
     print(f"solver sidecar listening on {args.host}:{port} "
           f"(backend={args.backend}, admission="
-          f"{'on' if admission_live else 'off'})")
+          f"{'on' if admission_live else 'off'}, delta="
+          f"{'on' if delta_live else 'off'})")
     if args.obs_port:
         from ..obs import default_flight
         from ..obs.export import serve as obs_serve

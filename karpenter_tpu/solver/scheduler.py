@@ -573,6 +573,7 @@ class BatchScheduler:
         daemonsets: Sequence[PodSpec] = (),
         unavailable: Optional[Set[tuple]] = None,
         max_delta_frac: Optional[float] = None,
+        force_full: bool = False,
         trace=None,
     ):
         """Warm-start delta solve through the full scheduler ladder (see
@@ -598,7 +599,7 @@ class BatchScheduler:
             prev, added, removed, iced,
             solve_displaced=_solve, solve_full=_solve,
             max_delta_frac=max_delta_frac, registry=self.registry,
-            unavailable=unavailable,
+            unavailable=unavailable, force_full=force_full,
         )
 
     #: capability probe for SolvePipeline._flush: this scheduler's

@@ -66,6 +66,36 @@ from .types import SimNode, SolveResult
 # host-side on purpose (see ops/masks.py BIG): no device init at import time
 BIGN = np.float32(1e9)  # "unbounded" node/pod counts
 
+#: applied once per process (TpuSolver.__init__ calls it; idempotent)
+_JIT_CACHE_WIRED = False
+
+
+def _init_jit_cache() -> None:
+    """Wire JAX's persistent (on-disk) compilation cache to ``KT_JIT_CACHE``
+    at solver init: every process that builds a solver — serve replicas,
+    the operator's fallback, bench subprocesses — shares compiled XLA
+    programs through one directory, so a restarted or scaled-out replica
+    loads the ~8 s solver compiles from disk instead of re-paying them
+    (ROADMAP item 2's shared-cache story; deploy/solver.yaml mounts the
+    default emptyDir and exports KT_JIT_CACHE at the mount path).
+
+    An explicit ``--jit-cache-dir`` (cli.py ``_maybe_jit_cache``) wins: if
+    the config already names a directory this is a no-op, so command-line
+    and env wiring compose instead of fighting."""
+    global _JIT_CACHE_WIRED
+    if _JIT_CACHE_WIRED:
+        return
+    _JIT_CACHE_WIRED = True
+    import os
+
+    cache_dir = os.environ.get("KT_JIT_CACHE", "")
+    if not cache_dir or cache_dir == "0":
+        return
+    if jax.config.jax_compilation_cache_dir:
+        return  # cli --jit-cache-dir already configured it
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 
 def _rung(n: int, quantum: int, linear_max: int, ratio: float = 1.5,
           axis_div: int = 1) -> int:
@@ -1096,6 +1126,11 @@ class TpuSolver:
     def __init__(self, clock: Optional[Clock] = None) -> None:
         import threading
 
+        # persistent AOT compile cache (KT_JIT_CACHE): every process that
+        # constructs a solver shares previously compiled XLA programs —
+        # a restarted replica skips the ~8s compile (ROADMAP item 2's
+        # shared-cache story; bench.py measure_cold_restart gates it)
+        _init_jit_cache()
         # injectable clock for the warm-failure backoff (tests advance a
         # FakeClock past WARM_FAILURE_BACKOFF instead of sleeping it out)
         self._clock = clock or Clock()
@@ -2045,6 +2080,7 @@ class TpuSolver:
         daemonsets: Sequence = (),
         unavailable=None,
         max_delta_frac: Optional[float] = None,
+        force_full: bool = False,
         tensorize_cache=None,
         registry=None,
         trace=None,
@@ -2092,7 +2128,7 @@ class TpuSolver:
             prev, added, removed, iced,
             solve_displaced=_solve, solve_full=_solve,
             max_delta_frac=max_delta_frac, registry=registry,
-            unavailable=unavailable,
+            unavailable=unavailable, force_full=force_full,
         )
 
     # ---- result extraction ---------------------------------------------

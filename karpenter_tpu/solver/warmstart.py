@@ -102,6 +102,12 @@ class DeltaOutcome:
     removed: int              # pods the step unseated
     total_pods: int           # solved pods after the step
     solve_ms: float           # wall time of the step
+    #: node names this step created / dropped, maintained INCREMENTALLY
+    #: (O(delta), never a scan of the fleet) — the delta-serving reply
+    #: builder reads these instead of diffing node sets per RPC.  Empty on
+    #: mode="full": the whole solution was rebuilt, deltas are meaningless.
+    created_nodes: List[str] = field(default_factory=list)
+    pruned_nodes: List[str] = field(default_factory=list)
 
     @property
     def fell_back(self) -> bool:
@@ -300,6 +306,7 @@ def delta_solve(
     max_delta_frac: Optional[float] = None,
     registry: Optional[Registry] = None,
     unavailable=None,
+    force_full: bool = False,
 ) -> DeltaOutcome:
     """One warm-started reconcile step.  ``added`` are new pods to place,
     ``removed`` are pod names leaving, ``iced`` entries are either
@@ -314,6 +321,14 @@ def delta_solve(
     ``unavailable`` offerings accumulate onto the chain on EVERY step
     (same semantics as ``iced`` offering entries) — seeding the first
     step's bookkeeping and merging into it thereafter.
+
+    ``force_full=True`` takes the full-fallback path unconditionally
+    (after the removal/reclaim bookkeeping, so the re-solve sees the
+    perturbed pod set): the delta-serving reseed path uses it when a
+    catalog/price epoch bump invalidates every cost the chain was packed
+    against — the re-solve from the stripped base keeps the session
+    alive instead of cold-starting the client (docs/ARCHITECTURE.md
+    round 14).
     """
     t0 = time.perf_counter()
     registry = registry or default_registry
@@ -334,6 +349,8 @@ def delta_solve(
     displaced: List[PodSpec] = list(added)
     reclaimed_pods: List[PodSpec] = []
     need_full = False
+    created_nodes: List[str] = []
+    pruned_nodes: List[str] = []
 
     # ---- iced: offerings and reclaimed nodes ---------------------------
     reclaim_names: List[str] = []
@@ -345,6 +362,7 @@ def delta_solve(
 
     # ---- removals: pure bookkeeping ------------------------------------
     n_removed = 0
+    maybe_emptied: Set[str] = set()  # proposal nodes that lost pods
     for name in removed:
         if name in infeasible:
             del infeasible[name]
@@ -380,6 +398,8 @@ def delta_solve(
                     need_full = True  # unknown resource: residual stale
                 del node.pods[k]
                 meta.total_pods -= 1
+                if idx >= meta.n_existing and not node.pods:
+                    maybe_emptied.add(node.name)
                 break
 
     # ---- reclaimed nodes: displace their pods --------------------------
@@ -404,12 +424,20 @@ def delta_solve(
             if _has_constraints(p):
                 need_full = True  # its own constraints must re-solve globally
             reclaimed_pods.append(p)
+        pruned_nodes.append(node.name)
         _drop_node(meta, idx)
     displaced = displaced + reclaimed_pods
 
-    # drop proposal nodes the removals emptied (their cost is reclaimed)
-    for idx in range(len(meta.nodes) - 1, meta.n_existing - 1, -1):
-        if not meta.nodes[idx].pods:
+    # drop proposal nodes the removals emptied (their cost is reclaimed).
+    # Only nodes that LOST a pod this step can have emptied — tracked
+    # above, so this stays O(delta): the delta-serving path calls this
+    # per RPC and a scan of the whole proposal fleet would put an
+    # O(cluster) pass under every sub-ms step.
+    for name in maybe_emptied:
+        idx = meta.node_idx.get(name)
+        if idx is not None and idx >= meta.n_existing \
+                and not meta.nodes[idx].pods:
+            pruned_nodes.append(name)
             _drop_node(meta, idx)
 
     # removals / reclaims free capacity (and provisioner-limit headroom):
@@ -438,6 +466,8 @@ def delta_solve(
             removed=n_removed,
             total_pods=meta.total_pods if total is None else total,
             solve_ms=ms,
+            created_nodes=[] if mode == "full" else created_nodes,
+            pruned_nodes=[] if mode == "full" else pruned_nodes,
         )
 
     def _rewrap() -> SolveResult:
@@ -477,7 +507,7 @@ def delta_solve(
 
     # ---- threshold + coupling guards -----------------------------------
     total = meta.total_pods + len(displaced)
-    if need_full or (displaced or n_removed) and (
+    if force_full or need_full or (displaced or n_removed) and (
         len(displaced) + n_removed
         > max(float(DELTA_MIN_PODS), frac * max(total, 1))
     ):
@@ -605,6 +635,7 @@ def delta_solve(
             node = new_by_name.get(target)
             if node is not None and target not in adopted:
                 adopted[target] = node
+                created_nodes.append(target)
                 _append_node(meta, node)
         if _has_constraints(p):
             gk = p.group_key()

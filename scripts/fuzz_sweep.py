@@ -6,6 +6,7 @@ judged on the whole distribution before touching the ceilings.
 
     python scripts/fuzz_sweep.py [plain,existing,kubelet] [n_seeds] [--cached]
     python scripts/fuzz_sweep.py --delta [n_seeds] [chain_len]
+    python scripts/fuzz_sweep.py --delta-wire [n_seeds] [chain_len]
 
 ``--cached`` re-solves every scenario a second time through ONE scheduler
 instance, so the second pass runs the incremental tensorize cache
@@ -19,6 +20,15 @@ add / remove / ICE / node-reclaim deltas through
 incremental result passes the ground-truth validator and (b) its cost per
 scheduled pod stays within the 1.02x parity ceiling of a from-scratch
 re-solve of the same pod set.
+
+``--delta-wire`` (ISSUE 10) drives the same random churn chains through a
+REAL gRPC client/server pair — ``DeltaSession`` against an in-process
+sidecar — asserting per step that (a) the client's merged view is
+byte-identical to the server's live session chain (the wire protocol is
+lossless), (b) the validator passes on the merged view, and (c) the cost
+ceiling holds.  Covers the serving protocol end to end: session
+establishment, delta-shaped replies, guard-trip full fallbacks, reclaims
+and ICE accumulation over the wire.
 
 CPU-pinned and repo-rooted; safe to run while the TPU tunnel is down.
 """
@@ -40,9 +50,11 @@ from karpenter_tpu.models.catalog import generate_catalog
 from karpenter_tpu.solver import reference
 from karpenter_tpu.solver.scheduler import BatchScheduler
 
-argv = [a for a in sys.argv[1:] if a not in ("--cached", "--delta")]
+argv = [a for a in sys.argv[1:]
+        if a not in ("--cached", "--delta", "--delta-wire")]
 cached = "--cached" in sys.argv[1:]
 delta = "--delta" in sys.argv[1:]
+delta_wire = "--delta-wire" in sys.argv[1:]
 catalog = generate_catalog(full=False)
 
 
@@ -172,6 +184,102 @@ def run_delta_chains(n_seeds: int, chain_len: int) -> int:
     return failures
 
 
+def run_delta_wire_chains(n_seeds: int, chain_len: int) -> int:
+    """Random churn chains through a REAL client/server pair; returns the
+    number of failing seeds.  Per step: client-view == server-chain byte
+    parity, validator clean, cost ceiling held."""
+    import random
+
+    from karpenter_tpu.metrics import Registry
+    from karpenter_tpu.service.client import DeltaSession
+    from karpenter_tpu.service.server import SolverService, make_server
+
+    reg = Registry()
+    service = SolverService(BatchScheduler(backend="tpu", registry=reg),
+                            registry=reg)
+    srv, port = make_server(service, port=0)
+    failures = 0
+    try:
+        for seed in range(n_seeds):
+            rng = random.Random(30_000 + seed)
+            pods, provs, unavailable = random_scenario(seed, catalog)
+            sess = DeltaSession(f"127.0.0.1:{port}", timeout=120.0)
+            cur = sess.solve(pods, provs, catalog, unavailable=unavailable)
+            if cur.infeasible:
+                doomed0 = set(cur.infeasible)
+                pods = [p for p in pods if p.name not in doomed0]
+            cur_pods = {p.name: p for p in pods}
+            problems = []
+            modes = []
+            extra_seed = 900 + seed
+            for step in range(chain_len):
+                kind = rng.choice(("add", "remove", "reclaim", "mixed"))
+                added, removed, iced = [], [], []
+                if kind in ("add", "mixed"):
+                    fresh = random_scenario(extra_seed, catalog)[0]
+                    extra_seed += 1
+                    take = fresh[: rng.randint(1, max(2, len(cur_pods) // 25))]
+                    added = _isolate_labels(take, f"w{seed}c{step}")
+                if kind in ("remove", "mixed") and cur.assignments:
+                    k = rng.randint(1, max(1, len(cur_pods) // 25))
+                    removed = rng.sample(sorted(cur.assignments),
+                                         min(k, len(cur.assignments)))
+                if kind == "reclaim":
+                    names = [n.name for n in cur.nodes]
+                    if names:
+                        iced = [rng.choice(names)]
+                cur = sess.solve_delta(added=added, removed=removed,
+                                       iced=iced)
+                doomed = set(removed)
+                for n in doomed:
+                    cur_pods.pop(n, None)
+                for p in added:
+                    cur_pods[p.name] = p
+                # (a) wire losslessness: client view == server chain
+                pipe = list(service._pipelines.values())[0]
+                entry = pipe._delta_tab.get(sess.session_id)
+                if entry is None:
+                    problems.append(f"step {step}: session lost")
+                    break
+                modes.append(entry.epoch)
+                if entry.prev.assignments != cur.assignments or \
+                        entry.prev.infeasible != cur.infeasible or \
+                        {n.name: sorted(p.name for p in n.pods)
+                         for n in entry.prev.nodes} != \
+                        {n.name: sorted(p.name for p in n.pods)
+                         for n in cur.nodes}:
+                    problems.append(f"step {step}: client diverged from "
+                                    "server chain")
+                # (b) ground-truth validity of the merged view
+                errs = validate_solution(list(cur_pods.values()), provs,
+                                         cur, catalog)
+                if errs:
+                    problems.append(f"step {step}: {errs[:2]}")
+                # (c) cost ceiling vs from-scratch
+                full = BatchScheduler(backend="tpu").solve(
+                    list(cur_pods.values()), provs, catalog,
+                    unavailable=set(sess._unavailable) or None)
+                if (full.new_node_cost > 0 and full.n_scheduled
+                        and cur.n_scheduled):
+                    r = (cur.new_node_cost / cur.n_scheduled) / (
+                        full.new_node_cost / full.n_scheduled)
+                    if r > DELTA_FUZZ_COST_CEILING + 1e-9:
+                        problems.append(f"step {step}: cost ratio {r:.4f}")
+            tag = "OK " if not problems else "FAIL"
+            print(f"delta-wire seed {seed}: {tag} epochs={modes}"
+                  + (f" {problems}" if problems else ""))
+            failures += bool(problems)
+            sess.close()
+    finally:
+        srv.stop(grace=None)
+        service.close()
+    return failures
+
+
+if delta_wire:
+    n_seeds = int(argv[0]) if len(argv) > 0 else 10
+    chain_len = int(argv[1]) if len(argv) > 1 else 4
+    sys.exit(1 if run_delta_wire_chains(n_seeds, chain_len) else 0)
 if delta:
     n_seeds = int(argv[0]) if len(argv) > 0 else 12
     chain_len = int(argv[1]) if len(argv) > 1 else 4

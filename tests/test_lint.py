@@ -672,6 +672,125 @@ class TestKT009UncountedShed:
         assert lint(src, self.RPC) == []
 
 
+class TestKT015DeltaSessionDiscipline:
+    SVC = "karpenter_tpu/service/delta.py"
+
+    def test_fires_on_unlocked_table_access(self):
+        src = """
+        class Table:
+            def peek(self, sid):
+                return self._sessions.get(sid)
+        """
+        findings = lint(src, self.SVC)
+        assert rules_of(findings) == ["KT015"]
+        assert "_sessions" in findings[0].message
+
+    def test_quiet_under_the_lock(self):
+        src = """
+        class Table:
+            def get(self, sid):
+                with self._lock:
+                    return self._sessions.get(sid)
+        """
+        assert lint(src, self.SVC) == []
+
+    def test_init_is_exempt(self):
+        src = """
+        class Table:
+            def __init__(self):
+                self._sessions = {}  # guarded-by: _lock
+        """
+        assert lint(src, self.SVC) == []
+
+    def test_locked_suffix_helpers_are_exempt(self):
+        # the repo's caller-holds-the-lock convention: the suffix is the
+        # contract; callers must hold the with themselves
+        src = """
+        class Table:
+            def _evict_expired_locked(self, now):
+                self._sessions.clear()
+
+            def clear(self):
+                with self._lock:
+                    self._evict_expired_locked(0.0)
+        """
+        assert lint(src, self.SVC) == []
+
+    def test_fires_on_uncounted_delta_path_solve(self):
+        src = """
+        class Pipe:
+            def _serve_delta(self, kwargs, info):
+                return self.scheduler.solve(kwargs.pop("pods"), [], [])
+        """
+        findings = lint(src, "karpenter_tpu/service/server.py")
+        assert rules_of(findings) == ["KT015"]
+        assert "karpenter_solver_delta_rpc_total" in findings[0].message
+
+    def test_uncounted_tensorize_on_delta_path_fires(self):
+        src = """
+        from karpenter_tpu.models.tensorize import tensorize
+
+        def delta_reseed(pods, provs, its):
+            return tensorize(pods, provs, its)
+        """
+        assert rules_of(lint(src, self.SVC)) == ["KT015"]
+
+    def test_quiet_with_outcome_counter_in_same_function(self):
+        src = """
+        from karpenter_tpu.metrics import DELTA_RPC
+
+        def zero_init(registry):
+            registry.counter(DELTA_RPC).inc({"outcome": "delta"}, value=0.0)
+
+        class Pipe:
+            def _serve_delta(self, kwargs, info):
+                result = self.scheduler.solve(kwargs.pop("pods"), [], [])
+                self.registry.counter(DELTA_RPC).inc({"outcome": "delta"})
+                return result
+        """
+        assert lint(src, "karpenter_tpu/service/server.py") == []
+
+    def test_quiet_with_counting_funnel(self):
+        src = """
+        def zero_init(registry):
+            registry.counter(DELTA_RPC).inc({"outcome": "delta"}, value=0.0)
+
+        class Pipe:
+            def _serve_delta(self, kwargs, info):
+                def _counted(reply, outcome):
+                    self.registry.counter(DELTA_RPC).inc({"outcome": outcome})
+                    return reply, outcome
+                result = self.scheduler.solve_delta(kwargs.pop("prev"))
+                return _counted(result, "delta")
+        """
+        assert lint(src, "karpenter_tpu/service/server.py") == []
+
+    def test_non_delta_functions_are_quiet(self):
+        src = """
+        class Pipe:
+            def _dispatch_single(self, kwargs):
+                return self.scheduler.solve(kwargs.pop("pods"), [], [])
+        """
+        assert lint(src, "karpenter_tpu/service/server.py") == []
+
+    def test_out_of_scope_files_are_quiet(self):
+        src = """
+        class Sched:
+            def solve_delta(self, prev):
+                return self.solve(prev)
+        """
+        assert lint(src, "karpenter_tpu/solver/scheduler.py") == []
+
+    def test_suppression_with_reason(self):
+        src = """
+        class Table:
+            def stats(self):
+                # ktlint: allow[KT015] single-field len read; torn reads benign
+                return len(self._sessions)
+        """
+        assert lint(src, self.SVC) == []
+
+
 class TestSuppressionGrammar:
     SRC = """
     import time

@@ -149,6 +149,54 @@ class TestInterruptionSeries:
             assert c.get({"message_type": kind}) == 0.0
 
 
+class TestDeltaSeries:
+    """ISSUE 10: the delta-serving family's full label population is born
+    at zero from DeltaSessionTable construction — RPC outcomes, eviction
+    reasons, the live-session gauge — and survives into expose()."""
+
+    def test_every_delta_series_is_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            DELTA_EVICT_REASONS,
+            DELTA_EVICTIONS,
+            DELTA_RPC,
+            DELTA_RPC_OUTCOMES,
+            DELTA_SESSIONS,
+        )
+        from karpenter_tpu.service.delta import DeltaSessionTable
+
+        reg = Registry()
+        DeltaSessionTable(registry=reg)
+        for outcome in DELTA_RPC_OUTCOMES:
+            assert series_exists(reg.counter(DELTA_RPC),
+                                 {"outcome": outcome}), \
+                f"delta_rpc{{outcome={outcome}}} missing"
+        for reason in DELTA_EVICT_REASONS:
+            assert series_exists(reg.counter(DELTA_EVICTIONS),
+                                 {"reason": reason})
+        assert reg.gauge(DELTA_SESSIONS).has()
+        text = reg.expose()
+        assert ('karpenter_solver_delta_rpc_total'
+                '{outcome="session_unknown"} 0') in text
+        assert 'karpenter_solver_delta_sessions 0' in text
+
+    def test_pipeline_construction_births_the_family(self):
+        # the serving path's own construction (SolvePipeline with KT_DELTA
+        # on) must zero-init the family without any delta RPC arriving
+        from karpenter_tpu.metrics import DELTA_RPC, DELTA_RPC_OUTCOMES
+        from karpenter_tpu.service.server import SolvePipeline
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        reg = Registry()
+        pipe = SolvePipeline(BatchScheduler(backend="oracle", registry=reg),
+                             registry=reg, max_slots=1)
+        try:
+            for outcome in DELTA_RPC_OUTCOMES:
+                assert series_exists(reg.counter(DELTA_RPC),
+                                     {"outcome": outcome})
+        finally:
+            pipe.stop()
+
+
 class TestAdmissionSeries:
     """ISSUE 5: the admission subsystem's full label population is born at
     zero from AdmissionControl construction — classes x shed reasons,

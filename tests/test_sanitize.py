@@ -410,6 +410,11 @@ class TestLockOrderWatcher:
         seen["SolverService._direct_lock"] = svc._direct_lock
         pipe = SolvePipeline(sched, registry=reg, max_slots=1)
         seen["SolvePipeline._submit_lock"] = pipe._submit_lock
+        seen["SolvePipeline._sched_lock"] = pipe._sched_lock
+        from karpenter_tpu.service.delta import DeltaSessionTable
+
+        seen["DeltaSessionTable._lock"] = DeltaSessionTable(
+            registry=reg, clock=FakeClock())._lock
         seen["InMemoryLeaseStore._lock"] = InMemoryLeaseStore()._lock
         try:
             unwrapped = [n for n in sanitize.LOCK_ORDER
