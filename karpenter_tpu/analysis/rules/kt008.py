@@ -55,6 +55,10 @@ BUCKET_GRID_STATICS = frozenset({
     "G", "C", "NR", "NE_pad", "S", "P", "D", "R", "Z", "K", "W",
     "track", "a", "b",
     "zone_key", "ct_key",
+    # the relax rung's iteration budget (solver/relax.py): bucketed onto
+    # RELAX_ITER_RUNGS, so the program ladder stays log-bounded — KT014
+    # audits the rung ladder and the key-tail single-sourcing
+    "relax_iters",
 })
 
 

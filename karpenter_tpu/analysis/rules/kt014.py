@@ -36,6 +36,17 @@ subset relation statically, cross-module:
    keys), and the ``serve --warmup`` blocking precompile must pass an
    explicit ``mega_slots`` grid so a configured ``--max-slots`` above the
    default is warmed, not discovered at the first full flush.
+5. **relax-rung surface** (``solver/relax.py``) — ``relax_dims`` must
+   delegate to ``solve_dims`` and emit only its keys; ``relax_signature``
+   must route through ``relax_dims`` AND ``_relax_key_tail``;
+   ``warm_relax`` must key its warm on ``relax_signature`` (the warm must
+   target exactly what dispatch will look up); the ``"relax_iters"``
+   key-tail literal is single-sourced in ``_relax_key_tail`` (the jit
+   wrapper's ``static_argnames`` naming the parameter is the one other
+   legal spelling); and ``RELAX_ITER_RUNGS`` must be a strictly-ascending
+   positive ladder — a duplicate, out-of-order, or non-positive entry is
+   unreachable through ``iter_rung``'s smallest-rung-≥-n bucketing, i.e.
+   a DEAD warm entry that warms a program no solve can ever dispatch.
 
 Every check degrades gracefully: it runs only when the module owning its
 anchor is in the analyzed set, and an anchor that has *moved* (function
@@ -66,10 +77,16 @@ HINT = ("the runtime vocabulary (solve_dims keys, _mega_rung slot rungs, "
 #: the dims keys; they are compile-signature axes of the vmapped kernel)
 KERNEL_STATICS = frozenset({"zone_key", "ct_key"})
 
+#: the relax rung's key-tail statics (solver/relax.py _relax_key_tail —
+#: the rule checks the real tail emits exactly these, so the model cannot
+#: drift from the source)
+RELAX_STATICS = frozenset({"relax_iters"})
+
 TPU = "solver/tpu.py"
 SCHED = "solver/scheduler.py"
 SERVER = "service/server.py"
 SWEEP = "solver/consolidation.py"
+RELAX = "solver/relax.py"
 KT008_FILE = "rules/kt008.py"
 
 
@@ -224,7 +241,7 @@ def check(files, project: Optional[Project] = None) -> List[Finding]:
                     "would flag the solver's own kernels as off-grid",
                     hint=HINT,
                 ))
-            stale = BUCKET_GRID_STATICS - vocab - tail_keys
+            stale = BUCKET_GRID_STATICS - vocab - tail_keys - RELAX_STATICS
             if stale and kt008f is not None:
                 line = 1
                 for node in ast.walk(kt008f.tree):
@@ -409,6 +426,130 @@ def check(files, project: Optional[Project] = None) -> List[Finding]:
                 "sweep's compile key can drift from what dispatch keys",
                 hint=HINT,
             ))
+
+    # (5) relax-rung surface (solver/relax.py): dims delegation, key-tail
+    # single-sourcing, warm-targets-dispatch-key, and the iteration-rung
+    # ladder's dead-entry audit
+    relaxf = _file(files, RELAX)
+    rd = rs = rt = wr = ir = None
+    rungs = None
+    if relaxf is not None:
+        rd = _func_def(relaxf.tree, "relax_dims")
+        rs = _func_def(relaxf.tree, "relax_signature")
+        rt = _func_def(relaxf.tree, "_relax_key_tail")
+        wr = _func_def(relaxf.tree, "warm_relax")
+        ir = _func_def(relaxf.tree, "iter_rung")
+        rungs = _int_tuple(relaxf.tree, "RELAX_ITER_RUNGS")
+        if (all(x is None for x in (rd, rs, rt, wr, ir))
+                and rungs is None):
+            relaxf = None  # fixture tolerance, like the anchors above
+    if relaxf is not None:
+        if rd is None:
+            _moved(out, relaxf.path, "`relax_dims`")
+        else:
+            if not _calls_name(rd, "solve_dims"):
+                out.append(Finding(
+                    ID, relaxf.path, rd.lineno,
+                    "`relax_dims` does not delegate to `solve_dims` — the "
+                    "relax program's compile signatures would fork from "
+                    "the single source of the bucketing math",
+                    hint=HINT,
+                ))
+            got = _dict_return_keys(rd)
+            if got is not None and dims_keys is not None:
+                for key in sorted(got[0] - dims_keys):
+                    out.append(Finding(
+                        ID, relaxf.path, got[1],
+                        f"`relax_dims` emits dims key `{key}` that "
+                        "`solve_dims` never emits — an invented key is a "
+                        "compile-signature axis no rung ladder bounds",
+                        hint=HINT,
+                    ))
+        if rt is None:
+            _moved(out, relaxf.path, "`_relax_key_tail`")
+        else:
+            got_tails = {n.value
+                         for ret in ast.walk(rt)
+                         if isinstance(ret, ast.Return)
+                         for n in ast.walk(ret)
+                         if isinstance(n, ast.Constant)
+                         and isinstance(n.value, str)}
+            if got_tails != set(RELAX_STATICS):
+                out.append(Finding(
+                    ID, relaxf.path, rt.lineno,
+                    f"`_relax_key_tail` emits key(s) {sorted(got_tails)} "
+                    f"but the audit registry models {sorted(RELAX_STATICS)}"
+                    " — update RELAX_STATICS (and KT008's registry) in the"
+                    " same PR the tail changes",
+                    hint=HINT,
+                ))
+        if rs is None:
+            _moved(out, relaxf.path, "`relax_signature`")
+        else:
+            for dep in ("relax_dims", "_relax_key_tail"):
+                if not _calls_name(rs, dep):
+                    out.append(Finding(
+                        ID, relaxf.path, rs.lineno,
+                        f"`relax_signature` does not call `{dep}` — its "
+                        "compile key can drift from what readiness/warm "
+                        "bookkeeping tracks",
+                        hint=HINT,
+                    ))
+        if wr is None:
+            _moved(out, relaxf.path, "`warm_relax`")
+        elif not _calls_name(wr, "relax_signature"):
+            out.append(Finding(
+                ID, relaxf.path, wr.lineno,
+                "`warm_relax` does not key its warm on `relax_signature` "
+                "— the warmed program and the dispatched lookup can drift",
+                hint=HINT,
+            ))
+        if ir is None:
+            _moved(out, relaxf.path, "`iter_rung`")
+        if rungs is None:
+            _moved(out, relaxf.path, "`RELAX_ITER_RUNGS` as an int tuple")
+        else:
+            vals, rline = rungs
+            for i, v in enumerate(vals):
+                if v <= 0 or (i > 0 and v <= vals[i - 1]):
+                    out.append(Finding(
+                        ID, relaxf.path, rline,
+                        f"RELAX_ITER_RUNGS entry {v} is unreachable "
+                        "through iter_rung's smallest-rung-≥-n bucketing "
+                        "(non-positive, duplicate, or out of order) — a "
+                        "dead warm entry warms a program no solve "
+                        "dispatches",
+                        hint=HINT,
+                    ))
+        # single-source "relax_iters": legal only inside _relax_key_tail
+        # or as a static_argnames entry (the jit parameter's own name)
+        for f in files:
+            if f.path.endswith(("test_lint.py", "kt014.py", "kt008.py")):
+                continue
+            static_arg_nodes = set()
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Call):
+                    for kw in node.keywords:
+                        if kw.arg == "static_argnames":
+                            for n2 in ast.walk(kw.value):
+                                static_arg_nodes.add(id(n2))
+            for node in ast.walk(f.tree):
+                if not (isinstance(node, ast.Constant)
+                        and node.value == "relax_iters"):
+                    continue
+                if id(node) in static_arg_nodes:
+                    continue
+                if f is relaxf and rt is not None \
+                        and rt.lineno <= node.lineno \
+                        <= getattr(rt, "end_lineno", rt.lineno):
+                    continue
+                out.append(Finding(
+                    ID, f.path, node.lineno,
+                    "`\"relax_iters\"` compile-key tail constructed "
+                    "outside `_relax_key_tail` — the tail is single-source"
+                    " by contract (the KT014 mega_slots precedent)",
+                    hint=HINT,
+                ))
     return out
 
 
@@ -424,7 +565,15 @@ def surface(files) -> Dict[str, object]:
     out: Dict[str, object] = {
         "bucket_grid_statics": sorted(BUCKET_GRID_STATICS),
         "kernel_statics": sorted(KERNEL_STATICS),
+        "relax_statics": sorted(RELAX_STATICS),
     }
+    relaxf = _file(files, RELAX)
+    if relaxf is not None:
+        rr = _int_tuple(relaxf.tree, "RELAX_ITER_RUNGS")
+        out["relax_iter_rungs"] = list(rr[0]) if rr else None
+        rd = _func_def(relaxf.tree, "relax_dims")
+        got = _dict_return_keys(rd) if rd is not None else None
+        out["relax_dims_keys"] = sorted(got[0]) if got else None
     if tpu is not None:
         fn = _func_def(tpu.tree, "solve_dims")
         got = _dict_return_keys(fn) if fn is not None else None

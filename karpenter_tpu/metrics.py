@@ -202,6 +202,18 @@ DELTA_SESSIONS = "karpenter_solver_delta_sessions"
 DELTA_EVICTIONS = "karpenter_solver_delta_session_evictions_total"
 #: eviction-reason label population (KT003)
 DELTA_EVICT_REASONS = ("ttl", "capacity", "stop", "error")
+RELAX_TOTAL = "karpenter_solver_relax_total"
+#: the full relax-rung outcome label population (KT003 zero-init source —
+#: BatchScheduler and solver/relax.py both init from it): 'improved' (the
+#: relax+round solution cost strictly less and shipped), 'tied' (the rung
+#: matched the scan's cost; the scan solution ships), 'fallback' (rounding/
+#: repair could not reach a valid cheaper solution, or the rung errored —
+#: the scan solution ships), 'skipped' (the rung was enabled but did not
+#: run: no eligible unconstrained groups, cold relax program, cold-served
+#: or budget-constrained solve)
+RELAX_OUTCOMES = ("improved", "tied", "fallback", "skipped")
+RELAX_DURATION = "karpenter_solver_relax_duration_seconds"
+RELAX_IMPROVEMENT = "karpenter_solver_relax_improvement_ratio"
 WARMSTART_SOLVES = "karpenter_solver_warmstart_solves_total"
 WARMSTART_DURATION = "karpenter_solver_warmstart_duration_seconds"
 WARMSTART_DISPLACED = "karpenter_solver_warmstart_displaced_pods"
@@ -433,6 +445,29 @@ INVENTORY = {
         "serve another epoch, so the session dies and the client "
         "re-establishes).  An evicted session costs its client ONE "
         "re-establishing full solve."),
+    RELAX_TOTAL: (
+        "counter", ("outcome",),
+        "Convex-relaxation refinement rung evaluations on device-tier "
+        "solves (KT_RELAX), by outcome: 'improved' (the relax+round "
+        "solution cost strictly less than the scan's and shipped), 'tied' "
+        "(the rung reached the scan's cost; the scan solution ships), "
+        "'fallback' (rounding/repair could not produce a valid cheaper "
+        "solution, or the rung errored — the scan solution ships "
+        "unchanged), 'skipped' (the rung was enabled but did not run: no "
+        "eligible unconstrained pod groups, relax program still compiling "
+        "behind, or a cold-served / budget-constrained solve).  The "
+        "shipped solution is min(scan, relax+round) by construction — "
+        "never worse than the scan."),
+    RELAX_DURATION: (
+        "histogram", (),
+        "Wall time of one relax-rung evaluation (eligibility partition + "
+        "fixed-iteration device solve + rounding/repair + cost compare), "
+        "seconds."),
+    RELAX_IMPROVEMENT: (
+        "gauge", (),
+        "Node-cost ratio relax/scan of the most recent relax-rung run "
+        "that reached a comparison (improved/tied/fallback): < 1.0 means "
+        "the rung found a cheaper packing than the vectorized FFD scan."),
     WARMSTART_SOLVES: (
         "counter", ("mode",),
         "Warm-start delta solves, by serving mode: 'noop' (removals only "

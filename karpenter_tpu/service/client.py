@@ -222,7 +222,12 @@ class RemoteScheduler:
         allow_new_nodes: bool = True,
         max_new_nodes: Optional[int] = None,
         trace=None,
+        relax: Optional[bool] = None,
     ) -> SolveResult:
+        # ``relax`` mirrors BatchScheduler.solve for facade parity; the
+        # rung is a server-side refinement governed by the sidecar's own
+        # KT_RELAX policy (the wire carries no per-request override), so
+        # only the local-fallback solve below honors the caller's value
         trace = trace or NULL_TRACE
         if self._remote_ok():
             # the trace stays operator-side: the wire carries no context, so
@@ -315,7 +320,7 @@ class RemoteScheduler:
             pods, provisioners, instance_types,
             existing_nodes=existing_nodes, daemonsets=daemonsets,
             unavailable=unavailable, allow_new_nodes=allow_new_nodes,
-            max_new_nodes=max_new_nodes, trace=trace,
+            max_new_nodes=max_new_nodes, trace=trace, relax=relax,
         )
 
     def warm_startup(

@@ -491,6 +491,10 @@ class BatchScheduler:
         from .warmstart import zero_init_metrics as _ws_zero_init
 
         _ws_zero_init(self.registry)
+        # relax-rung series exist before the first device solve (KT003)
+        from .relax import zero_init_metrics as _rx_zero_init
+
+        _rx_zero_init(self.registry)
 
     def _device_health_changed(self, healthy: bool) -> None:
         self.registry.gauge(SOLVER_DEVICE_HEALTHY).set(1 if healthy else 0)
@@ -509,6 +513,7 @@ class BatchScheduler:
         allow_new_nodes: bool = True,
         max_new_nodes: Optional[int] = None,
         trace=None,
+        relax: Optional[bool] = None,
     ) -> SolveResult:
         """Solve with preference relaxation: pods carrying preferences
         (preferred affinity terms, ScheduleAnyway topology spreads) are first
@@ -523,7 +528,7 @@ class BatchScheduler:
             pods, provisioners, instance_types,
             existing_nodes=existing_nodes, daemonsets=daemonsets,
             unavailable=unavailable, allow_new_nodes=allow_new_nodes,
-            max_new_nodes=max_new_nodes, trace=trace,
+            max_new_nodes=max_new_nodes, trace=trace, relax=relax,
             # a synchronous caller fences immediately — async dispatch buys
             # no overlap and would just split the device call across two
             # code paths; keep solve() on the classic sync path
@@ -542,6 +547,7 @@ class BatchScheduler:
         allow_new_nodes: bool = True,
         max_new_nodes: Optional[int] = None,
         trace=None,
+        relax: Optional[bool] = None,
     ) -> "PendingScheduleResult":
         """Async entry point for pipelined callers (service/server.py
         SolvePipeline): tensorizes and DISPATCHES the first solver wave to
@@ -558,7 +564,8 @@ class BatchScheduler:
             pods, provisioners, instance_types,
             existing_nodes=existing_nodes, daemonsets=daemonsets,
             unavailable=unavailable, allow_new_nodes=allow_new_nodes,
-            max_new_nodes=max_new_nodes, trace=trace, dispatch=True,
+            max_new_nodes=max_new_nodes, trace=trace, relax=relax,
+            dispatch=True,
         )
 
     def solve_delta(
@@ -587,17 +594,26 @@ class BatchScheduler:
         coupling guard trips.  Consumes ``prev``; returns a
         ``DeltaOutcome``."""
         from . import warmstart
+        from .relax import relax_delta_enabled
 
-        def _solve(pods, existing, unavail):
+        # the relax rung is a $-for-latency trade the sub-ms delta path
+        # must not pay: displaced-subproblem scans always skip it, and the
+        # FULL-solve boundaries (threshold/guard fallbacks — already
+        # paying a whole re-solve) run it only when KT_RELAX_DELTA=1
+        def _solve(pods, existing, unavail, relax=False):
             return self.solve(
                 pods, provisioners, instance_types,
                 existing_nodes=existing, daemonsets=daemonsets,
-                unavailable=unavail or None, trace=trace,
+                unavailable=unavail or None, trace=trace, relax=relax,
             )
+
+        def _solve_full(pods, existing, unavail):
+            return _solve(pods, existing, unavail,
+                          relax=None if relax_delta_enabled() else False)
 
         return warmstart.delta_solve(
             prev, added, removed, iced,
-            solve_displaced=_solve, solve_full=_solve,
+            solve_displaced=_solve, solve_full=_solve_full,
             max_delta_frac=max_delta_frac, registry=self.registry,
             unavailable=unavailable, force_full=force_full,
         )
@@ -640,7 +656,13 @@ class BatchScheduler:
                 self._submit(
                     req["pods"], req["provisioners"], req["instance_types"],
                     **{k: v for k, v in req.items()
-                       if k not in ("pods", "provisioners", "instance_types")},
+                       if k not in ("pods", "provisioners", "instance_types",
+                                    "relax")},
+                    # megabatch slots skip the relax rung: the coalesced
+                    # flush is the latency path, and a per-slot host
+                    # rounding pass on the dispatcher thread would stall
+                    # every batchmate behind it (KT_RELAX's routing note)
+                    relax=bool(req.get("relax", False)),
                     dispatch=True,
                 )
                 for req in requests
@@ -772,6 +794,7 @@ class BatchScheduler:
         allow_new_nodes: bool = True,
         max_new_nodes: Optional[int] = None,
         trace=None,
+        relax: Optional[bool] = None,
         dispatch: bool = False,
     ) -> "PendingScheduleResult":
         t0 = time.perf_counter()
@@ -878,6 +901,15 @@ class BatchScheduler:
                             max_new_nodes=max_new_nodes,
                         )
                     reseat_span.annotate(repair_waves=waves)
+
+                # convex-relaxation refinement rung (solver/relax.py):
+                # re-pack the large unconstrained groups globally and ship
+                # min(scan, relax+round) — never worse by construction
+                result = self._maybe_relax(
+                    result, hardened, provisioners, instance_types,
+                    daemonsets, unavailable, allow_new_nodes,
+                    max_new_nodes, relax, trace,
+                )
                 trace.annotate(
                     served_cold=result.served_cold,
                     n_nodes=len(result.nodes),
@@ -1152,6 +1184,86 @@ class BatchScheduler:
                         return False
         return True
 
+    def _maybe_relax(
+        self, result: SolveResult, hardened, provisioners, instance_types,
+        daemonsets, unavailable, allow_new_nodes,
+        max_new_nodes: Optional[int], relax: Optional[bool], trace,
+    ) -> SolveResult:
+        """Route a finished device-tier solve through the convex-relaxation
+        refinement rung (solver/relax.py) and ship min(scan, relax+round).
+
+        ``relax`` is the caller's policy: False skips unconditionally (the
+        delta fast path, megabatch slots), None defers to ``KT_RELAX``
+        (default on).  The rung only applies to device-scan results — the
+        oracle-routed small/ct-spread batches and forced non-device
+        backends return untouched and uncounted (the rung's outcome
+        counter partitions rung EVALUATIONS, not all solves) — and only to
+        unbudgeted provisioning solves: consolidation what-ifs
+        (max_new_nodes / allow_new_nodes) are judged on feasibility at a
+        fixed budget, not on node cost.  A still-compiling relax program
+        counts 'skipped' and warms behind — the serving path never eats
+        the XLA stall (the compile-behind contract, KT014-audited)."""
+        from . import relax as relax_mod
+
+        if relax is False or not relax_mod.relax_enabled():
+            return result
+        if self.backend not in ("auto", "tpu"):
+            return result  # the rung refines the device scan only
+        if not allow_new_nodes or max_new_nodes is not None:
+            return result
+        tpu_pods = [p for p in hardened if not device_inexpressible(p)]
+        if (not tpu_pods or len(tpu_pods) <= self.native_batch_limit
+                or batch_needs_oracle(hardened)):
+            # small batches are oracle-grade already (and under auto the
+            # oracle served them — no scan to refine); the rung targets
+            # LARGE unconstrained groups on every backend, so forced-tpu
+            # small-batch tests/fuzz keep byte-stable scan results
+            return result
+        if self._tensorize_cache is None:
+            return result  # without cached tensorize the probe would pay
+            # a full host build per solve — not the rung's trade
+        guarded = self.backend == "auto" and self._guard.enabled
+        if result.served_cold or (guarded and not self._guard.healthy):
+            relax_mod.record_outcome(self.registry, "skipped")
+            return result
+        try:
+            # identity-tier hit: these are the same pod objects the solve
+            # wave tensorized moments ago
+            st, _tsec = self._tensorize(
+                tpu_pods, provisioners, instance_types, daemonsets,
+                unavailable, trace=trace)
+            sig = relax_mod.relax_signature(st)
+            if not self._tpu.ready(sig):
+                if self.compile_behind and self._guard.healthy:
+                    relax_mod.warm_relax(self._tpu, st)
+                relax_mod.record_outcome(self.registry, "skipped")
+                return result
+
+            def _repair(stranded, seeds):
+                # integrality repair: the existing scan, seeded from the
+                # rounded fleet as existing-node state (PR-6 shape); the
+                # repair solve must never re-enter the rung
+                return self._submit(
+                    stranded, provisioners, instance_types,
+                    existing_nodes=seeds, daemonsets=daemonsets,
+                    unavailable=unavailable, allow_new_nodes=True,
+                    relax=False, trace=trace,
+                ).result()
+
+            result, _outcome = relax_mod.refine(
+                result, st, registry=self.registry,
+                guard=self._guard if guarded else None, trace=trace,
+                repair_solve=_repair,
+            )
+            return result
+        # ktlint: allow[KT005] the rung is an optimization layer — any
+        # routing failure ships the proven scan solution as a fallback
+        except Exception:
+            logger.warning("relax rung routing failed; scan solution ships",
+                           exc_info=True)
+            relax_mod.record_outcome(self.registry, "fallback")
+            return result
+
     def _solve_wave(
         self, pods, provisioners, instance_types, existing_nodes, daemonsets,
         unavailable, allow_new_nodes, max_new_nodes, first=None,
@@ -1275,6 +1387,8 @@ class BatchScheduler:
         empty-cluster ones.  Returns the number of compiles accepted.  Cheap
         to call repeatedly (signatures dedupe), so the operator re-invokes
         it on settings changes that reshape the catalog."""
+        from . import relax as relax_mod
+
         if (self.backend not in ("auto", "tpu") or not self.compile_behind
                 or not self._guard.healthy):
             return 0
@@ -1284,6 +1398,12 @@ class BatchScheduler:
             # provisioning shape: batch solved against the current cluster
             if self._tpu.warm_async(st, existing_nodes=existing_nodes,
                                     mesh=self.mesh, on_done=self._warm_done):
+                started += 1
+            # the relax rung's program for the same shape (KT_RELAX): the
+            # first refinable solve then runs the rung instead of
+            # skip-and-warm-behind (KT014 audits this grid's coverage)
+            if relax_mod.relax_enabled() and relax_mod.warm_relax(
+                    self._tpu, st):
                 started += 1
             if existing_nodes:
                 # consolidation what-if shape: a small repack against the

@@ -7,6 +7,7 @@ judged on the whole distribution before touching the ceilings.
     python scripts/fuzz_sweep.py [plain,existing,kubelet] [n_seeds] [--cached]
     python scripts/fuzz_sweep.py --delta [n_seeds] [chain_len]
     python scripts/fuzz_sweep.py --delta-wire [n_seeds] [chain_len]
+    python scripts/fuzz_sweep.py --relax [n_seeds]
 
 ``--cached`` re-solves every scenario a second time through ONE scheduler
 instance, so the second pass runs the incremental tensorize cache
@@ -20,6 +21,14 @@ add / remove / ICE / node-reclaim deltas through
 incremental result passes the ground-truth validator and (b) its cost per
 scheduled pod stays within the 1.02x parity ceiling of a from-scratch
 re-solve of the same pod set.
+
+``--relax`` (ISSUE 11) drives random scenarios through the convex-
+relaxation refinement rung (solver/relax.py) directly: per seed, the scan
+solves the scenario, ``relax.refine`` refines it, and the sweep asserts
+(a) the shipped solution NEVER costs more than the scan's (the min-of-two
+construction, proven under fuzz, not just claimed), (b) the ground-truth
+validator passes on the shipped solution, and (c) the schedulable-pod set
+is unchanged.  Prints the outcome histogram.
 
 ``--delta-wire`` (ISSUE 10) drives the same random churn chains through a
 REAL gRPC client/server pair — ``DeltaSession`` against an in-process
@@ -51,10 +60,11 @@ from karpenter_tpu.solver import reference
 from karpenter_tpu.solver.scheduler import BatchScheduler
 
 argv = [a for a in sys.argv[1:]
-        if a not in ("--cached", "--delta", "--delta-wire")]
+        if a not in ("--cached", "--delta", "--delta-wire", "--relax")]
 cached = "--cached" in sys.argv[1:]
 delta = "--delta" in sys.argv[1:]
 delta_wire = "--delta-wire" in sys.argv[1:]
+relax_mode = "--relax" in sys.argv[1:]
 catalog = generate_catalog(full=False)
 
 
@@ -276,6 +286,86 @@ def run_delta_wire_chains(n_seeds: int, chain_len: int) -> int:
     return failures
 
 
+def _relax_mix(seed: int):
+    """Seed-varied unconstrained complementary-resource block appended to
+    each scenario so the rung has eligible mass (random tiny scenarios
+    are mostly constraint-bearing — adversarial for the partition, but
+    they would only ever exercise the 'skipped' outcome)."""
+    from karpenter_tpu.models.instancetype import GIB
+    from karpenter_tpu.models.pod import PodSpec
+
+    pods = []
+    for d in range(6):
+        kind = (d + seed) % 3
+        if kind == 0:
+            cpu, mem = 1.0 + (d % 3) * 0.5, 0.25 * GIB
+        elif kind == 1:
+            cpu, mem = 0.1 + 0.05 * d, (4.0 + 2 * (d % 2)) * GIB
+        else:
+            cpu, mem = 0.5 * (1 + d % 2), 2.0 * GIB
+        for i in range(12 + (seed * 7 + d * 3) % 30):
+            pods.append(PodSpec(
+                name=f"rxf{seed}-{d}-{i}", labels={"app": f"rxfz{seed}{d}"},
+                requests={"cpu": cpu, "memory": mem},
+                owner_key=f"rxf{seed}-{d}",
+            ))
+    return pods
+
+
+def run_relax_seeds(n_seeds: int) -> int:
+    """Random scenarios (plus an unconstrained mix block) straight through
+    the relax rung; returns the number of failing seeds.  Every seed
+    asserts the never-worse select, ground-truth validity, and an
+    unchanged schedulable-pod set.  Scenario routing mirrors the
+    scheduler's: preference-bearing pods harden first, and batches the
+    device scan does not serve (ct-spread oracle routes, inexpressible
+    carve-outs) are skipped — the rung never sees them in production."""
+    from karpenter_tpu.metrics import Registry
+    from karpenter_tpu.models.tensorize import (
+        batch_needs_oracle, device_inexpressible, tensorize)
+    from karpenter_tpu.solver import relax
+    from karpenter_tpu.solver.scheduler import _harden_preferences
+    from karpenter_tpu.solver.tpu import TpuSolver
+
+    solver = TpuSolver()
+    failures = 0
+    outcomes = {}
+    for seed in range(n_seeds):
+        base, provs, unavailable = random_scenario(seed, catalog)
+        pods = [_harden_preferences(p) for p in base] + _relax_mix(seed)
+        if batch_needs_oracle(pods) or any(
+                device_inexpressible(p) for p in pods):
+            print(f"relax seed {seed}: SKIP (oracle-routed batch)")
+            continue
+        st = tensorize(pods, provs, catalog, unavailable=unavailable)
+        scan = solver.solve(st, track_assignments=True).result
+        scan_cost = scan.new_node_cost
+        scan_scheduled = set(scan.assignments)
+        reg = Registry()
+        shipped, outcome = relax.refine(scan, st, registry=reg)
+        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        problems = []
+        if shipped.new_node_cost > scan_cost + 1e-9:
+            problems.append(
+                f"shipped ${shipped.new_node_cost:.4f} > scan "
+                f"${scan_cost:.4f} — never-worse violated")
+        if set(shipped.assignments) != scan_scheduled:
+            problems.append("schedulable-pod set changed")
+        errs = validate_solution(pods, provs, shipped, catalog,
+                                 unavailable=unavailable or ())
+        if errs:
+            problems.append(f"validator: {errs[:2]}")
+        tag = "OK " if not problems else "FAIL"
+        print(f"relax seed {seed}: {tag} {outcome}"
+              + (f" {problems}" if problems else ""))
+        failures += bool(problems)
+    print(f"relax outcomes over {n_seeds} seeds: {outcomes}")
+    return failures
+
+
+if relax_mode:
+    n_seeds = int(argv[0]) if len(argv) > 0 else 25
+    sys.exit(1 if run_relax_seeds(n_seeds) else 0)
 if delta_wire:
     n_seeds = int(argv[0]) if len(argv) > 0 else 10
     chain_len = int(argv[1]) if len(argv) > 1 else 4

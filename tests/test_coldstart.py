@@ -99,6 +99,9 @@ class TestCompileBehind:
     def test_warm_queue_drains_beyond_concurrency_cap(self, small_catalog, monkeypatch):
         from karpenter_tpu.solver.tpu import TpuSolver
 
+        # scan-warm queue semantics in isolation: the relax rung's extra
+        # warms (tests/test_relax.py covers them) would shift the counts
+        monkeypatch.setenv("KT_RELAX", "0")
         monkeypatch.setattr(TpuSolver, "MAX_CONCURRENT_WARMS", 1)
         reg = Registry()
         sched = BatchScheduler(backend="auto", registry=reg)
@@ -114,6 +117,7 @@ class TestCompileBehind:
         the queued ones: stop_warms clears the queue and blocks new spawns."""
         from karpenter_tpu.solver.tpu import TpuSolver
 
+        monkeypatch.setenv("KT_RELAX", "0")  # scan warms only (count-exact)
         monkeypatch.setattr(TpuSolver, "MAX_CONCURRENT_WARMS", 1)
         reg = Registry()
         sched = BatchScheduler(backend="auto", registry=reg)
@@ -149,11 +153,15 @@ class TestCompileBehind:
         assert not sched._tpu.warm_async(st)
         assert sched._tpu._failed_until  # backoff armed
 
-    def test_warm_startup_uses_cluster_size(self, small_catalog):
+    def test_warm_startup_uses_cluster_size(self, small_catalog, monkeypatch):
         """The warmed signatures must reflect the live cluster's NE/NR rungs
         — an operator restarting over a populated cluster warms the shapes
         its solves will actually hit (VERDICT r3 review finding)."""
         from karpenter_tpu.solver.tpu import SimNode
+
+        # scan signatures only: relax signatures carry no NE_pad and the
+        # count below is exact (the rung's warms have their own tests)
+        monkeypatch.setenv("KT_RELAX", "0")
 
         reg = Registry()
         sched = BatchScheduler(backend="auto", registry=reg)

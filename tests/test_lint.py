@@ -1707,6 +1707,181 @@ class TestKT014CompileSurface:
             assert set(sides["runtime"]) <= set(sides["warmed"]), floor
 
 
+class TestKT014RelaxSurface:
+    """The relax rung's compile-surface audit (ISSUE 11): dims delegation,
+    key-tail single-sourcing, warm-targets-dispatch-key, and the
+    iteration-rung ladder's dead-entry detection."""
+
+    from karpenter_tpu.analysis.rules import kt014 as RULE
+
+    RELAX_OK = ("karpenter_tpu/solver/relax.py", """
+        RELAX_ITER_RUNGS = (32, 64, 128, 256)
+
+        def iter_rung(n):
+            for r in RELAX_ITER_RUNGS:
+                if n <= r:
+                    return r
+            return RELAX_ITER_RUNGS[-1]
+
+        def relax_dims(st):
+            from .tpu import solve_dims
+            dims = solve_dims(st, NE=0, node_budget=1)
+            return dict(G=dims["G"], C=dims["C"], R=dims["R"])
+
+        def _relax_key_tail(relax_iters):
+            return (("relax_iters", relax_iters),)
+
+        def relax_signature(st, relax_iters=None):
+            return tuple(sorted(relax_dims(st).items())) + _relax_key_tail(
+                iter_rung(relax_iters or 64))
+
+        def warm_relax(solver, st):
+            sig = relax_signature(st)
+            return solver.warm_custom(sig, lambda: None)
+        """)
+
+    def test_consistent_relax_surface_is_quiet(self):
+        assert lint_files([self.RELAX_OK], [self.RULE]) == []
+
+    def test_relax_dims_must_delegate(self):
+        relax = (self.RELAX_OK[0], self.RELAX_OK[1].replace(
+            "dims = solve_dims(st, NE=0, node_budget=1)", "dims = {}"))
+        findings = lint_files([relax], [self.RULE])
+        assert any("does not delegate to `solve_dims`" in f.message
+                   for f in findings)
+
+    def test_relax_dims_invented_key_fires(self):
+        tpu_ok = TestKT014CompileSurface.TPU_OK
+        relax = (self.RELAX_OK[0], self.RELAX_OK[1].replace(
+            'dict(G=dims["G"], C=dims["C"], R=dims["R"])',
+            'dict(G=dims["G"], C=dims["C"], R=dims["R"], iters=64)'))
+        findings = lint_files([tpu_ok, relax], [self.RULE])
+        assert any("`iters`" in f.message for f in findings)
+
+    def test_signature_bypassing_tail_fires(self):
+        relax = (self.RELAX_OK[0], self.RELAX_OK[1].replace(
+            "+ _relax_key_tail(\n                iter_rung(relax_iters or 64))",
+            ""))
+        findings = lint_files([relax], [self.RULE])
+        assert any("`relax_signature` does not call `_relax_key_tail`"
+                   in f.message for f in findings)
+
+    def test_hand_rolled_relax_tail_fires(self):
+        relax = (self.RELAX_OK[0], self.RELAX_OK[1] + """
+        def rogue(n):
+            return (("relax_iters", n),)
+        """)
+        findings = lint_files([relax], [self.RULE])
+        assert any("single-source" in f.message for f in findings)
+
+    def test_static_argnames_spelling_is_legal(self):
+        relax = (self.RELAX_OK[0], self.RELAX_OK[1] + """
+        import jax
+        from functools import partial
+
+        relax_jit = partial(jax.jit, static_argnames=("relax_iters",))(
+            iter_rung)
+        """)
+        assert lint_files([relax], [self.RULE]) == []
+
+    def test_dead_rung_entry_fires(self):
+        for bad in ("(32, 64, 64, 256)", "(32, 128, 64)", "(0, 64)"):
+            relax = (self.RELAX_OK[0], self.RELAX_OK[1].replace(
+                "(32, 64, 128, 256)", bad))
+            findings = lint_files([relax], [self.RULE])
+            assert any("dead warm entry" in f.message
+                       for f in findings), bad
+
+    def test_warm_bypassing_signature_fires(self):
+        relax = (self.RELAX_OK[0], self.RELAX_OK[1].replace(
+            "sig = relax_signature(st)", "sig = ('relax',)"))
+        findings = lint_files([relax], [self.RULE])
+        assert any("`warm_relax`" in f.message for f in findings)
+
+    def test_relax_fixture_without_anchors_stays_quiet(self):
+        files = [("karpenter_tpu/solver/relax.py", """
+        def helper(x):
+            return x
+        """)]
+        assert lint_files(files, [self.RULE]) == []
+
+    def test_registry_models_the_real_tail(self):
+        """RELAX_STATICS (this rule's model) vs the real _relax_key_tail
+        and KT008's registry — the three must agree, and every ladder
+        entry must be reachable through the real iter_rung."""
+        from karpenter_tpu.analysis.rules.kt008 import BUCKET_GRID_STATICS
+        from karpenter_tpu.analysis.rules.kt014 import RELAX_STATICS
+        from karpenter_tpu.solver.relax import (
+            RELAX_ITER_RUNGS,
+            _relax_key_tail,
+            iter_rung,
+        )
+
+        assert RELAX_STATICS <= BUCKET_GRID_STATICS
+        assert {k for k, _v in _relax_key_tail(64)} == set(RELAX_STATICS)
+        for e in RELAX_ITER_RUNGS:
+            assert iter_rung(e) == e, e
+        for n in range(1, max(RELAX_ITER_RUNGS) * 2):
+            assert iter_rung(n) in RELAX_ITER_RUNGS, n
+
+    def test_package_surface_includes_relax(self):
+        from karpenter_tpu.analysis.ktlint import collect_package_files
+        from karpenter_tpu.analysis.rules.kt014 import surface
+
+        s = surface(collect_package_files())
+        assert s["relax_iter_rungs"], s
+        assert s["relax_dims_keys"], s
+        assert set(s["relax_dims_keys"]) <= set(s["solve_dims_keys"]), s
+
+
+class TestKT008RelaxCoverage:
+    """KT008's serving-dir glob covers solver/relax.py: a per-call jit
+    wrapper or an off-grid static in the rung fires like anywhere else on
+    the serving path (ISSUE 11 satellite)."""
+
+    def test_per_call_jit_in_relax_fires(self):
+        from karpenter_tpu.analysis.rules import kt008
+
+        src = """
+        import jax
+
+        def refine(x):
+            fn = jax.jit(lambda y: y)
+            return fn(x)
+        """
+        findings = lint_files(
+            [("karpenter_tpu/solver/relax.py", src)], [kt008])
+        assert rules_of(findings) == ["KT008"]
+
+    def test_off_grid_static_in_relax_fires(self):
+        from karpenter_tpu.analysis.rules import kt008
+
+        src = """
+        import jax
+        from functools import partial
+
+        bad_jit = partial(jax.jit, static_argnames=("iters",))(len)
+        good_jit = partial(jax.jit, static_argnames=("relax_iters",))(len)
+        """
+        findings = lint_files(
+            [("karpenter_tpu/solver/relax.py", src)], [kt008])
+        assert rules_of(findings) == ["KT008"]
+        assert "iters" in findings[0].message
+
+    def test_layout_ctor_in_relax_fires(self):
+        from karpenter_tpu.analysis.rules import kt011
+
+        src = """
+        from jax.sharding import NamedSharding
+
+        def refine(mesh, spec, x):
+            return NamedSharding(mesh, spec)
+        """
+        findings = lint_files(
+            [("karpenter_tpu/solver/relax.py", src)], [kt011])
+        assert rules_of(findings) == ["KT011"]
+
+
 class TestWholeProgramGates:
     def test_package_zero_findings_for_new_rules(self):
         from karpenter_tpu.analysis.rules import kt012, kt013, kt014

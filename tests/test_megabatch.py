@@ -502,13 +502,23 @@ class TestBucketGridPrecompile:
                     mesh=mesh))
             return True
 
+        def fake_warm_custom(sig, thunk, on_done=None):
+            # the relax rung's program warms through warm_custom
+            accepted.append(sig)
+            return True
+
         sched._tpu.warm_async = fake_warm
+        sched._tpu.warm_custom = fake_warm_custom
         n = sched.precompile_buckets(provs, small_catalog,
                                      mega_slots=(2, 4, 8))
         assert n == len(accepted)
         warmed = set(accepted)
+        from karpenter_tpu.solver.relax import relax_signature
+
         for st in sched._profile_tensors(provs, small_catalog, ()):
             assert sched._tpu.signature(st) in warmed
+            assert relax_signature(st) in warmed, (
+                "relax program not precompiled for a reachable bucket")
             for s in (2, 4, 8):
                 assert sched._tpu.mega_signature(st, slots=s) in warmed, (
                     f"rung {s} not precompiled for a reachable bucket")
@@ -518,6 +528,7 @@ class TestBucketGridPrecompile:
         reg = Registry()
         sched = BatchScheduler(backend="tpu", registry=reg)
         sched._tpu.warm_async = lambda *a, **kw: True
+        sched._tpu.warm_custom = lambda *a, **kw: True
         sched._tpu.warm_idle = lambda: True
         sched.precompile_buckets(provs, small_catalog, mega_slots=(2,),
                                  wait=True, timeout=5.0)
