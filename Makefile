@@ -2,7 +2,7 @@ PYTHON ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
 
-.PHONY: all test native proto bench clean battletest lint obs-demo overload-demo
+.PHONY: all test native proto bench clean battletest lint obs-demo overload-demo chaos
 
 all: native proto
 
@@ -54,6 +54,21 @@ obs-demo:
 # breaker state and brownout level
 overload-demo:
 	JAX_PLATFORMS=cpu $(PYTHON) -m karpenter_tpu.admission
+
+# chaos harness (docs/RESILIENCE.md, ISSUE 12): a composed seeded
+# KT_FAULTS schedule (8 fault kinds: transport UNAVAILABLE/reset,
+# mid-step + mid-commit exceptions, injected latency, session-table wipe,
+# TTL clock jump, spool corruption/truncation) drives a churn chain over
+# real gRPC judged against a fault-free oracle chain — every recovery
+# must end byte-identical, every error typed, recovery cost <= 1 full
+# solve per fault — then the kill-and-restart scenario both WITH the
+# session snapshot (zero re-establishes; every session resumes warm) and
+# WITHOUT (exactly one re-establish per client).  A tier-1-sized seeded
+# rung of the same schedules runs in tests/test_faults.py.
+chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_drive.py
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_drive.py --restart
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_drive.py --restart --no-snapshot
 
 clean:
 	rm -f karpenter_tpu/solver/_native*.so

@@ -131,6 +131,8 @@ def cmd_serve(args) -> int:
         argv += ["--default-priority", args.default_priority]
     if args.default_deadline_ms is not None:
         argv += ["--default-deadline-ms", str(args.default_deadline_ms)]
+    if args.session_dir is not None:
+        argv += ["--session-dir", args.session_dir]
     if args.warmup:
         argv.append("--warmup")
     if args.small:
@@ -243,6 +245,11 @@ def main(argv=None) -> int:
     v.add_argument("--default-deadline-ms", type=float, default=None,
                    help="enqueue deadline when the RPC carries none "
                         "(KT_DEFAULT_DEADLINE_MS; 0 = no deadline)")
+    v.add_argument("--session-dir", default=None,
+                   help="delta-session snapshot spool (KT_SESSION_DIR): "
+                        "restored at startup, written on graceful "
+                        "shutdown + every KT_SESSION_SNAPSHOT_S "
+                        "(docs/RESILIENCE.md)")
     v.add_argument("--warmup", action="store_true",
                    help="block startup on the AOT bucket-grid precompile "
                         "(single ladder + megabatch rungs) so the serving "

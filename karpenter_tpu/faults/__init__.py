@@ -1,0 +1,65 @@
+"""Fault-injection plane — seeded, deterministic chaos for the serving stack.
+
+ISSUE 12's second half: the serving failure paths (session eviction,
+guard-trip fallback, breaker open, mid-step exceptions, SESSION_UNKNOWN
+re-establish, snapshot corruption) are each unit-tested in isolation, but
+composed adversarial sequences only ever happen in production.  This
+package makes them happen on demand, deterministically, through the REAL
+choke points:
+
+- :class:`FaultPlane` — a schedule of injection rules parsed from
+  ``KT_FAULTS`` (default off), fired at named choke-point sites threaded
+  through ``TpuSolver`` (dispatch/fence), ``SolvePipeline`` (delta
+  step/commit), ``DeltaSessionTable`` (table + snapshot spool),
+  ``service/client.py`` (transport) and the breaker feed.  Every injection
+  is counted (``karpenter_faults_injected_total{kind,site}``) and lands in
+  the flight recorder.
+- :data:`NULL_PLANE` — the zero-cost production default: falsy, so hot
+  call sites guard with ``if self._faults:`` and pay one truthiness check.
+- :func:`count_recovery` / :func:`zero_init_recovery` — the recovery-
+  outcome funnel (``karpenter_faults_recovered_total{site,outcome}``).
+  Counted for REAL faults too, not just injected ones; ktlint KT016 pins
+  that every recovering ``except`` on a faultable path reports here.
+- :func:`jitter` — the sanctioned randomness source for serving-path code
+  (retry backoff jitter).  KT016 bans raw ``random`` in solver//service/;
+  this package is the one home for nondeterminism, seeded so chaos runs
+  replay.
+
+The chaos harness (``scripts/chaos_drive.py``, ``make chaos``) composes
+schedules over real gRPC and asserts the recovery invariants in
+docs/RESILIENCE.md.
+"""
+
+from .plane import (  # noqa: F401
+    FAULT_KINDS,
+    FAULT_RECOVERY_OUTCOMES,
+    FAULT_SITES,
+    Effect,
+    FaultPlane,
+    InjectedFault,
+    NULL_PLANE,
+    NullPlane,
+    count_recovery,
+    faults_enabled,
+    jitter,
+    plane,
+    zero_init_recovery,
+)
+
+
+def __getattr__(name):  # PEP 562: grpc-backed class resolves lazily
+    if name == "InjectedRpcError":
+        # importlib, not `from . import plane`: the factory function
+        # `plane` above shadows the submodule name on this package
+        import importlib
+
+        return importlib.import_module(
+            __name__ + ".plane").InjectedRpcError
+    raise AttributeError(name)
+
+__all__ = [
+    "FAULT_KINDS", "FAULT_RECOVERY_OUTCOMES", "FAULT_SITES", "Effect",
+    "FaultPlane", "InjectedFault", "InjectedRpcError", "NULL_PLANE",
+    "NullPlane", "count_recovery", "faults_enabled", "jitter", "plane",
+    "zero_init_recovery",
+]

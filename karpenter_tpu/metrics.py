@@ -200,8 +200,45 @@ DELTA_RPC_OUTCOMES = ("delta", "fallback_full", "establish", "reseed",
 DELTA_RPC_DURATION = "karpenter_solver_delta_rpc_duration_seconds"
 DELTA_SESSIONS = "karpenter_solver_delta_sessions"
 DELTA_EVICTIONS = "karpenter_solver_delta_session_evictions_total"
-#: eviction-reason label population (KT003)
-DELTA_EVICT_REASONS = ("ttl", "capacity", "stop", "error")
+#: eviction-reason label population (KT003).  'fault' is the injected
+#: session-table wipe (docs/RESILIENCE.md) — production never emits it.
+DELTA_EVICT_REASONS = ("ttl", "capacity", "stop", "error", "fault")
+# ---- session durability (ISSUE 12: crash-safe delta serving) ------------
+SNAPSHOT_WRITES = "karpenter_solver_session_snapshot_writes_total"
+#: snapshot write outcomes (KT003 zero-init source): 'written' (spool file
+#: atomically replaced), 'empty' (no live sessions — nothing written),
+#: 'error' (serialization or I/O failed; the previous spool survives)
+SNAPSHOT_WRITE_OUTCOMES = ("written", "empty", "error")
+SNAPSHOT_SKIPPED = "karpenter_solver_session_snapshot_skipped_total"
+#: per-session skip reasons: 'in_step' (a delta step was mid-mutation at
+#: capture — an epoch-atomic snapshot must not persist a half-applied
+#: chain), 'torn' (a step started or committed while the lock-free
+#: writer was pickling this chain; the possibly-inconsistent bytes are
+#: discarded)
+SNAPSHOT_SKIP_REASONS = ("in_step", "torn")
+SNAPSHOT_RESTORE = "karpenter_solver_session_snapshot_restore_total"
+#: restore outcomes — every refusal is a COLD START plus this label, never
+#: a crash or a diverged chain (docs/RESILIENCE.md)
+SNAPSHOT_RESTORE_OUTCOMES = ("restored", "missing", "corrupt", "truncated",
+                             "version", "catalog_epoch", "error")
+SNAPSHOT_DURATION = "karpenter_solver_session_snapshot_duration_seconds"
+SNAPSHOT_SESSIONS = "karpenter_solver_session_snapshot_sessions"
+# ---- fault-injection plane (ISSUE 12: KT_FAULTS, karpenter_tpu/faults/) -
+FAULTS_INJECTED = "karpenter_faults_injected_total"
+FAULTS_RECOVERED = "karpenter_faults_recovered_total"
+#: every choke point the plane can fire at (label population + the site
+#: vocabulary scripts and docs share)
+FAULT_SITES = ("dispatch", "fence", "delta_step", "delta_commit",
+               "session_table", "snapshot_write", "snapshot_read",
+               "transport", "breaker")
+#: the injectable fault catalog (docs/RESILIENCE.md)
+FAULT_KINDS = ("device_hang", "dispatch_exc", "slow_fence", "slow_step",
+               "rpc_unavailable", "rpc_reset", "session_wipe", "clock_jump",
+               "snapshot_corrupt", "snapshot_truncate", "breaker_trip")
+#: recovery outcomes the serving stack reports per site (KT016 pins that
+#: every recovering except on a faultable path lands here)
+FAULT_RECOVERY_OUTCOMES = ("ok", "retried", "fallback", "evicted", "cold",
+                           "skipped", "failed")
 RELAX_TOTAL = "karpenter_solver_relax_total"
 #: the full relax-rung outcome label population (KT003 zero-init source —
 #: BatchScheduler and solver/relax.py both init from it): 'improved' (the
@@ -444,7 +481,58 @@ INVENTORY = {
         "delta step raised mid-apply — the half-mutated chain must not "
         "serve another epoch, so the session dies and the client "
         "re-establishes).  An evicted session costs its client ONE "
-        "re-establishing full solve."),
+        "re-establishing full solve.  'fault' is the injected session-"
+        "table wipe (KT_FAULTS chaos runs only)."),
+    SNAPSHOT_WRITES: (
+        "counter", ("outcome",),
+        "Session-table snapshot writes to the KT_SESSION_DIR spool "
+        "(docs/RESILIENCE.md), by outcome: 'written' (spool atomically "
+        "replaced: write-temp + fsync + rename), 'empty' (no live "
+        "sessions; nothing written), 'error' (serialization or I/O "
+        "failed — the previous spool file survives untouched)."),
+    SNAPSHOT_SKIPPED: (
+        "counter", ("reason",),
+        "Sessions left OUT of a snapshot, by reason: 'in_step' (a delta "
+        "step was mid-mutation at capture) or 'torn' (a step started or "
+        "committed while the lock-free writer was pickling the chain; "
+        "its bytes are discarded).  Epoch-atomicity: a half-applied "
+        "chain is never persisted — a skipped session costs its client "
+        "one re-establish after a restart, never a replayed half-step."),
+    SNAPSHOT_RESTORE: (
+        "counter", ("outcome",),
+        "Session-table restore attempts at pipeline startup, by outcome: "
+        "'restored' (live chains rehydrated; restarted replica serves "
+        "the next delta of every surviving session warm), 'missing' (no "
+        "spool file — plain cold start), 'corrupt' (checksum or decode "
+        "failure), 'truncated' (payload shorter than the header "
+        "declares), 'version' (snapshot format or chain-schema skew), "
+        "'catalog_epoch' (spool written under a different catalog epoch — older or newer), 'error' "
+        "(unexpected failure).  Every non-'restored' outcome degrades to "
+        "today's cold behavior — never a diverged chain."),
+    SNAPSHOT_DURATION: (
+        "histogram", (),
+        "Wall time of one session-table snapshot write or restore, "
+        "seconds."),
+    SNAPSHOT_SESSIONS: (
+        "gauge", (),
+        "Sessions persisted in the most recent snapshot write (0 until "
+        "the first write)."),
+    FAULTS_INJECTED: (
+        "counter", ("kind", "site"),
+        "Faults the KT_FAULTS injection plane fired, by kind and choke-"
+        "point site (docs/RESILIENCE.md fault catalog).  Production runs "
+        "the zero-cost no-op plane; any sample here means a chaos "
+        "schedule is live."),
+    FAULTS_RECOVERED: (
+        "counter", ("site", "outcome"),
+        "Recovery outcomes observed at faultable choke points, by site "
+        "and outcome: 'retried' (transport retry rode through), "
+        "'fallback' (served by a degraded tier), 'evicted' (session "
+        "dropped; client re-establishes), 'cold' (snapshot refused; "
+        "cold start), 'skipped' (work bypassed), 'failed' (typed error "
+        "surfaced to the caller), 'ok' (recovered in place).  Counted "
+        "for REAL faults too, not just injected ones — KT016 pins that "
+        "every recovering except on a faultable path lands here."),
     RELAX_TOTAL: (
         "counter", ("outcome",),
         "Convex-relaxation refinement rung evaluations on device-tier "

@@ -19,8 +19,14 @@ from ..metrics import (
     ADMISSION_BROWNOUT_LEVEL,
     ADMISSION_QUEUE_DEPTH,
     ADMISSION_SHED,
+    FAULTS_INJECTED,
+    FAULTS_RECOVERED,
     FLIGHT_DUMPS,
     INFLIGHT_DEPTH,
+    SNAPSHOT_RESTORE,
+    SNAPSHOT_SESSIONS,
+    SNAPSHOT_SKIPPED,
+    SNAPSHOT_WRITES,
     REMOTE_DEGRADED,
     SOLVER_COLD_FALLBACKS,
     SOLVER_COMPILE_IN_PROGRESS,
@@ -100,6 +106,31 @@ def statusz(registry: Registry, flight: Optional[FlightRecorder] = None) -> dict
             "breaker": _BREAKER_STATES.get(
                 registry.gauge(ADMISSION_BREAKER_STATE).get(), "closed"),
             "brownout_level": registry.gauge(ADMISSION_BROWNOUT_LEVEL).get(),
+        }
+    inj = registry.counter(FAULTS_INJECTED)
+    fired = {f"{dict(lk).get('kind', '')}@{dict(lk).get('site', '')}": v
+             for lk, v in inj.values.items() if v}
+    if fired:
+        # a chaos schedule is live (KT_FAULTS): the injection scoreboard
+        # + the recovery-outcome partition (docs/RESILIENCE.md)
+        doc["faults"] = {
+            "injected": fired,
+            "recovered": {
+                f"{dict(lk).get('site', '')}:{dict(lk).get('outcome', '')}": v
+                for lk, v in
+                registry.counter(FAULTS_RECOVERED).values.items() if v},
+        }
+    writes = registry.counter(SNAPSHOT_WRITES)
+    if writes.values:
+        # session durability is wired (the table zero-inits the family):
+        # spool write/restore outcomes + the last snapshot's size
+        doc["session_snapshot"] = {
+            "writes": _series(writes, "outcome"),
+            "restore": _series(registry.counter(SNAPSHOT_RESTORE),
+                               "outcome"),
+            "skipped": _series(registry.counter(SNAPSHOT_SKIPPED),
+                               "reason"),
+            "last_sessions": registry.gauge(SNAPSHOT_SESSIONS).get(),
         }
     if flight is not None:
         doc["flight_recorder"] = {

@@ -14,6 +14,7 @@ not production.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Dict, List, Optional, Sequence, Set
 
@@ -21,8 +22,10 @@ import grpc
 
 from collections import OrderedDict
 
+from .. import faults as faults_mod
 from ..admission import SolveDeadlineError, SolveShedError, parse_class
 from ..metrics import Registry, registry as default_registry
+from ..utils.clock import Clock
 from ..models.instancetype import InstanceType
 from ..models.pod import PodSpec
 from ..obs.trace import NULL_TRACE
@@ -40,10 +43,71 @@ from ..metrics import REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES  # noqa: E402
 # (names + help text live in metrics.INVENTORY so docs/METRICS.md covers them)
 
 
+class SolveRetriesExhausted(grpc.RpcError):
+    """Transport UNAVAILABLE outlived the bounded retry budget — the
+    replica is not merely restarting, it is gone.  Typed (the PR-5
+    surface: callers back off / re-plan, never silent-retry), and still a
+    ``grpc.RpcError`` with an UNAVAILABLE ``code()`` so availability-first
+    facades (``RemoteScheduler``) keep their degrade-to-local-fallback
+    behavior unchanged."""
+
+    def __init__(self, msg: str, attempts: int) -> None:
+        super().__init__(msg)
+        self.attempts = attempts
+
+    def code(self):
+        return grpc.StatusCode.UNAVAILABLE
+
+    def details(self) -> str:
+        return str(self.args[0]) if self.args else ""
+
+
+class SolveStepFailed(Exception):
+    """A delta step failed server-side mid-apply (gRPC INTERNAL on a
+    session call).  The server evicted the session (the half-mutated
+    chain must never serve another epoch — service/server.py
+    ``_serve_delta``); the client keeps its ledger + pending perturbation,
+    and the NEXT ``solve_delta`` call re-establishes transparently via the
+    session_unknown path — one full solve, never a diverged chain, never
+    an untyped transport error through the facade."""
+
+
+#: retry budget for transport UNAVAILABLE (KT_RPC_RETRIES): how many
+#: RE-attempts one solve_raw pays before the typed give-up.  1 = ride
+#: through a single replica restart; 0 disables ride-through.
+DEFAULT_RPC_RETRIES = 1
+#: base backoff before a retry, ms (KT_RPC_BACKOFF_MS); the actual sleep
+#: is base * (1 + jitter) with jitter from the faults facade so a
+#: restart storm's retries decorrelate
+DEFAULT_RPC_BACKOFF_MS = 200.0
+
+
 class SolverClient:
-    def __init__(self, target: str, timeout: float = 60.0) -> None:
+    def __init__(self, target: str, timeout: float = 60.0,
+                 clock: Optional[Clock] = None,
+                 retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None,
+                 registry: Optional[Registry] = None) -> None:
         self.target = target
         self.timeout = timeout
+        # injectable clock: tests drive the backoff without real sleeps
+        self.clock = clock or Clock()
+        if retries is None:
+            retries = int(os.environ.get("KT_RPC_RETRIES",
+                                         str(DEFAULT_RPC_RETRIES)))
+        if backoff_s is None:
+            backoff_s = float(os.environ.get(
+                "KT_RPC_BACKOFF_MS", str(DEFAULT_RPC_BACKOFF_MS))) / 1000.0
+        self.retries = max(0, retries)
+        self.backoff_s = max(0.0, backoff_s)
+        # transport fault site (docs/RESILIENCE.md): injected UNAVAILABLE/
+        # reset errors exercise the retry path through real handling.
+        # Recovery outcomes land in the registry the EMBEDDING hands us
+        # (RemoteScheduler/DeltaSession pass theirs through), so the
+        # site x outcome partition stays whole on custom registries.
+        self._faults = faults_mod.plane()
+        self._registry = registry or default_registry
+        faults_mod.zero_init_recovery(self._registry)
         self._connect()
 
     def _connect(self) -> None:
@@ -83,7 +147,51 @@ class SolverClient:
 
     def solve_raw(self, request: pb.SolveRequest,
                   timeout: Optional[float] = None) -> pb.SolveResponse:
-        return self._solve(request, timeout=timeout or self.timeout)
+        """One Solve RPC with restart ride-through (ISSUE 12 satellite):
+        transport UNAVAILABLE — the exact shape of a replica restart —
+        retries ONCE per budget unit (KT_RPC_RETRIES, default 1) after a
+        jittered backoff on a fresh channel, then surfaces the typed
+        :class:`SolveRetriesExhausted`.  Typed sheds are NEVER retried:
+        RESOURCE_EXHAUSTED / DEADLINE_EXCEEDED mean the sidecar is
+        protecting itself — overload is not an outage (the PR-5
+        invariant), and a retry storm into an overloaded server is how
+        outages are made."""
+        # every path out of this loop returns or raises: the final
+        # iteration's except always raises (attempt + 1 >= attempts
+        # matches every error on the last pass)
+        attempts = self.retries + 1
+        for attempt in range(attempts):
+            try:
+                if self._faults:
+                    self._faults.fire("transport")
+                return self._solve(request, timeout=timeout or self.timeout)
+            except grpc.RpcError as err:
+                code = (err.code()
+                        if callable(getattr(err, "code", None)) else None)
+                if code != grpc.StatusCode.UNAVAILABLE \
+                        or attempt + 1 >= attempts:
+                    if code == grpc.StatusCode.UNAVAILABLE:
+                        faults_mod.count_recovery(
+                            self._registry, "transport", "failed")
+                        raise SolveRetriesExhausted(
+                            f"solver {self.target} unavailable after "
+                            f"{attempts} attempt(s): "
+                            f"{getattr(err, 'details', lambda: '')() or err}",
+                            attempts) from err
+                    raise
+                # replica restarting: fresh channel (a channel that began
+                # connecting mid-outage can wedge in backoff — see reset),
+                # jittered pause, one more try.  Counted whether the
+                # UNAVAILABLE was injected or organic.
+                faults_mod.count_recovery(
+                    self._registry, "transport", "retried")
+                logger.debug(
+                    "solver %s UNAVAILABLE (attempt %d/%d); retrying "
+                    "after backoff", self.target, attempt + 1, attempts)
+                self.reset()
+                if self.backoff_s > 0:
+                    self.clock.sleep(
+                        self.backoff_s * (1.0 + faults_mod.jitter()))
 
     def warm_raw(self, request: pb.WarmRequest) -> pb.WarmResponse:
         return self._warm(request, timeout=self.timeout)
@@ -123,7 +231,8 @@ class RemoteScheduler:
         deadline_s: Optional[float] = None,
         shed_fallback: bool = False,
     ) -> None:
-        self.client = SolverClient(target, timeout=timeout)
+        self.client = SolverClient(target, timeout=timeout,
+                                   registry=registry)
         self.target = target
         self.backend = backend
         # admission identity (docs/ADMISSION.md): every Solve this facade
@@ -154,6 +263,7 @@ class RemoteScheduler:
         # creates the sample; construction alone does not)
         self.registry.counter(REMOTE_FALLBACK_SOLVES).inc(value=0.0)
         self.registry.gauge(REMOTE_DEGRADED).set(0)
+        faults_mod.zero_init_recovery(self.registry)
 
     #: RPC status codes that mean "the sidecar is not reachable right now".
     #: Anything else (UNIMPLEMENTED from an older sidecar's missing Warm
@@ -315,6 +425,9 @@ class RemoteScheduler:
                         node.pods = [by_name.get(p.name, p) for p in node.pods]
                     return result
         self.registry.counter(REMOTE_FALLBACK_SOLVES).inc()
+        # recovery-outcome funnel (KT016): every local-fallback serve IS a
+        # recovery from a transport-path failure, injected or organic
+        faults_mod.count_recovery(self.registry, "transport", "fallback")
         trace.annotate(remote_fallback=True)
         return self.fallback.solve(
             pods, provisioners, instance_types,
@@ -390,8 +503,13 @@ class DeltaSession:
     :class:`SolveShedError` and a budgeted ``DEADLINE_EXCEEDED`` to
     :class:`SolveDeadlineError` WITHOUT consuming the session — the
     sidecar is protecting itself, not forgetting the chain; back off and
-    call again.  Transport failures drop the session (the next call
-    re-establishes against whatever replaced the sidecar).
+    call again.  Transport ``UNAVAILABLE`` (a replica restarting under
+    us) rides through ONE bounded jittered-backoff retry inside
+    ``SolverClient.solve_raw`` (KT_RPC_RETRIES), then surfaces the typed
+    :class:`SolveRetriesExhausted`; the session is KEPT either way — a
+    snapshot-restoring replacement replica serves the next delta warm,
+    and a replacement without our chain answers ``unknown`` for exactly
+    one re-establishing full solve (docs/RESILIENCE.md).
 
     ``KT_DELTA=0`` (client-side) turns the facade into a plain full-solve
     client: every call re-ships the cluster with NO session fields on the
@@ -406,10 +524,12 @@ class DeltaSession:
     def __init__(self, target: str, *, session_id: Optional[str] = None,
                  timeout: float = 60.0, backend: str = "",
                  priority: str = "", deadline_s: Optional[float] = None,
-                 client: Optional[SolverClient] = None) -> None:
+                 client: Optional[SolverClient] = None,
+                 registry: Optional[Registry] = None) -> None:
         import uuid
 
-        self.client = client or SolverClient(target, timeout=timeout)
+        self.client = client or SolverClient(target, timeout=timeout,
+                                             registry=registry)
         self.session_id = session_id or uuid.uuid4().hex
         self.backend = backend
         self.priority = parse_class(priority) if priority else ""
@@ -623,8 +743,10 @@ class DeltaSession:
     def _rpc(self, req: pb.SolveRequest) -> pb.SolveResponse:
         """solve_raw with the PR-5 typed shed surface.  Typed sheds do NOT
         consume the session (pending perturbation + epoch survive for the
-        next call); transport failures drop it (the next call re-
-        establishes against whatever replaced the sidecar)."""
+        next call); transport failures KEEP it too (ISSUE 12): a
+        snapshot-restoring replacement replica serves the next delta
+        warm, and one without our chain answers session_unknown for
+        exactly one re-establishing full solve."""
         rpc_timeout = (min(self.client.timeout, self.deadline_s)
                        if self.deadline_s else None)
         try:
@@ -647,10 +769,27 @@ class DeltaSession:
                     f"solve deadline budget ({self.deadline_s:g}s) spent: "
                     f"{detail}", pclass=self.priority,
                     reason="deadline") from err
-            # transport failure: the channel may be wedged in backoff
-            # (see SolverClient.reset) and the sidecar may have restarted
-            # without our chain — drop the session, rebuild the channel
-            self._established = False
+            if code == grpc.StatusCode.INTERNAL or code == getattr(
+                    grpc.StatusCode, "UNKNOWN", None):
+                # the server failed MID-STEP (it evicted our session; the
+                # dispatcher re-raised into the RPC).  Typed surface: the
+                # session ledger + pending perturbation survive, and the
+                # next call re-establishes via session_unknown — exactly
+                # one full solve (docs/RESILIENCE.md invariant: errors
+                # are typed, recovery cost is bounded)
+                faults_mod.count_recovery(
+                    self.client._registry, "delta_step", "failed")
+                raise SolveStepFailed(
+                    f"delta step failed server-side: {detail}") from err
+            # transport failure after the client's bounded ride-through
+            # retry (SolverClient.solve_raw): rebuild the channel, KEEP
+            # the session — the replacement replica restores the
+            # KT_SESSION_DIR spool and serves our next delta WARM
+            # (docs/RESILIENCE.md).  Keeping it is safe either way: if
+            # the restart lost (or half-applied) our chain, the epoch
+            # check answers session_unknown and the next call pays
+            # exactly ONE re-establishing full solve — the pre-snapshot
+            # behavior, never a diverged chain.
             self.client.reset()
             raise
 

@@ -32,6 +32,12 @@ solve, never a diverged chain.
 Knobs: ``KT_DELTA`` (default on; 0 disables the whole path and the wire
 behaves byte-identically to pre-delta serving), ``KT_DELTA_SESSIONS``
 (table capacity, default 64), ``KT_DELTA_TTL_S`` (idle TTL, default 900).
+Durability (ISSUE 12, docs/RESILIENCE.md): ``KT_SESSION_DIR`` spools the
+chains to disk on graceful shutdown and periodically at epoch boundaries
+(``KT_SESSION_SNAPSHOT_S``), so a restarted replica serves the next delta
+of every surviving session WARM instead of paying one re-establishing
+full solve per client; ``KT_CATALOG_EPOCH`` (optional) refuses spools
+from any OTHER catalog epoch (older or newer — rollbacks too).
 
 Known limitation (documented, bounded): session ESTABLISHMENTS are full
 solves served synchronously on the fast path (held batches are flushed
@@ -45,12 +51,15 @@ if restart storms ever dominate (ROADMAP item 2's fleet story).
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from .. import faults as faults_mod
 from ..metrics import (
     DELTA_EVICT_REASONS,
     DELTA_EVICTIONS,
@@ -58,11 +67,22 @@ from ..metrics import (
     DELTA_RPC_DURATION,
     DELTA_RPC_OUTCOMES,
     DELTA_SESSIONS,
+    SNAPSHOT_DURATION,
+    SNAPSHOT_RESTORE,
+    SNAPSHOT_RESTORE_OUTCOMES,
+    SNAPSHOT_SESSIONS,
+    SNAPSHOT_SKIP_REASONS,
+    SNAPSHOT_SKIPPED,
+    SNAPSHOT_WRITE_OUTCOMES,
+    SNAPSHOT_WRITES,
     Registry,
     registry as default_registry,
 )
-from ..solver.types import SimNode, SolveResult
+from ..solver.types import SimNode, SolveResult, advance_node_counter
 from ..utils.clock import Clock
+from . import snapshot as snap
+
+logger = logging.getLogger(__name__)
 
 #: default live-session capacity per pipeline (KT_DELTA_SESSIONS); LRU past
 #: it — an evicted session costs its client one re-establishing full solve
@@ -104,6 +124,12 @@ class SessionEntry:
     #: fallback — which drops the chain meta — cannot forget an ICE
     unavailable: set = field(default_factory=set)
     last_used: float = 0.0
+    #: True while a delta step is mid-mutation on this chain.  Written by
+    #: the dispatcher only; read by the snapshot writer so an epoch-atomic
+    #: snapshot SKIPS a half-applied chain (a SIGTERM landing mid-step
+    #: must never persist it — docs/RESILIENCE.md).  Transient: never
+    #: serialized.
+    in_step: bool = False
 
 
 @dataclass
@@ -143,7 +169,8 @@ class DeltaSessionTable:
     def __init__(self, registry: Optional[Registry] = None,
                  clock: Optional[Clock] = None,
                  capacity: Optional[int] = None,
-                 ttl_s: Optional[float] = None) -> None:
+                 ttl_s: Optional[float] = None,
+                 faults=None) -> None:
         self.registry = registry or default_registry
         self.clock = clock or Clock()
         if capacity is None:
@@ -154,9 +181,27 @@ class DeltaSessionTable:
                                          str(DEFAULT_TTL_S)))
         self.capacity = max(1, capacity)
         self.ttl_s = max(0.0, ttl_s)
+        # fault-injection plane (docs/RESILIENCE.md): the null no-op plane
+        # unless KT_FAULTS configures a chaos schedule; the pipeline hands
+        # its own plane down so one schedule covers table + delta path
+        self._faults = (faults if faults is not None
+                        else faults_mod.plane(self.registry))
+        #: injected clock skew, seconds (fault kind ``clock_jump``):
+        #: added to every TTL/LRU timestamp read, so a jump ages the whole
+        #: table at once — the mass-TTL-eviction adversary
+        self._skew = 0.0  # guarded-by: _lock
         self._lock = threading.Lock()
         #: LRU order: oldest first  # guarded-by: _lock
         self._sessions: "OrderedDict[str, SessionEntry]" = OrderedDict()
+        #: serializes spool WRITES (the background periodic writer vs the
+        #: shutdown write): whoever starts last renames last, so a slow
+        #: older capture can never replace a newer spool.  Never nested
+        #: inside _lock (snapshot acquires it first, then _lock briefly
+        #: for the capture).
+        self._spool_lock = threading.Lock()
+        #: strictly above every session epoch this table has ever issued,
+        #: observed, restored, or evicted  # guarded-by: _lock
+        self._epoch_floor = 1
         zero_init_metrics(self.registry)
 
     def __len__(self) -> int:
@@ -166,22 +211,58 @@ class DeltaSessionTable:
     def _gauge_locked(self) -> None:
         self.registry.gauge(DELTA_SESSIONS).set(len(self._sessions))
 
+    def _note_epoch_locked(self, epoch: int) -> None:
+        """Every epoch that leaves the table's sight (evicted, dropped,
+        cleared) or enters it (put, restore) raises the establishment
+        floor past it — see :meth:`next_epoch`."""
+        if epoch + 1 > self._epoch_floor:
+            self._epoch_floor = epoch + 1
+
+    def next_epoch(self) -> int:
+        """Establishment epoch: strictly above every epoch this table has
+        ever issued, observed, restored, or evicted.  A re-established
+        session can therefore NEVER advance back onto an epoch a stale
+        incarnation reached — the epoch-collision path by which a stale
+        spool (or a lost reply racing an eviction) could pass the exact-
+        match check and silently diverge a chain is closed by
+        construction."""
+        with self._lock:
+            for e in self._sessions.values():
+                self._note_epoch_locked(e.epoch)
+            return self._epoch_floor
+
     def _evict_expired_locked(self, now: float) -> None:
         if self.ttl_s <= 0:
             return
         dead = [sid for sid, e in self._sessions.items()
                 if now - e.last_used > self.ttl_s]
         for sid in dead:
+            self._note_epoch_locked(self._sessions[sid].epoch)
             del self._sessions[sid]
         if dead:
             self.registry.counter(DELTA_EVICTIONS).inc(
                 {"reason": "ttl"}, value=float(len(dead)))
 
+    def _table_fault(self) -> None:
+        """Fire the session-table choke point (before taking the lock —
+        the wipe effect re-enters via :meth:`clear`)."""
+        effect = self._faults.fire("session_table")
+        if effect is None:
+            return
+        if effect.kind == "session_wipe":
+            self.clear("fault")
+        elif effect.kind == "clock_jump":
+            with self._lock:
+                self._skew += effect.value
+
     def get(self, session_id: str) -> Optional[SessionEntry]:
         """Look up a live session (touches its TTL + LRU position); expired
         entries are evicted on the way."""
+        if self._faults:
+            self._table_fault()
         now = self.clock.now()
         with self._lock:
+            now += self._skew
             self._evict_expired_locked(now)
             entry = self._sessions.get(session_id)
             if entry is not None:
@@ -192,15 +273,20 @@ class DeltaSessionTable:
 
     def put(self, entry: SessionEntry) -> None:
         """Insert or replace a session; LRU-evicts past capacity."""
+        if self._faults:
+            self._table_fault()
         now = self.clock.now()
-        entry.last_used = now
         with self._lock:
+            now += self._skew
+            entry.last_used = now
+            self._note_epoch_locked(entry.epoch)
             self._evict_expired_locked(now)
             self._sessions[entry.session_id] = entry
             self._sessions.move_to_end(entry.session_id)
             evicted = 0
             while len(self._sessions) > self.capacity:
-                self._sessions.popitem(last=False)
+                _sid, old = self._sessions.popitem(last=False)
+                self._note_epoch_locked(old.epoch)
                 evicted += 1
             if evicted:
                 self.registry.counter(DELTA_EVICTIONS).inc(
@@ -214,7 +300,9 @@ class DeltaSessionTable:
         re-apply onto a corrupted base, so the only safe outcome is
         eviction (the client re-establishes with one full solve)."""
         with self._lock:
-            if self._sessions.pop(session_id, None) is not None:
+            gone = self._sessions.pop(session_id, None)
+            if gone is not None:
+                self._note_epoch_locked(gone.epoch)
                 self.registry.counter(DELTA_EVICTIONS).inc(
                     {"reason": reason})
             self._gauge_locked()
@@ -222,11 +310,220 @@ class DeltaSessionTable:
     def clear(self, reason: str = "stop") -> None:
         with self._lock:
             n = len(self._sessions)
+            for e in self._sessions.values():
+                self._note_epoch_locked(e.epoch)
             self._sessions.clear()
             if n:
                 self.registry.counter(DELTA_EVICTIONS).inc(
                     {"reason": reason}, value=float(n))
             self._gauge_locked()
+
+    # ---- durability (ISSUE 12: snapshot/restore, docs/RESILIENCE.md) ----
+    def snapshot(self, dir_path: str) -> dict:
+        """Write every quiescent session chain to the KT_SESSION_DIR
+        spool (epoch-atomic: write-temp + fsync + rename).
+
+        Needs NO scheduler lock, so the periodic write runs on a
+        background thread and no serving path ever stalls behind pickle
+        + fsync: each entry is pickled INDIVIDUALLY outside the table
+        lock, and any chain a delta step touched during that window is
+        discarded —
+
+        - ``in_step`` at capture -> skipped (counted ``in_step``): the
+          dispatcher sets the marker BEFORE its first mutation, so a
+          chain mid-mutation is never even pickled;
+        - pickle failure, or ``in_step``/``epoch`` moved by the time the
+          entry's bytes are done -> discarded (counted ``torn``): a step
+          that STARTED during pickling flips ``in_step`` first, and one
+          that started AND committed moved the epoch — either way the
+          possibly-inconsistent bytes are dropped.
+
+        A skipped/torn session just costs its client one re-establish if
+        the process dies before the next snapshot — the spool never
+        carries a half-applied chain.  Returns ``{"written": n,
+        "skipped": n}`` (skipped = in_step + torn).
+
+        Concurrent writers (the background periodic thread vs the
+        shutdown write) serialize on ``_spool_lock``: whoever starts
+        last captures last AND renames last, so a slow older capture can
+        never replace a newer spool."""
+        with self._spool_lock:
+            return self._snapshot_impl(dir_path)
+
+    def _snapshot_impl(self, dir_path: str) -> dict:
+        t0 = time.perf_counter()
+        with self._lock:
+            live = list(self._sessions.values())
+        entries, skipped = [], 0
+        max_epoch = 0
+        for e in live:
+            if e.in_step:
+                skipped += 1
+                self.registry.counter(SNAPSHOT_SKIPPED).inc(
+                    {"reason": "in_step"})
+                continue
+            epoch0 = e.epoch
+            try:
+                blob = snap.pack_entry(dict(
+                    session_id=e.session_id, prev=e.prev,
+                    epoch=int(epoch0),
+                    catalog_epoch=int(e.catalog_epoch),
+                    provisioners=list(e.provisioners),
+                    instance_types=list(e.instance_types),
+                    daemonsets=list(e.daemonsets),
+                    unavailable=set(e.unavailable)))
+            # ktlint: allow[KT005] a chain mutating under the pickler can
+            # raise anything; the entry is discarded as torn and counted
+            except Exception:  # noqa: BLE001
+                blob = None
+            if blob is None or e.in_step or e.epoch != epoch0:
+                skipped += 1
+                self.registry.counter(SNAPSHOT_SKIPPED).inc(
+                    {"reason": "torn"})
+                continue
+            max_epoch = max(max_epoch, int(e.catalog_epoch))
+            entries.append(blob)
+        writes = self.registry.counter(SNAPSHOT_WRITES)
+        if not entries:
+            if skipped == 0:
+                # genuinely no sessions: an OLD spool left on disk would
+                # resurrect long-evicted chains at the next restart —
+                # "no sessions" must persist as "no spool" (with skipped
+                # chains we keep the previous spool: those sessions are
+                # live and a crash should still restore their last
+                # committed epoch)
+                try:
+                    os.unlink(snap.spool_path(dir_path))
+                except OSError:
+                    pass
+                self.registry.gauge(SNAPSHOT_SESSIONS).set(0.0)
+            writes.inc({"outcome": "empty"})
+            return {"written": 0, "skipped": skipped}
+        try:
+            blob = snap.pack(entries, catalog_epoch=max_epoch)
+            # spool-byte adversary (snapshot_corrupt/_truncate): mangles
+            # AFTER the checksum is computed, so a restore must detect it
+            blob = self._faults.mangle("snapshot_write", blob)
+            snap.write_atomic(dir_path, blob)
+        # ktlint: allow[KT005] a failing snapshot must never take serving
+        # down; the previous spool survives and the outcome is counted
+        except Exception:  # noqa: BLE001
+            logger.warning("session snapshot write to %s failed",
+                           dir_path, exc_info=True)
+            writes.inc({"outcome": "error"})
+            faults_mod.count_recovery(self.registry, "snapshot_write",
+                                      "failed")
+            return {"written": 0, "skipped": skipped}
+        writes.inc({"outcome": "written"})
+        self.registry.gauge(SNAPSHOT_SESSIONS).set(float(len(entries)))
+        self.registry.histogram(SNAPSHOT_DURATION).observe(
+            time.perf_counter() - t0)
+        return {"written": len(entries), "skipped": skipped}
+
+    def restore(self, dir_path: str,
+                expected_catalog_epoch: Optional[int] = None) -> int:
+        """Rehydrate the table from the spool at startup.  Every refusal
+        (corrupt / truncated / version skew / stale catalog epoch) is a
+        counted COLD START — never a crash, never a diverged chain.
+        Returns the number of sessions restored."""
+        t0 = time.perf_counter()
+
+        def _count(outcome: str) -> None:
+            self.registry.counter(SNAPSHOT_RESTORE).inc(
+                {"outcome": outcome})
+
+        blob = snap.read(dir_path)
+        if blob is None:
+            _count("missing")
+            return 0
+        try:
+            raw_entries, _epoch = snap.unpack(
+                blob, expected_catalog_epoch=expected_catalog_epoch)
+            entries = [snap.unpack_entry(b) for b in raw_entries]
+            restored = 0
+            now = self.clock.now()
+            # a restarted process's auto-name counter starts at 0: advance
+            # it past every restored node index so a fresh proposal can
+            # never collide with (and silently cross-wire) a chain node
+            max_idx = -1
+            for d in entries:
+                prev = d.get("prev")
+                meta = getattr(prev, "_warmstart_meta", None)
+                names = [n.name for n in
+                         list(getattr(prev, "nodes", ()) or ())
+                         + list(getattr(prev, "existing_nodes", ()) or ())]
+                if meta is not None:
+                    names += [n.name for n in meta.nodes]
+                for nm in names:
+                    if nm.startswith("node-"):
+                        try:
+                            max_idx = max(max_idx, int(nm[5:]))
+                        except ValueError:
+                            pass
+            if max_idx >= 0:
+                advance_node_counter(max_idx)
+            with self._lock:
+                now += self._skew
+                for d in entries:
+                    entry = SessionEntry(
+                        session_id=d["session_id"], prev=d["prev"],
+                        epoch=int(d["epoch"]),
+                        catalog_epoch=int(d["catalog_epoch"]),
+                        provisioners=d["provisioners"],
+                        instance_types=d["instance_types"],
+                        daemonsets=tuple(d.get("daemonsets") or ()),
+                        unavailable=set(d.get("unavailable") or ()),
+                        last_used=now,
+                    )
+                    # the establishment floor clears every restored epoch:
+                    # a session re-established after a restore can never
+                    # advance back onto an epoch its old incarnation
+                    # reached (the epoch-collision divergence class)
+                    self._note_epoch_locked(entry.epoch)
+                    self._sessions[entry.session_id] = entry
+                    self._sessions.move_to_end(entry.session_id)
+                    restored += 1
+                evicted = 0
+                while len(self._sessions) > self.capacity:
+                    self._sessions.popitem(last=False)
+                    evicted += 1
+                    restored -= 1
+                if evicted:
+                    self.registry.counter(DELTA_EVICTIONS).inc(
+                        {"reason": "capacity"}, value=float(evicted))
+                self._gauge_locked()
+            # restore-once: the spool is CONSUMED — these chains mutate
+            # from here on, and a later crash that never wrote a fresh
+            # snapshot must cold-start rather than resurrect this now-
+            # doubly-stale file (the stale-spool divergence class)
+            try:
+                os.unlink(snap.spool_path(dir_path))
+            except OSError:
+                pass
+        except snap.SnapshotRefused as err:
+            logger.warning("session snapshot refused; serving cold: %s",
+                           err)
+            _count(err.reason)
+            faults_mod.count_recovery(self.registry, "snapshot_read",
+                                      "cold")
+            self.clear("stop")  # drop any partially-restored entries
+            return 0
+        # ktlint: allow[KT005] an unexpectedly-shaped spool is the same
+        # outcome as a corrupt one: counted cold start, never a crash
+        except Exception:  # noqa: BLE001
+            logger.warning("session snapshot restore from %s failed; "
+                           "serving cold", dir_path, exc_info=True)
+            _count("error")
+            faults_mod.count_recovery(self.registry, "snapshot_read",
+                                      "cold")
+            self.clear("stop")
+            return 0
+        _count("restored")
+        self.registry.histogram(SNAPSHOT_DURATION).observe(
+            time.perf_counter() - t0)
+        logger.info("restored %d delta session(s) from %s", restored,
+                    dir_path)
+        return restored
 
 
 def zero_init_metrics(registry: Registry) -> None:
@@ -245,3 +542,24 @@ def zero_init_metrics(registry: Registry) -> None:
     if not gauge.has():
         gauge.set(0)
     registry.histogram(DELTA_RPC_DURATION)
+    # session durability families (ISSUE 12): the first snapshot write /
+    # restore refusal of a replica's life must survive rate()
+    writes = registry.counter(SNAPSHOT_WRITES)
+    for outcome in SNAPSHOT_WRITE_OUTCOMES:
+        if not writes.has({"outcome": outcome}):
+            writes.inc({"outcome": outcome}, value=0.0)
+    skipped = registry.counter(SNAPSHOT_SKIPPED)
+    for reason in SNAPSHOT_SKIP_REASONS:
+        if not skipped.has({"reason": reason}):
+            skipped.inc({"reason": reason}, value=0.0)
+    restore = registry.counter(SNAPSHOT_RESTORE)
+    for outcome in SNAPSHOT_RESTORE_OUTCOMES:
+        if not restore.has({"outcome": outcome}):
+            restore.inc({"outcome": outcome}, value=0.0)
+    sg = registry.gauge(SNAPSHOT_SESSIONS)
+    if not sg.has():
+        sg.set(0)
+    registry.histogram(SNAPSHOT_DURATION)
+    # recovery-outcome population (KT016's funnel is live in production —
+    # organic faults count too, so the series must exist from birth)
+    faults_mod.zero_init_recovery(registry)

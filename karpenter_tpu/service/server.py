@@ -13,6 +13,7 @@ import argparse
 import logging
 import os
 import queue
+import signal
 import threading
 import time
 from concurrent import futures
@@ -21,6 +22,7 @@ from typing import Optional
 
 import grpc
 
+from .. import faults as faults_mod
 from ..admission import (
     AdmissionControl,
     SolveDeadlineError,
@@ -236,9 +238,57 @@ class SolvePipeline:
         # every session-carrying request degrades to the classic full
         # path — byte-identical to pre-delta serving.  Table entries are
         # dispatcher-owned; the table's own lock only guards the dict.
+        # fault-injection plane (ISSUE 12, docs/RESILIENCE.md): the
+        # zero-cost null plane unless KT_FAULTS configures a chaos
+        # schedule; shared with the session table so ONE seeded schedule
+        # covers the delta path and the table/spool choke points
+        self._faults = faults_mod.plane(
+            self.registry,
+            flight=getattr(getattr(scheduler, "tracer", None),
+                           "flight", None))
         self._delta_tab: Optional[DeltaSessionTable] = (
-            DeltaSessionTable(registry=self.registry, clock=self._clock)
+            DeltaSessionTable(registry=self.registry, clock=self._clock,
+                              faults=self._faults)
             if delta_enabled() else None)
+        # session durability (ISSUE 12): with KT_SESSION_DIR set, chains
+        # spool to disk on graceful shutdown and periodically at epoch
+        # boundaries (KT_SESSION_SNAPSHOT_S), and a restarted replica
+        # rehydrates here — every surviving session's next delta is served
+        # WARM instead of costing a re-establishing full solve.  A refused
+        # spool (corrupt/version/catalog skew) is a counted cold start.
+        self._spool_dir = os.environ.get("KT_SESSION_DIR", "")
+        if self._spool_dir:
+            # one spool PER PIPELINE: the service lazily builds a pipeline
+            # per requested backend, and two tables sharing one spool file
+            # would clobber each other's sessions at every write — the
+            # last pipeline to stop would be the only one whose clients
+            # resume warm.  Namespace by the scheduler's backend.
+            self._spool_dir = os.path.join(
+                self._spool_dir, getattr(scheduler, "backend", "") or "auto")
+        self._snap_interval = float(
+            os.environ.get("KT_SESSION_SNAPSHOT_S", "30"))
+        self._last_snap = self._clock.now()   # guarded-by: _sched_lock
+        #: in-flight background spool write (the periodic snapshot runs
+        #: OFF the serving paths — the table's torn-entry guard makes a
+        #: lock-free write safe).  Written under _sched_lock
+        #: (_maybe_snapshot); snapshot_sessions' shutdown read is
+        #: deliberately lock-free — a dispatcher wedged inside the lock
+        #: must not deadlock shutdown, and the unique write_atomic temp
+        #: names make even a racing writer rename-safe.
+        self._snap_worker: Optional[threading.Thread] = None
+        if self._spool_dir and self._delta_tab is not None:
+            cat = os.environ.get("KT_CATALOG_EPOCH", "")
+            tracer = getattr(scheduler, "tracer", None)
+            if tracer is not None:
+                with tracer.start("restore", spool=self._spool_dir) as tr:
+                    n = self._delta_tab.restore(
+                        self._spool_dir,
+                        expected_catalog_epoch=int(cat) if cat else None)
+                    tr.annotate(sessions=n)
+            else:
+                self._delta_tab.restore(
+                    self._spool_dir,
+                    expected_catalog_epoch=int(cat) if cat else None)
         #: lazily-built host FFD scheduler for breaker-open / brownout
         #: routed solves (device capacity stays reserved for the classes
         #: that keep the device path)
@@ -352,10 +402,61 @@ class SolvePipeline:
                     _kwargs, fut, _t_enq, _t_wall = ticket.item
                     _resolve(fut, exc=RuntimeError("solve pipeline stopped"))
         if self._delta_tab is not None:
-            # session chains die with the pipeline; clients re-establish
-            # against the replacement (counted so a restart storm is
-            # visible as eviction reason "stop", not mystery unknowns)
+            # graceful shutdown: spool the chains FIRST (KT_SESSION_DIR
+            # set), so the replacement replica serves every surviving
+            # session warm...
+            if self._spool_dir:
+                self.snapshot_sessions()
+            # ...then the in-memory chains die with the pipeline; clients
+            # whose sessions were not spooled re-establish against the
+            # replacement (counted so a restart storm is visible as
+            # eviction reason "stop", not mystery unknowns)
             self._delta_tab.clear("stop")
+
+    def snapshot_sessions(self) -> dict:
+        """Spool every quiescent session chain (graceful-shutdown path:
+        the serve SIGTERM handler and deploy preStop land here via
+        ``stop()``; chaos/regression tests call it directly).  Safe
+        against a dispatcher wedged MID-STEP — the wedged chain carries
+        ``in_step``/moves its epoch and the table skips/discards it
+        (epoch-atomicity over completeness: that one client
+        re-establishes, nobody replays half a mutation).  Ordering vs an
+        in-flight background periodic write is the table's ``_spool_lock``:
+        this call captures AND renames after that writer finishes, so an
+        older capture can never replace this newer spool."""
+        if not self._spool_dir or self._delta_tab is None:
+            return {}
+        return self._delta_tab.snapshot(self._spool_dir)
+
+    def _maybe_snapshot(self) -> None:
+        """Periodic epoch-boundary spool write, handed to a background
+        thread: pickling up to KT_DELTA_SESSIONS chains + fsync must
+        never sit on a sub-ms serving path or hold the scheduler lock
+        (the table's per-entry torn-entry guard makes the lock-free
+        write safe).  Interval state is _sched_lock-serialized (every
+        call site holds it); at most one write is in flight — a boundary
+        arriving while one runs is skipped, the next one catches up."""
+        if (not self._spool_dir or self._delta_tab is None
+                or self._snap_interval <= 0 or self._stop.is_set()):
+            # the _stop check matters: stop() writes the shutdown spool
+            # then clears the table, and a straggling tick afterwards
+            # would snapshot the now-EMPTY table — whose empty-write
+            # path removes the spool the shutdown just wrote
+            return
+        # callers already hold the (re-entrant) ownership lock; taking it
+        # here keeps the interval/worker state lexically guarded
+        with self._sched_lock:
+            now = self._clock.now()
+            if now - self._last_snap < self._snap_interval:
+                return
+            if (self._snap_worker is not None
+                    and self._snap_worker.is_alive()):
+                return
+            self._last_snap = now
+            self._snap_worker = threading.Thread(
+                target=self._delta_tab.snapshot, args=(self._spool_dir,),
+                name="session-snapshot", daemon=True)
+            self._snap_worker.start()
 
     def _finalize(self, pending, fut: Future) -> None:
         try:
@@ -386,6 +487,19 @@ class SolvePipeline:
         if fut in self._host_futs:
             self._host_futs.discard(fut)
             return
+        if self._faults:
+            effect = self._faults.fire("breaker")
+            if effect is not None and effect.kind == "breaker_trip":
+                # synthetic failure into the breaker's device-path feed:
+                # composes breaker-open host routing with whatever else
+                # the schedule is doing.  RETURN: the request whose
+                # completion carried the injected trip must not also
+                # record its organic outcome — record_success would
+                # reset the closed-state failure count to 0 and N
+                # consecutive injected trips could never reach the
+                # open threshold
+                self._adm.breaker.record_failure("injected")
+                return
         if err is None:
             self._adm.breaker.record_success()
         elif isinstance(err, DeviceHang):
@@ -584,7 +698,8 @@ class SolvePipeline:
             # counter sweep per sub-ms RPC would tax exactly the path this
             # shortcut exists to strip
             reply.solve_ms = (time.perf_counter() - t_wall) * 1000.0
-            return reply
+            self._maybe_snapshot()  # epoch boundary (caller holds the
+            return reply            # scheduler-ownership lock)
         finally:
             if ticket is not None:
                 self._adm.release(ticket)
@@ -627,6 +742,9 @@ class SolvePipeline:
         reply.solve_ms = (time.perf_counter() - t_wall) * 1000.0
         _resolve(fut, result=reply)
         self._unhand(fut)
+        # epoch boundary: the chain just committed, nothing is mid-step —
+        # the natural moment for the periodic durability write
+        self._maybe_snapshot()
 
     def _serve_delta(self, kwargs: dict, info: dict, trace):
         """One session-routed request -> (DeltaReply, outcome label).
@@ -665,14 +783,22 @@ class SolvePipeline:
                 # delta serving off: answer like a plain solve ("" state
                 # tells the client no session was retained)
                 return _counted(_full_reply(result, 0, "", state=""), "establish")
+            # establishment epochs come from the table's monotone floor,
+            # NOT a constant 1: a re-established session must never be
+            # able to advance back onto an epoch a stale incarnation
+            # (spooled, or lost to an eviction race) already reached —
+            # an exact-match epoch check against stale state is the one
+            # silent-divergence path the protocol must close
+            epoch0 = tab.next_epoch()
             tab.put(SessionEntry(
-                session_id=sid, prev=result, epoch=1,
+                session_id=sid, prev=result, epoch=epoch0,
                 catalog_epoch=info["catalog_epoch"],
                 provisioners=provisioners, instance_types=instance_types,
                 daemonsets=kwargs.get("daemonsets") or (),
                 unavailable=set(kwargs.get("unavailable") or ()),
             ))
-            return _counted(_full_reply(result, 1, "establish"), "establish")
+            return _counted(_full_reply(result, epoch0, "establish"),
+                            "establish")
         # ---- incremental step -------------------------------------------
         entry = tab.get(sid) if tab is not None else None
         if entry is None or entry.epoch != info["base_epoch"]:
@@ -698,8 +824,13 @@ class SolvePipeline:
             # the step raised MID-APPLY: the chain may be half-mutated at
             # an unchanged epoch, and the client's cumulative retry would
             # pass the epoch check and re-apply onto a corrupted base —
-            # evict, so the client re-establishes from scratch
+            # evict, so the client re-establishes from scratch.  The
+            # recovery outcome is counted whether the fault was injected
+            # or organic (docs/RESILIENCE.md invariant: errors are typed,
+            # recoveries are visible).
             tab.drop(sid, "error")
+            faults_mod.count_recovery(self.registry, "delta_step",
+                                      "evicted")
             raise
 
     def _apply_delta_step(self, entry: SessionEntry, info: dict, pods,
@@ -708,6 +839,16 @@ class SolvePipeline:
         """Apply one incremental step onto a live chain (dispatcher- or
         inline-thread, under _sched_lock either way).  Mutates the entry;
         the caller owns eviction if anything below raises."""
+        # mid-mutation marker: from here until the epoch increments, this
+        # chain must never be snapshotted (the spool writer skips it) —
+        # set BEFORE the first mutation below, cleared after the commit
+        entry.in_step = True
+        if self._faults:
+            effect = self._faults.fire("delta_step")
+            if effect is not None and effect.kind == "slow_step":
+                # injected latency while in_step is True: the adversary a
+                # SIGTERM-mid-mutation snapshot must survive
+                self._faults.sleep(effect)
         if reseed:
             entry.instance_types = instance_types
             if provisioners:
@@ -748,7 +889,12 @@ class SolvePipeline:
             force_full=reseed, trace=trace,
         )
         entry.prev = outcome.result
+        if self._faults:
+            # the half-mutated adversary: prev already replaced, epoch not
+            # yet acked — a raise HERE must evict, never snapshot
+            self._faults.fire("delta_commit")
         entry.epoch += 1
+        entry.in_step = False
         if reseed:
             return _counted(
                 _full_reply(outcome.result, entry.epoch, "reseed"), "reseed")
@@ -835,6 +981,10 @@ class SolvePipeline:
                         self._flush(batch, reason)
                     if not len(self._coal):
                         self._drain(self._inflight.pop_to(0))
+                    # idle tick: chains quiescent under _sched_lock — keep
+                    # the spool fresh even when delta traffic rides the
+                    # inline shortcut between dispatcher wakeups
+                    self._maybe_snapshot()
                 continue
             # in hand from pop to resolution (_flush/_finalize remove
             # it); coalescer-held requests stay in the ledger so a
@@ -1173,6 +1323,14 @@ def main(argv=None) -> int:
                         help="enqueue deadline applied when the RPC "
                              "carries none (KT_DEFAULT_DEADLINE_MS; 0 = "
                              "no deadline)")
+    parser.add_argument("--session-dir", default=None,
+                        help="delta-session snapshot spool "
+                             "(KT_SESSION_DIR): chains spool here on "
+                             "graceful shutdown and every "
+                             "KT_SESSION_SNAPSHOT_S seconds, and are "
+                             "restored at startup so a restarted replica "
+                             "serves surviving sessions warm "
+                             "(docs/RESILIENCE.md); empty disables")
     args = parser.parse_args(argv)
     # admission knobs land in the env so every pipeline the service lazily
     # constructs (per backend) picks them up uniformly
@@ -1182,6 +1340,10 @@ def main(argv=None) -> int:
         os.environ["KT_DEFAULT_PRIORITY_CLASS"] = args.default_priority
     if args.default_deadline_ms is not None:
         os.environ["KT_DEFAULT_DEADLINE_MS"] = str(args.default_deadline_ms)
+    if args.session_dir is not None:
+        # env, not a ctor param: every pipeline the service lazily
+        # constructs (per backend) picks the spool up uniformly
+        os.environ["KT_SESSION_DIR"] = args.session_dir
     service = SolverService(BatchScheduler(backend=args.backend),
                             max_slots=args.max_slots,
                             max_wait_ms=args.max_wait_ms)
@@ -1225,17 +1387,39 @@ def main(argv=None) -> int:
         from ..obs.export import serve as obs_serve
 
         flight = service.tracer.flight or default_flight()
+        # a unix: gRPC address is not a TCP hostname — the obs HTTP
+        # server stays on loopback in the same-pod sidecar topology
+        obs_host = ("127.0.0.1" if args.host.startswith("unix:")
+                    else args.host)
         _obs_server, obs_port = obs_serve(
-            service.registry, flight, port=args.obs_port, host=args.host)
-        print(f"observability on http://{args.host}:{obs_port}/tracez")
+            service.registry, flight, port=args.obs_port, host=obs_host)
+        print(f"observability on http://{obs_host}:{obs_port}/tracez")
+    # graceful shutdown (ISSUE 12, docs/RESILIENCE.md): SIGTERM — the
+    # kubelet's pod-termination signal, reinforced by deploy/solver.yaml's
+    # preStop sleep so in-flight RPCs drain inside the grace window — and
+    # Ctrl-C both land here: stop accepting RPCs, then close the service,
+    # which spools every live session chain to KT_SESSION_DIR before the
+    # table clears.  The replacement replica restores the spool and serves
+    # every surviving session's next delta WARM.
+    stop_ev = threading.Event()
+
+    def _graceful(signum, _frame):
+        print(f"signal {signum}: draining RPCs + snapshotting delta "
+              "sessions", flush=True)
+        stop_ev.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
     try:
-        while True:
-            time.sleep(3600)
+        while not stop_ev.wait(timeout=3600):
+            pass
     except KeyboardInterrupt:
-        server.stop(grace=2.0)
-        service.close()
-        for sched in service._schedulers.values():
-            sched.stop_warms()
+        pass
+    server.stop(grace=2.0)
+    service.close()
+    for sched in service._schedulers.values():
+        sched.stop_warms()
+    print("solver sidecar stopped", flush=True)
     return 0
 
 

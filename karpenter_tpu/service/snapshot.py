@@ -1,0 +1,195 @@
+"""Session-table snapshot spool — versioned, checksummed serialization of
+live delta chains (ISSUE 12 tentpole, docs/RESILIENCE.md).
+
+PR 10 made steady-state serving session-stateful; a replica restart then
+destroys every ``_warmstart_meta`` chain and costs one full re-establishing
+solve PER CLIENT.  This module is the durability half of the fix: the
+``DeltaSessionTable`` serializes its chains to a spool file under
+``KT_SESSION_DIR`` (the jit-cache PVC precedent — mount the same pod-local
+or shared volume) on graceful shutdown and periodically at epoch
+boundaries, and a restarted replica rehydrates the table so every
+surviving session's next delta is served WARM.
+
+File layout (one file, ``sessions.snap``)::
+
+    MAGIC(8) | version(>I) | payload_len(>Q) | sha256(payload)(32) | payload
+
+``payload`` is a pickle of ``{"schema": ..., "catalog_epoch": ...,
+"entries": [...]}`` — pickle is the right tool here because the spool is
+written and read by the SAME binary (the chain carries numpy residual
+matrices and the full SimNode graph, and pickle preserves the node-object
+identity sharing between ``result.nodes`` and ``meta.nodes`` that the
+warm-start tiers rely on).  What makes it safe is the envelope:
+
+- **Atomic**: write-temp + fsync + rename — a SIGKILL mid-write leaves
+  the previous spool intact, never a torn file.
+- **Checksummed**: a flipped byte anywhere in the payload fails the
+  sha256 and the restore refuses (``corrupt``).
+- **Length-framed**: a truncated payload is detected BEFORE the checksum
+  (``truncated``) so operators can tell disk-full from bit-rot.
+- **Versioned twice**: the format version (:data:`SNAPSHOT_VERSION`) and
+  a schema fingerprint derived from the live dataclass fields of
+  ``SolveResult`` + ``warmstart._Meta`` — a refactor that changes the
+  chain shape auto-invalidates old spools (``version``) instead of
+  unpickling into a subtly different world.
+- **Catalog-gated**: a spool whose catalog epoch DIFFERS from the
+  configured ``KT_CATALOG_EPOCH`` is refused whole (``catalog_epoch``)
+  — older or newer, a chain packed against another epoch's prices must
+  not serve warm.
+
+Every refusal is a COLD START plus a counted reason
+(``karpenter_solver_session_snapshot_restore_total{outcome}``), never a
+crash and never a diverged chain.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import pickle
+import struct
+from typing import Optional, Tuple
+
+MAGIC = b"KTSESS1\n"
+#: bump when the envelope layout changes (the schema fingerprint below
+#: covers chain-SHAPE drift automatically)
+SNAPSHOT_VERSION = 1
+_HEADER = struct.Struct(">IQ")  # version, payload length
+#: spool file name under KT_SESSION_DIR
+SPOOL_NAME = "sessions.snap"
+
+
+class SnapshotRefused(Exception):
+    """A spool file that must not be restored.  ``reason`` is one of the
+    ``SNAPSHOT_RESTORE_OUTCOMES`` labels (corrupt / truncated / version /
+    catalog_epoch) — the caller counts it and cold-starts."""
+
+    def __init__(self, reason: str, detail: str = "") -> None:
+        super().__init__(f"session snapshot refused ({reason}): {detail}")
+        self.reason = reason
+
+
+def chain_schema() -> str:
+    """Fingerprint of the live chain shape: the dataclass fields of the
+    result and warm-start bookkeeping the spool pickles.  Computed from
+    the RUNNING code, so a refactor that adds/renames a field refuses old
+    spools without anyone remembering to bump a constant."""
+    from ..solver.types import SimNode, SolveResult
+    from ..solver.warmstart import _Meta
+
+    names = "|".join(
+        ",".join(sorted(cls.__dataclass_fields__))
+        for cls in (SolveResult, _Meta, SimNode)
+        if hasattr(cls, "__dataclass_fields__"))
+    return hashlib.sha256(names.encode()).hexdigest()[:16]
+
+
+def spool_path(dir_path: str) -> str:
+    return os.path.join(dir_path, SPOOL_NAME)
+
+
+def pack_entry(entry: dict) -> bytes:
+    """One session entry -> its own pickle blob.  Entries are pickled
+    INDIVIDUALLY so the table can serialize them without any scheduler
+    lock: a chain that mutates under the pickler corrupts (or tears)
+    only its own blob, which the caller detects via the epoch/in_step
+    re-check and discards — the spool never carries a torn chain."""
+    return pickle.dumps(entry, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def unpack_entry(blob: bytes) -> dict:
+    return pickle.loads(blob)
+
+
+def pack(entries: list, catalog_epoch: int = 0) -> bytes:
+    """Serialize per-entry blobs (from :func:`pack_entry`) into one
+    framed, checksummed spool blob."""
+    payload = pickle.dumps(
+        {"schema": chain_schema(), "catalog_epoch": int(catalog_epoch),
+         "entries": entries},
+        protocol=pickle.HIGHEST_PROTOCOL)
+    buf = io.BytesIO()
+    buf.write(MAGIC)
+    buf.write(_HEADER.pack(SNAPSHOT_VERSION, len(payload)))
+    buf.write(hashlib.sha256(payload).digest())
+    buf.write(payload)
+    return buf.getvalue()
+
+
+def unpack(blob: bytes,
+           expected_catalog_epoch: Optional[int] = None) -> Tuple[list, int]:
+    """Validate + deserialize a spool blob -> (entries, catalog_epoch).
+
+    Raises :class:`SnapshotRefused` with the counted reason on every
+    adversarial shape: wrong magic / failed checksum / undecodable
+    (``corrupt``), short payload (``truncated``), format-version or
+    chain-schema drift (``version``), stale catalog (``catalog_epoch``).
+    """
+    head_len = len(MAGIC) + _HEADER.size + 32
+    if len(blob) < head_len:
+        raise SnapshotRefused("truncated",
+                              f"{len(blob)}B < {head_len}B header")
+    if blob[:len(MAGIC)] != MAGIC:
+        raise SnapshotRefused("corrupt", "bad magic")
+    version, length = _HEADER.unpack_from(blob, len(MAGIC))
+    if version != SNAPSHOT_VERSION:
+        raise SnapshotRefused(
+            "version", f"format v{version}, want v{SNAPSHOT_VERSION}")
+    digest = blob[len(MAGIC) + _HEADER.size:head_len]
+    payload = blob[head_len:]
+    if len(payload) < length:
+        raise SnapshotRefused(
+            "truncated", f"payload {len(payload)}B < declared {length}B")
+    payload = payload[:length]
+    if hashlib.sha256(payload).digest() != digest:
+        raise SnapshotRefused("corrupt", "payload checksum mismatch")
+    try:
+        doc = pickle.loads(payload)
+    # ktlint: allow[KT005] any undecodable payload is the same outcome: a
+    # refused snapshot, counted 'corrupt', cold start
+    except Exception as err:  # noqa: BLE001
+        raise SnapshotRefused("corrupt", f"unpickle failed: {err}") from err
+    if not isinstance(doc, dict) or "entries" not in doc:
+        raise SnapshotRefused("corrupt", "payload is not a snapshot doc")
+    if doc.get("schema") != chain_schema():
+        raise SnapshotRefused(
+            "version", "chain schema drift (warm-start bookkeeping shape "
+            "changed since this spool was written)")
+    epoch = int(doc.get("catalog_epoch", 0))
+    if (expected_catalog_epoch is not None
+            and epoch != int(expected_catalog_epoch)):
+        raise SnapshotRefused(
+            "catalog_epoch",
+            f"spool catalog epoch {epoch} != configured "
+            f"{expected_catalog_epoch}")
+    return list(doc["entries"]), epoch
+
+
+def write_atomic(dir_path: str, blob: bytes) -> str:
+    """write-temp + fsync + rename: the spool is either the complete new
+    snapshot or the complete previous one — never a torn file.  The temp
+    lives in the SAME directory so the rename is atomic on one mount,
+    and carries a per-writer suffix so a background periodic write and a
+    shutdown write can never interleave inside one temp file."""
+    import threading
+
+    os.makedirs(dir_path, exist_ok=True)
+    final = spool_path(dir_path)
+    tmp = f"{final}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "wb") as fh:
+        fh.write(blob)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, final)
+    return final
+
+
+def read(dir_path: str) -> Optional[bytes]:
+    """The spool's bytes, or None when no snapshot exists (plain cold
+    start, counted 'missing')."""
+    try:
+        with open(spool_path(dir_path), "rb") as fh:
+            return fh.read()
+    except FileNotFoundError:
+        return None

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults as faults_mod
 from ..models import labels as L
 from ..models.tensorize import NO_SELECTOR, SolveTensors
 from ..obs.trace import NULL_TRACE
@@ -1134,6 +1135,10 @@ class TpuSolver:
         # injectable clock for the warm-failure backoff (tests advance a
         # FakeClock past WARM_FAILURE_BACKOFF instead of sleeping it out)
         self._clock = clock or Clock()
+        # fault-injection plane (docs/RESILIENCE.md): null + falsy unless
+        # KT_FAULTS configures a chaos schedule — the dispatch/fence choke
+        # points below guard with one truthiness check
+        self._faults = faults_mod.plane()
         self._lock = threading.Lock()
         self._ready: set = set()                     # guarded-by: _lock
         self._compiling: set = set()                 # guarded-by: _lock
@@ -1756,7 +1761,13 @@ class TpuSolver:
                 st, existing_nodes, max_nodes, track_assignments, mesh, full_nr,
             )
         with trace.span("device_execute", full_nr=full_nr):
+            if self._faults:
+                self._faults.fire("dispatch")     # dispatch_exc raises here
             carry, ys = run(init)
+            if self._faults:
+                effect = self._faults.fire("fence")  # device_hang raises
+                if effect is not None and effect.kind == "slow_fence":
+                    self._faults.sleep(effect)
             np.asarray(carry[7])  # D2H fence; see timing note below
         compile_ms = (time.perf_counter() - t0) * 1000.0
         solve_ms = compile_ms
@@ -1826,6 +1837,8 @@ class TpuSolver:
                 st, existing_nodes, max_nodes, track_assignments, mesh,
                 full_nr=False,
             )
+            if self._faults:
+                self._faults.fire("dispatch")  # dispatch_exc raises here
             carry, ys = run(init)  # async: enqueued, not fenced
         return PendingTpuSolve(
             solver=self, st=st, existing_nodes=existing_nodes, NE=NE,
@@ -2278,6 +2291,10 @@ class PendingTpuSolve:
             return self._out
         s = self.solver
         with self.trace.span("device_fence"):
+            if s._faults:
+                effect = s._faults.fire("fence")  # device_hang raises here
+                if effect is not None and effect.kind == "slow_fence":
+                    s._faults.sleep(effect)
             np.asarray(self.carry[7])  # the one-RTT D2H fence
         elapsed_ms = (time.perf_counter() - self.t0) * 1000.0
         s._mark_ready(_dims_key(self.full_dims if self.full_nr

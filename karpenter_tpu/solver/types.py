@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import dataclasses
-import itertools
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -12,7 +12,31 @@ from ..models.instancetype import InstanceType
 from ..models.pod import PodSpec, Taint
 from ..models.resources import ResourceList, add, fits, subtract
 
-_node_counter = itertools.count()
+_node_lock = threading.Lock()
+_node_next = 0
+
+
+def _next_node_idx() -> int:
+    """The process-global auto-name index, lock-atomic: naming and
+    :func:`advance_node_counter` must not race — a thread minting an
+    index below a just-raised floor would hand out a colliding name."""
+    global _node_next
+    with _node_lock:
+        idx = _node_next
+        _node_next += 1
+        return idx
+
+
+def advance_node_counter(floor: int) -> None:
+    """Ensure future auto-named SimNodes get indices STRICTLY ABOVE
+    ``floor``.  Session restore (service/delta.py) needs this: a restarted
+    process's counter starts back at 0, and a fresh proposal named
+    ``node-5`` colliding with a restored chain's ``node-5`` would silently
+    cross-wire assignments — the exact diverged-chain class the snapshot
+    envelope exists to prevent."""
+    global _node_next
+    with _node_lock:
+        _node_next = max(_node_next, floor + 1)
 
 
 @dataclass
@@ -40,7 +64,7 @@ class SimNode:
 
     def __post_init__(self) -> None:
         if not self.name:
-            self.name = f"node-{next(_node_counter)}"
+            self.name = f"node-{_next_node_idx()}"
 
     def used(self) -> ResourceList:
         out: ResourceList = {L.RESOURCE_PODS: float(len(self.pods))}

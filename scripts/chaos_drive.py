@@ -1,0 +1,457 @@
+#!/usr/bin/env python
+"""Seeded chaos harness — composed fault schedules over real gRPC, judged
+against a fault-free oracle chain (ISSUE 12; docs/RESILIENCE.md).
+
+Two drivers, both importable by tests (tests/test_faults.py runs a
+tier-1-sized schedule) and runnable standalone (``make chaos``):
+
+``run_chaos`` — the composed-schedule run: one CHAOS server constructed
+under a KT_FAULTS schedule (8 fault kinds on one seed: transport
+UNAVAILABLE + reset, mid-step and mid-commit exceptions, injected step
+latency, a session-table wipe, a TTL clock jump, spool corruption and
+truncation) and one ORACLE server with the null plane, both behind real
+gRPC on unix sockets.  A seeded churn chain drives the chaos session; the
+driver mirrors every perturbation onto the oracle session with the SAME
+recovery structure (a chaos re-establish is mirrored as an oracle
+re-establish of the identical pod list, so both chains see identical
+request sequences and the deterministic solver must answer identically).
+After every recovered step the global invariants hold:
+
+1. **No silent divergence** — the chaos client's merged view is
+   byte-identical to the chaos server's live chain entry.
+2. **Oracle parity** — the chaos view equals the fault-free oracle view
+   as a node partition (per-node offering + pod set; node NAMES come from
+   a process-global counter and can never match across servers).
+3. **Typed errors only** — everything raised through the facade is
+   SolveShedError / SolveDeadlineError / SolveRetriesExhausted /
+   SolveStepFailed.
+4. **Bounded recovery** — full re-establishes <= faults injected + 1
+   (the +1 is the initial establishment): one fault costs AT MOST one
+   full solve, never a retry storm.
+
+``run_restart`` — the kill-and-restart scenario: a solver sidecar
+SUBPROCESS serving a churn chain is SIGTERM'd mid-chain and relaunched on
+the same unix socket.  With KT_SESSION_DIR the replacement restores the
+session spool and every client's next delta is served WARM (zero
+re-establishing full solves); without it, exactly N clients pay exactly
+one re-establish each.  ``bench.py measure_restart_recovery`` gates this
+(restore p50 bounded, the zero / exactly-N re-solve counts).
+
+Usage::
+
+    python scripts/chaos_drive.py                      # composed schedule
+    python scripts/chaos_drive.py --steps 120 --pods 5000 --seed 7
+    python scripts/chaos_drive.py --restart            # kill + restart
+    python scripts/chaos_drive.py --restart --no-snapshot
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+TYPED_ERRORS_DOC = ("SolveShedError", "SolveDeadlineError",
+                    "SolveRetriesExhausted", "SolveStepFailed")
+
+
+def make_pods(n, tag):
+    """Unconstrained steady-state churn pods (the bench's warm-start
+    shape: 6 deployment families, no topology)."""
+    from karpenter_tpu.models.pod import PodSpec
+
+    out = []
+    for i in range(n):
+        g = i % 6
+        out.append(PodSpec(
+            name=f"{tag}-{i}", labels={"app": f"ws{g}"},
+            requests={"cpu": 0.25 * (1 + g % 3),
+                      "memory": (0.5 + g % 4) * 2**30},
+            owner_key=f"ws{g}",
+        ))
+    return out
+
+
+def canonical(res):
+    """Server-independent view of a solution: the node partition (offering
+    + sorted pod names per node) + the infeasible set.  Node NAMES come
+    from a process-global counter, so cross-server comparison must be
+    name-blind."""
+    return (
+        sorted((n.instance_type, n.zone, n.capacity_type,
+                tuple(sorted(p.name for p in n.pods)))
+               for n in res.nodes),
+        dict(res.infeasible),
+    )
+
+
+def default_schedule(seed: int, steps: int) -> str:
+    """8 fault kinds composed on ONE seeded schedule, spread over the
+    chain so recoveries interleave (occurrence numbers are per-site:
+    transport counts client RPC attempts, session_table counts table
+    get/put, delta_step counts applied steps, snapshot_write counts spool
+    writes)."""
+    mid = max(6, steps // 2)
+    late = max(10, (3 * steps) // 4)
+    return (
+        f"seed={seed};"
+        # ride-through: one injected UNAVAILABLE, retried transparently
+        f"rpc_unavailable@transport:at=4;"
+        # exhaustion: two consecutive attempts fail -> typed give-up
+        f"rpc_reset@transport:at=9;rpc_unavailable@transport:at=10;"
+        # mid-step + half-mutated commit exceptions -> eviction + typed
+        f"dispatch_exc@delta_step:at=6;"
+        f"dispatch_exc@delta_commit:at={mid};"
+        # injected latency while in_step=True
+        f"slow_step@delta_step:at=3:value=0.02;"
+        # the table adversaries: wipe + TTL clock jump
+        f"session_wipe@session_table:at={mid + 2};"
+        f"clock_jump@session_table:at={late}:value=100000;"
+        # the spool adversaries (detected at the next restore)
+        f"snapshot_corrupt@snapshot_write:at=1;"
+        f"snapshot_truncate@snapshot_write:at=3:value=0.4"
+    )
+
+
+def _serve_pair(tmp, pods_n, schedule, session_dir=None, snapshot_s=None):
+    """(oracle, chaos) in-process servers on unix sockets.  Construction
+    ORDER is the env dance: the oracle stack is built with KT_FAULTS
+    unset (null plane), then the chaos stack under the schedule."""
+    from karpenter_tpu.metrics import Registry
+    from karpenter_tpu.service.server import SolverService, make_server
+    from karpenter_tpu.solver.scheduler import BatchScheduler
+
+    def build(sock):
+        reg = Registry()
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        service = SolverService(sched, registry=reg)
+        # construct the pipeline EAGERLY: components capture their fault
+        # plane (and session spool) from env at construction, and the
+        # service builds pipelines lazily on first RPC — by which time
+        # this harness has restored the environment
+        service._pipeline_for(sched)
+        srv, _ = make_server(service, host=sock)
+        return reg, service, srv
+
+    assert not os.environ.get("KT_FAULTS"), \
+        "run the harness from a KT_FAULTS-clean environment"
+    o_sock = f"unix:{tmp}/oracle.sock"
+    c_sock = f"unix:{tmp}/chaos.sock"
+    oracle = build(o_sock)
+    saved = {}
+    try:
+        saved["KT_FAULTS"] = os.environ.pop("KT_FAULTS", None)
+        os.environ["KT_FAULTS"] = schedule
+        if session_dir is not None:
+            saved["KT_SESSION_DIR"] = os.environ.pop("KT_SESSION_DIR", None)
+            os.environ["KT_SESSION_DIR"] = session_dir
+        if snapshot_s is not None:
+            saved["KT_SESSION_SNAPSHOT_S"] = os.environ.pop(
+                "KT_SESSION_SNAPSHOT_S", None)
+            os.environ["KT_SESSION_SNAPSHOT_S"] = str(snapshot_s)
+        chaos = build(c_sock)
+    finally:
+        for key, old in saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+    return (oracle, o_sock), (chaos, c_sock)
+
+
+def run_chaos(seed=42, steps=60, pods_n=1500, churn=6, schedule=None,
+              verbose=True):
+    """The composed-schedule chaos run.  Returns the scoreboard dict;
+    raises AssertionError the moment an invariant breaks."""
+    from karpenter_tpu.admission import SolveDeadlineError, SolveShedError
+    from karpenter_tpu.metrics import FAULTS_INJECTED, registry as global_reg
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.service.client import (
+        DeltaSession, SolveRetriesExhausted, SolveStepFailed, SolverClient,
+    )
+
+    schedule = schedule or default_schedule(seed, steps)
+    catalog = generate_catalog(full=False)
+    provs = [Provisioner(name="default").with_defaults()]
+    tmp = tempfile.mkdtemp(prefix="kt-chaos-")
+    spool = os.path.join(tmp, "spool")
+    (oracle, o_sock), (chaos, c_sock) = _serve_pair(
+        tmp, pods_n, schedule, session_dir=spool, snapshot_s=0.0001)
+    (o_reg, o_service, o_srv) = oracle
+    (c_reg, c_service, c_srv) = chaos
+    typed = {k: 0 for k in TYPED_ERRORS_DOC}
+
+    def injected_total():
+        # server-side sites count into the chaos server's registry;
+        # client-side (transport) into the process default — sum both,
+        # as a delta against the harness's start
+        return (sum(c_reg.counter(FAULTS_INJECTED).values.values())
+                + sum(global_reg.counter(FAULTS_INJECTED).values.values()))
+
+    injected_base = injected_total()
+    try:
+        # chaos client: ride-through retry with a fast test backoff; it is
+        # built AFTER the env dance above restored KT_FAULTS="" — the
+        # TRANSPORT faults come from the schedule captured by... no: the
+        # client plane must see the schedule, so set it for this ctor
+        os.environ["KT_FAULTS"] = schedule
+        try:
+            c_client = SolverClient(c_sock, timeout=120.0, retries=1,
+                                    backoff_s=0.01)
+        finally:
+            os.environ.pop("KT_FAULTS", None)
+        sess = DeltaSession(c_sock, timeout=120.0, client=c_client)
+        o_sess = DeltaSession(o_sock, timeout=120.0)
+        pods = make_pods(pods_n, "cw")
+        sess.solve(list(pods), provs, catalog)
+        o_sess.solve(list(pods), provs, catalog)
+        rng = random.Random(seed)
+        live = [p.name for p in pods]
+        cum_add, cum_rm = [], []
+        last_resends = sess.full_resends
+        checked = 0
+        for k in range(steps):
+            rm = rng.sample(live, churn)
+            rms = set(rm)
+            live = [n for n in live if n not in rms]
+            add = make_pods(churn, f"cw{k}")
+            live += [p.name for p in add]
+            try:
+                cur = sess.solve_delta(added=add, removed=rm)
+            except (SolveShedError, SolveDeadlineError,
+                    SolveRetriesExhausted, SolveStepFailed) as err:
+                typed[type(err).__name__] += 1
+                cum_add += add
+                cum_rm += rm
+                continue
+            # ktlint-free zone (scripts): any OTHER exception is an
+            # invariant breach and propagates — errors must be typed
+            if sess.full_resends > last_resends:
+                # the chaos call re-established internally (eviction,
+                # wipe, clock jump, mid-step failure on a prior call):
+                # mirror the SAME full solve onto the oracle — identical
+                # pod list, identical order
+                o_sess.solve(list(sess._pods.values()), provs, catalog)
+                last_resends = sess.full_resends
+            else:
+                o_sess.solve_delta(added=cum_add + add, removed=cum_rm + rm)
+            cum_add, cum_rm = [], []
+            # invariant 1: client view == server chain, byte-identical
+            pipe = list(c_service._pipelines.values())[0]
+            with pipe._delta_tab._lock:   # direct peek: get() would
+                entry = pipe._delta_tab._sessions.get(sess.session_id)
+            if entry is not None:         # advance the fault schedule
+                assert entry.prev.assignments == cur.assignments, \
+                    f"step {k}: client assignments diverged from chain"
+                assert entry.prev.infeasible == cur.infeasible, \
+                    f"step {k}: client infeasible diverged from chain"
+                assert ({n.name: sorted(p.name for p in n.pods)
+                         for n in entry.prev.nodes}
+                        == {n.name: sorted(p.name for p in n.pods)
+                            for n in cur.nodes}), \
+                    f"step {k}: client node map diverged from chain"
+            # invariant 2: fault-free oracle parity (name-blind partition)
+            assert canonical(cur) == canonical(o_sess.result()), \
+                f"step {k}: chaos view diverged from the fault-free oracle"
+            checked += 1
+        injected = injected_total() - injected_base
+        # invariant 4: bounded recovery — one fault costs at most one
+        # full re-establishing solve
+        assert sess.full_resends - 1 <= injected, (
+            f"{sess.full_resends - 1} re-establishes for {injected} "
+            "injected faults — recovery is not bounded")
+        board = {
+            "seed": seed, "steps": steps, "pods": pods_n,
+            "parity_checked_steps": checked,
+            "typed_errors": typed,
+            "full_resends": sess.full_resends,
+            "delta_rpcs": sess.delta_rpcs,
+            "faults_injected": int(injected),
+            "injected_by_rule": {
+                f"{dict(lk).get('kind')}@{dict(lk).get('site')}": v
+                for reg in (c_reg, global_reg)
+                for lk, v in reg.counter(FAULTS_INJECTED).values.items()
+                if v},
+        }
+        if verbose:
+            print("chaos run clean:")
+            for key, val in board.items():
+                print(f"  {key}: {val}")
+        return board
+    finally:
+        o_srv.stop(grace=None)
+        c_srv.stop(grace=None)
+        o_service.close()
+        c_service.close()
+
+
+# ---- kill-and-restart scenario (subprocess server) ----------------------
+
+_SERVE_ARGS = ["-m", "karpenter_tpu.service.server", "--backend", "oracle"]
+
+
+def _spawn_server(sock, session_dir, snapshot_s="2"):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("KT_FAULTS", None)
+    if session_dir:
+        env["KT_SESSION_DIR"] = session_dir
+        env["KT_SESSION_SNAPSHOT_S"] = snapshot_s
+    else:
+        env.pop("KT_SESSION_DIR", None)
+    proc = subprocess.Popen(
+        [sys.executable, *_SERVE_ARGS, "--host", sock],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    return proc
+
+
+def _wait_ready(sock, timeout=60.0):
+    from karpenter_tpu.service.client import SolverClient
+
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        client = SolverClient(sock, timeout=5.0, retries=0)
+        try:
+            if client.health(timeout=2.0).ok:
+                client.close()
+                return
+        except Exception as err:  # noqa: BLE001 — startup polling
+            last = err
+            client.reset()
+            time.sleep(0.25)
+        finally:
+            client.close()
+    raise RuntimeError(f"server on {sock} never became healthy: {last}")
+
+
+def run_restart(pods_n=4000, clients=4, pre_steps=4, post_steps=4, churn=6,
+                seed=11, snapshot=True, verbose=True, strict=True):
+    """SIGTERM a serving subprocess mid-chain, relaunch it on the same
+    socket, continue every client's chain.  Returns the scoreboard:
+    ``extra_resends`` is 0 with a snapshot (every session restored warm)
+    and exactly ``clients`` without one."""
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.service.client import DeltaSession, SolverClient
+
+    catalog = generate_catalog(full=False)
+    provs = [Provisioner(name="default").with_defaults()]
+    tmp = tempfile.mkdtemp(prefix="kt-restart-")
+    sock = f"unix:{tmp}/solver.sock"
+    spool = os.path.join(tmp, "spool") if snapshot else ""
+    proc = _spawn_server(sock, spool)
+    sessions, rngs, lives = [], [], []
+    try:
+        _wait_ready(sock)
+        per = pods_n // clients
+        for c in range(clients):
+            client = SolverClient(sock, timeout=120.0, retries=2,
+                                  backoff_s=0.3)
+            s = DeltaSession(sock, timeout=120.0, client=client)
+            pods = make_pods(per, f"rc{c}")
+            s.solve(list(pods), provs, catalog)
+            sessions.append(s)
+            rngs.append(random.Random(seed + c))
+            lives.append([p.name for p in pods])
+
+        def step(c, tag):
+            rm = rngs[c].sample(lives[c], churn)
+            rms = set(rm)
+            lives[c] = [n for n in lives[c] if n not in rms]
+            add = make_pods(churn, f"rc{c}{tag}")
+            lives[c] += [p.name for p in add]
+            return sessions[c].solve_delta(added=add, removed=rm)
+
+        for k in range(pre_steps):
+            for c in range(clients):
+                step(c, f"a{k}")
+        resends_before = [s.full_resends for s in sessions]
+        # SIGTERM: graceful — the serve handler drains + snapshots
+        t_kill = time.perf_counter()
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+        proc2 = _spawn_server(sock, spool)
+        _wait_ready(sock)
+        restart_wall_s = time.perf_counter() - t_kill
+        # continue every chain through the restarted replica: the retry
+        # budget rides through any residual connection raciness
+        t0 = time.perf_counter()
+        first_delta_ms = []
+        for c in range(clients):
+            t1 = time.perf_counter()
+            step(c, "post0")
+            first_delta_ms.append((time.perf_counter() - t1) * 1000.0)
+        for k in range(1, post_steps):
+            for c in range(clients):
+                step(c, f"b{k}")
+        post_wall_s = time.perf_counter() - t0
+        extra = sum(s.full_resends for s in sessions) - sum(resends_before)
+        board = {
+            "snapshot": snapshot,
+            "clients": clients,
+            "pods": pods_n,
+            "extra_resends": extra,
+            "restart_wall_s": round(restart_wall_s, 2),
+            "first_post_delta_ms": [round(v, 2) for v in first_delta_ms],
+            "post_chain_wall_s": round(post_wall_s, 2),
+        }
+        if verbose:
+            print(f"restart run ({'with' if snapshot else 'WITHOUT'} "
+                  "snapshot):")
+            for key, val in board.items():
+                print(f"  {key}: {val}")
+        expect = 0 if snapshot else clients
+        if strict:  # bench (strict=False) reports; check_budgets gates
+            assert extra == expect, (
+                f"expected {expect} post-restart re-establishes, saw "
+                f"{extra}")
+        return board
+    finally:
+        for s in sessions:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+        for p in (proc, locals().get("proc2")):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--pods", type=int, default=1500)
+    ap.add_argument("--churn", type=int, default=6)
+    ap.add_argument("--schedule", default=None,
+                    help="override the composed KT_FAULTS schedule")
+    ap.add_argument("--restart", action="store_true",
+                    help="run the kill-and-restart scenario instead")
+    ap.add_argument("--no-snapshot", action="store_true",
+                    help="(--restart) run WITHOUT KT_SESSION_DIR: every "
+                         "client pays one re-establish")
+    args = ap.parse_args(argv)
+    if args.restart:
+        run_restart(snapshot=not args.no_snapshot)
+    else:
+        run_chaos(seed=args.seed, steps=args.steps, pods_n=args.pods,
+                  churn=args.churn, schedule=args.schedule)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -391,6 +391,43 @@ class TestDeltaServingGates:
         assert benchmod.check_budgets({"value": 100.0}) == {}
 
 
+class TestRestartRecoveryGates:
+    """ISSUE 12 budget gates (measure_restart_recovery): a snapshot
+    restart costs ZERO per-client full re-solves, a snapshot-less restart
+    costs exactly N, and the restored first delta p50 stays bounded."""
+
+    GOOD = {"restart_recovery_clients": 4,
+            "restart_recovery_resends_with_snapshot": 0,
+            "restart_recovery_resends_without": 4,
+            "restart_first_delta_p50_ms": 2.8}
+
+    def test_within_budgets_clean(self):
+        assert benchmod.check_budgets(dict(self.GOOD)) == {}
+
+    def test_resends_after_snapshot_restart_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, restart_recovery_resends_with_snapshot=2))
+        assert any("WITH a session snapshot" in f
+                   for f in out["budget_flags"])
+
+    def test_wrong_no_spool_baseline_flagged(self):
+        # fewer than N means the scenario never exercised the restart;
+        # more than N means a retry storm — both must flag
+        for wrong in (2, 7):
+            out = benchmod.check_budgets(
+                dict(self.GOOD, restart_recovery_resends_without=wrong))
+            assert any("exactly one full solve per client" in f
+                       for f in out["budget_flags"])
+
+    def test_slow_restore_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, restart_first_delta_p50_ms=900.0))
+        assert any("restore budget" in f for f in out["budget_flags"])
+
+    def test_missing_restart_fields_not_flagged(self):
+        assert benchmod.check_budgets({"value": 100.0}) == {}
+
+
 @pytest.mark.slow
 def test_500k_pod_solve_stretch():
     """ISSUE 6 stretch rung: the solve bench ceiling lifted from 50k

@@ -152,7 +152,17 @@ def test_inventory_metrics_are_emitted(small_catalog):
     delta_family = {m for m in INVENTORY
                     if m.startswith("karpenter_solver_delta_")}
 
+    # session durability + fault plane (ISSUE 12): service-side like the
+    # two families above — the snapshot spool rides the SolvePipeline
+    # (KT_SESSION_DIR) and the injection plane only exists under KT_FAULTS;
+    # full-population zero-init is asserted by tests/test_metrics_init.py::
+    # TestResilienceSeries and exercised end to end by tests/test_faults.py
+    resilience_family = {m for m in INVENTORY
+                         if m.startswith("karpenter_solver_session_snapshot_")
+                         or m.startswith("karpenter_faults_")}
+
     missing = (set(INVENTORY) - emitted - admission_family - delta_family
+               - resilience_family
                - {REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES})
     assert not missing, (
         f"documented metrics never emitted: {sorted(missing)} "

@@ -183,7 +183,11 @@ class TestEpochAndSessionLoss:
         assert sess.delta_rpcs == dr + 1        # the probe that found out
         assert reg.counter(DELTA_RPC).get(
             {"outcome": "session_unknown"}) == 1
-        assert sess.established and sess.epoch == 1
+        # establishment epochs ride the table's monotone floor (ISSUE 12:
+        # a re-establish can never revisit an old incarnation's epoch),
+        # so assert the ack matches the live chain, not a literal 1
+        assert sess.established
+        assert sess.epoch == _entry(service, sess.session_id).epoch
         assert all(f"x-{i}" in res.assignments for i in range(3))
         assert "p-0" not in res.assignments
         entry = _entry(service, sess.session_id)
@@ -202,11 +206,12 @@ class TestEpochAndSessionLoss:
         sess._epoch = 99  # simulate a lost ack
         res = sess.solve_delta(added=_pods("x", 1))
         # recovered via full re-establish; the server never applied onto
-        # the stale chain
-        assert sess.established and sess.epoch == 1
+        # the stale chain (the new establishment epoch rides the monotone
+        # floor, strictly above the old incarnation's)
         entry2 = _entry(service, sess.session_id)
+        assert sess.established and sess.epoch == entry2.epoch
         assert entry2.prev.assignments == res.assignments
-        assert entry2 is not entry or entry2.epoch == 1
+        assert entry2 is not entry and entry2.epoch > entry.epoch
         sess.close()
 
 

@@ -1,0 +1,758 @@
+"""ISSUE 12 — crash-safe delta serving + the seeded fault-injection plane.
+
+Four layers, cheapest first:
+
+- ``TestFaultPlane`` — the KT_FAULTS grammar and determinism contract.
+- ``TestSnapshotSpool`` / ``TestSnapshotAdversaries`` — the versioned,
+  checksummed session spool: round trip, every refusal shape loading as
+  "cold start + counted reason", the node-counter collision guard.
+- ``TestMidStepAtomicity`` / ``TestClientRideThrough`` — epoch-atomic
+  snapshots under an in-flight step, and the client's bounded
+  jittered-backoff restart ride-through (typed give-up, no retry on
+  sheds).
+- ``TestChaosSmoke`` / ``TestRestoreParity`` — a tier-1-sized seeded
+  composed-fault schedule through real gRPC (scripts/chaos_drive.py), and
+  the restart-parity proof: a killed-and-restarted server continues a
+  churn chain byte-identically to the unkilled oracle.
+"""
+
+import importlib.util
+import os
+import threading
+import time
+
+import grpc
+import pytest
+
+from karpenter_tpu import faults
+from karpenter_tpu.metrics import (
+    FAULTS_INJECTED,
+    FAULTS_RECOVERED,
+    SNAPSHOT_RESTORE,
+    SNAPSHOT_SKIPPED,
+    SNAPSHOT_WRITES,
+    Registry,
+)
+from karpenter_tpu.models.catalog import generate_catalog
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.service import snapshot as snap
+from karpenter_tpu.service.delta import DeltaSessionTable, SessionEntry
+from karpenter_tpu.solver.types import SimNode, SolveResult
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _chaos_drive():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_drive", os.path.join(REPO, "scripts", "chaos_drive.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------------------
+class TestFaultPlane:
+    def test_null_plane_is_falsy_and_inert(self):
+        assert not faults.NULL_PLANE
+        assert faults.NULL_PLANE.fire("dispatch") is None
+        assert faults.NULL_PLANE.mangle("snapshot_write", b"x") == b"x"
+        assert faults.plane() is faults.NULL_PLANE
+
+    def test_env_plane_construction(self, monkeypatch):
+        monkeypatch.setenv("KT_FAULTS", "dispatch_exc@dispatch:at=1")
+        p = faults.plane(registry=Registry())
+        assert p and isinstance(p, faults.FaultPlane)
+
+    def test_bad_schedule_raises_loud(self):
+        with pytest.raises(ValueError):
+            faults.FaultPlane("typo_kind@dispatch:at=1", registry=Registry())
+        with pytest.raises(ValueError):
+            faults.FaultPlane("dispatch_exc@nowhere:at=1",
+                              registry=Registry())
+
+    def test_unenactable_kind_site_combo_raises_loud(self):
+        # both halves valid in isolation, but the dispatch site discards
+        # latency effects — a rule that can never fire must not construct
+        # (it would report a green chaos run that tested nothing)
+        for combo in ("slow_fence@dispatch", "session_wipe@transport",
+                      "snapshot_corrupt@snapshot_read",
+                      "device_hang@dispatch"):
+            with pytest.raises(ValueError):
+                faults.FaultPlane(f"{combo}:at=1", registry=Registry())
+
+    def test_every_kind_has_an_enacting_site(self):
+        from karpenter_tpu.faults.plane import KIND_SITES
+        from karpenter_tpu.metrics import FAULT_KINDS, FAULT_SITES
+
+        assert set(KIND_SITES) == set(FAULT_KINDS)
+        for kind, sites in KIND_SITES.items():
+            assert sites and set(sites) <= set(FAULT_SITES)
+            for site in sites:
+                faults.FaultPlane(f"{kind}@{site}:at=1",
+                                  registry=Registry())
+
+    def test_at_rule_fires_exactly_once(self):
+        reg = Registry()
+        p = faults.FaultPlane("dispatch_exc@dispatch:at=2", registry=reg)
+        assert p.fire("dispatch") is None
+        with pytest.raises(faults.InjectedFault) as ei:
+            p.fire("dispatch")
+        assert ei.value.kind == "dispatch_exc"
+        assert ei.value.occurrence == 2
+        for _ in range(10):
+            assert p.fire("dispatch") is None
+        assert reg.counter(FAULTS_INJECTED).get(
+            {"kind": "dispatch_exc", "site": "dispatch"}) == 1.0
+
+    def test_every_and_n_compose(self):
+        p = faults.FaultPlane("slow_fence@fence:every=2:n=2:value=0.0",
+                              registry=Registry())
+        hits = [p.fire("fence") is not None for _ in range(8)]
+        assert hits == [False, True, False, True, False, False, False, False]
+
+    def test_p_rule_replays_identically_per_seed(self):
+        def run(seed):
+            p = faults.FaultPlane(
+                f"seed={seed};slow_step@delta_step:p=0.5:value=0.0",
+                registry=Registry())
+            return [p.fire("delta_step") is not None for _ in range(32)]
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)  # astronomically unlikely to tie
+
+    def test_injected_rpc_error_is_a_real_rpc_error(self):
+        p = faults.FaultPlane("rpc_unavailable@transport:at=1",
+                              registry=Registry())
+        with pytest.raises(grpc.RpcError) as ei:
+            p.fire("transport")
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+
+    def test_mangle_truncates_and_corrupts(self):
+        data = bytes(range(256)) * 8
+        p = faults.FaultPlane(
+            "snapshot_truncate@snapshot_write:at=1:value=0.25",
+            registry=Registry())
+        assert len(p.mangle("snapshot_write", data)) == len(data) // 4
+        p2 = faults.FaultPlane("seed=3;snapshot_corrupt@snapshot_write:at=1",
+                               registry=Registry())
+        mangled = p2.mangle("snapshot_write", data)
+        assert len(mangled) == len(data) and mangled != data
+
+    def test_recovery_funnel_counts(self):
+        reg = Registry()
+        faults.zero_init_recovery(reg)
+        faults.count_recovery(reg, "transport", "retried")
+        assert reg.counter(FAULTS_RECOVERED).get(
+            {"site": "transport", "outcome": "retried"}) == 1.0
+
+
+# --------------------------------------------------------------------------
+def _entry(sid="s1", epoch=3, pods=("a",)):
+    node = SimNode(instance_type="t1", provisioner="default", zone="z1",
+                   capacity_type="on-demand", price=1.0,
+                   allocatable={"cpu": 8.0, "memory": 2**34, "pods": 110.0})
+    res = SolveResult(nodes=[node],
+                      assignments={p: node.name for p in pods},
+                      infeasible={})
+    return SessionEntry(session_id=sid, prev=res, epoch=epoch,
+                        catalog_epoch=0, provisioners=(), instance_types=())
+
+
+class TestSnapshotSpool:
+    def test_round_trip_restores_chain_state(self, tmp_path):
+        reg = Registry()
+        tab = DeltaSessionTable(registry=reg, capacity=8)
+        tab.put(_entry("s1", epoch=5, pods=("a", "b")))
+        tab.put(_entry("s2", epoch=2))
+        stats = tab.snapshot(str(tmp_path))
+        assert stats == {"written": 2, "skipped": 0}
+        reg2 = Registry()
+        tab2 = DeltaSessionTable(registry=reg2, capacity=8)
+        assert tab2.restore(str(tmp_path)) == 2
+        e = tab2.get("s1")
+        assert e.epoch == 5
+        assert set(e.prev.assignments) == {"a", "b"}
+        assert reg2.counter(SNAPSHOT_RESTORE).get(
+            {"outcome": "restored"}) == 1.0
+
+    def test_missing_spool_is_counted_cold_start(self, tmp_path):
+        reg = Registry()
+        tab = DeltaSessionTable(registry=reg, capacity=8)
+        assert tab.restore(str(tmp_path / "nowhere")) == 0
+        assert reg.counter(SNAPSHOT_RESTORE).get(
+            {"outcome": "missing"}) == 1.0
+
+    def test_empty_table_writes_nothing(self, tmp_path):
+        reg = Registry()
+        tab = DeltaSessionTable(registry=reg, capacity=8)
+        assert tab.snapshot(str(tmp_path)) == {"written": 0, "skipped": 0}
+        assert reg.counter(SNAPSHOT_WRITES).get({"outcome": "empty"}) == 1.0
+        assert not (tmp_path / snap.SPOOL_NAME).exists()
+
+    def test_atomic_write_replaces_whole_file(self, tmp_path):
+        tab = DeltaSessionTable(registry=Registry(), capacity=8)
+        tab.put(_entry("s1"))
+        tab.snapshot(str(tmp_path))
+        first = (tmp_path / snap.SPOOL_NAME).read_bytes()
+        tab.put(_entry("s2"))
+        tab.snapshot(str(tmp_path))
+        second = (tmp_path / snap.SPOOL_NAME).read_bytes()
+        assert second != first
+        assert not list(tmp_path.glob(snap.SPOOL_NAME + ".tmp*"))
+
+    def test_restore_respects_capacity(self, tmp_path):
+        tab = DeltaSessionTable(registry=Registry(), capacity=8)
+        for i in range(6):
+            tab.put(_entry(f"s{i}"))
+        tab.snapshot(str(tmp_path))
+        small = DeltaSessionTable(registry=Registry(), capacity=2)
+        assert small.restore(str(tmp_path)) == 2
+        assert len(small) == 2
+
+    def test_node_counter_advances_past_restored_names(self, tmp_path):
+        tab = DeltaSessionTable(registry=Registry(), capacity=8)
+        tab.put(_entry("s1"))
+        with tab._lock:
+            restored_names = {n.name
+                              for n in tab._sessions["s1"].prev.nodes}
+        tab.snapshot(str(tmp_path))
+        tab2 = DeltaSessionTable(registry=Registry(), capacity=8)
+        tab2.restore(str(tmp_path))
+        # a fresh auto-named proposal must never collide with (and
+        # silently cross-wire) a restored chain node
+        fresh = SimNode(instance_type="t1", provisioner="d", zone="z",
+                        capacity_type="on-demand", price=1.0,
+                        allocatable={})
+        assert fresh.name not in restored_names
+
+
+class TestSnapshotAdversaries:
+    """Corrupt / truncated / version-skewed / catalog-stale spools each
+    load as 'cold start + counted reason' — never a crash, never a
+    diverged chain."""
+
+    def _spool(self, tmp_path):
+        tab = DeltaSessionTable(registry=Registry(), capacity=8)
+        tab.put(_entry("s1", epoch=4))
+        tab.snapshot(str(tmp_path))
+        return str(tmp_path), (tmp_path / snap.SPOOL_NAME)
+
+    def _restore(self, dir_path, expected=None):
+        reg = Registry()
+        tab = DeltaSessionTable(registry=reg, capacity=8)
+        n = tab.restore(dir_path, expected_catalog_epoch=expected)
+        return n, reg, tab
+
+    def test_corrupt_payload(self, tmp_path):
+        d, spool = self._spool(tmp_path)
+        blob = bytearray(spool.read_bytes())
+        blob[-10] ^= 0xFF
+        spool.write_bytes(bytes(blob))
+        n, reg, tab = self._restore(d)
+        assert n == 0 and len(tab) == 0
+        assert reg.counter(SNAPSHOT_RESTORE).get(
+            {"outcome": "corrupt"}) == 1.0
+
+    def test_truncated_payload(self, tmp_path):
+        d, spool = self._spool(tmp_path)
+        blob = spool.read_bytes()
+        spool.write_bytes(blob[:len(blob) // 2])
+        n, reg, _ = self._restore(d)
+        assert n == 0
+        assert reg.counter(SNAPSHOT_RESTORE).get(
+            {"outcome": "truncated"}) == 1.0
+
+    def test_truncated_to_under_header(self, tmp_path):
+        d, spool = self._spool(tmp_path)
+        spool.write_bytes(spool.read_bytes()[:10])
+        n, reg, _ = self._restore(d)
+        assert n == 0
+        assert reg.counter(SNAPSHOT_RESTORE).get(
+            {"outcome": "truncated"}) == 1.0
+
+    def test_bad_magic_is_corrupt(self, tmp_path):
+        d, spool = self._spool(tmp_path)
+        blob = bytearray(spool.read_bytes())
+        blob[:4] = b"EVIL"
+        spool.write_bytes(bytes(blob))
+        n, reg, _ = self._restore(d)
+        assert n == 0
+        assert reg.counter(SNAPSHOT_RESTORE).get(
+            {"outcome": "corrupt"}) == 1.0
+
+    def test_version_skew_refused(self, tmp_path, monkeypatch):
+        d, spool = self._spool(tmp_path)
+        monkeypatch.setattr(snap, "SNAPSHOT_VERSION", snap.SNAPSHOT_VERSION + 1)
+        n, reg, _ = self._restore(d)
+        assert n == 0
+        assert reg.counter(SNAPSHOT_RESTORE).get(
+            {"outcome": "version"}) == 1.0
+
+    def test_chain_schema_drift_refused(self, tmp_path, monkeypatch):
+        d, _ = self._spool(tmp_path)
+        monkeypatch.setattr(snap, "chain_schema", lambda: "different")
+        n, reg, _ = self._restore(d)
+        assert n == 0
+        assert reg.counter(SNAPSHOT_RESTORE).get(
+            {"outcome": "version"}) == 1.0
+
+    def test_catalog_epoch_skew_refused(self, tmp_path):
+        d, _ = self._spool(tmp_path)
+        n, reg, _ = self._restore(d, expected=7)
+        assert n == 0
+        assert reg.counter(SNAPSHOT_RESTORE).get(
+            {"outcome": "catalog_epoch"}) == 1.0
+
+    def test_injected_write_corruption_is_caught_at_restore(
+            self, tmp_path, monkeypatch):
+        # end to end through the plane: the spool mangled ON THE WAY TO
+        # DISK (after the checksum) must be refused at the next restore
+        reg = Registry()
+        plane = faults.FaultPlane(
+            "seed=5;snapshot_corrupt@snapshot_write:at=1", registry=reg)
+        tab = DeltaSessionTable(registry=reg, capacity=8, faults=plane)
+        tab.put(_entry("s1"))
+        assert tab.snapshot(str(tmp_path))["written"] == 1
+        n, reg2, _ = self._restore(str(tmp_path))
+        assert n == 0
+        assert reg2.counter(SNAPSHOT_RESTORE).get(
+            {"outcome": "corrupt"}) == 1.0
+
+
+# --------------------------------------------------------------------------
+class TestMidStepAtomicity:
+    """A snapshot racing an in-flight delta step must skip that session
+    (epoch-atomicity): the in_step marker, end to end through a real
+    pipeline with injected step latency."""
+
+    def test_in_step_sessions_are_skipped_and_counted(self, tmp_path):
+        reg = Registry()
+        tab = DeltaSessionTable(registry=reg, capacity=8)
+        e1, e2 = _entry("live"), _entry("midstep")
+        e2.in_step = True
+        tab.put(e1)
+        tab.put(e2)
+        stats = tab.snapshot(str(tmp_path))
+        assert stats == {"written": 1, "skipped": 1}
+        assert reg.counter(SNAPSHOT_SKIPPED).get(
+            {"reason": "in_step"}) == 1.0
+        tab2 = DeltaSessionTable(registry=Registry(), capacity=8)
+        tab2.restore(str(tmp_path))
+        assert tab2.get("live") is not None
+        assert tab2.get("midstep") is None  # re-establishes, never replays
+
+    def test_sigterm_mid_step_snapshot_skips_the_mutating_chain(
+            self, small_catalog, monkeypatch, tmp_path):
+        """Regression for the ISSUE 12 bug-fix satellite: a snapshot that
+        lands while _apply_delta_step is mid-mutation (injected slow_step
+        latency) must not persist the half-mutated chain."""
+        from karpenter_tpu.service.client import DeltaSession
+        from karpenter_tpu.service.server import SolverService, make_server
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        monkeypatch.setenv("KT_SESSION_DIR", str(tmp_path))
+        monkeypatch.setenv("KT_SESSION_SNAPSHOT_S", "0")  # periodic off
+        monkeypatch.setenv("KT_FAULTS",
+                           "slow_step@delta_step:at=2:value=0.6")
+        reg = Registry()
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        service = SolverService(sched, registry=reg)
+        pipe = service._pipeline_for(sched)
+        sock = f"unix:{tmp_path}/mid.sock"
+        srv, _ = make_server(service, host=sock)
+        try:
+            provs = [Provisioner(name="default").with_defaults()]
+            chaos = _chaos_drive()
+            pods = chaos.make_pods(60, "ms")
+            sess = DeltaSession(sock, timeout=60.0)
+            sess.solve(pods, provs, small_catalog)
+            sess.solve_delta(added=chaos.make_pods(2, "ms1"))  # step 1 ok
+            stats = {}
+
+            def snap_mid_step():
+                time.sleep(0.2)  # step 2 is sleeping 0.6s in_step=True
+                # the shutdown path: cannot get the sched lock (the step
+                # holds it), falls back to the in_step skip
+                got = pipe._sched_lock.acquire(timeout=0.05)
+                try:
+                    stats.update(pipe._delta_tab.snapshot(str(tmp_path)))
+                finally:
+                    if got:
+                        pipe._sched_lock.release()
+
+            t = threading.Thread(target=snap_mid_step)
+            t.start()
+            sess.solve_delta(added=chaos.make_pods(2, "ms2"))  # slow step
+            t.join()
+            assert stats == {"written": 0, "skipped": 1}
+            assert reg.counter(SNAPSHOT_SKIPPED).get(
+                {"reason": "in_step"}) == 1.0
+            # after the step commits, the same chain snapshots fine and a
+            # restarted table serves it at the COMMITTED epoch (the
+            # pipeline namespaces its spool per backend)
+            assert pipe.snapshot_sessions()["written"] == 1
+            tab2 = DeltaSessionTable(registry=Registry(), capacity=8)
+            tab2.restore(os.path.join(str(tmp_path), "oracle"))
+            entry = tab2.get(sess.session_id)
+            assert entry is not None and entry.epoch == sess.epoch
+        finally:
+            srv.stop(grace=None)
+            service.close()
+
+    def test_mid_commit_exception_evicts_and_never_snapshots(
+            self, small_catalog, monkeypatch, tmp_path):
+        """The half-mutated adversary: a raise between prev-replacement
+        and the epoch ack evicts the session; the next snapshot holds no
+        trace of it and the client recovers with ONE typed error + ONE
+        re-establish."""
+        from karpenter_tpu.service.client import DeltaSession, SolveStepFailed
+        from karpenter_tpu.service.server import SolverService, make_server
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        monkeypatch.setenv("KT_SESSION_DIR", str(tmp_path))
+        monkeypatch.setenv("KT_SESSION_SNAPSHOT_S", "0")
+        monkeypatch.setenv("KT_FAULTS", "dispatch_exc@delta_commit:at=1")
+        reg = Registry()
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        service = SolverService(sched, registry=reg)
+        pipe = service._pipeline_for(sched)
+        sock = f"unix:{tmp_path}/commit.sock"
+        srv, _ = make_server(service, host=sock)
+        try:
+            provs = [Provisioner(name="default").with_defaults()]
+            chaos = _chaos_drive()
+            sess = DeltaSession(sock, timeout=60.0)
+            sess.solve(chaos.make_pods(60, "mc"), provs, small_catalog)
+            with pytest.raises(SolveStepFailed):
+                sess.solve_delta(added=chaos.make_pods(2, "mc1"))
+            assert pipe.snapshot_sessions() == {"written": 0, "skipped": 0}
+            assert reg.counter(FAULTS_RECOVERED).get(
+                {"site": "delta_step", "outcome": "evicted"}) == 1.0
+            # recovery: the pending perturbation re-applies via exactly
+            # one transparent re-establish, view == server chain
+            before = sess.full_resends
+            cur = sess.solve_delta(added=chaos.make_pods(2, "mc2"))
+            assert sess.full_resends == before + 1
+            with pipe._delta_tab._lock:
+                entry = pipe._delta_tab._sessions.get(sess.session_id)
+            assert entry.prev.assignments == cur.assignments
+            assert {"mc1-0", "mc1-1", "mc2-0", "mc2-1"} <= set(
+                cur.assignments) | set(cur.infeasible)
+        finally:
+            srv.stop(grace=None)
+            service.close()
+
+
+# --------------------------------------------------------------------------
+class TestClientRideThrough:
+    def test_injected_unavailable_rides_through_one_retry(
+            self, monkeypatch, tmp_path, small_catalog):
+        from karpenter_tpu.service.client import RemoteScheduler, SolverClient
+        from karpenter_tpu.service.server import SolverService, make_server
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        reg = Registry()
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        service = SolverService(sched, registry=reg)
+        sock = f"unix:{tmp_path}/ride.sock"
+        srv, _ = make_server(service, host=sock)
+        try:
+            monkeypatch.setenv("KT_FAULTS", "rpc_unavailable@transport:at=1")
+            client = SolverClient(sock, timeout=60.0, retries=1,
+                                  backoff_s=0.01)
+            monkeypatch.delenv("KT_FAULTS")
+            remote = RemoteScheduler(sock, timeout=60.0)
+            remote.client.close()
+            remote.client = client
+            chaos = _chaos_drive()
+            provs = [Provisioner(name="default").with_defaults()]
+            res = remote.solve(chaos.make_pods(20, "rt"), provs,
+                               small_catalog)
+            # the injected UNAVAILABLE was absorbed by the retry: the
+            # solve is served REMOTELY, not by the local fallback
+            assert not remote.degraded()
+            assert len(res.assignments) == 20
+        finally:
+            srv.stop(grace=None)
+            service.close()
+
+    def test_exhausted_budget_raises_typed(self, monkeypatch):
+        from karpenter_tpu.service.client import (
+            SolveRetriesExhausted, SolverClient,
+        )
+        from karpenter_tpu.service import solver_pb2 as pb
+        from karpenter_tpu.utils.clock import FakeClock
+
+        # two consecutive injected UNAVAILABLEs exhaust retries=1
+        monkeypatch.setenv("KT_FAULTS",
+                           "rpc_unavailable@transport:at=1;"
+                           "rpc_reset@transport:at=2")
+        clock = FakeClock()
+        client = SolverClient("unix:/tmp/never-listens.sock", timeout=5.0,
+                              clock=clock, retries=1, backoff_s=10.0)
+        with pytest.raises(SolveRetriesExhausted) as ei:
+            client.solve_raw(pb.SolveRequest())
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert ei.value.attempts == 2
+        # the backoff ran on the INJECTABLE clock, jittered above base
+        assert 10.0 <= clock.now() <= 20.0
+        client.close()
+
+    def test_typed_sheds_are_never_retried(self):
+        from karpenter_tpu.service.client import SolverClient
+        from karpenter_tpu.service import solver_pb2 as pb
+
+        client = SolverClient("unix:/tmp/never-listens.sock", timeout=5.0,
+                              retries=3, backoff_s=0.0)
+        calls = []
+
+        class Shed(grpc.RpcError):
+            def code(self):
+                return grpc.StatusCode.RESOURCE_EXHAUSTED
+
+            def details(self):
+                return "queue full"
+
+        def stub(request, timeout=None):
+            calls.append(1)
+            raise Shed()
+
+        client._solve = stub
+        with pytest.raises(grpc.RpcError) as ei:
+            client.solve_raw(pb.SolveRequest())
+        assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert len(calls) == 1  # overload is not an outage: ONE attempt
+        client.close()
+
+    def test_restart_with_spool_resumes_warm(self, small_catalog,
+                                             monkeypatch, tmp_path):
+        """In-process restart: stop the serving stack (graceful: spools
+        sessions), bring a NEW service up on the same socket + spool, and
+        the same DeltaSession continues its chain WARM — zero
+        re-establishing full solves."""
+        from karpenter_tpu.metrics import DELTA_RPC
+        from karpenter_tpu.service.client import DeltaSession, SolverClient
+        from karpenter_tpu.service.server import SolverService, make_server
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        monkeypatch.setenv("KT_SESSION_DIR", str(tmp_path / "spool"))
+        chaos = _chaos_drive()
+        provs = [Provisioner(name="default").with_defaults()]
+        sock = f"unix:{tmp_path}/warm.sock"
+
+        def serve():
+            reg = Registry()
+            sched = BatchScheduler(backend="oracle", registry=reg)
+            service = SolverService(sched, registry=reg)
+            service._pipeline_for(sched)
+            srv, _ = make_server(service, host=sock)
+            return reg, service, srv
+
+        reg1, service1, srv1 = serve()
+        client = SolverClient(sock, timeout=60.0, retries=2, backoff_s=0.05)
+        sess = DeltaSession(sock, timeout=60.0, client=client)
+        pods = chaos.make_pods(300, "wr")
+        sess.solve(pods, provs, small_catalog)
+        sess.solve_delta(added=chaos.make_pods(3, "wr1"))
+        epoch_before = sess.epoch
+        # graceful shutdown: service.close() -> pipeline.stop() -> spool
+        srv1.stop(grace=None)
+        service1.close()
+        reg2, service2, srv2 = serve()
+        try:
+            cur = sess.solve_delta(added=chaos.make_pods(3, "wr2"))
+            assert sess.full_resends == 1          # ZERO re-establishes
+            assert sess.epoch == epoch_before + 1  # the chain continued
+            # and it was served as an incremental delta, not a full solve
+            assert reg2.counter(DELTA_RPC).get({"outcome": "delta"}) == 1.0
+            assert reg2.counter(SNAPSHOT_RESTORE).get(
+                {"outcome": "restored"}) == 1.0
+            pipe = list(service2._pipelines.values())[0]
+            with pipe._delta_tab._lock:
+                entry = pipe._delta_tab._sessions.get(sess.session_id)
+            assert entry.prev.assignments == cur.assignments
+        finally:
+            srv2.stop(grace=None)
+            service2.close()
+
+    def test_restart_without_spool_costs_one_reestablish(
+            self, small_catalog, monkeypatch, tmp_path):
+        from karpenter_tpu.service.client import DeltaSession, SolverClient
+        from karpenter_tpu.service.server import SolverService, make_server
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        monkeypatch.delenv("KT_SESSION_DIR", raising=False)
+        chaos = _chaos_drive()
+        provs = [Provisioner(name="default").with_defaults()]
+        sock = f"unix:{tmp_path}/cold.sock"
+
+        def serve():
+            reg = Registry()
+            sched = BatchScheduler(backend="oracle", registry=reg)
+            service = SolverService(sched, registry=reg)
+            srv, _ = make_server(service, host=sock)
+            return service, srv
+
+        service1, srv1 = serve()
+        client = SolverClient(sock, timeout=60.0, retries=2, backoff_s=0.05)
+        sess = DeltaSession(sock, timeout=60.0, client=client)
+        sess.solve(chaos.make_pods(300, "cr"), provs, small_catalog)
+        srv1.stop(grace=None)
+        service1.close()
+        service2, srv2 = serve()
+        try:
+            sess.solve_delta(added=chaos.make_pods(3, "cr1"))
+            assert sess.full_resends == 2  # exactly ONE re-establish
+        finally:
+            srv2.stop(grace=None)
+            service2.close()
+
+
+# --------------------------------------------------------------------------
+class TestBreakerTripInjection:
+    def test_consecutive_trips_open_the_breaker(self, small_catalog,
+                                                monkeypatch):
+        """breaker_trip@breaker must actually OPEN the breaker under
+        healthy traffic: the request whose completion carries the
+        injected trip must not also record its organic success (which
+        would reset the closed-state failure count every time)."""
+        from karpenter_tpu.service.server import SolvePipeline
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        monkeypatch.setenv("KT_FAULTS", "breaker_trip@breaker:every=1")
+        reg = Registry()
+        pipe = SolvePipeline(BatchScheduler(backend="oracle", registry=reg),
+                             registry=reg, max_slots=1)
+        try:
+            assert pipe._adm is not None
+            chaos = _chaos_drive()
+            provs = [Provisioner(name="default").with_defaults()]
+            for k in range(4):
+                pipe.solve(dict(pods=chaos.make_pods(5, f"bt{k}"),
+                                provisioners=provs,
+                                instance_types=small_catalog))
+            assert pipe._adm.breaker.state == "open"
+        finally:
+            pipe.stop()
+
+
+class TestChaosSmoke:
+    """Tier-1 rung of `make chaos`: the composed seeded schedule (8 fault
+    kinds) over real gRPC, judged against the fault-free oracle chain."""
+
+    def test_seeded_composed_schedule_recovers_clean(self):
+        chaos = _chaos_drive()
+        board = chaos.run_chaos(seed=12, steps=24, pods_n=400, churn=4,
+                                verbose=False)
+        # the schedule actually fired (composability is the point)
+        assert board["faults_injected"] >= 6
+        assert len(board["injected_by_rule"]) >= 6
+        # typed errors only is asserted inside run_chaos; bounded
+        # recovery + per-step parity too — reaching here means clean
+        assert board["parity_checked_steps"] >= board["steps"] - sum(
+            board["typed_errors"].values())
+
+
+class TestRestoreParity:
+    """The restart-parity satellite: a killed-and-restarted server
+    continues a churn chain BYTE-IDENTICALLY to the unkilled oracle."""
+
+    def _run(self, pods_n, steps, monkeypatch, tmp_path):
+        from karpenter_tpu.service.client import DeltaSession, SolverClient
+        from karpenter_tpu.service.server import SolverService, make_server
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        chaos = _chaos_drive()
+        provs = [Provisioner(name="default").with_defaults()]
+        catalog = generate_catalog(full=False)
+        spool = str(tmp_path / "spool")
+        r_sock = f"unix:{tmp_path}/restart.sock"
+        o_sock = f"unix:{tmp_path}/oracle.sock"
+
+        def serve(sock, with_spool):
+            if with_spool:
+                monkeypatch.setenv("KT_SESSION_DIR", spool)
+            else:
+                monkeypatch.delenv("KT_SESSION_DIR", raising=False)
+            reg = Registry()
+            sched = BatchScheduler(backend="oracle", registry=reg)
+            service = SolverService(sched, registry=reg)
+            service._pipeline_for(sched)
+            srv, _ = make_server(service, host=sock)
+            return service, srv
+
+        o_service, o_srv = serve(o_sock, False)
+        r_service, r_srv = serve(r_sock, True)
+        import random as _random
+
+        rng = _random.Random(5)
+        pods = chaos.make_pods(pods_n, "rp")
+        client = SolverClient(r_sock, timeout=300.0, retries=2,
+                              backoff_s=0.05)
+        sess = DeltaSession(r_sock, timeout=300.0, client=client)
+        o_sess = DeltaSession(o_sock, timeout=300.0)
+        try:
+            sess.solve(list(pods), provs, catalog)
+            o_sess.solve(list(pods), provs, catalog)
+            live = [p.name for p in pods]
+
+            def step(k):
+                rm = rng.sample(live, 6)
+                rms = set(rm)
+                live[:] = [n for n in live if n not in rms]
+                add = chaos.make_pods(6, f"rp{k}")
+                live.extend(p.name for p in add)
+                cur = sess.solve_delta(added=list(add), removed=list(rm))
+                ora = o_sess.solve_delta(added=list(add), removed=list(rm))
+                return cur, ora
+
+            for k in range(steps // 2):
+                cur, ora = step(k)
+            # kill + restart the chain's server mid-chain (graceful)
+            r_srv.stop(grace=None)
+            r_service.close()
+            r_service, r_srv = serve(r_sock, True)
+            for k in range(steps // 2, steps):
+                cur, ora = step(k)
+            assert sess.full_resends == 1  # restored: zero re-establishes
+            # byte-identical continuation: same assignments pod->node
+            # PARTITION as the unkilled oracle, same infeasible set, and
+            # the client view byte-equal to the restarted server's chain
+            assert chaos.canonical(cur) == chaos.canonical(ora)
+            pipe = list(r_service._pipelines.values())[0]
+            with pipe._delta_tab._lock:
+                entry = pipe._delta_tab._sessions.get(sess.session_id)
+            assert entry.prev.assignments == cur.assignments
+            assert entry.prev.infeasible == cur.infeasible
+        finally:
+            for srv, service in ((o_srv, o_service), (r_srv, r_service)):
+                srv.stop(grace=None)
+                service.close()
+
+    def test_restart_continues_chain_byte_identical(self, monkeypatch,
+                                                    tmp_path):
+        self._run(2000, 10, monkeypatch, tmp_path)
+
+    def test_restart_parity_20k_pod_chain(self, monkeypatch, tmp_path):
+        """The satellite-sized proof: 20k-pod churn chain through a
+        kill-and-restart, byte-identical to the unkilled oracle."""
+        self._run(20_000, 12, monkeypatch, tmp_path)
+
+
+# --------------------------------------------------------------------------
+class TestStatuszSurface:
+    def test_faults_and_snapshot_blocks_appear(self, tmp_path):
+        from karpenter_tpu.obs.export import statusz
+
+        reg = Registry()
+        plane = faults.FaultPlane("dispatch_exc@dispatch:at=1",
+                                  registry=reg)
+        with pytest.raises(faults.InjectedFault):
+            plane.fire("dispatch")
+        tab = DeltaSessionTable(registry=reg, capacity=8)
+        tab.put(_entry("s1"))
+        tab.snapshot(str(tmp_path))
+        doc = statusz(reg)
+        assert doc["faults"]["injected"]["dispatch_exc@dispatch"] == 1.0
+        assert doc["session_snapshot"]["writes"]["written"] == 1.0
+        assert doc["session_snapshot"]["last_sessions"] == 1.0

@@ -791,6 +791,162 @@ class TestKT015DeltaSessionDiscipline:
         assert lint(src, self.SVC) == []
 
 
+class TestKT016FaultPlaneDiscipline:
+    """ISSUE 12: serving-path code consults faults only via the FaultPlane
+    facade (no raw random / KT_FAULT env probes in solver//service/), and
+    every except that recovers from a faultable operation lands a recovery
+    outcome in karpenter_faults_recovered_total in the same function."""
+
+    SVC = "karpenter_tpu/service/server.py"
+    SOLVER = "karpenter_tpu/solver/tpu.py"
+
+    def test_fires_on_random_import_in_serving_code(self):
+        src = """
+        import random
+
+        def backoff():
+            return random.random()
+        """
+        findings = lint(src, self.SVC)
+        assert "KT016" in rules_of(findings)
+
+    def test_fires_on_from_random_import(self):
+        src = """
+        from random import uniform
+
+        def backoff():
+            return uniform(0, 1)
+        """
+        assert "KT016" in rules_of(lint(src, self.SOLVER))
+
+    def test_fires_on_raw_fault_env_probe(self):
+        src = """
+        import os
+
+        def chaotic():
+            return os.environ.get("KT_FAULTS", "")
+        """
+        findings = lint(src, self.SVC)
+        assert "KT016" in rules_of(findings)
+        assert any("KT_FAULTS" in f.message for f in findings)
+
+    def test_faults_package_is_the_sanctioned_home(self):
+        src = """
+        import os
+        import random
+
+        def plane():
+            return os.environ.get("KT_FAULTS", "") and random.random()
+        """
+        assert "KT016" not in rules_of(lint(src, "karpenter_tpu/faults/plane.py"))
+
+    def test_non_serving_dirs_are_quiet(self):
+        # controllers/ etc. are out of scope — the plane threads through
+        # solver/ and service/ only
+        src = """
+        import random
+
+        def shuffle_candidates(c):
+            random.shuffle(c)
+        """
+        assert "KT016" not in rules_of(lint(src, "karpenter_tpu/controllers/deprovisioning.py"))
+
+    def test_other_env_probes_are_quiet(self):
+        src = """
+        import os
+
+        def knob():
+            return os.environ.get("KT_MAX_SLOTS", "8")
+        """
+        assert "KT016" not in rules_of(lint(src, self.SVC))
+
+    def test_fires_on_uncounted_recovery(self):
+        src = """
+        class Pipe:
+            def _serve_delta(self, entry, info):
+                try:
+                    return self._apply_delta_step(entry, info)
+                except Exception:
+                    self._delta_tab.drop(info["sid"], "error")
+                    return None
+        """
+        findings = lint(src, self.SVC)
+        assert "KT016" in rules_of(findings)
+        assert any("karpenter_faults_recovered_total" in f.message
+                   for f in findings)
+
+    def test_quiet_with_count_recovery_helper(self):
+        src = """
+        from karpenter_tpu import faults
+
+        class Pipe:
+            def _serve_delta(self, entry, info):
+                try:
+                    return self._apply_delta_step(entry, info)
+                except Exception:
+                    faults.count_recovery(self.registry, "delta_step",
+                                          "evicted")
+                    return None
+        """
+        assert "KT016" not in rules_of(lint(src, self.SVC))
+
+    def test_quiet_with_direct_counter_inc(self):
+        src = """
+        from karpenter_tpu.metrics import FAULTS_RECOVERED
+
+        def zero_init(registry):
+            registry.counter(FAULTS_RECOVERED).inc(
+                {"site": "transport", "outcome": "retried"}, value=0.0)
+
+        class Client:
+            def solve_raw(self, req):
+                try:
+                    return self._solve(req)
+                except Exception:
+                    self.registry.counter(FAULTS_RECOVERED).inc(
+                        {"site": "transport", "outcome": "retried"})
+                    return self._solve(req)
+        """
+        assert "KT016" not in rules_of(lint(src, self.SVC))
+
+    def test_bare_reraise_tail_is_exempt(self):
+        # cleanup + re-raise surfaces the error typed: the RECOVERY (if
+        # any) happens in the caller, which the rule judges separately
+        src = """
+        class Pipe:
+            def _serve_delta(self, entry, info):
+                try:
+                    return self._apply_delta_step(entry, info)
+                except Exception:
+                    self._delta_tab.drop(info["sid"], "error")
+                    raise
+        """
+        assert "KT016" not in rules_of(lint(src, self.SVC))
+
+    def test_unfaultable_try_bodies_are_quiet(self):
+        src = """
+        class Pipe:
+            def _bucket_of(self, kwargs):
+                try:
+                    return self.scheduler.bucket_key(kwargs)
+                except Exception:
+                    return None
+        """
+        assert "KT016" not in rules_of(lint(src, self.SVC))
+
+    def test_suppression_with_reason(self):
+        src = """
+        class Pipe:
+            def _serve_delta(self, entry, info):
+                try:
+                    return self._apply_delta_step(entry, info)
+                # ktlint: allow[KT016] counted by the _counted funnel upstream
+                except Exception:
+                    return None
+        """
+        assert "KT016" not in rules_of(lint(src, self.SVC))
+
+
 class TestSuppressionGrammar:
     SRC = """
     import time

@@ -197,6 +197,75 @@ class TestDeltaSeries:
             pipe.stop()
 
 
+class TestResilienceSeries:
+    """ISSUE 12: the session-durability and fault-plane families are born
+    at zero — snapshot write/skip/restore outcomes from DeltaSessionTable
+    construction, the full site x outcome recovery population, and the
+    per-rule injected series from FaultPlane construction — and survive
+    into expose()."""
+
+    def test_snapshot_families_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            SNAPSHOT_RESTORE,
+            SNAPSHOT_RESTORE_OUTCOMES,
+            SNAPSHOT_SESSIONS,
+            SNAPSHOT_SKIP_REASONS,
+            SNAPSHOT_SKIPPED,
+            SNAPSHOT_WRITE_OUTCOMES,
+            SNAPSHOT_WRITES,
+        )
+        from karpenter_tpu.service.delta import DeltaSessionTable
+
+        reg = Registry()
+        DeltaSessionTable(registry=reg)
+        for outcome in SNAPSHOT_WRITE_OUTCOMES:
+            assert series_exists(reg.counter(SNAPSHOT_WRITES),
+                                 {"outcome": outcome})
+        for reason in SNAPSHOT_SKIP_REASONS:
+            assert series_exists(reg.counter(SNAPSHOT_SKIPPED),
+                                 {"reason": reason})
+        for outcome in SNAPSHOT_RESTORE_OUTCOMES:
+            assert series_exists(reg.counter(SNAPSHOT_RESTORE),
+                                 {"outcome": outcome})
+        assert reg.gauge(SNAPSHOT_SESSIONS).has()
+        text = reg.expose()
+        assert ('karpenter_solver_session_snapshot_restore_total'
+                '{outcome="catalog_epoch"} 0') in text
+        assert 'karpenter_solver_session_snapshot_sessions 0' in text
+
+    def test_recovery_population_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            FAULT_RECOVERY_OUTCOMES,
+            FAULT_SITES,
+            FAULTS_RECOVERED,
+        )
+        from karpenter_tpu.service.delta import DeltaSessionTable
+
+        reg = Registry()
+        DeltaSessionTable(registry=reg)
+        for site in FAULT_SITES:
+            for outcome in FAULT_RECOVERY_OUTCOMES:
+                assert series_exists(reg.counter(FAULTS_RECOVERED),
+                                     {"site": site, "outcome": outcome}), \
+                    f"recovered{{site={site},outcome={outcome}}} missing"
+        assert ('karpenter_faults_recovered_total'
+                '{outcome="retried",site="transport"} 0') in reg.expose()
+
+    def test_plane_zero_inits_its_schedule(self):
+        from karpenter_tpu import faults
+        from karpenter_tpu.metrics import FAULTS_INJECTED
+
+        reg = Registry()
+        faults.FaultPlane(
+            "dispatch_exc@dispatch:at=5;session_wipe@session_table:p=0.1",
+            registry=reg)
+        assert series_exists(reg.counter(FAULTS_INJECTED),
+                             {"kind": "dispatch_exc", "site": "dispatch"})
+        assert series_exists(
+            reg.counter(FAULTS_INJECTED),
+            {"kind": "session_wipe", "site": "session_table"})
+
+
 class TestAdmissionSeries:
     """ISSUE 5: the admission subsystem's full label population is born at
     zero from AdmissionControl construction — classes x shed reasons,
