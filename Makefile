@@ -2,7 +2,7 @@ PYTHON ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
 
-.PHONY: all test native proto bench clean battletest lint obs-demo overload-demo chaos
+.PHONY: all test native proto bench clean battletest lint obs-demo overload-demo chaos chaos-fleet
 
 all: native proto
 
@@ -69,6 +69,31 @@ chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_drive.py
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_drive.py --restart
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_drive.py --restart --no-snapshot
+
+# fleet-failover chaos (docs/RESILIENCE.md, ISSUE 13): 3 replicas sharing
+# one session spool behind fleet-aware clients, judged per step against a
+# fault-free oracle.  The seed matrix (KT_FLEET_SEEDS, CI-friendly: each
+# seed re-rolls the session ids and therefore the rendezvous placement,
+# the victim, and the kill timing) runs every scenario per seed:
+#   kill       hard kill-one-of-three -> lease-steal adoption, ZERO
+#              re-establishes, byte-parity vs the oracle chain
+#   drain      graceful drain-one-of-three -> DRAINING hints, proactive
+#              re-home, ZERO re-establishes
+#   kill-cold  the no-spool baseline -> exactly one re-establish per
+#              orphaned session (the PR-10 floor)
+#   contend    two survivors adopt the same dead session concurrently ->
+#              exactly one lease winner, typed refusal for the loser
+#   stale      spool rolled back to pre-kill records -> adoption succeeds
+#              but the epoch check refuses the stale chain: one typed
+#              re-establish per session, never a silent divergence
+KT_FLEET_SEEDS ?= 23 24 25
+chaos-fleet:
+	for seed in $(KT_FLEET_SEEDS); do \
+	  for mode in kill drain kill-cold contend stale; do \
+	    JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_drive.py --fleet \
+	      --mode $$mode --seed $$seed || exit 1; \
+	  done; \
+	done
 
 clean:
 	rm -f karpenter_tpu/solver/_native*.so

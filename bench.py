@@ -593,6 +593,35 @@ def check_budgets(rec):
             f"first post-restart delta p50 {rfp:.1f}ms exceeds the "
             f"{RESTART_FIRST_DELTA_P50_BUDGET_MS:g}ms restore budget — "
             "restored sessions are not serving warm")
+    # fleet-failover gates (ISSUE 13): kill-one-of-N must hand every
+    # orphaned session to a surviving replica WARM (zero re-establishing
+    # solves, lease-steal adoption), and the no-spool baseline must cost
+    # exactly one re-establish per orphaned session (the PR-10 floor —
+    # more is a retry storm, fewer means the scenario never fired)
+    fw = rec.get("fleet_warm_failover_resends")
+    if fw is not None and fw != 0:
+        flags.append(
+            f"{fw:.0f} re-establishing solve(s) after a kill-one-of-N "
+            "failover WITH the shared spool — adoption is not serving "
+            "orphaned sessions warm")
+    fv = rec.get("fleet_victim_sessions")
+    if fv is not None and fv == 0:
+        flags.append(
+            "the fleet kill scenario orphaned zero sessions — the "
+            "failover path was never exercised")
+    fs = rec.get("fleet_steal_adoptions")
+    if fs is not None and fv is not None and fs < fv:
+        flags.append(
+            f"only {fs:.0f} lease-steal adoption(s) for {fv:.0f} orphaned "
+            "sessions — survivors are not adopting the dead replica's "
+            "chains")
+    fc_res = rec.get("fleet_cold_failover_resends")
+    fc_vic = rec.get("fleet_cold_victim_sessions")
+    if fc_res is not None and fc_vic is not None and fc_res != fc_vic:
+        flags.append(
+            f"{fc_res:.0f} re-establishes for {fc_vic:.0f} orphaned "
+            "sessions on the no-spool fleet baseline — the cold path "
+            "must cost exactly one full solve per session")
     # persistent AOT compile cache gates (ISSUE 10 satellite)
     if rec.get("cold_restart_cache_populated") is False:
         flags.append(
@@ -1596,6 +1625,46 @@ def measure_restart_recovery():
     }
 
 
+def measure_fleet_failover():
+    """Fleet failover (ISSUE 13): kill one of three in-process solver
+    replicas sharing ONE session spool mid-chain (scripts/chaos_drive
+    ``run_fleet``, real gRPC on unix sockets, fleet-aware clients with
+    session-affinity routing, every chain mirrored onto a fault-free
+    oracle), twice — with the shared spool (surviving replicas STEAL the
+    dead replica's sessions after the lease TTL and serve their next
+    delta WARM) and without (the PR-10 cold baseline).
+
+    Gates (check_budgets): warm-failover re-establishes == 0 with at
+    least one orphaned session steal-adopted; the no-spool baseline costs
+    exactly one re-establish per orphaned session.  Typed-errors-only and
+    per-step oracle byte-parity are asserted INSIDE run_fleet — reaching
+    a scoreboard at all means they held."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_drive",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "scripts", "chaos_drive.py"))
+    chaos = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos)
+    warm = chaos.run_fleet(mode="kill", verbose=False, strict=False)
+    if warm["extra_resends"] != 0 or not warm["victim_sessions"]:
+        # breach hygiene (repo idiom): a loaded host can delay the
+        # periodic record write past the kill — real on a fresh run or
+        # it was a blip
+        warm = chaos.run_fleet(mode="kill", seed=warm["seed"] + 1,
+                               verbose=False, strict=False)
+    cold = chaos.run_fleet(mode="kill-cold", verbose=False, strict=False)
+    return {
+        "fleet_victim_sessions": warm["victim_sessions"],
+        "fleet_warm_failover_resends": warm["extra_resends"],
+        "fleet_steal_adoptions": warm["adoptions"].get("stolen", 0),
+        "fleet_cold_victim_sessions": cold["victim_sessions"],
+        "fleet_cold_failover_resends": cold["extra_resends"],
+        "fleet_typed_errors": sum(warm["typed_errors"].values()),
+    }
+
+
 _COLD_RESTART_SNIPPET = """
 import time
 from karpenter_tpu.models.catalog import generate_catalog
@@ -1882,6 +1951,7 @@ def run_bench():
     delta_serving = measure_delta_serving()
     cold_restart = measure_cold_restart()
     restart_recovery = measure_restart_recovery()
+    fleet_failover = measure_fleet_failover()
     warm_ms, warm_cold, nowarm_ms, warmcold_err = measure_warm_coldstart()
 
     rec_cold = {
@@ -1925,6 +1995,7 @@ def run_bench():
         **delta_serving,
         **cold_restart,
         **restart_recovery,
+        **fleet_failover,
         "cost_ratio_vs_ffd": round(cost_ratio, 4),
         "tpu_nodes": len(out.result.nodes),
         "ffd_nodes": len(oracle.nodes),

@@ -31,7 +31,8 @@ Kinds (docs/RESILIENCE.md fault catalog) split into two behaviors:
   ``session_wipe`` (table clear), ``clock_jump`` (TTL-clock skew),
   ``snapshot_corrupt``/``snapshot_truncate`` (spool mangling via
   :meth:`FaultPlane.mangle`), ``breaker_trip`` (a synthetic failure fed to
-  the circuit breaker).
+  the circuit breaker), ``lease_steal`` (a contending sibling lease
+  planted under an in-flight adoption — the exactly-one-owner adversary).
 
 A misconfigured schedule raises ``ValueError`` at construction: chaos is
 explicitly opted into, and a typo that silently no-ops would report a
@@ -67,7 +68,7 @@ RAISE_KINDS = ("device_hang", "dispatch_exc", "rpc_unavailable", "rpc_reset")
 #: nothing outcome the fail-loud contract below exists to prevent.
 KIND_SITES = {
     "device_hang": ("fence",),
-    "dispatch_exc": ("dispatch", "delta_step", "delta_commit"),
+    "dispatch_exc": ("dispatch", "delta_step", "delta_commit", "adopt"),
     "slow_fence": ("fence",),
     "slow_step": ("delta_step",),
     "rpc_unavailable": ("transport",),
@@ -77,6 +78,7 @@ KIND_SITES = {
     "snapshot_corrupt": ("snapshot_write",),
     "snapshot_truncate": ("snapshot_write",),
     "breaker_trip": ("breaker",),
+    "lease_steal": ("adopt",),
 }
 
 #: default ``value=`` per kind (seconds, or keep-fraction for truncate)
@@ -85,6 +87,10 @@ _DEFAULT_VALUES = {
     "slow_step": 0.05,
     "clock_jump": 3600.0,
     "snapshot_truncate": 0.5,
+    # lease_steal@adopt: how long the injected contending lease is valid
+    # for — the adoption under test must observe a sibling's UNEXPIRED
+    # claim and refuse (the exactly-one-owner adversary)
+    "lease_steal": 3600.0,
 }
 
 

@@ -196,13 +196,19 @@ DELTA_RPC = "karpenter_solver_delta_rpc_total"
 #: client's (session, epoch) — the client re-establishes with ONE full
 #: solve)
 DELTA_RPC_OUTCOMES = ("delta", "fallback_full", "establish", "reseed",
-                      "session_unknown")
+                      "session_unknown", "drain_refused")
 DELTA_RPC_DURATION = "karpenter_solver_delta_rpc_duration_seconds"
 DELTA_SESSIONS = "karpenter_solver_delta_sessions"
 DELTA_EVICTIONS = "karpenter_solver_delta_session_evictions_total"
 #: eviction-reason label population (KT003).  'fault' is the injected
 #: session-table wipe (docs/RESILIENCE.md) — production never emits it.
-DELTA_EVICT_REASONS = ("ttl", "capacity", "stop", "error", "fault")
+#: 'drain' is the graceful fleet handoff (record spooled + lease released
+#: + entry dropped so a sibling replica adopts the chain WARM); and
+#: 'lease_lost' is the zombie-writer guard — this replica's session lease
+#: was stolen after expiry, so the chain is dropped rather than served or
+#: spooled over the new owner's record.
+DELTA_EVICT_REASONS = ("ttl", "capacity", "stop", "error", "fault",
+                       "drain", "lease_lost")
 # ---- session durability (ISSUE 12: crash-safe delta serving) ------------
 SNAPSHOT_WRITES = "karpenter_solver_session_snapshot_writes_total"
 #: snapshot write outcomes (KT003 zero-init source): 'written' (spool file
@@ -215,7 +221,7 @@ SNAPSHOT_SKIPPED = "karpenter_solver_session_snapshot_skipped_total"
 #: chain), 'torn' (a step started or committed while the lock-free
 #: writer was pickling this chain; the possibly-inconsistent bytes are
 #: discarded)
-SNAPSHOT_SKIP_REASONS = ("in_step", "torn")
+SNAPSHOT_SKIP_REASONS = ("in_step", "torn", "lease_lost")
 SNAPSHOT_RESTORE = "karpenter_solver_session_snapshot_restore_total"
 #: restore outcomes — every refusal is a COLD START plus this label, never
 #: a crash or a diverged chain (docs/RESILIENCE.md)
@@ -223,6 +229,30 @@ SNAPSHOT_RESTORE_OUTCOMES = ("restored", "missing", "corrupt", "truncated",
                              "version", "catalog_epoch", "error")
 SNAPSHOT_DURATION = "karpenter_solver_session_snapshot_duration_seconds"
 SNAPSHOT_SESSIONS = "karpenter_solver_session_snapshot_sessions"
+# ---- fleet failover (ISSUE 13: warm delta-session handoff) --------------
+SESSION_ADOPTIONS = "karpenter_solver_session_adoptions_total"
+#: adoption outcomes (KT003 zero-init source; docs/RESILIENCE.md adoption
+#: state machine): 'adopted' (free lease claimed, record consumed, chain
+#: live), 'stolen' (the previous owner's lease had EXPIRED — a dead
+#: replica's session adopted after the lease TTL), 'lease_held' (typed
+#: refusal: a sibling replica holds an unexpired lease — exactly-one-owner
+#: by construction), 'missing' (no spool record for the session),
+#: 'refused' (the record failed the envelope checks — corrupt/version/
+#: catalog skew, also counted per-reason in the restore family), 'error'
+#: (unexpected failure; cold start)
+SESSION_ADOPTION_OUTCOMES = ("adopted", "stolen", "lease_held", "missing",
+                             "refused", "error")
+SESSION_LEASES = "karpenter_solver_session_leases_owned"
+FLEET_ENDPOINTS = "karpenter_fleet_endpoints"
+#: endpoint-state label population (client-side, FleetClient): 'known'
+#: (configured), 'healthy' (serving), 'draining' (answered a DRAINING
+#: hint; new sessions route elsewhere until the pod dies)
+FLEET_ENDPOINT_STATES = ("known", "healthy", "draining")
+FLEET_FAILOVERS = "karpenter_fleet_failovers_total"
+#: why a session was re-homed to a different replica: 'death' (transport
+#: failure outlived the retry budget) or 'drain' (the serving replica
+#: answered the graceful-drain hint)
+FLEET_FAILOVER_REASONS = ("death", "drain")
 # ---- fault-injection plane (ISSUE 12: KT_FAULTS, karpenter_tpu/faults/) -
 FAULTS_INJECTED = "karpenter_faults_injected_total"
 FAULTS_RECOVERED = "karpenter_faults_recovered_total"
@@ -230,11 +260,12 @@ FAULTS_RECOVERED = "karpenter_faults_recovered_total"
 #: vocabulary scripts and docs share)
 FAULT_SITES = ("dispatch", "fence", "delta_step", "delta_commit",
                "session_table", "snapshot_write", "snapshot_read",
-               "transport", "breaker")
+               "transport", "breaker", "adopt")
 #: the injectable fault catalog (docs/RESILIENCE.md)
 FAULT_KINDS = ("device_hang", "dispatch_exc", "slow_fence", "slow_step",
                "rpc_unavailable", "rpc_reset", "session_wipe", "clock_jump",
-               "snapshot_corrupt", "snapshot_truncate", "breaker_trip")
+               "snapshot_corrupt", "snapshot_truncate", "breaker_trip",
+               "lease_steal")
 #: recovery outcomes the serving stack reports per site (KT016 pins that
 #: every recovering except on a faultable path lands here)
 FAULT_RECOVERY_OUTCOMES = ("ok", "retried", "fallback", "evicted", "cold",
@@ -460,11 +491,14 @@ INVENTORY = {
         "base; the session survives), 'establish' (a full solve created or "
         "replaced the session chain), 'reseed' (a catalog/price epoch bump "
         "re-solved the chain server-side from the stripped base), "
-        "'session_unknown' (no live chain for the client's (session, "
-        "epoch); the client re-establishes with one full solve).  A "
-        "healthy steady-state fleet is dominated by 'delta'; sustained "
-        "'session_unknown' means the table is too small or the TTL too "
-        "short (KT_DELTA_SESSIONS / KT_DELTA_TTL_S)."),
+        "'session_unknown' (no live chain — and no adoptable spool "
+        "record — for the client's (session, epoch); the client "
+        "re-establishes with one full solve), 'drain_refused' (an "
+        "establishment refused while this replica drains; the client "
+        "re-homes and establishes on a sibling).  A healthy steady-state "
+        "fleet is dominated by 'delta'; sustained 'session_unknown' "
+        "means the table is too small or the TTL too short "
+        "(KT_DELTA_SESSIONS / KT_DELTA_TTL_S)."),
     DELTA_RPC_DURATION: (
         "histogram", (),
         "Server-side wall time of one session-routed RPC dispatch "
@@ -480,9 +514,15 @@ INVENTORY = {
         "KT_DELTA_SESSIONS), 'stop' (pipeline shutdown), 'error' (a "
         "delta step raised mid-apply — the half-mutated chain must not "
         "serve another epoch, so the session dies and the client "
-        "re-establishes).  An evicted session costs its client ONE "
-        "re-establishing full solve.  'fault' is the injected session-"
-        "table wipe (KT_FAULTS chaos runs only)."),
+        "re-establishes), 'drain' (graceful fleet handoff: the record is "
+        "spooled, the lease released and the entry dropped so a sibling "
+        "replica adopts the chain WARM — docs/RESILIENCE.md), "
+        "'lease_lost' (this replica's session lease was stolen after "
+        "expiry; the chain is dropped rather than served or spooled over "
+        "the new owner's record).  An evicted session costs its client "
+        "AT MOST one re-establishing full solve ('drain' normally costs "
+        "zero — the adopting replica serves warm).  'fault' is the "
+        "injected session-table wipe (KT_FAULTS chaos runs only)."),
     SNAPSHOT_WRITES: (
         "counter", ("outcome",),
         "Session-table snapshot writes to the KT_SESSION_DIR spool "
@@ -493,11 +533,14 @@ INVENTORY = {
     SNAPSHOT_SKIPPED: (
         "counter", ("reason",),
         "Sessions left OUT of a snapshot, by reason: 'in_step' (a delta "
-        "step was mid-mutation at capture) or 'torn' (a step started or "
+        "step was mid-mutation at capture), 'torn' (a step started or "
         "committed while the lock-free writer was pickling the chain; "
-        "its bytes are discarded).  Epoch-atomicity: a half-applied "
-        "chain is never persisted — a skipped session costs its client "
-        "one re-establish after a restart, never a replayed half-step."),
+        "its bytes are discarded), or 'lease_lost' (the session's spool "
+        "lease is now held by a sibling replica — a zombie writer must "
+        "never clobber the adopter's record).  Epoch-atomicity: a half-"
+        "applied chain is never persisted — a skipped session costs its "
+        "client one re-establish after a restart, never a replayed "
+        "half-step."),
     SNAPSHOT_RESTORE: (
         "counter", ("outcome",),
         "Session-table restore attempts at pipeline startup, by outcome: "
@@ -517,6 +560,40 @@ INVENTORY = {
         "gauge", (),
         "Sessions persisted in the most recent snapshot write (0 until "
         "the first write)."),
+    SESSION_ADOPTIONS: (
+        "counter", ("outcome",),
+        "Session-spool adoption attempts (fleet failover, docs/"
+        "RESILIENCE.md): any replica can restore a specific session from "
+        "the shared KT_SESSION_DIR spool on demand, by outcome: 'adopted' "
+        "(free lease claimed, record consumed, next delta serves WARM), "
+        "'stolen' (the previous owner's lease had expired — a dead "
+        "replica's session picked up after KT_SESSION_LEASE_S), "
+        "'lease_held' (typed refusal: a sibling holds an unexpired lease "
+        "— two replicas can never both adopt a chain), 'missing' (no "
+        "record; the client pays the PR-10 exactly-one re-establish), "
+        "'refused' (record failed the envelope checks — also counted "
+        "per-reason in the restore family), 'error' (unexpected failure; "
+        "cold start)."),
+    SESSION_LEASES: (
+        "gauge", (),
+        "Session-spool leases this replica currently holds (owned "
+        "sessions with a spool record under the shared KT_SESSION_DIR).  "
+        "0 when no spool is configured."),
+    FLEET_ENDPOINTS: (
+        "gauge", ("state",),
+        "Solver-fleet endpoints as seen by the fleet-aware client "
+        "(KT_FLEET_ENDPOINTS), by state: 'known' (configured), 'healthy' "
+        "(serving), 'draining' (answered the graceful-drain hint; new "
+        "sessions route elsewhere until the pod dies)."),
+    FLEET_FAILOVERS: (
+        "counter", ("reason",),
+        "Sessions re-homed to a different solver replica by the fleet-"
+        "aware client, by reason: 'death' (transport failure outlived "
+        "the retry budget — the replica is gone; the adopting replica "
+        "restores the chain from the shared spool and serves the next "
+        "delta warm) or 'drain' (the serving replica answered "
+        "session_state='draining'; the client proactively re-homes "
+        "before the pod dies)."),
     FAULTS_INJECTED: (
         "counter", ("kind", "site"),
         "Faults the KT_FAULTS injection plane fired, by kind and choke-"

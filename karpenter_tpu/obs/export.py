@@ -19,10 +19,16 @@ from ..metrics import (
     ADMISSION_BROWNOUT_LEVEL,
     ADMISSION_QUEUE_DEPTH,
     ADMISSION_SHED,
+    DELTA_EVICTIONS,
+    DELTA_SESSIONS,
     FAULTS_INJECTED,
     FAULTS_RECOVERED,
+    FLEET_ENDPOINTS,
+    FLEET_FAILOVERS,
     FLIGHT_DUMPS,
     INFLIGHT_DEPTH,
+    SESSION_ADOPTIONS,
+    SESSION_LEASES,
     SNAPSHOT_RESTORE,
     SNAPSHOT_SESSIONS,
     SNAPSHOT_SKIPPED,
@@ -132,6 +138,27 @@ def statusz(registry: Registry, flight: Optional[FlightRecorder] = None) -> dict
                                "reason"),
             "last_sessions": registry.gauge(SNAPSHOT_SESSIONS).get(),
         }
+    adoptions = registry.counter(SESSION_ADOPTIONS)
+    endpoints = registry.gauge(FLEET_ENDPOINTS)
+    if any(adoptions.values.values()) or endpoints.values \
+            or registry.gauge(SESSION_LEASES).get():
+        # the fleet dimension is live (ISSUE 13, docs/RESILIENCE.md):
+        # server-side session ownership (owned/adopted/drained + lease
+        # state) and, on a client-embedding process, the endpoint set
+        doc["fleet"] = {
+            "sessions_owned": registry.gauge(DELTA_SESSIONS).get(),
+            "leases_owned": registry.gauge(SESSION_LEASES).get(),
+            "adoptions": {k: v for k, v in
+                          _series(adoptions, "outcome").items() if v},
+            "sessions_drained": registry.counter(DELTA_EVICTIONS).get(
+                {"reason": "drain"}),
+            "lease_lost": registry.counter(DELTA_EVICTIONS).get(
+                {"reason": "lease_lost"}),
+        }
+        if endpoints.values:
+            doc["fleet"]["endpoints"] = _series(endpoints, "state")
+            doc["fleet"]["failovers"] = _series(
+                registry.counter(FLEET_FAILOVERS), "reason")
     if flight is not None:
         doc["flight_recorder"] = {
             "ring": len(flight.traces()),

@@ -24,7 +24,13 @@ from collections import OrderedDict
 
 from .. import faults as faults_mod
 from ..admission import SolveDeadlineError, SolveShedError, parse_class
-from ..metrics import Registry, registry as default_registry
+from ..metrics import (
+    FLEET_ENDPOINTS,
+    FLEET_FAILOVER_REASONS,
+    FLEET_FAILOVERS,
+    Registry,
+    registry as default_registry,
+)
 from ..utils.clock import Clock
 from ..models.instancetype import InstanceType
 from ..models.pod import PodSpec
@@ -70,6 +76,17 @@ class SolveStepFailed(Exception):
     and the NEXT ``solve_delta`` call re-establishes transparently via the
     session_unknown path — one full solve, never a diverged chain, never
     an untyped transport error through the facade."""
+
+
+class SolverDraining(Exception):
+    """The replica refused a session establishment because it is
+    gracefully draining (``session_state="draining"``,
+    docs/RESILIENCE.md).  A fleet-aware client never surfaces this — the
+    :class:`FleetClient` re-routes the establishment to a sibling — but a
+    single-endpoint ``DeltaSession`` pointed at a draining pod has
+    nowhere to go: typed, the session ledger + pending perturbation
+    survive, and the next call retries (against the replacement pod once
+    it lands)."""
 
 
 #: retry budget for transport UNAVAILABLE (KT_RPC_RETRIES): how many
@@ -198,6 +215,264 @@ class SolverClient:
 
     def close(self) -> None:
         self.channel.close()
+
+
+class FleetClient:
+    """Endpoint-set transport over N solver replicas — session-affinity
+    routing with warm failover (ISSUE 13, docs/RESILIENCE.md).
+
+    Duck-types the slice of :class:`SolverClient` the session facades use
+    (``solve_raw`` / ``timeout`` / ``reset`` / ``close``), so
+    ``DeltaSession(..., client=FleetClient(...))`` is the whole wiring.
+    Routing reads the REQUEST: ``session_id`` rendezvous-hashes over the
+    live endpoints (highest-random-weight, so one replica death re-homes
+    ONLY that replica's sessions and every client agrees on the target
+    without coordination); sessionless solves ride the same hash of "".
+
+    Failure handling, per RPC:
+
+    - transport ``UNAVAILABLE`` surviving the per-endpoint retry budget
+      -> the endpoint is marked DEAD (counted failover ``death``), the
+      request re-routes to the next endpoint in rendezvous order and is
+      re-sent.  For a delta step that is safe: the dead replica either
+      never applied it, or applied it without replying — in which case
+      the adopting replica's spool record is one epoch ahead, the epoch
+      check answers ``session_unknown``, and the client pays the PR-10
+      exactly-one re-establish instead of ever diverging.  With the
+      shared spool current, the adopting replica serves the step WARM.
+    - ``session_state="draining"`` on an ESTABLISHMENT -> the endpoint is
+      marked DRAINING (counted failover ``drain``), the establishment
+      re-sends to a sibling.  On a DELTA reply the served result is
+      returned as-is and the endpoint marked, so the session's next RPC
+      proactively re-homes before the pod dies.
+    - typed sheds / deadline / INTERNAL pass through untouched — overload
+      and step failures are per-replica postures, not routing events.
+
+    Dead endpoints are re-probed (Health, ``PROBE_TIMEOUT``) at most once
+    per ``reconnect_interval`` when routing wants them; a probe that
+    answers revives the endpoint (a replaced pod on the same address).
+    Draining endpoints revive the same way once their replacement serves.
+
+    Knobs: ``KT_FLEET_ENDPOINTS`` (comma-separated targets) when no
+    explicit endpoint list is given.  Endpoint states are exported as
+    ``karpenter_fleet_endpoints{state}`` and re-homes as
+    ``karpenter_fleet_failovers_total{reason}``.
+    """
+
+    RECONNECT_INTERVAL = 5.0
+    PROBE_TIMEOUT = 2.0
+
+    def __init__(self, endpoints: Optional[Sequence[str]] = None,
+                 timeout: float = 60.0,
+                 retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None,
+                 registry: Optional[Registry] = None,
+                 clock: Optional[Clock] = None,
+                 reconnect_interval: float = RECONNECT_INTERVAL) -> None:
+        if endpoints is None:
+            env = os.environ.get("KT_FLEET_ENDPOINTS", "")
+            endpoints = [e.strip() for e in env.split(",") if e.strip()]
+        if not endpoints:
+            raise ValueError(
+                "FleetClient needs at least one endpoint (pass endpoints= "
+                "or set KT_FLEET_ENDPOINTS)")
+        self.endpoints = list(endpoints)
+        self.timeout = timeout
+        self.clock = clock or Clock()
+        self._registry = registry or default_registry
+        self.reconnect_interval = reconnect_interval
+        self._clients: Dict[str, SolverClient] = {
+            ep: SolverClient(ep, timeout=timeout, clock=self.clock,
+                             retries=retries, backoff_s=backoff_s,
+                             registry=self._registry)
+            for ep in self.endpoints
+        }
+        #: endpoint -> "healthy" | "dead" | "draining"
+        self._state: Dict[str, str] = {ep: "healthy"
+                                       for ep in self.endpoints}
+        self._last_probe: Dict[str, float] = {ep: 0.0
+                                              for ep in self.endpoints}
+        faults_mod.zero_init_recovery(self._registry)
+        fo = self._registry.counter(FLEET_FAILOVERS)
+        for reason in FLEET_FAILOVER_REASONS:
+            if not fo.has({"reason": reason}):
+                fo.inc({"reason": reason}, value=0.0)
+        self._export_states()
+
+    # ---- endpoint state --------------------------------------------------
+    def _export_states(self) -> None:
+        gauge = self._registry.gauge(FLEET_ENDPOINTS)
+        states = list(self._state.values())
+        gauge.set(float(len(states)), {"state": "known"})
+        gauge.set(float(states.count("healthy")), {"state": "healthy"})
+        gauge.set(float(states.count("draining")), {"state": "draining"})
+
+    def _mark(self, endpoint: str, state: str) -> bool:
+        """Transition an endpoint's state; True iff it actually changed
+        (failover counting keys on the TRANSITION — a whole-fleet drain
+        serving deltas through the last-resort path must not re-count
+        every reply)."""
+        if self._state.get(endpoint) == state:
+            return False
+        logger.warning("fleet endpoint %s -> %s", endpoint, state)
+        self._state[endpoint] = state
+        if state in ("dead", "draining"):
+            # arm the revival probe a FULL interval out: an immediate
+            # probe would flip a still-answering drainer straight back to
+            # healthy and ping-pong the very sessions the hint re-homed
+            # ktlint: allow[KT002] transport-health stopwatch, see
+            # _revive_due
+            self._last_probe[endpoint] = time.monotonic()
+        self._export_states()
+        return True
+
+    def states(self) -> Dict[str, str]:
+        """Endpoint -> state snapshot (observability/tests)."""
+        return dict(self._state)
+
+    def _revive_due(self, endpoint: str) -> bool:
+        # ktlint: allow[KT002] transport-health stopwatch, the
+        # RemoteScheduler._remote_ok precedent: probe pacing must follow
+        # real wall progress, not an injected test clock
+        now = time.monotonic()
+        if now - self._last_probe.get(endpoint, 0.0) \
+                < self.reconnect_interval:
+            return False
+        self._last_probe[endpoint] = now
+        return True
+
+    def _probe(self, endpoint: str) -> bool:
+        client = self._clients[endpoint]
+        try:
+            ok = bool(client.health(timeout=self.PROBE_TIMEOUT).ok)
+        except grpc.RpcError:
+            # arm the NEXT probe with a fresh channel (the wedged-channel
+            # class SolverClient.reset documents); a DRAINING pod that
+            # stopped answering has died — dead-state probing now owns
+            # its revival once the replacement serves
+            client.reset()
+            self._mark(endpoint, "dead")
+            return False
+        if ok:
+            self._mark(endpoint, "healthy")
+        return ok
+
+    # ---- routing ---------------------------------------------------------
+    @staticmethod
+    def _weight(session_id: str, endpoint: str) -> int:
+        import hashlib
+
+        return int.from_bytes(
+            hashlib.sha256(f"{session_id}|{endpoint}".encode()).digest()[:8],
+            "big")
+
+    def rendezvous(self, session_id: str) -> List[str]:
+        """Every endpoint, best first (highest-random-weight hash of
+        (session, endpoint)): the session's home is the first LIVE entry,
+        and failover walks the same order on every client."""
+        return sorted(self.endpoints,
+                      key=lambda ep: self._weight(session_id, ep),
+                      reverse=True)
+
+    def endpoint_for(self, session_id: str,
+                     exclude: Optional[set] = None) -> Optional[str]:
+        """The session's current home: the first HEALTHY endpoint in
+        rendezvous order.  Draining endpoints are routed around — the
+        hint already handed the chain to the spool, so the next RPC must
+        land on the sibling that will adopt it, not ping-pong back into
+        the drainer — and serve only as a last resort when the whole
+        fleet drains at once (they still answer deltas correctly; an
+        establishment there is refused and retried).  Dead endpoints get
+        a paced revival probe on the way.  None when everything is
+        excluded or dead."""
+        exclude = exclude or set()
+        fallback = None
+        for ep in self.rendezvous(session_id):
+            if ep in exclude:
+                continue
+            state = self._state[ep]
+            if state in ("dead", "draining") and self._revive_due(ep):
+                # paced revival probe.  Dead: the replacement pod on the
+                # same address answers -> healthy.  Draining: the pod
+                # either still drains (probe ok -> healthy; one RPC will
+                # re-mark it the moment it answers another hint — a
+                # bounded mislabel, never a wrong result) or has died
+                # (probe fails -> dead, and the dead path picks up its
+                # replacement).  Without this, a drained-and-replaced
+                # endpoint would stay excluded forever.
+                self._probe(ep)
+                state = self._state[ep]
+            if state == "healthy":
+                return ep
+            if state == "draining" and fallback is None:
+                fallback = ep  # an all-draining fleet still serves deltas
+        return fallback
+
+    # ---- SolverClient surface -------------------------------------------
+    def solve_raw(self, request: pb.SolveRequest,
+                  timeout: Optional[float] = None) -> pb.SolveResponse:
+        sid = getattr(request, "session_id", "")
+        establish = bool(sid) and not bool(getattr(request, "delta", False))
+        tried: set = set()
+        while True:
+            ep = self.endpoint_for(sid, exclude=tried)
+            if ep is None:
+                raise SolveRetriesExhausted(
+                    f"no live solver endpoint (of {len(self.endpoints)}) "
+                    f"for session {sid or '<none>'}", len(tried))
+            try:
+                resp = self._clients[ep].solve_raw(request, timeout=timeout)
+            except grpc.RpcError as err:
+                code = (err.code()
+                        if callable(getattr(err, "code", None)) else None)
+                if code == grpc.StatusCode.UNAVAILABLE:
+                    # the replica is gone (the per-endpoint retry budget
+                    # already rode through a mere restart): fail the
+                    # session over — the next endpoint adopts its chain
+                    # from the shared spool and serves WARM.  Counted on
+                    # the state TRANSITION, not per failing RPC.
+                    if self._mark(ep, "dead"):
+                        self._registry.counter(FLEET_FAILOVERS).inc(
+                            {"reason": "death"})
+                    faults_mod.count_recovery(
+                        self._registry, "transport", "fallback")
+                    tried.add(ep)
+                    continue
+                raise  # sheds / deadline / INTERNAL: per-replica posture
+            if getattr(resp, "session_state", "") == "draining":
+                if self._mark(ep, "draining"):
+                    self._registry.counter(FLEET_FAILOVERS).inc(
+                        {"reason": "drain"})
+                if establish:
+                    # the handshake's refusal half: nothing was served —
+                    # re-home the establishment to a sibling.  When the
+                    # WHOLE fleet is draining at once (rolling restart
+                    # tail) there is no sibling: return the refusal so
+                    # the session facade raises the typed, retriable
+                    # SolverDraining — the replicas are alive and
+                    # protecting their handoffs, which is not an outage
+                    if self.endpoint_for(sid,
+                                         exclude=tried | {ep}) is None:
+                        return resp
+                    tried.add(ep)
+                    continue
+                # a served delta carrying the hint: return it; the next
+                # RPC for this session routes to a live sibling, which
+                # adopts the handed-off chain warm
+            return resp
+
+    def reset(self) -> None:
+        for client in self._clients.values():
+            client.reset()
+
+    def health(self, timeout: Optional[float] = None):
+        """Health of the session-less routing target (facade parity)."""
+        ep = self.endpoint_for("") or self.endpoints[0]
+        return self._clients[ep].health(timeout=timeout)
+
+    def close(self) -> None:
+        for client in self._clients.values():
+            client.close()
 
 
 class RemoteScheduler:
@@ -511,6 +786,13 @@ class DeltaSession:
     and a replacement without our chain answers ``unknown`` for exactly
     one re-establishing full solve (docs/RESILIENCE.md).
 
+    Fleet posture (ISSUE 13): pass ``client=FleetClient([...])`` and the
+    session rides the whole replica fleet — rendezvous affinity routing,
+    failover on replica death (the sibling ADOPTS the chain from the
+    shared spool and serves the next delta warm), and proactive
+    re-homing on the graceful-drain ``session_state="draining"`` hint,
+    which this facade treats as a served step.
+
     ``KT_DELTA=0`` (client-side) turns the facade into a plain full-solve
     client: every call re-ships the cluster with NO session fields on the
     wire — byte-identical requests to pre-delta serving.
@@ -686,12 +968,17 @@ class DeltaSession:
         )
         self.delta_rpcs += 1
         reply = codec.decode_delta_reply(self._rpc(req))
-        if reply.state != "ok":
+        if reply.state not in ("ok", "draining"):
             # SESSION_UNKNOWN (evicted / epoch mismatch / delta-off
             # server): exactly ONE transparent full resend re-establishes
             # — never a retry loop, never a silently diverged chain
             self._established = False
             return self._reestablish()
+        # "draining" is a SERVED step plus a hint (the graceful fleet
+        # handshake): the replica applied this delta, spooled the chain
+        # and released its lease — the session stays established, and a
+        # fleet-aware transport routes the next RPC to a sibling, which
+        # adopts the chain and serves it warm (docs/RESILIENCE.md)
         self._epoch = reply.epoch
         if reply.full:
             self._apply_full(reply)
@@ -814,6 +1101,16 @@ class DeltaSession:
         )
         self.full_resends += 1
         reply = codec.decode_delta_reply(self._rpc(req))
+        if self.enabled and reply.state == "draining":
+            # an establishment REFUSED by a draining replica, and the
+            # transport had no sibling to re-route to (single-endpoint
+            # client, or the whole fleet draining at once).  Nothing was
+            # solved; ledger + pending perturbation survive for a retry
+            # against the replacement pod.
+            raise SolverDraining(
+                "solver is draining and refused the session "
+                "establishment; retry shortly (a FleetClient re-homes "
+                "this automatically)")
         self._established = reply.state == "ok"
         self._epoch = reply.epoch
         self._apply_full(reply)

@@ -39,6 +39,16 @@ of every surviving session WARM instead of paying one re-establishing
 full solve per client; ``KT_CATALOG_EPOCH`` (optional) refuses spools
 from any OTHER catalog epoch (older or newer — rollbacks too).
 
+Fleet handoff (ISSUE 13): the spool is SESSION-ADDRESSABLE — one record
+file + one ownership lease per session (``service/snapshot.py``) — so on
+a SHARED volume any replica can :meth:`DeltaSessionTable.adopt` a
+specific session on demand: a replica death or graceful drain hands the
+warm chain to whichever sibling the client re-homes to, and the lease
+protocol (claim / typed refusal / steal-after-``KT_SESSION_LEASE_S``)
+guarantees exactly one adopter.  ``KT_REPLICA_ID`` names this replica as
+the lease owner (the deploy sets the pod name; defaults to a stable
+per-process id so in-process restarts self-renew).
+
 Known limitation (documented, bounded): session ESTABLISHMENTS are full
 solves served synchronously on the fast path (held batches are flushed
 first, so other traffic proceeds between them), not coalesced into
@@ -67,6 +77,9 @@ from ..metrics import (
     DELTA_RPC_DURATION,
     DELTA_RPC_OUTCOMES,
     DELTA_SESSIONS,
+    SESSION_ADOPTION_OUTCOMES,
+    SESSION_ADOPTIONS,
+    SESSION_LEASES,
     SNAPSHOT_DURATION,
     SNAPSHOT_RESTORE,
     SNAPSHOT_RESTORE_OUTCOMES,
@@ -170,7 +183,10 @@ class DeltaSessionTable:
                  clock: Optional[Clock] = None,
                  capacity: Optional[int] = None,
                  ttl_s: Optional[float] = None,
-                 faults=None) -> None:
+                 faults=None,
+                 spool_dir: Optional[str] = None,
+                 lease_s: Optional[float] = None,
+                 replica: Optional[str] = None) -> None:
         self.registry = registry or default_registry
         self.clock = clock or Clock()
         if capacity is None:
@@ -181,6 +197,30 @@ class DeltaSessionTable:
                                          str(DEFAULT_TTL_S)))
         self.capacity = max(1, capacity)
         self.ttl_s = max(0.0, ttl_s)
+        #: default spool directory for snapshot/restore/adopt (callers may
+        #: still pass an explicit dir — tests do); set by the pipeline to
+        #: its backend-namespaced KT_SESSION_DIR
+        self.spool_dir = spool_dir or ""
+        if lease_s is None:
+            lease_s = float(os.environ.get(
+                "KT_SESSION_LEASE_S", str(snap.DEFAULT_LEASE_S)))
+        #: ownership-lease TTL (KT_SESSION_LEASE_S): a dead replica's
+        #: sessions become stealable this long after its last record
+        #: write — the fleet's failover-warmness window
+        self.lease_s = max(0.0, lease_s)
+        #: this replica's lease-owner identity (KT_REPLICA_ID or a stable
+        #: per-process id — see snapshot.replica_id)
+        self.replica = replica or os.environ.get(
+            "KT_REPLICA_ID", "") or snap.replica_id()
+        #: KT_CATALOG_EPOCH pin: when set, records from any OTHER catalog
+        #: epoch are refused — by the boot restore AND by adopt-on-miss
+        #: (a failed-over chain packed against stale prices must not
+        #: serve warm any more than a restored one may)
+        cat = os.environ.get("KT_CATALOG_EPOCH", "")
+        self.expected_catalog_epoch: Optional[int] = (
+            int(cat) if cat else None)
+        #: sids whose spool leases this table holds  # guarded-by: _lock
+        self._owned: set = set()
         # fault-injection plane (docs/RESILIENCE.md): the null no-op plane
         # unless KT_FAULTS configures a chaos schedule; the pipeline hands
         # its own plane down so one schedule covers table + delta path
@@ -210,6 +250,9 @@ class DeltaSessionTable:
 
     def _gauge_locked(self) -> None:
         self.registry.gauge(DELTA_SESSIONS).set(len(self._sessions))
+
+    def _leases_gauge_locked(self) -> None:
+        self.registry.gauge(SESSION_LEASES).set(float(len(self._owned)))
 
     def _note_epoch_locked(self, epoch: int) -> None:
         """Every epoch that leaves the table's sight (evicted, dropped,
@@ -298,16 +341,33 @@ class DeltaSessionTable:
         mid-apply leaves the chain half-mutated at an UNCHANGED epoch —
         the client's cumulative retry would pass the epoch check and
         re-apply onto a corrupted base, so the only safe outcome is
-        eviction (the client re-establishes with one full solve)."""
+        eviction (the client re-establishes with one full solve).  An
+        error-evicted session's spool RECORD dies with it: the last
+        committed epoch on disk is clean, but a poisoned chain's client
+        must re-establish from ground truth, not re-adopt and re-apply
+        onto state the server already failed to advance once.  A
+        ``lease_lost`` drop touches NO spool state — the record and lease
+        belong to the new owner now."""
         with self._lock:
             gone = self._sessions.pop(session_id, None)
             if gone is not None:
                 self._note_epoch_locked(gone.epoch)
                 self.registry.counter(DELTA_EVICTIONS).inc(
                     {"reason": reason})
+            self._owned.discard(session_id)
+            self._leases_gauge_locked()
             self._gauge_locked()
+        if gone is not None and reason == "error" and self.spool_dir:
+            snap.remove_record(self.spool_dir, session_id)
+            snap.release_lease(self.spool_dir, session_id, self.replica)
 
     def clear(self, reason: str = "stop") -> None:
+        """Evict everything.  The graceful-shutdown path (``stop``) also
+        RELEASES every owned lease — records stay on disk, so a sibling
+        (or the replacement replica) adopts each surviving session
+        instantly instead of waiting out the lease TTL.  The injected
+        ``fault`` wipe releases nothing: a real in-memory loss would
+        not."""
         with self._lock:
             n = len(self._sessions)
             for e in self._sessions.values():
@@ -316,12 +376,21 @@ class DeltaSessionTable:
             if n:
                 self.registry.counter(DELTA_EVICTIONS).inc(
                     {"reason": reason}, value=float(n))
+            owned = list(self._owned)
+            if reason == "stop":
+                self._owned.clear()
+            self._leases_gauge_locked()
             self._gauge_locked()
+        if reason == "stop" and self.spool_dir:
+            for sid in owned:
+                snap.release_lease(self.spool_dir, sid, self.replica)
 
-    # ---- durability (ISSUE 12: snapshot/restore, docs/RESILIENCE.md) ----
-    def snapshot(self, dir_path: str) -> dict:
-        """Write every quiescent session chain to the KT_SESSION_DIR
-        spool (epoch-atomic: write-temp + fsync + rename).
+    # ---- durability + fleet handoff (ISSUE 12/13, docs/RESILIENCE.md) ----
+    def snapshot(self, dir_path: Optional[str] = None) -> dict:
+        """Write every quiescent session chain to its own record file
+        under the KT_SESSION_DIR spool (epoch-atomic: write-temp + fsync
+        + rename per record), claiming/renewing this replica's ownership
+        lease on each.
 
         Needs NO scheduler lock, so the periodic write runs on a
         background thread and no serving path ever stalls behind pickle
@@ -336,31 +405,50 @@ class DeltaSessionTable:
           entry's bytes are done -> discarded (counted ``torn``): a step
           that STARTED during pickling flips ``in_step`` first, and one
           that started AND committed moved the epoch — either way the
-          possibly-inconsistent bytes are dropped.
+          possibly-inconsistent bytes are dropped;
+        - lease renewal refused (counted ``lease_lost``): a sibling stole
+          this session after our lease expired — the zombie-writer guard:
+          the chain is DROPPED, never served again here and never spooled
+          over the new owner's record.
 
         A skipped/torn session just costs its client one re-establish if
         the process dies before the next snapshot — the spool never
-        carries a half-applied chain.  Returns ``{"written": n,
-        "skipped": n}`` (skipped = in_step + torn).
+        carries a half-applied chain.  Records owned by this replica
+        whose sessions have since been evicted are swept (record removed,
+        lease released).  Returns ``{"written": n, "skipped": n}``.
 
-        Concurrent writers (the background periodic thread vs the
-        shutdown write) serialize on ``_spool_lock``: whoever starts
-        last captures last AND renames last, so a slow older capture can
-        never replace a newer spool."""
-        with self._spool_lock:
-            return self._snapshot_impl(dir_path)
+        Concurrent writers (the background periodic thread, the shutdown
+        write, adopt/own/handoff on the serving threads) serialize on
+        ``_spool_lock`` PER RECORD — each claim+write is one locked
+        section with a liveness + epoch re-check, so a slow older
+        capture can never replace a newer record while a serving-thread
+        adoption stalls behind at most one record's write, never a whole
+        table pass."""
+        dir_path = dir_path or self.spool_dir
+        if not dir_path:
+            return {}
+        # a table spools to ONE directory for its lifetime; learning it
+        # from the first explicit call keeps eviction/clear lease cleanup
+        # working for callers that pass the dir per call (tests, scripts)
+        self.spool_dir = self.spool_dir or dir_path
+        # _spool_lock is taken PER ENTRY inside (around each claim +
+        # write), never across the whole pass: adopt-on-miss and
+        # establishment ownership run on the SERVING threads, and a pass
+        # pickling KT_DELTA_SESSIONS chains must stall them by at most
+        # one record's claim+write, not the whole table's
+        return self._snapshot_impl(dir_path)
 
     def _snapshot_impl(self, dir_path: str) -> dict:
         t0 = time.perf_counter()
         with self._lock:
             live = list(self._sessions.values())
-        entries, skipped = [], 0
-        max_epoch = 0
+        skipped = self.registry.counter(SNAPSHOT_SKIPPED)
+        writes = self.registry.counter(SNAPSHOT_WRITES)
+        written, n_skipped, errored = 0, 0, False
         for e in live:
             if e.in_step:
-                skipped += 1
-                self.registry.counter(SNAPSHOT_SKIPPED).inc(
-                    {"reason": "in_step"})
+                n_skipped += 1
+                skipped.inc({"reason": "in_step"})
                 continue
             epoch0 = e.epoch
             try:
@@ -377,153 +465,441 @@ class DeltaSessionTable:
             except Exception:  # noqa: BLE001
                 blob = None
             if blob is None or e.in_step or e.epoch != epoch0:
-                skipped += 1
-                self.registry.counter(SNAPSHOT_SKIPPED).inc(
-                    {"reason": "torn"})
+                n_skipped += 1
+                skipped.inc({"reason": "torn"})
                 continue
-            max_epoch = max(max_epoch, int(e.catalog_epoch))
-            entries.append(blob)
-        writes = self.registry.counter(SNAPSHOT_WRITES)
-        if not entries:
-            if skipped == 0:
-                # genuinely no sessions: an OLD spool left on disk would
-                # resurrect long-evicted chains at the next restart —
-                # "no sessions" must persist as "no spool" (with skipped
-                # chains we keep the previous spool: those sessions are
-                # live and a crash should still restore their last
-                # committed epoch)
+            # the slow pickle above ran lock-free; the claim + write are
+            # one _spool_lock section so they can never interleave with
+            # a concurrent adopt/own/handoff of the SAME session — and a
+            # session that left the table while we pickled (drain
+            # handoff, eviction) is not re-spooled from its stale bytes
+            with self._spool_lock:
+                with self._lock:
+                    gone = e.session_id not in self._sessions
+                if gone:
+                    continue
+                if e.in_step or e.epoch != epoch0:
+                    # the chain moved while we pickled OR while we waited
+                    # for the spool lock (a concurrent pass/handoff may
+                    # have written a NEWER record) — these bytes must not
+                    # land
+                    n_skipped += 1
+                    skipped.inc({"reason": "torn"})
+                    continue
                 try:
-                    os.unlink(snap.spool_path(dir_path))
+                    snap.claim_lease(dir_path, e.session_id, self.replica,
+                                     self.clock.now(), self.lease_s)
+                except snap.LeaseHeld:
+                    # stolen after our lease expired (a wedged interval,
+                    # a paused container): the session belongs to its
+                    # adopter now — drop it, write NOTHING over their
+                    # record
+                    n_skipped += 1
+                    skipped.inc({"reason": "lease_lost"})
+                    self.drop(e.session_id, "lease_lost")
+                    continue
                 except OSError:
-                    pass
-                self.registry.gauge(SNAPSHOT_SESSIONS).set(0.0)
-            writes.inc({"outcome": "empty"})
-            return {"written": 0, "skipped": skipped}
-        try:
-            blob = snap.pack(entries, catalog_epoch=max_epoch)
-            # spool-byte adversary (snapshot_corrupt/_truncate): mangles
-            # AFTER the checksum is computed, so a restore must detect it
-            blob = self._faults.mangle("snapshot_write", blob)
-            snap.write_atomic(dir_path, blob)
-        # ktlint: allow[KT005] a failing snapshot must never take serving
-        # down; the previous spool survives and the outcome is counted
-        except Exception:  # noqa: BLE001
-            logger.warning("session snapshot write to %s failed",
-                           dir_path, exc_info=True)
+                    # a wedged lease MUTEX (a claimant died inside the
+                    # critical section; self-heals after the staleness
+                    # breaker) is an infrastructure failure, NOT a lost
+                    # lease — the session is KEPT and this pass simply
+                    # could not refresh its record
+                    logger.warning("lease mutex wedged for %s; record "
+                                   "not refreshed this pass",
+                                   e.session_id, exc_info=True)
+                    errored = True
+                    faults_mod.count_recovery(self.registry,
+                                              "snapshot_write", "failed")
+                    continue
+                try:
+                    rec = snap.pack([blob],
+                                    catalog_epoch=int(e.catalog_epoch))
+                    # spool-byte adversary (snapshot_corrupt/_truncate):
+                    # mangles AFTER the checksum — restore must detect it
+                    rec = self._faults.mangle("snapshot_write", rec)
+                    snap.write_record(dir_path, e.session_id, rec)
+                # ktlint: allow[KT005] a failing record write must never
+                # take serving down; the previous record survives,
+                # outcome counted
+                except Exception:  # noqa: BLE001
+                    logger.warning("session record write (%s) to %s "
+                                   "failed", e.session_id, dir_path,
+                                   exc_info=True)
+                    errored = True
+                    faults_mod.count_recovery(self.registry,
+                                              "snapshot_write", "failed")
+                    continue
+                written += 1
+                with self._lock:
+                    self._owned.add(e.session_id)
+                    self._leases_gauge_locked()
+        # sweep: owned records whose sessions are GONE (ttl/capacity/
+        # wipe-evicted between passes) must not outlive them — a stale
+        # record resurrected later is the divergence class restore-once
+        # exists to close.  Judged against the LIVE table under _lock,
+        # never the pass-start capture: a session established or adopted
+        # WHILE this pass pickled is live, and releasing its fresh lease
+        # would hand it back to whatever zombie incarnation own() just
+        # superseded.  Drain handoffs left _owned already, so a sibling's
+        # adopted record is never swept.
+        with self._lock:
+            stale = [sid for sid in self._owned
+                     if sid not in self._sessions]
+        for sid in stale:
+            snap.remove_record(dir_path, sid)
+            snap.release_lease(dir_path, sid, self.replica)
+            with self._lock:
+                self._owned.discard(sid)
+                self._leases_gauge_locked()
+        self._gc_orphans(dir_path)
+        if errored:
             writes.inc({"outcome": "error"})
-            faults_mod.count_recovery(self.registry, "snapshot_write",
-                                      "failed")
-            return {"written": 0, "skipped": skipped}
-        writes.inc({"outcome": "written"})
-        self.registry.gauge(SNAPSHOT_SESSIONS).set(float(len(entries)))
-        self.registry.histogram(SNAPSHOT_DURATION).observe(
-            time.perf_counter() - t0)
-        return {"written": len(entries), "skipped": skipped}
+        elif not written:
+            writes.inc({"outcome": "empty"})
+            if not n_skipped:
+                self.registry.gauge(SNAPSHOT_SESSIONS).set(0.0)
+        else:
+            writes.inc({"outcome": "written"})
+        if written:
+            self.registry.gauge(SNAPSHOT_SESSIONS).set(float(written))
+            self.registry.histogram(SNAPSHOT_DURATION).observe(
+                time.perf_counter() - t0)
+        return {"written": written, "skipped": n_skipped}
 
-    def restore(self, dir_path: str,
+    def _gc_orphans(self, dir_path: str) -> None:
+        """Expire ORPHANED records: a replica that died uncleanly and
+        whose clients never came back leaves records nobody will ever
+        adopt (boot restores stop at capacity, adoption is client-driven),
+        and a shared PVC must not grow without bound.  A record is
+        reaped when it is not ours, its bytes have not been refreshed
+        for the session idle TTL (a live sibling rewrites records every
+        snapshot pass, so a stale mtime means the writer is gone), AND
+        its lease is free or expired.  The session's client — if it ever
+        returns — pays the PR-10 one re-establish, exactly what TTL
+        eviction has always cost.  Disabled with the TTL (ttl_s=0)."""
+        if self.ttl_s <= 0:
+            return
+        now = self.clock.now()
+        for sid in snap.list_sessions(dir_path):
+            with self._lock:
+                if sid in self._owned or sid in self._sessions:
+                    continue
+            # the reap decision + removal are one _spool_lock section, so
+            # it fully serializes against an in-flight adoption of the
+            # same record (adopt holds the lock end to end); the checks
+            # re-run inside
+            with self._spool_lock:
+                age = snap.record_age_s(dir_path, sid)
+                if age is None or age <= max(self.ttl_s, self.lease_s):
+                    continue
+                lease = snap.lease_state(dir_path, sid)
+                if lease is not None \
+                        and float(lease.get("expires_at", 0.0)) > now:
+                    # ANY unexpired lease — a live sibling's, or our own
+                    # serving thread's in-flight adoption — is hands-off
+                    continue
+                snap.remove_record(dir_path, sid)
+                snap.release_lease(dir_path, sid,
+                                   str((lease or {}).get("owner", "")))
+            self.registry.counter(DELTA_EVICTIONS).inc({"reason": "ttl"})
+            logger.info("reaped orphaned session record %s (idle %.0fs)",
+                        sid, age)
+
+    def restore(self, dir_path: Optional[str] = None,
                 expected_catalog_epoch: Optional[int] = None) -> int:
-        """Rehydrate the table from the spool at startup.  Every refusal
-        (corrupt / truncated / version skew / stale catalog epoch) is a
-        counted COLD START — never a crash, never a diverged chain.
-        Returns the number of sessions restored."""
+        """Rehydrate the table from the spool at startup: scan the
+        session records and ADOPT each one whose lease this replica can
+        claim.  Sibling-owned sessions (unexpired foreign lease) are left
+        untouched — on a shared volume a boot-time restore must never
+        poach a live replica's chains.  Records past this table's
+        capacity are also left ON DISK with their leases unclaimed, so a
+        sibling can adopt what we cannot hold (the PR-12 whole-file spool
+        deleted capacity-evicted entries; on a shared spool that would
+        destroy a sibling's sessions).  Every envelope refusal (corrupt /
+        truncated / version skew / stale catalog epoch) is a counted COLD
+        START for that record only — never a crash, never a diverged
+        chain.  Returns the number of sessions restored."""
+        dir_path = dir_path or self.spool_dir
+        if dir_path:
+            self.spool_dir = self.spool_dir or dir_path
+        if expected_catalog_epoch is None:
+            expected_catalog_epoch = self.expected_catalog_epoch
         t0 = time.perf_counter()
+        sids = snap.list_sessions(dir_path) if dir_path else []
+        if not sids:
+            self.registry.counter(SNAPSHOT_RESTORE).inc(
+                {"outcome": "missing"})
+            return 0
+        restored = 0
+        for sid in sids:
+            if len(self) >= self.capacity:
+                # full: leave the remaining records (and their leases)
+                # for siblings — adoption respects capacity, it never
+                # adopt-then-evicts someone else's chain off the disk
+                break
+            if self._adopt_impl(dir_path, sid,
+                                expected_catalog_epoch) is not None:
+                restored += 1
+        if restored:
+            self.registry.counter(SNAPSHOT_RESTORE).inc(
+                {"outcome": "restored"})
+            self.registry.histogram(SNAPSHOT_DURATION).observe(
+                time.perf_counter() - t0)
+            logger.info("restored %d delta session(s) from %s", restored,
+                        dir_path)
+        return restored
+
+    def adopt(self, dir_path: Optional[str] = None,
+              session_id: str = "") -> Optional[SessionEntry]:
+        """On-demand single-session adoption — the fleet-failover path:
+        a session-routed RPC missing the table tries the shared spool
+        before answering ``session_unknown``, so the replica a client
+        re-homed to (replica death, graceful drain) serves the next delta
+        WARM.  Exactly-one-owner is the lease protocol's job: a free
+        lease is claimed, an expired one stolen (counted — the dead-
+        replica path), an unexpired foreign one refuses typed (counted
+        ``lease_held``; the caller answers unknown and the client pays
+        the PR-10 exactly-one re-establish).  Returns the live entry or
+        None."""
+        dir_path = dir_path or self.spool_dir
+        if not dir_path or not session_id:
+            return None
+        self.spool_dir = self.spool_dir or dir_path
+        with self._spool_lock:
+            return self._adopt_impl(dir_path, session_id,
+                                    self.expected_catalog_epoch)
+
+    def _adopt_impl(self, dir_path: str, session_id: str,
+                    expected_catalog_epoch: Optional[int] = None,
+                    ) -> Optional[SessionEntry]:
+        adoptions = self.registry.counter(SESSION_ADOPTIONS)
 
         def _count(outcome: str) -> None:
-            self.registry.counter(SNAPSHOT_RESTORE).inc(
-                {"outcome": outcome})
+            adoptions.inc({"outcome": outcome})
 
-        blob = snap.read(dir_path)
-        if blob is None:
+        if not snap.record_exists(dir_path, session_id):
+            # the COMMON miss (a genuinely unknown session — every
+            # session_unknown RPC retries this path) short-circuits to
+            # one stat: the lease claim's ~6 shared-volume file ops are
+            # only paid when there is actually a record to adopt.  The
+            # post-claim read below still guards the consumed-between
+            # race.
             _count("missing")
-            return 0
+            return None
+        if self._faults:
+            effect = self._faults.fire("adopt")
+            if effect is not None and effect.kind == "lease_steal":
+                # the contention adversary: a sibling claims the lease an
+                # instant before we do — our claim below must refuse
+                try:
+                    snap.claim_lease(dir_path, session_id,
+                                     "injected-contender",
+                                     self.clock.now(), effect.value)
+                except snap.LeaseHeld:
+                    pass  # someone (maybe us) already holds it — fine
         try:
-            raw_entries, _epoch = snap.unpack(
-                blob, expected_catalog_epoch=expected_catalog_epoch)
-            entries = [snap.unpack_entry(b) for b in raw_entries]
-            restored = 0
-            now = self.clock.now()
-            # a restarted process's auto-name counter starts at 0: advance
-            # it past every restored node index so a fresh proposal can
-            # never collide with (and silently cross-wire) a chain node
-            max_idx = -1
-            for d in entries:
-                prev = d.get("prev")
-                meta = getattr(prev, "_warmstart_meta", None)
-                names = [n.name for n in
-                         list(getattr(prev, "nodes", ()) or ())
-                         + list(getattr(prev, "existing_nodes", ()) or ())]
-                if meta is not None:
-                    names += [n.name for n in meta.nodes]
-                for nm in names:
-                    if nm.startswith("node-"):
-                        try:
-                            max_idx = max(max_idx, int(nm[5:]))
-                        except ValueError:
-                            pass
-            if max_idx >= 0:
-                advance_node_counter(max_idx)
-            with self._lock:
-                now += self._skew
-                for d in entries:
-                    entry = SessionEntry(
-                        session_id=d["session_id"], prev=d["prev"],
-                        epoch=int(d["epoch"]),
-                        catalog_epoch=int(d["catalog_epoch"]),
-                        provisioners=d["provisioners"],
-                        instance_types=d["instance_types"],
-                        daemonsets=tuple(d.get("daemonsets") or ()),
-                        unavailable=set(d.get("unavailable") or ()),
-                        last_used=now,
-                    )
-                    # the establishment floor clears every restored epoch:
-                    # a session re-established after a restore can never
-                    # advance back onto an epoch its old incarnation
-                    # reached (the epoch-collision divergence class)
-                    self._note_epoch_locked(entry.epoch)
-                    self._sessions[entry.session_id] = entry
-                    self._sessions.move_to_end(entry.session_id)
-                    restored += 1
-                evicted = 0
-                while len(self._sessions) > self.capacity:
-                    self._sessions.popitem(last=False)
-                    evicted += 1
-                    restored -= 1
-                if evicted:
-                    self.registry.counter(DELTA_EVICTIONS).inc(
-                        {"reason": "capacity"}, value=float(evicted))
-                self._gauge_locked()
-            # restore-once: the spool is CONSUMED — these chains mutate
-            # from here on, and a later crash that never wrote a fresh
-            # snapshot must cold-start rather than resurrect this now-
-            # doubly-stale file (the stale-spool divergence class)
-            try:
-                os.unlink(snap.spool_path(dir_path))
-            except OSError:
-                pass
-        except snap.SnapshotRefused as err:
-            logger.warning("session snapshot refused; serving cold: %s",
-                           err)
-            _count(err.reason)
-            faults_mod.count_recovery(self.registry, "snapshot_read",
-                                      "cold")
-            self.clear("stop")  # drop any partially-restored entries
-            return 0
-        # ktlint: allow[KT005] an unexpectedly-shaped spool is the same
-        # outcome as a corrupt one: counted cold start, never a crash
-        except Exception:  # noqa: BLE001
-            logger.warning("session snapshot restore from %s failed; "
-                           "serving cold", dir_path, exc_info=True)
+            how = snap.claim_lease(dir_path, session_id, self.replica,
+                                   self.clock.now(), self.lease_s)
+        except snap.LeaseHeld as held:
+            logger.info("session %s not adopted: lease held by %s",
+                        session_id, held.owner)
+            _count("lease_held")
+            return None
+        except OSError:
+            # wedged lease mutex: typed cold outcome (the client pays
+            # the one re-establish), never an untyped dispatcher error
+            logger.warning("lease mutex wedged adopting %s; serving "
+                           "cold", session_id, exc_info=True)
             _count("error")
             faults_mod.count_recovery(self.registry, "snapshot_read",
                                       "cold")
-            self.clear("stop")
-            return 0
-        _count("restored")
-        self.registry.histogram(SNAPSHOT_DURATION).observe(
-            time.perf_counter() - t0)
-        logger.info("restored %d delta session(s) from %s", restored,
-                    dir_path)
-        return restored
+            return None
+        try:
+            blob = snap.read_record(dir_path, session_id)
+            if blob is None:
+                _count("missing")
+                if how != "renewed":
+                    snap.release_lease(dir_path, session_id, self.replica)
+                return None
+            raw_entries, _epoch = snap.unpack(
+                blob, expected_catalog_epoch=expected_catalog_epoch)
+            d = snap.unpack_entry(raw_entries[0])
+            # a restarted process's auto-name counter starts at 0: advance
+            # it past every adopted node index so a fresh proposal can
+            # never collide with (and silently cross-wire) a chain node
+            prev = d.get("prev")
+            meta = getattr(prev, "_warmstart_meta", None)
+            names = [n.name for n in
+                     list(getattr(prev, "nodes", ()) or ())
+                     + list(getattr(prev, "existing_nodes", ()) or ())]
+            if meta is not None:
+                names += [n.name for n in meta.nodes]
+            max_idx = -1
+            for nm in names:
+                if nm.startswith("node-"):
+                    try:
+                        max_idx = max(max_idx, int(nm[5:]))
+                    except ValueError:
+                        pass
+            if max_idx >= 0:
+                advance_node_counter(max_idx)
+            now = self.clock.now()
+            entry = SessionEntry(
+                session_id=d["session_id"], prev=d["prev"],
+                epoch=int(d["epoch"]),
+                catalog_epoch=int(d["catalog_epoch"]),
+                provisioners=d["provisioners"],
+                instance_types=d["instance_types"],
+                daemonsets=tuple(d.get("daemonsets") or ()),
+                unavailable=set(d.get("unavailable") or ()),
+            )
+            with self._lock:
+                entry.last_used = now + self._skew
+                # the establishment floor clears every adopted epoch: a
+                # session re-established after adoption can never advance
+                # back onto an epoch its old incarnation reached (the
+                # epoch-collision divergence class)
+                self._note_epoch_locked(entry.epoch)
+                self._sessions[entry.session_id] = entry
+                self._sessions.move_to_end(entry.session_id)
+                self._owned.add(entry.session_id)
+                evicted = 0
+                while len(self._sessions) > self.capacity:
+                    _sid, old = self._sessions.popitem(last=False)
+                    self._note_epoch_locked(old.epoch)
+                    evicted += 1
+                if evicted:
+                    self.registry.counter(DELTA_EVICTIONS).inc(
+                        {"reason": "capacity"}, value=float(evicted))
+                self._leases_gauge_locked()
+                self._gauge_locked()
+            # adopt-once: the record is CONSUMED — the chain mutates from
+            # here on, and a later crash that never wrote a fresh record
+            # must cold-start rather than resurrect this now-stale file
+            # (the stale-spool divergence class); our periodic snapshot
+            # re-creates it at the next committed epoch
+            snap.remove_record(dir_path, session_id)
+            _count("stolen" if how == "stolen" else "adopted")
+            return entry
+        except snap.SnapshotRefused as err:
+            logger.warning("session record %s refused; serving cold: %s",
+                           session_id, err)
+            self.registry.counter(SNAPSHOT_RESTORE).inc(
+                {"outcome": err.reason})
+            _count("refused")
+            faults_mod.count_recovery(self.registry, "snapshot_read",
+                                      "cold")
+            if how != "renewed":
+                snap.release_lease(dir_path, session_id, self.replica)
+            return None
+        # ktlint: allow[KT005] an unexpectedly-shaped record is the same
+        # outcome as a corrupt one: counted cold start, never a crash
+        except Exception:  # noqa: BLE001
+            logger.warning("session record %s adoption failed; serving "
+                           "cold", session_id, exc_info=True)
+            self.registry.counter(SNAPSHOT_RESTORE).inc(
+                {"outcome": "error"})
+            _count("error")
+            faults_mod.count_recovery(self.registry, "snapshot_read",
+                                      "cold")
+            if how != "renewed":
+                snap.release_lease(dir_path, session_id, self.replica)
+            return None
+
+    def handoff(self, session_id: str,
+                dir_path: Optional[str] = None) -> bool:
+        """Graceful-drain handoff of ONE session: spool its record at the
+        current (committed) epoch, RELEASE the lease so any sibling
+        adopts instantly, and drop the entry (evicted ``drain``) so this
+        replica can never serve another epoch of a chain it just gave
+        away.  The client saw ``session_state="draining"`` on the same
+        reply and re-homes; the adopting replica restores the record and
+        serves its next delta WARM.  Returns True when the chain was
+        handed off."""
+        dir_path = dir_path or self.spool_dir
+        if not dir_path:
+            return False
+        with self._spool_lock:
+            with self._lock:
+                e = self._sessions.get(session_id)
+                if e is None or e.in_step:
+                    return False
+                blob_src = dict(
+                    session_id=e.session_id, prev=e.prev,
+                    epoch=int(e.epoch),
+                    catalog_epoch=int(e.catalog_epoch),
+                    provisioners=list(e.provisioners),
+                    instance_types=list(e.instance_types),
+                    daemonsets=list(e.daemonsets),
+                    unavailable=set(e.unavailable))
+                catalog_epoch = int(e.catalog_epoch)
+            try:
+                snap.claim_lease(dir_path, session_id, self.replica,
+                                 self.clock.now(), self.lease_s)
+                rec = snap.pack([snap.pack_entry(blob_src)],
+                                catalog_epoch=catalog_epoch)
+                rec = self._faults.mangle("snapshot_write", rec)
+                snap.write_record(dir_path, session_id, rec)
+            except snap.LeaseHeld:
+                # a sibling already owns it (stolen while we were
+                # wedged): drop without touching their spool state
+                self.drop(session_id, "lease_lost")
+                faults_mod.count_recovery(self.registry, "snapshot_write",
+                                          "skipped")
+                return False
+            # ktlint: allow[KT005] a failing handoff write degrades to the
+            # stop()-path snapshot (the session stays until shutdown);
+            # counted so a drain that cannot spool is visible
+            except Exception:  # noqa: BLE001
+                logger.warning("drain handoff of %s failed; session kept "
+                               "for the shutdown snapshot", session_id,
+                               exc_info=True)
+                faults_mod.count_recovery(self.registry, "snapshot_write",
+                                          "failed")
+                return False
+            snap.release_lease(dir_path, session_id, self.replica)
+            with self._lock:
+                gone = self._sessions.pop(session_id, None)
+                if gone is not None:
+                    self._note_epoch_locked(gone.epoch)
+                    self.registry.counter(DELTA_EVICTIONS).inc(
+                        {"reason": "drain"})
+                self._owned.discard(session_id)
+                self._leases_gauge_locked()
+                self._gauge_locked()
+            return True
+
+    def own(self, session_id: str,
+            dir_path: Optional[str] = None) -> None:
+        """Take spool ownership of a just-ESTABLISHED session: force-claim
+        the lease (the client re-established HERE, so any incarnation a
+        sibling's lease guarded is obsolete by the client's own
+        authority) and discard the obsolete record.  Without this, a
+        session re-established away from its lease holder livelocks:
+        the holder renews forever over a zombie entry while the serving
+        replica's every snapshot drops the live chain as lease-lost."""
+        dir_path = dir_path or self.spool_dir
+        if not dir_path:
+            return
+        with self._spool_lock:
+            try:
+                snap.claim_lease(dir_path, session_id, self.replica,
+                                 self.clock.now(), self.lease_s,
+                                 force=True)
+            # ktlint: allow[KT005] a lost claim race or I/O failure just
+            # defers ownership to the next snapshot pass; serving goes on
+            except Exception:  # noqa: BLE001
+                logger.warning("could not take spool ownership of %s",
+                               session_id, exc_info=True)
+                return
+            snap.remove_record(dir_path, session_id)
+            with self._lock:
+                self._owned.add(session_id)
+                self._leases_gauge_locked()
+
+    def leases_owned(self) -> int:
+        with self._lock:
+            return len(self._owned)
 
 
 def zero_init_metrics(registry: Registry) -> None:
@@ -560,6 +936,15 @@ def zero_init_metrics(registry: Registry) -> None:
     if not sg.has():
         sg.set(0)
     registry.histogram(SNAPSHOT_DURATION)
+    # fleet-handoff families (ISSUE 13): the first adoption/steal of a
+    # replica's life must survive rate()
+    adoptions = registry.counter(SESSION_ADOPTIONS)
+    for outcome in SESSION_ADOPTION_OUTCOMES:
+        if not adoptions.has({"outcome": outcome}):
+            adoptions.inc({"outcome": outcome}, value=0.0)
+    lg = registry.gauge(SESSION_LEASES)
+    if not lg.has():
+        lg.set(0)
     # recovery-outcome population (KT016's funnel is live in production —
     # organic faults count too, so the series must exist from birth)
     faults_mod.zero_init_recovery(registry)

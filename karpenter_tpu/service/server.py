@@ -246,25 +246,34 @@ class SolvePipeline:
             self.registry,
             flight=getattr(getattr(scheduler, "tracer", None),
                            "flight", None))
-        self._delta_tab: Optional[DeltaSessionTable] = (
-            DeltaSessionTable(registry=self.registry, clock=self._clock,
-                              faults=self._faults)
-            if delta_enabled() else None)
-        # session durability (ISSUE 12): with KT_SESSION_DIR set, chains
-        # spool to disk on graceful shutdown and periodically at epoch
-        # boundaries (KT_SESSION_SNAPSHOT_S), and a restarted replica
-        # rehydrates here — every surviving session's next delta is served
-        # WARM instead of costing a re-establishing full solve.  A refused
-        # spool (corrupt/version/catalog skew) is a counted cold start.
+        # session durability (ISSUE 12) + fleet handoff (ISSUE 13): with
+        # KT_SESSION_DIR set, every session spools to its own record file
+        # (graceful shutdown, drain handoff, and periodically at epoch
+        # boundaries — KT_SESSION_SNAPSHOT_S), and any replica sharing the
+        # volume rehydrates a session on demand (boot restore + adopt-on-
+        # miss) under the exactly-one-owner lease protocol — a failed-over
+        # session's next delta is served WARM by whichever replica the
+        # client re-homes to.  A refused record (corrupt/version/catalog
+        # skew) is a counted cold start.
         self._spool_dir = os.environ.get("KT_SESSION_DIR", "")
         if self._spool_dir:
-            # one spool PER PIPELINE: the service lazily builds a pipeline
-            # per requested backend, and two tables sharing one spool file
-            # would clobber each other's sessions at every write — the
-            # last pipeline to stop would be the only one whose clients
-            # resume warm.  Namespace by the scheduler's backend.
+            # records are namespaced PER BACKEND under the shared dir: the
+            # service lazily builds a pipeline per requested backend, and
+            # an auto-backend replica must never adopt (or clobber) an
+            # oracle-backend chain — same-backend SIBLING replicas share
+            # the namespace deliberately; the lease protocol arbitrates.
             self._spool_dir = os.path.join(
                 self._spool_dir, getattr(scheduler, "backend", "") or "auto")
+        self._delta_tab: Optional[DeltaSessionTable] = (
+            DeltaSessionTable(registry=self.registry, clock=self._clock,
+                              faults=self._faults,
+                              spool_dir=self._spool_dir)
+            if delta_enabled() else None)
+        #: graceful-drain latch (SIGTERM / SolverService.drain): new
+        #: session establishments are refused with a DRAINING hint, and
+        #: every served delta hands its chain off to the shared spool so
+        #: the client's next RPC lands warm on a sibling
+        self._draining = False
         self._snap_interval = float(
             os.environ.get("KT_SESSION_SNAPSHOT_S", "30"))
         self._last_snap = self._clock.now()   # guarded-by: _sched_lock
@@ -412,6 +421,23 @@ class SolvePipeline:
             # replacement (counted so a restart storm is visible as
             # eviction reason "stop", not mystery unknowns)
             self._delta_tab.clear("stop")
+
+    def drain(self) -> None:
+        """Enter graceful-drain mode (the fleet handshake, docs/
+        RESILIENCE.md): from here on NEW session establishments are
+        refused with a ``session_state="draining"`` hint, every served
+        delta hands its chain off to the shared spool (record + released
+        lease + dropped entry) on the same reply, and an immediate
+        snapshot pass spools every quiescent chain so sessions that never
+        send another delta before the pod dies are already adoptable.
+        Serving continues — classic full solves and in-flight session
+        chains are unaffected until their handoff."""
+        self._draining = True
+        if self._delta_tab is not None and self._spool_dir:
+            self._delta_tab.snapshot(self._spool_dir)
+
+    def draining(self) -> bool:
+        return self._draining
 
     def snapshot_sessions(self) -> dict:
         """Spool every quiescent session chain (graceful-shutdown path:
@@ -768,6 +794,13 @@ class SolvePipeline:
             return reply, outcome
 
         if not info["delta"]:
+            if self._draining and tab is not None:
+                # graceful drain: this replica admits NO new (or re-
+                # establishing) sessions — the DRAINING hint sends the
+                # client to a sibling, which establishes there instead of
+                # binding a chain to a pod about to die
+                return _counted(DeltaReply(state="draining", full=False),
+                                "drain_refused")
             # establish (or re-establish): ONE classic full solve, and the
             # result becomes the session's chain base
             result = self.scheduler.solve(
@@ -797,10 +830,26 @@ class SolvePipeline:
                 daemonsets=kwargs.get("daemonsets") or (),
                 unavailable=set(kwargs.get("unavailable") or ()),
             ))
+            if self._spool_dir:
+                # take spool ownership NOW (force-claim): the client's
+                # establishment supersedes any incarnation a sibling's
+                # lease still guards — without this a session re-homed by
+                # a routing flap livelocks between the stale lease holder
+                # and the replica actually serving it
+                tab.own(sid, self._spool_dir)
             return _counted(_full_reply(result, epoch0, "establish"),
                             "establish")
         # ---- incremental step -------------------------------------------
         entry = tab.get(sid) if tab is not None else None
+        if entry is None and tab is not None and self._spool_dir:
+            # fleet failover (docs/RESILIENCE.md): the chain may be
+            # waiting in the shared spool — a dead or drained sibling
+            # spooled it, the client re-homed here, and adoption (lease
+            # claim + record consume) serves this very delta WARM.  Every
+            # adoption outcome is counted; an unexpired sibling lease
+            # refuses typed and the client pays the PR-10 exactly-one
+            # re-establish instead.
+            entry = tab.adopt(self._spool_dir, sid)
         if entry is None or entry.epoch != info["base_epoch"]:
             # evicted / never established / epoch mismatch after a lost
             # response: the only safe answer is "re-establish" — applying
@@ -815,9 +864,19 @@ class SolvePipeline:
             return _counted(DeltaReply(state="unknown", full=False),
                             "session_unknown")
         try:
-            return self._apply_delta_step(
+            reply, outcome = self._apply_delta_step(
                 entry, info, pods, provisioners, instance_types,
                 kwargs, reseed, trace, _counted)
+            if self._draining and reply.state == "ok":
+                # drain handshake: the step was served (warm, committed),
+                # its chain is handed off to the shared spool (record at
+                # the acked epoch, lease RELEASED, entry dropped), and
+                # the reply carries the DRAINING hint so the client
+                # re-homes before this pod dies — the adopting sibling
+                # serves the session's next delta warm
+                tab.handoff(sid, self._spool_dir)
+                reply.state = "draining"
+            return reply, outcome
         # ktlint: allow[KT005] re-raised after eviction: the RPC thread
         # gets the real error, the poisoned chain never serves again
         except BaseException:
@@ -1112,6 +1171,16 @@ class SolverService:
                 self._pipelines[id(sched)] = pipe
             return pipe
 
+    def drain(self) -> None:
+        """Graceful-drain every pipeline (the serve SIGTERM handshake):
+        new sessions are refused with the DRAINING hint, served deltas
+        hand their chains to the shared spool, clients re-home to
+        siblings — call :meth:`close` after the drain window to stop."""
+        with self._direct_lock:
+            pipes = list(self._pipelines.values())
+        for pipe in pipes:
+            pipe.drain()
+
     def close(self) -> None:
         # latch closed + snapshot under the lock (a late first RPC racing
         # shutdown must neither resize the dict mid-iteration nor construct
@@ -1394,27 +1463,40 @@ def main(argv=None) -> int:
         _obs_server, obs_port = obs_serve(
             service.registry, flight, port=args.obs_port, host=obs_host)
         print(f"observability on http://{obs_host}:{obs_port}/tracez")
-    # graceful shutdown (ISSUE 12, docs/RESILIENCE.md): SIGTERM — the
+    # graceful shutdown (ISSUE 12/13, docs/RESILIENCE.md): SIGTERM — the
     # kubelet's pod-termination signal, reinforced by deploy/solver.yaml's
-    # preStop sleep so in-flight RPCs drain inside the grace window — and
-    # Ctrl-C both land here: stop accepting RPCs, then close the service,
-    # which spools every live session chain to KT_SESSION_DIR before the
-    # table clears.  The replacement replica restores the spool and serves
-    # every surviving session's next delta WARM.
+    # preStop sleep — first enters the DRAIN handshake: new sessions are
+    # refused with a session_state="draining" hint, every served delta
+    # hands its chain to the KT_SESSION_DIR spool (lease released) on the
+    # same reply, and clients proactively re-home to sibling replicas.
+    # After KT_DRAIN_GRACE_S (or a second signal) the service stops, which
+    # spools any remaining chains and releases their leases — whichever
+    # replica each client lands on serves its next delta WARM.
     stop_ev = threading.Event()
+    drain_ev = threading.Event()
+    drain_grace = float(os.environ.get("KT_DRAIN_GRACE_S", "2"))
 
     def _graceful(signum, _frame):
-        print(f"signal {signum}: draining RPCs + snapshotting delta "
-              "sessions", flush=True)
-        stop_ev.set()
+        if not drain_ev.is_set():
+            print(f"signal {signum}: draining — new sessions refused, "
+                  f"chains handed to the session spool; exiting in "
+                  f"{drain_grace:g}s (signal again to exit now)",
+                  flush=True)
+            drain_ev.set()
+        else:
+            stop_ev.set()
 
     signal.signal(signal.SIGTERM, _graceful)
     signal.signal(signal.SIGINT, _graceful)
     try:
-        while not stop_ev.wait(timeout=3600):
+        while not drain_ev.wait(timeout=3600):
             pass
+        service.drain()
+        stop_ev.wait(timeout=drain_grace)
     except KeyboardInterrupt:
         pass
+    print("drain window closed: snapshotting remaining delta sessions",
+          flush=True)
     server.stop(grace=2.0)
     service.close()
     for sched in service._schedulers.values():

@@ -1,25 +1,29 @@
-"""Session-table snapshot spool — versioned, checksummed serialization of
-live delta chains (ISSUE 12 tentpole, docs/RESILIENCE.md).
+"""Session spool — versioned, checksummed, SESSION-ADDRESSABLE storage of
+live delta chains, plus the ownership-lease API that makes it multi-writer
+safe (ISSUE 12 tentpole; fleet handoff reworked in ISSUE 13 —
+docs/RESILIENCE.md).
 
 PR 10 made steady-state serving session-stateful; a replica restart then
 destroys every ``_warmstart_meta`` chain and costs one full re-establishing
-solve PER CLIENT.  This module is the durability half of the fix: the
-``DeltaSessionTable`` serializes its chains to a spool file under
-``KT_SESSION_DIR`` (the jit-cache PVC precedent — mount the same pod-local
-or shared volume) on graceful shutdown and periodically at epoch
-boundaries, and a restarted replica rehydrates the table so every
-surviving session's next delta is served WARM.
+solve PER CLIENT.  PR 12 spooled the whole table to one file so a replica
+RESTART resumes warm; this revision makes the spool the FLEET's handoff
+medium: each session is its own record file under
+``KT_SESSION_DIR/<backend>/sessions/``, guarded by a lease file under
+``.../leases/``, so ANY replica sharing the volume (a shared PVC) can
+restore a specific session on demand (``DeltaSessionTable.adopt``) — not
+just its own table at boot — while the lease protocol guarantees two
+replicas can never both adopt one chain.
 
-File layout (one file, ``sessions.snap``)::
+Record layout (one file per session, ``sessions/<sid>.snap``)::
 
     MAGIC(8) | version(>I) | payload_len(>Q) | sha256(payload)(32) | payload
 
 ``payload`` is a pickle of ``{"schema": ..., "catalog_epoch": ...,
-"entries": [...]}`` — pickle is the right tool here because the spool is
-written and read by the SAME binary (the chain carries numpy residual
-matrices and the full SimNode graph, and pickle preserves the node-object
-identity sharing between ``result.nodes`` and ``meta.nodes`` that the
-warm-start tiers rely on).  What makes it safe is the envelope:
+"entries": [one entry blob]}`` — pickle is the right tool here because the
+spool is written and read by the SAME binary (the chain carries numpy
+residual matrices and the full SimNode graph, and pickle preserves the
+node-object identity sharing between ``result.nodes`` and ``meta.nodes``
+that the warm-start tiers rely on).  What makes it safe is the envelope:
 
 - **Atomic**: write-temp + fsync + rename — a SIGKILL mid-write leaves
   the previous spool intact, never a torn file.
@@ -40,24 +44,100 @@ warm-start tiers rely on).  What makes it safe is the envelope:
 Every refusal is a COLD START plus a counted reason
 (``karpenter_solver_session_snapshot_restore_total{outcome}``), never a
 crash and never a diverged chain.
+
+The lease protocol (``leases/<sid>.lease``, JSON ``{owner, expires_at}``):
+
+- **Claim** (:func:`claim_lease`) — an ``O_CREAT|O_EXCL`` create: exactly
+  one creator wins on a shared POSIX volume.  Claiming your OWN lease
+  renews it (write-temp + rename, safe because you own it).
+- **Refusal** — an unexpired lease held by another owner raises the typed
+  :class:`LeaseHeld`; the caller counts it and answers the client
+  ``session_unknown`` (one re-establish, the PR-10 floor) instead of
+  splitting the chain's ownership.
+- **Steal after expiry** — an EXPIRED foreign lease is stolen by renaming
+  it to a per-claimant tombstone (two concurrent stealers race the
+  rename; exactly one wins, the loser re-reads and refuses) and then
+  re-claimed with the same exclusive create.  A live owner renews on
+  every record write, so only a dead (or wedged-past-TTL) replica's
+  sessions are stealable — the failover-warmness window IS the lease TTL
+  (``KT_SESSION_LEASE_S``).
+
+Ownership is verified on every record write: a zombie replica whose lease
+was stolen gets :class:`LeaseHeld` back from its renewal and must DROP the
+chain (counted ``lease_lost``) — it can neither serve another epoch of it
+nor clobber the adopter's newer record.
+
+ktlint **KT017** pins this file (plus the ``DeltaSessionTable`` facade in
+``service/delta.py``) as the ONLY place in ``service/`` allowed to touch
+the record/lease primitives — a drive-by ``open()`` of a spool path from
+the server or client layer would bypass the exactly-one-owner protocol.
 """
 
 from __future__ import annotations
 
 import hashlib
 import io
+import json
 import os
 import pickle
 import struct
-from typing import Optional, Tuple
+import time as _time
+from typing import Dict, List, Optional, Tuple
 
 MAGIC = b"KTSESS1\n"
 #: bump when the envelope layout changes (the schema fingerprint below
 #: covers chain-SHAPE drift automatically)
 SNAPSHOT_VERSION = 1
 _HEADER = struct.Struct(">IQ")  # version, payload length
-#: spool file name under KT_SESSION_DIR
+#: legacy PR-12 whole-table spool file name (reworked to per-session
+#: records in ISSUE 13; the name survives for the tombstone check below)
 SPOOL_NAME = "sessions.snap"
+#: per-session record files live here, one ``<sid>.snap`` each
+SESSIONS_SUBDIR = "sessions"
+#: per-session ownership leases live here, one ``<sid>.lease`` each
+LEASES_SUBDIR = "leases"
+RECORD_SUFFIX = ".snap"
+LEASE_SUFFIX = ".lease"
+#: default ownership-lease TTL, seconds (KT_SESSION_LEASE_S).  A dead
+#: replica's sessions become stealable this long after its last record
+#: write — the fleet's failover-warmness window.  Graceful paths (drain,
+#: SIGTERM shutdown) RELEASE leases so adoption is instant.
+DEFAULT_LEASE_S = 10.0
+
+_REPLICA_ID: Optional[str] = None
+
+
+def replica_id() -> str:
+    """This process's stable spool-owner identity: ``KT_REPLICA_ID`` (the
+    deploy sets the pod name) or a generated ``<host>-<pid>-<rand>``.
+    Cached per process, so a restarted in-process service (tests, the
+    single-replica topology) self-renews its own leases and resumes warm
+    without waiting out the TTL."""
+    global _REPLICA_ID
+    env = os.environ.get("KT_REPLICA_ID", "")
+    if env:
+        return env
+    if _REPLICA_ID is None:
+        import socket
+        import uuid
+
+        _REPLICA_ID = (f"{socket.gethostname()}-{os.getpid()}-"
+                       f"{uuid.uuid4().hex[:8]}")
+    return _REPLICA_ID
+
+
+class LeaseHeld(Exception):
+    """Typed adoption refusal: another replica holds an UNEXPIRED lease on
+    this session — exactly one owner per chain, by construction."""
+
+    def __init__(self, session_id: str, owner: str,
+                 expires_at: float) -> None:
+        super().__init__(
+            f"session {session_id!r} lease held by {owner!r} "
+            f"until {expires_at:.3f}")
+        self.session_id = session_id
+        self.owner = owner
+        self.expires_at = expires_at
 
 
 class SnapshotRefused(Exception):
@@ -166,30 +246,297 @@ def unpack(blob: bytes,
     return list(doc["entries"]), epoch
 
 
-def write_atomic(dir_path: str, blob: bytes) -> str:
-    """write-temp + fsync + rename: the spool is either the complete new
-    snapshot or the complete previous one — never a torn file.  The temp
-    lives in the SAME directory so the rename is atomic on one mount,
-    and carries a per-writer suffix so a background periodic write and a
-    shutdown write can never interleave inside one temp file."""
+def _atomic_write(path: str, blob: bytes) -> str:
+    """The one atomic file-install primitive every spool write rides:
+    write-temp + fsync + rename.  The temp lives in the SAME directory
+    so the rename is atomic on one mount, and carries a per-writer
+    (pid + thread) suffix so concurrent writers can never interleave
+    inside one temp file."""
     import threading
 
-    os.makedirs(dir_path, exist_ok=True)
-    final = spool_path(dir_path)
-    tmp = f"{final}.tmp.{os.getpid()}.{threading.get_ident()}"
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "wb") as fh:
         fh.write(blob)
         fh.flush()
         os.fsync(fh.fileno())
-    os.replace(tmp, final)
-    return final
+    os.replace(tmp, path)
+    return path
+
+
+def write_atomic(dir_path: str, blob: bytes) -> str:
+    """Legacy whole-table spool write: either the complete new snapshot
+    or the complete previous one — never a torn file."""
+    os.makedirs(dir_path, exist_ok=True)
+    return _atomic_write(spool_path(dir_path), blob)
 
 
 def read(dir_path: str) -> Optional[bytes]:
-    """The spool's bytes, or None when no snapshot exists (plain cold
-    start, counted 'missing')."""
+    """The legacy whole-table spool's bytes, or None when no snapshot
+    exists (plain cold start, counted 'missing')."""
     try:
         with open(spool_path(dir_path), "rb") as fh:
             return fh.read()
     except FileNotFoundError:
         return None
+
+
+# ---------------------------------------------------------------------------
+# session-addressable records (ISSUE 13: the fleet's shared-spool layout)
+# ---------------------------------------------------------------------------
+
+def _safe_name(session_id: str) -> str:
+    """Filesystem-safe encoding of a session id.  Ids are uuid hex in
+    production, but the spool must not trust the wire: anything outside
+    ASCII [A-Za-z0-9._-] is escaped as fixed-width per-UTF-8-byte
+    ``%xx`` (collision-free — '%' itself escapes, and fixed width keeps
+    the decoding unambiguous so two distinct hostile ids can never
+    collide onto one record/lease file), so an id can neither traverse
+    out of the spool directory nor alias another session's files."""
+    out = []
+    for ch in session_id:
+        if ch.isascii() and (ch.isalnum() or ch in "._-"):
+            out.append(ch)
+        else:
+            out.extend(f"%{b:02x}" for b in ch.encode("utf-8"))
+    return "".join(out) or "%00"
+
+
+def _unsafe_name(encoded: str) -> str:
+    """Inverse of :func:`_safe_name` (record filename -> session id)."""
+    buf = bytearray()
+    i = 0
+    while i < len(encoded):
+        if encoded[i] == "%" and i + 3 <= len(encoded):
+            try:
+                buf.append(int(encoded[i + 1:i + 3], 16))
+                i += 3
+                continue
+            except ValueError:
+                pass
+        buf.extend(encoded[i].encode("utf-8"))
+        i += 1
+    return buf.decode("utf-8", errors="replace")
+
+
+def session_path(dir_path: str, session_id: str) -> str:
+    return os.path.join(dir_path, SESSIONS_SUBDIR,
+                        _safe_name(session_id) + RECORD_SUFFIX)
+
+
+def lease_path(dir_path: str, session_id: str) -> str:
+    return os.path.join(dir_path, LEASES_SUBDIR,
+                        _safe_name(session_id) + LEASE_SUFFIX)
+
+
+def list_sessions(dir_path: str) -> List[str]:
+    """Session ids with a record under the spool (encoded filenames
+    decoded back), oldest record first so boot-time adoption under a
+    capacity bound keeps the fleet's most senior chains deterministic."""
+    sess_dir = os.path.join(dir_path, SESSIONS_SUBDIR)
+    entries = []
+    try:
+        listing = list(os.scandir(sess_dir))
+    except FileNotFoundError:
+        return []
+    for e in listing:
+        if not e.name.endswith(RECORD_SUFFIX):
+            continue
+        try:
+            # per-entry: a sibling consuming (unlinking) ONE record
+            # mid-scan must not blank the whole listing — the shared
+            # spool is contended by design
+            if e.is_file():
+                entries.append((e.stat().st_mtime, e.name))
+        except FileNotFoundError:
+            continue
+    return [_unsafe_name(name[:-len(RECORD_SUFFIX)])
+            for _mtime, name in sorted(entries)]
+
+
+def write_record(dir_path: str, session_id: str, blob: bytes) -> str:
+    """One session's framed record (from :func:`pack`), installed
+    atomically."""
+    final = session_path(dir_path, session_id)
+    os.makedirs(os.path.dirname(final), exist_ok=True)
+    return _atomic_write(final, blob)
+
+
+def record_exists(dir_path: str, session_id: str) -> bool:
+    """Cheap existence probe — the adopt-on-miss fast path checks this
+    BEFORE paying the lease-claim file ops, since the common miss (a
+    genuinely unknown session) has no record at all."""
+    return os.path.exists(session_path(dir_path, session_id))
+
+
+def record_age_s(dir_path: str, session_id: str) -> Optional[float]:
+    """Seconds since the record's bytes were last refreshed (wall clock —
+    a live owner rewrites its records every snapshot pass, so a large
+    age means the writer is gone), or None when the record is absent."""
+    try:
+        mtime = os.stat(session_path(dir_path, session_id)).st_mtime
+    except OSError:
+        return None
+    # ktlint: allow[KT002] cross-process spool freshness is wall-clock
+    # infrastructure, like the lease-mutex staleness breaker
+    return max(0.0, _time.time() - mtime)
+
+
+def read_record(dir_path: str, session_id: str) -> Optional[bytes]:
+    try:
+        with open(session_path(dir_path, session_id), "rb") as fh:
+            return fh.read()
+    except FileNotFoundError:
+        return None
+
+
+def remove_record(dir_path: str, session_id: str) -> None:
+    try:
+        os.unlink(session_path(dir_path, session_id))
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the ownership-lease API (exactly one adopter per chain)
+# ---------------------------------------------------------------------------
+
+def _read_lease(path: str) -> Optional[Dict]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.loads(fh.read())
+        if isinstance(doc, dict) and "owner" in doc:
+            return doc
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+#: how long a claim-mutex directory may exist before it is presumed
+#: abandoned (a claimant died INSIDE the microseconds-long critical
+#: section) and broken by the next claimant.  Generous on purpose: the
+#: mkdir mtime is stamped by the STORAGE server on a shared volume, and
+#: the margin must swallow realistic client/server clock skew — a
+#: breaker that fires on a fresh mutex would let two claimants run the
+#: read-decide-write concurrently.  A genuinely wedged mutex only delays
+#: adoption (typed refusal -> one client re-establish), never serving.
+_MUTEX_STALE_S = 30.0
+
+
+class _LeaseMutex:
+    """Per-lease critical section: an ``os.mkdir`` of ``<lease>.lock`` —
+    atomic on a shared POSIX volume, exactly one winner — serializes
+    every lease MUTATION (claim / renew / steal / release).  This is what
+    makes the protocol's read-decide-write sequences actually atomic:
+    rename-based steal schemes can yank a fresh lease a faster claimant
+    just installed (observed in the contention tests), while a mutexed
+    read-decide-write cannot.  The critical section is microseconds of
+    file I/O; a mutex older than ``_MUTEX_STALE_S`` means its holder died
+    inside it and is broken (rmdir races resolve to one winner)."""
+
+    def __init__(self, path: str) -> None:
+        self._dir = path + ".lock"
+
+    def __enter__(self):
+        for _ in range(2000):  # ~4s worst case at 2ms per spin
+            try:
+                os.mkdir(self._dir)
+                return self
+            except FileExistsError:
+                try:
+                    st = os.stat(self._dir)
+                    # ktlint: allow[KT002] mutex staleness is wall-clock
+                    # infrastructure shared ACROSS processes — an
+                    # injectable test clock has no meaning for a sibling
+                    # replica's mkdir timestamp
+                    age = _time.time() - st.st_mtime
+                except OSError:
+                    continue  # released between the mkdir and the stat
+                if age > _MUTEX_STALE_S:
+                    try:
+                        # re-verify at the last instant: if the dir was
+                        # re-created since our stat (its identity moved),
+                        # this rmdir would break a FRESH claimant's mutex
+                        # — the decide-then-break window is narrowed to
+                        # the microseconds between these two syscalls
+                        st2 = os.stat(self._dir)
+                        if st2.st_mtime == st.st_mtime \
+                                and st2.st_ino == st.st_ino:
+                            os.rmdir(self._dir)  # break the orphan
+                    except OSError:
+                        pass
+                else:
+                    _time.sleep(0.002)
+        raise OSError(f"lease mutex {self._dir} wedged")
+
+    def __exit__(self, *exc):
+        try:
+            os.rmdir(self._dir)
+        except OSError:
+            pass
+
+
+def _write_lease(path: str, payload: bytes) -> None:
+    """Atomic lease install (caller holds the mutex)."""
+    _atomic_write(path, payload)
+
+
+def claim_lease(dir_path: str, session_id: str, owner: str, now: float,
+                ttl_s: float, force: bool = False) -> str:
+    """Claim (or renew, or steal-after-expiry) the session's ownership
+    lease, atomically (read-decide-write under the per-lease mutex).
+    Returns ``"claimed"`` (was free), ``"renewed"`` (already ours), or
+    ``"stolen"`` (the previous owner's lease had expired).  Raises
+    :class:`LeaseHeld` when another owner's UNEXPIRED lease stands — the
+    typed refusal that keeps adoption exactly-once.
+
+    ``force=True`` steals even an unexpired foreign lease — reserved for
+    session ESTABLISHMENT (``DeltaSessionTable.own``): the client just
+    re-established the chain HERE, so whatever incarnation the old lease
+    guarded is obsolete by the client's own authority; the old owner's
+    next renewal refuses and it drops its zombie entry (``lease_lost``)
+    instead of livelocking the session between two replicas."""
+    path = lease_path(dir_path, session_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = json.dumps({"owner": owner,
+                          "expires_at": now + max(0.0, ttl_s)}).encode()
+    with _LeaseMutex(path):
+        cur = _read_lease(path)
+        if cur is None:
+            # free (never claimed, released, or unreadable garbage — a
+            # corrupt lease must not wedge its session forever)
+            _write_lease(path, payload)
+            return "claimed"
+        if cur.get("owner") == owner:
+            _write_lease(path, payload)
+            return "renewed"
+        if not force and float(cur.get("expires_at", 0.0)) > now:
+            raise LeaseHeld(session_id, str(cur.get("owner")),
+                            float(cur.get("expires_at", 0.0)))
+        _write_lease(path, payload)
+        return "stolen"
+
+
+def release_lease(dir_path: str, session_id: str, owner: str) -> None:
+    """Release the lease iff we still own it (a stolen lease belongs to
+    the new owner — never delete it out from under them).  The
+    owner-check + unlink runs under the same per-lease mutex as claims,
+    so a release racing a steal cannot delete the thief's fresh lease."""
+    path = lease_path(dir_path, session_id)
+    if not os.path.exists(path):
+        return
+    try:
+        with _LeaseMutex(path):
+            cur = _read_lease(path)
+            if cur is not None and cur.get("owner") == owner:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+    except OSError:
+        pass  # wedged mutex: leave the lease to expire on its own
+
+
+def lease_state(dir_path: str, session_id: str) -> Optional[Dict]:
+    """The lease document ({owner, expires_at}) or None — observability
+    only (statusz, tests); never a correctness input."""
+    return _read_lease(lease_path(dir_path, session_id))

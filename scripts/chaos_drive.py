@@ -37,12 +37,38 @@ re-establishing full solves); without it, exactly N clients pay exactly
 one re-establish each.  ``bench.py measure_restart_recovery`` gates this
 (restore p50 bounded, the zero / exactly-N re-solve counts).
 
+``run_fleet`` — the fleet-failover scenarios (ISSUE 13): N solver
+replicas on unix sockets sharing ONE session spool, fleet-aware clients
+(``FleetClient`` session-affinity routing), every chain mirrored onto a
+fault-free single-replica oracle.  Modes:
+
+- ``kill``      — hard-kill one of N mid-chain (no snapshot, no lease
+  release); after the lease TTL the surviving replicas STEAL the dead
+  replica's sessions from the shared spool and serve their next delta
+  WARM: zero re-establishing solves, byte-parity vs the oracle.
+- ``drain``     — graceful drain of one of N: establishments refused with
+  the DRAINING hint, served deltas hand their chains off (record + lease
+  release + drop), clients proactively re-home; zero re-establishes.
+- ``kill-cold`` — the no-spool baseline: the kill costs exactly ONE
+  re-establish per orphaned session (the PR-10 floor).
+- ``contend``   — two surviving replicas adopt the SAME dead session
+  concurrently: exactly one wins the lease, the loser refuses typed.
+- ``stale``     — the spool is rolled back to pre-kill records (a PVC
+  restore adversary): adoption succeeds but the epoch check refuses to
+  serve the stale chain — exactly one re-establish per session, never a
+  silent divergence.
+
+``bench.py measure_fleet_failover`` gates kill (0 re-establishes) and
+kill-cold (exactly one per orphaned session) in ``check_budgets``.
+
 Usage::
 
     python scripts/chaos_drive.py                      # composed schedule
     python scripts/chaos_drive.py --steps 120 --pods 5000 --seed 7
     python scripts/chaos_drive.py --restart            # kill + restart
     python scripts/chaos_drive.py --restart --no-snapshot
+    python scripts/chaos_drive.py --fleet              # kill-one-of-three
+    python scripts/chaos_drive.py --fleet --mode drain --seed 24
 """
 
 from __future__ import annotations
@@ -431,6 +457,361 @@ def run_restart(pods_n=4000, clients=4, pre_steps=4, post_steps=4, churn=6,
                 p.wait(timeout=10)
 
 
+# ---- fleet-failover scenarios (ISSUE 13) ---------------------------------
+
+def _build_replica(sock, spool, replica, lease_s, snapshot_s):
+    """One in-process solver replica with its fault/spool config captured
+    from env at construction (the _serve_pair env dance)."""
+    from karpenter_tpu.metrics import Registry
+    from karpenter_tpu.service.server import SolverService, make_server
+    from karpenter_tpu.solver.scheduler import BatchScheduler
+
+    saved = {}
+    env = {"KT_REPLICA_ID": replica}
+    if spool:
+        env["KT_SESSION_DIR"] = spool
+        env["KT_SESSION_SNAPSHOT_S"] = str(snapshot_s)
+        env["KT_SESSION_LEASE_S"] = str(lease_s)
+    try:
+        for key, val in env.items():
+            saved[key] = os.environ.pop(key, None)
+            os.environ[key] = val
+        if not spool:
+            saved["KT_SESSION_DIR"] = os.environ.pop("KT_SESSION_DIR", None)
+        reg = Registry()
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        service = SolverService(sched, registry=reg)
+        pipe = service._pipeline_for(sched)
+        srv, _ = make_server(service, host=sock)
+    finally:
+        for key, old in saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+    return {"reg": reg, "service": service, "pipe": pipe, "srv": srv,
+            "sock": sock, "replica": replica, "alive": True}
+
+
+def _hard_kill(rep):
+    """The unclean death: the gRPC server stops answering and the
+    dispatcher (and with it the periodic snapshot + lease renewal) halts
+    — no final spool write, no lease release.  The replica's sessions
+    become adoptable only after the lease TTL, exactly like a crashed
+    pod on a shared PVC."""
+    rep["srv"].stop(grace=None)
+    rep["pipe"]._stop.set()
+    rep["pipe"]._thread.join(timeout=10)
+    rep["alive"] = False
+
+
+def _settle_spool(reps, deadline_s=10.0):
+    """Wait until every live session's spool record is at its chain's
+    committed epoch (the periodic writer runs on idle ticks; a HARD kill
+    right after a step may lose the last write — bounded by design, but
+    the warm-failover scenarios measure the steady state, where the
+    record IS current)."""
+    from karpenter_tpu.service import snapshot as snap
+
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        behind = 0
+        for rep in reps:
+            if not rep["alive"]:
+                continue
+            tab = rep["pipe"]._delta_tab
+            spool = rep["pipe"]._spool_dir
+            if tab is None or not spool:
+                continue
+            with tab._lock:
+                live = {sid: e.epoch for sid, e in tab._sessions.items()}
+            for sid, epoch in live.items():
+                blob = snap.read_record(spool, sid)
+                if blob is None:
+                    behind += 1
+                    continue
+                try:
+                    raw, _ = snap.unpack(blob)
+                    if int(snap.unpack_entry(raw[0])["epoch"]) != epoch:
+                        behind += 1
+                except snap.SnapshotRefused:
+                    behind += 1
+        if behind == 0:
+            return
+        time.sleep(0.05)
+    raise RuntimeError("session spool never settled to the live epochs")
+
+
+def run_fleet(replicas=3, clients=6, pods_n=1200, pre_steps=3, post_steps=3,
+              churn=4, seed=23, mode="kill", lease_s=0.4, verbose=True,
+              strict=True):
+    """One fleet-failover scenario (see the module docstring's mode
+    catalog).  Returns the scoreboard; raises AssertionError the moment
+    an invariant breaks (strict=True)."""
+    import threading
+
+    from karpenter_tpu.admission import SolveDeadlineError, SolveShedError
+    from karpenter_tpu.metrics import SESSION_ADOPTIONS
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.service.client import (
+        DeltaSession, FleetClient, SolveRetriesExhausted, SolveStepFailed,
+        SolverDraining,
+    )
+    from karpenter_tpu.service import snapshot as snap
+
+    assert mode in ("kill", "drain", "kill-cold", "contend", "stale"), mode
+    spooled = mode != "kill-cold"
+    catalog = generate_catalog(full=False)
+    provs = [Provisioner(name="default").with_defaults()]
+    tmp = tempfile.mkdtemp(prefix="kt-fleet-")
+    spool = os.path.join(tmp, "spool") if spooled else ""
+    reps = [_build_replica(f"unix:{tmp}/r{i}.sock", spool, f"replica-{i}",
+                           lease_s, 0.0001) for i in range(replicas)]
+    oracle = _build_replica(f"unix:{tmp}/oracle.sock", "", "oracle", 1.0, 0)
+    socks = [r["sock"] for r in reps]
+    typed = {k: 0 for k in
+             TYPED_ERRORS_DOC + ("SolverDraining", "LeaseHeld")}
+    sessions = []
+    try:
+        rng = random.Random(seed)
+        per = max(20, pods_n // clients)
+        for c in range(clients):
+            fc = FleetClient(socks, timeout=120.0, retries=1,
+                             backoff_s=0.02)
+            sess = DeltaSession(socks[0], timeout=120.0, client=fc)
+            mirror = DeltaSession(oracle["sock"], timeout=120.0)
+            pods = make_pods(per, f"fl{c}")
+            sess.solve(list(pods), provs, catalog)
+            mirror.solve(list(pods), provs, catalog)
+            sessions.append({
+                "fc": fc, "sess": sess, "mirror": mirror,
+                "live": [p.name for p in pods],
+                "cum_add": [], "cum_rm": [],
+                "resends": sess.full_resends,
+            })
+
+        def step(s, tag):
+            """One churn step + oracle mirror + parity check.  Returns
+            False when the step surfaced a typed error (perturbation
+            stays pending, cumulative retry next call)."""
+            rm = rng.sample(s["live"], min(churn, len(s["live"])))
+            rms = set(rm)
+            s["live"] = [n for n in s["live"] if n not in rms]
+            add = make_pods(churn, tag)
+            s["live"] += [p.name for p in add]
+            try:
+                cur = s["sess"].solve_delta(added=add, removed=rm)
+            except (SolveShedError, SolveDeadlineError,
+                    SolveRetriesExhausted, SolveStepFailed,
+                    SolverDraining) as err:
+                typed[type(err).__name__] += 1
+                s["cum_add"] += add
+                s["cum_rm"] += rm
+                return False
+            if s["sess"].full_resends > s["resends"]:
+                # the chain re-established internally: mirror the SAME
+                # full solve so both sides see identical sequences
+                s["mirror"].solve(list(s["sess"]._pods.values()), provs,
+                                  catalog)
+                s["resends"] = s["sess"].full_resends
+            else:
+                s["mirror"].solve_delta(added=s["cum_add"] + add,
+                                        removed=s["cum_rm"] + rm)
+            s["cum_add"], s["cum_rm"] = [], []
+            assert canonical(cur) == canonical(s["mirror"].result()), \
+                f"{tag}: fleet view diverged from the fault-free oracle"
+            return True
+
+        for k in range(pre_steps):
+            for c, s in enumerate(sessions):
+                step(s, f"fl{c}a{k}")
+        if spooled:
+            _settle_spool(reps)
+        # the victim: the replica serving the most sessions (rendezvous
+        # picks it deterministically per seed via the session ids)
+        by_ep = {r["sock"]: [] for r in reps}
+        for s in sessions:
+            by_ep[s["fc"].endpoint_for(s["sess"].session_id)].append(s)
+        victim = max(reps, key=lambda r: len(by_ep[r["sock"]]))
+        victim_sessions = by_ep[victim["sock"]]
+        n_victim = len(victim_sessions)
+        resends_before = sum(s["sess"].full_resends for s in sessions)
+
+        contended = {}
+        if mode in ("kill", "kill-cold", "contend", "stale"):
+            if mode == "stale":
+                # snapshot the CURRENT records (file-by-file: survivors
+                # are live writers, so temp files come and go under any
+                # tree walk), then advance the chains so the on-disk
+                # state we roll back to is genuinely stale.  The pipeline
+                # namespaces its spool per backend ("oracle" here).
+                import shutil
+
+                rec_dir = os.path.join(spool, "oracle",
+                                       snap.SESSIONS_SUBDIR)
+                stale_dir = os.path.join(tmp, "stale-copy")
+                os.makedirs(stale_dir, exist_ok=True)
+                for name in os.listdir(rec_dir):
+                    if not name.endswith(snap.RECORD_SUFFIX):
+                        continue
+                    try:
+                        shutil.copyfile(os.path.join(rec_dir, name),
+                                        os.path.join(stale_dir, name))
+                    except FileNotFoundError:
+                        pass  # consumed/replaced mid-copy
+                for k in range(2):
+                    for c, s in enumerate(sessions):
+                        step(s, f"fl{c}s{k}")
+                _settle_spool(reps)
+            _hard_kill(victim)
+            if mode == "stale":
+                # roll the RECORDS back in place (the PVC-restore
+                # adversary): every record is now at a PRE-advance epoch.
+                # Surviving replicas are live writers on this tree, so
+                # records are replaced file-by-file (their own sessions'
+                # next periodic write re-freshens them) — never an rmtree
+                # under a live writer.
+                for name in os.listdir(stale_dir):
+                    t = os.path.join(rec_dir, name + ".stale-tmp")
+                    shutil.copyfile(os.path.join(stale_dir, name), t)
+                    os.replace(t, os.path.join(rec_dir, name))
+            if spooled:
+                # leases stop renewing at death; adoption is legal (as a
+                # counted STEAL) only after the TTL — the fleet's
+                # failover-warmness window
+                time.sleep(lease_s + 0.3)
+        elif mode == "drain":
+            victim["service"].drain()
+
+        if mode == "contend":
+            # two survivors race to adopt the SAME dead session directly
+            # (the client would only ever ask one): exactly one may win
+            survivors = [r for r in reps if r["alive"]][:2]
+            sid = victim_sessions[0]["sess"].session_id \
+                if victim_sessions else sessions[0]["sess"].session_id
+            results = {}
+            barrier = threading.Barrier(len(survivors))
+
+            def adopt(rep):
+                barrier.wait()
+                tab = rep["pipe"]._delta_tab
+                results[rep["replica"]] = tab.adopt(
+                    rep["pipe"]._spool_dir, sid)
+
+            threads = [threading.Thread(target=adopt, args=(r,))
+                       for r in survivors]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            winners = [k for k, v in results.items() if v is not None]
+            assert len(winners) == 1, (
+                f"lease contention yielded {len(winners)} adopters "
+                f"(want exactly 1): {results}")
+            held = sum(r["reg"].counter(SESSION_ADOPTIONS).get(
+                {"outcome": "lease_held"}) for r in reps)
+            assert held >= 1.0, "the losing adopter was not counted"
+            typed["LeaseHeld"] += int(held)
+
+        # continue every chain through the fleet
+        post_ok = 0
+        for k in range(post_steps):
+            for c, s in enumerate(sessions):
+                if step(s, f"fl{c}b{k}"):
+                    post_ok += 1
+        extra = sum(s["sess"].full_resends for s in sessions) \
+            - resends_before
+
+        if spooled:
+            # let zombie reconciliation land before the audit: a replica
+            # holding a stale adopted entry drops it (lease_lost) on its
+            # next periodic snapshot pass, after the establishment that
+            # superseded it force-took the lease
+            time.sleep(0.4)
+        # single-owner audit: every session lives in AT MOST one serving
+        # replica's table (the acceptance criterion: no seed may ever
+        # yield two replicas serving the same session epoch)
+        multi_owner = []
+        for s in sessions:
+            sid = s["sess"].session_id
+            holders = []
+            for rep in reps:
+                if not rep["alive"]:
+                    continue
+                tab = rep["pipe"]._delta_tab
+                with tab._lock:
+                    if sid in tab._sessions:
+                        holders.append(rep["replica"])
+            if len(holders) > 1:
+                multi_owner.append((sid, holders))
+        assert not multi_owner, \
+            f"sessions served by multiple replicas: {multi_owner}"
+
+        adoptions = {}
+        for rep in reps:
+            for lk, v in rep["reg"].counter(
+                    SESSION_ADOPTIONS).values.items():
+                if v:
+                    key = dict(lk).get("outcome", "")
+                    adoptions[key] = adoptions.get(key, 0) + int(v)
+        board = {
+            "mode": mode, "seed": seed, "replicas": replicas,
+            "clients": clients, "pods": per * clients,
+            "victim": victim["replica"],
+            "victim_sessions": n_victim,
+            "extra_resends": extra,
+            "post_steps_served": post_ok,
+            "typed_errors": {k: v for k, v in typed.items() if v},
+            "adoptions": adoptions,
+        }
+        if verbose:
+            print(f"fleet {mode} run clean:")
+            for key, val in board.items():
+                print(f"  {key}: {val}")
+        if strict:
+            if mode in ("kill", "drain"):
+                assert extra == 0, (
+                    f"{extra} re-establishing solve(s) on the warm "
+                    f"failover path (mode={mode}; want ZERO — the spool "
+                    "must hand every chain off warm)")
+                if mode == "kill" and n_victim:
+                    stolen = adoptions.get("stolen", 0)
+                    assert stolen >= n_victim, (
+                        f"only {stolen} steal-adoptions for {n_victim} "
+                        "orphaned sessions")
+            elif mode == "kill-cold":
+                assert extra == n_victim, (
+                    f"{extra} re-establishes for {n_victim} orphaned "
+                    "sessions without a spool — the cold path must cost "
+                    "exactly one per session")
+            elif mode == "contend":
+                # at most ONE re-establish (only when the probe's winner
+                # was not the endpoint the client routes to)
+                assert extra <= 1, (
+                    f"{extra} re-establishes after one contended "
+                    "adoption — contention must cost at most one")
+            elif mode == "stale":
+                assert extra == n_victim, (
+                    f"{extra} re-establishes for {n_victim} stale-spool "
+                    "sessions — stale adoption must cost exactly one "
+                    "re-establish each, never serve the stale chain")
+        return board
+    finally:
+        for rep in reps + [oracle]:
+            try:
+                rep["srv"].stop(grace=None)
+                rep["service"].close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+        for s in sessions:
+            try:
+                s["sess"].close()
+                s["mirror"].close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=42)
@@ -444,8 +825,21 @@ def main(argv=None):
     ap.add_argument("--no-snapshot", action="store_true",
                     help="(--restart) run WITHOUT KT_SESSION_DIR: every "
                          "client pays one re-establish")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run a fleet-failover scenario (N replicas, one "
+                         "shared session spool, fleet-aware clients)")
+    ap.add_argument("--mode", default="kill",
+                    choices=["kill", "drain", "kill-cold", "contend",
+                             "stale"],
+                    help="(--fleet) scenario: hard kill-one-of-N (warm "
+                         "steal), graceful drain-one-of-N, the no-spool "
+                         "cold baseline, concurrent lease contention, or "
+                         "stale-spool adoption")
+    ap.add_argument("--replicas", type=int, default=3)
     args = ap.parse_args(argv)
-    if args.restart:
+    if args.fleet:
+        run_fleet(replicas=args.replicas, seed=args.seed, mode=args.mode)
+    elif args.restart:
         run_restart(snapshot=not args.no_snapshot)
     else:
         run_chaos(seed=args.seed, steps=args.steps, pods_n=args.pods,

@@ -428,6 +428,51 @@ class TestRestartRecoveryGates:
         assert benchmod.check_budgets({"value": 100.0}) == {}
 
 
+class TestFleetFailoverGates:
+    """ISSUE 13 budget gates (measure_fleet_failover): kill-one-of-N with
+    the shared spool costs ZERO re-establishing solves (every orphaned
+    session steal-adopted by a survivor), and the no-spool baseline costs
+    exactly one re-establish per orphaned session."""
+
+    GOOD = {"fleet_victim_sessions": 3,
+            "fleet_warm_failover_resends": 0,
+            "fleet_steal_adoptions": 3,
+            "fleet_cold_victim_sessions": 2,
+            "fleet_cold_failover_resends": 2}
+
+    def test_within_budgets_clean(self):
+        assert benchmod.check_budgets(dict(self.GOOD)) == {}
+
+    def test_warm_failover_resends_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, fleet_warm_failover_resends=2))
+        assert any("kill-one-of-N failover WITH the shared spool" in f
+                   for f in out["budget_flags"])
+
+    def test_unexercised_scenario_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, fleet_victim_sessions=0,
+                 fleet_steal_adoptions=0))
+        assert any("never exercised" in f for f in out["budget_flags"])
+
+    def test_missing_steal_adoptions_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, fleet_steal_adoptions=1))
+        assert any("not adopting" in f for f in out["budget_flags"])
+
+    def test_wrong_cold_baseline_flagged(self):
+        # fewer than N means the scenario never orphaned anything; more
+        # means a retry storm — both must flag
+        for wrong in (0, 5):
+            out = benchmod.check_budgets(
+                dict(self.GOOD, fleet_cold_failover_resends=wrong))
+            assert any("exactly one full solve per session" in f
+                       for f in out["budget_flags"])
+
+    def test_missing_fleet_fields_not_flagged(self):
+        assert benchmod.check_budgets({"value": 100.0}) == {}
+
+
 @pytest.mark.slow
 def test_500k_pod_solve_stretch():
     """ISSUE 6 stretch rung: the solve bench ceiling lifted from 50k

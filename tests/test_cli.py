@@ -158,11 +158,16 @@ def test_inventory_metrics_are_emitted(small_catalog):
     # full-population zero-init is asserted by tests/test_metrics_init.py::
     # TestResilienceSeries and exercised end to end by tests/test_faults.py
     resilience_family = {m for m in INVENTORY
-                         if m.startswith("karpenter_solver_session_snapshot_")
+                         if m.startswith("karpenter_solver_session_")
                          or m.startswith("karpenter_faults_")}
 
+    # the fleet family is CLIENT-side (FleetClient, service/client.py):
+    # zero-inited at its construction, asserted by tests/test_metrics_init
+    # ::TestFleetSeries and exercised end to end by tests/test_fleet.py
+    fleet_family = {m for m in INVENTORY if m.startswith("karpenter_fleet_")}
+
     missing = (set(INVENTORY) - emitted - admission_family - delta_family
-               - resilience_family
+               - resilience_family - fleet_family
                - {REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES})
     assert not missing, (
         f"documented metrics never emitted: {sorted(missing)} "

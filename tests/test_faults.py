@@ -187,27 +187,40 @@ class TestSnapshotSpool:
         tab = DeltaSessionTable(registry=reg, capacity=8)
         assert tab.snapshot(str(tmp_path)) == {"written": 0, "skipped": 0}
         assert reg.counter(SNAPSHOT_WRITES).get({"outcome": "empty"}) == 1.0
-        assert not (tmp_path / snap.SPOOL_NAME).exists()
+        assert snap.list_sessions(str(tmp_path)) == []
 
-    def test_atomic_write_replaces_whole_file(self, tmp_path):
+    def test_atomic_write_replaces_whole_record(self, tmp_path):
         tab = DeltaSessionTable(registry=Registry(), capacity=8)
-        tab.put(_entry("s1"))
+        tab.put(_entry("s1", epoch=3))
         tab.snapshot(str(tmp_path))
-        first = (tmp_path / snap.SPOOL_NAME).read_bytes()
-        tab.put(_entry("s2"))
+        rec = tmp_path / snap.SESSIONS_SUBDIR
+        first = (rec / "s1.snap").read_bytes()
+        tab.put(_entry("s1", epoch=4))
         tab.snapshot(str(tmp_path))
-        second = (tmp_path / snap.SPOOL_NAME).read_bytes()
+        second = (rec / "s1.snap").read_bytes()
         assert second != first
-        assert not list(tmp_path.glob(snap.SPOOL_NAME + ".tmp*"))
+        assert not list(rec.glob("*.tmp*"))
 
-    def test_restore_respects_capacity(self, tmp_path):
+    def test_restore_respects_capacity_and_keeps_sibling_records(
+            self, tmp_path):
+        """The ISSUE 13 bug-fix satellite: a consuming restore must evict
+        (consume) ONLY the records it actually adopted — on a shared
+        spool the over-capacity remainder belongs to sibling replicas and
+        must survive, unclaimed, for them to adopt."""
         tab = DeltaSessionTable(registry=Registry(), capacity=8)
         for i in range(6):
             tab.put(_entry(f"s{i}"))
         tab.snapshot(str(tmp_path))
+        tab.clear("stop")  # graceful: leases released, records kept
         small = DeltaSessionTable(registry=Registry(), capacity=2)
         assert small.restore(str(tmp_path)) == 2
         assert len(small) == 2
+        remaining = set(snap.list_sessions(str(tmp_path)))
+        assert len(remaining) == 4  # adopted records consumed, rest KEPT
+        # ...and the rest are free for a sibling to adopt right now
+        other = DeltaSessionTable(registry=Registry(), capacity=8,
+                                  replica="sibling-replica")
+        assert other.restore(str(tmp_path)) == 4
 
     def test_node_counter_advances_past_restored_names(self, tmp_path):
         tab = DeltaSessionTable(registry=Registry(), capacity=8)
@@ -235,7 +248,8 @@ class TestSnapshotAdversaries:
         tab = DeltaSessionTable(registry=Registry(), capacity=8)
         tab.put(_entry("s1", epoch=4))
         tab.snapshot(str(tmp_path))
-        return str(tmp_path), (tmp_path / snap.SPOOL_NAME)
+        tab.clear("stop")  # release the lease: the restorer is the point
+        return str(tmp_path), (tmp_path / snap.SESSIONS_SUBDIR / "s1.snap")
 
     def _restore(self, dir_path, expected=None):
         reg = Registry()

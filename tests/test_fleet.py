@@ -1,0 +1,642 @@
+"""ISSUE 13 — fleet failover: warm delta-session handoff across replicas.
+
+Five layers, cheapest first:
+
+- ``TestLeaseProtocol`` — the snapshot.py ownership-lease primitives:
+  claim / renew / typed refusal / steal-after-expiry / force-steal /
+  release, including the concurrent-claim race (exactly one winner).
+- ``TestAdoption`` — ``DeltaSessionTable.adopt``: sibling leases refuse
+  typed, dead leases steal after the TTL, records are consumed, the
+  injected ``lease_steal@adopt`` adversary, and the zombie-writer guard
+  (a stolen session is dropped, never spooled over the adopter).
+- ``TestAdoptionRaces`` — two replica tables adopting the same session
+  concurrently over one shared spool: exactly one wins.
+- ``TestDrainHandshake`` — the graceful-drain protocol over real gRPC
+  under KT_SANITIZE=1: establishments refused with the DRAINING hint,
+  served deltas hand their chains off, a fleet client re-homes warm.
+- ``TestFleetClient`` / ``TestFleetFailoverWarm`` /
+  ``TestFleetChaosSmoke`` — affinity routing, death failover, and the
+  tier-1 rung of ``make chaos-fleet``'s kill-one-of-three scenario
+  (real gRPC on unix sockets, oracle parity asserted inside the
+  harness).
+"""
+
+import importlib.util
+import os
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.metrics import (
+    DELTA_EVICTIONS,
+    FLEET_ENDPOINTS,
+    FLEET_FAILOVERS,
+    SESSION_ADOPTIONS,
+    SESSION_LEASES,
+    SNAPSHOT_SKIPPED,
+    Registry,
+)
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.service import snapshot as snap
+from karpenter_tpu.service.delta import DeltaSessionTable
+from karpenter_tpu.utils.clock import FakeClock
+
+from tests.test_faults import _entry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _chaos_drive():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_drive", os.path.join(REPO, "scripts", "chaos_drive.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------------------
+class TestLeaseProtocol:
+    def test_claim_renew_release(self, tmp_path):
+        d = str(tmp_path)
+        assert snap.claim_lease(d, "s1", "a", 100.0, 10.0) == "claimed"
+        state = snap.lease_state(d, "s1")
+        assert state["owner"] == "a" and state["expires_at"] == 110.0
+        # claiming your own lease renews (and extends) it
+        assert snap.claim_lease(d, "s1", "a", 105.0, 10.0) == "renewed"
+        assert snap.lease_state(d, "s1")["expires_at"] == 115.0
+        snap.release_lease(d, "s1", "a")
+        assert snap.lease_state(d, "s1") is None
+
+    def test_unexpired_foreign_lease_refuses_typed(self, tmp_path):
+        d = str(tmp_path)
+        snap.claim_lease(d, "s1", "a", 100.0, 10.0)
+        with pytest.raises(snap.LeaseHeld) as ei:
+            snap.claim_lease(d, "s1", "b", 105.0, 10.0)
+        assert ei.value.owner == "a"
+        assert ei.value.session_id == "s1"
+
+    def test_expired_lease_steals(self, tmp_path):
+        d = str(tmp_path)
+        snap.claim_lease(d, "s1", "a", 100.0, 10.0)
+        assert snap.claim_lease(d, "s1", "b", 111.0, 10.0) == "stolen"
+        assert snap.lease_state(d, "s1")["owner"] == "b"
+        # ...and the loser of the steal (the dead owner waking up) refuses
+        with pytest.raises(snap.LeaseHeld):
+            snap.claim_lease(d, "s1", "a", 112.0, 10.0)
+
+    def test_force_steal_breaks_unexpired_lease(self, tmp_path):
+        # the establishment path (DeltaSessionTable.own): the client's
+        # re-establish supersedes whatever the old lease guarded
+        d = str(tmp_path)
+        snap.claim_lease(d, "s1", "a", 100.0, 10.0)
+        assert snap.claim_lease(d, "s1", "b", 101.0, 10.0,
+                                force=True) == "stolen"
+        assert snap.lease_state(d, "s1")["owner"] == "b"
+
+    def test_release_is_owner_checked(self, tmp_path):
+        d = str(tmp_path)
+        snap.claim_lease(d, "s1", "a", 100.0, 10.0)
+        snap.release_lease(d, "s1", "b")  # not yours: no-op
+        assert snap.lease_state(d, "s1")["owner"] == "a"
+
+    def test_concurrent_claims_have_exactly_one_winner(self, tmp_path):
+        d = str(tmp_path)
+        outcomes = {}
+        barrier = threading.Barrier(8)
+
+        def claim(owner):
+            barrier.wait()
+            try:
+                outcomes[owner] = snap.claim_lease(
+                    d, "hot", owner, 100.0, 10.0)
+            except snap.LeaseHeld:
+                outcomes[owner] = "held"
+
+        threads = [threading.Thread(target=claim, args=(f"r{i}",))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        winners = [o for o, how in outcomes.items() if how != "held"]
+        assert len(winners) == 1, outcomes
+        assert snap.lease_state(d, "hot")["owner"] == winners[0]
+
+    def test_concurrent_steals_of_expired_lease_one_winner(self, tmp_path):
+        d = str(tmp_path)
+        snap.claim_lease(d, "hot", "dead", 100.0, 10.0)
+        outcomes = {}
+        barrier = threading.Barrier(6)
+
+        def steal(owner):
+            barrier.wait()
+            try:
+                outcomes[owner] = snap.claim_lease(
+                    d, "hot", owner, 200.0, 10.0)  # long expired
+            except snap.LeaseHeld:
+                outcomes[owner] = "held"
+
+        threads = [threading.Thread(target=steal, args=(f"r{i}",))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # exactly one claimant ends OWNING (micro-racing can label the
+        # winner "claimed" when it lost the yank but won the re-create;
+        # ownership — not the label — is the protocol's guarantee)
+        winners = [o for o, how in outcomes.items() if how != "held"]
+        assert len(winners) == 1, outcomes
+        assert snap.lease_state(d, "hot")["owner"] == winners[0]
+
+    def test_hostile_session_id_stays_inside_the_spool(self, tmp_path):
+        d = str(tmp_path)
+        sid = "../../../etc/evil"
+        snap.claim_lease(d, sid, "a", 100.0, 10.0)
+        snap.write_record(d, sid, b"payload")
+        files = [str(p) for p in tmp_path.rglob("*") if p.is_file()]
+        assert all(str(tmp_path) in f for f in files)
+        assert snap.list_sessions(d) == [sid]  # round-trips the encoding
+        assert snap.read_record(d, sid) == b"payload"
+
+
+# --------------------------------------------------------------------------
+class TestAdoption:
+    def _two_replicas(self, clock=None):
+        clock = clock or FakeClock(start=1000.0)
+        a = DeltaSessionTable(registry=Registry(), clock=clock, capacity=8,
+                              replica="rep-a", lease_s=10.0)
+        b = DeltaSessionTable(registry=Registry(), clock=clock, capacity=8,
+                              replica="rep-b", lease_s=10.0)
+        return clock, a, b
+
+    def test_live_sibling_lease_refuses_adoption(self, tmp_path):
+        clock, a, b = self._two_replicas()
+        a.put(_entry("s1", epoch=5))
+        a.snapshot(str(tmp_path))  # claims rep-a's lease
+        assert b.adopt(str(tmp_path), "s1") is None
+        assert b.registry.counter(SESSION_ADOPTIONS).get(
+            {"outcome": "lease_held"}) == 1.0
+        # the record is untouched — rep-a still owns the chain
+        assert snap.read_record(str(tmp_path), "s1") is not None
+
+    def test_dead_sibling_steals_after_lease_expiry(self, tmp_path):
+        clock, a, b = self._two_replicas()
+        a.put(_entry("s1", epoch=5, pods=("a", "b")))
+        a.snapshot(str(tmp_path))
+        clock.advance(11.0)  # rep-a "died": lease expired, never renewed
+        entry = b.adopt(str(tmp_path), "s1")
+        assert entry is not None and entry.epoch == 5
+        assert set(entry.prev.assignments) == {"a", "b"}
+        assert b.registry.counter(SESSION_ADOPTIONS).get(
+            {"outcome": "stolen"}) == 1.0
+        # adopt-once: the record is consumed; the lease is rep-b's now
+        assert snap.read_record(str(tmp_path), "s1") is None
+        assert snap.lease_state(str(tmp_path), "s1")["owner"] == "rep-b"
+        assert b.leases_owned() == 1
+        assert b.registry.gauge(SESSION_LEASES).get() == 1.0
+
+    def test_missing_record_is_counted(self, tmp_path):
+        _clock, _a, b = self._two_replicas()
+        assert b.adopt(str(tmp_path), "ghost") is None
+        assert b.registry.counter(SESSION_ADOPTIONS).get(
+            {"outcome": "missing"}) == 1.0
+        # the speculative lease claim was rolled back
+        assert snap.lease_state(str(tmp_path), "ghost") is None
+
+    def test_corrupt_record_is_counted_refused(self, tmp_path):
+        from karpenter_tpu.metrics import SNAPSHOT_RESTORE
+
+        clock, a, b = self._two_replicas()
+        a.put(_entry("s1"))
+        a.snapshot(str(tmp_path))
+        a.clear("stop")
+        path = snap.session_path(str(tmp_path), "s1")
+        blob = bytearray(open(path, "rb").read())
+        blob[-5] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        assert b.adopt(str(tmp_path), "s1") is None
+        assert b.registry.counter(SESSION_ADOPTIONS).get(
+            {"outcome": "refused"}) == 1.0
+        assert b.registry.counter(SNAPSHOT_RESTORE).get(
+            {"outcome": "corrupt"}) == 1.0
+
+    def test_injected_lease_steal_contention(self, tmp_path, monkeypatch):
+        """lease_steal@adopt: the plane plants a contending sibling lease
+        under the in-flight adoption — the claim must refuse typed and
+        count lease_held (the exactly-one-owner adversary)."""
+        from karpenter_tpu import faults
+        from karpenter_tpu.metrics import FAULTS_INJECTED
+
+        clock = FakeClock(start=1000.0)
+        a = DeltaSessionTable(registry=Registry(), clock=clock, capacity=8,
+                              replica="rep-a", lease_s=10.0)
+        a.put(_entry("s1"))
+        a.snapshot(str(tmp_path))
+        a.clear("stop")  # lease released: adoption would normally win
+        reg = Registry()
+        plane = faults.FaultPlane("lease_steal@adopt:at=1", registry=reg)
+        b = DeltaSessionTable(registry=reg, clock=clock, capacity=8,
+                              replica="rep-b", lease_s=10.0, faults=plane)
+        assert b.adopt(str(tmp_path), "s1") is None
+        assert reg.counter(FAULTS_INJECTED).get(
+            {"kind": "lease_steal", "site": "adopt"}) == 1.0
+        assert reg.counter(SESSION_ADOPTIONS).get(
+            {"outcome": "lease_held"}) == 1.0
+        # the record survives for the (injected) owner
+        assert snap.read_record(str(tmp_path), "s1") is not None
+
+    def test_zombie_writer_drops_chain_and_never_clobbers(self, tmp_path):
+        """The zombie-writer guard: a replica whose session lease was
+        stolen (it was wedged past the TTL) must DROP the chain on its
+        next snapshot pass — counted lease_lost — and write NOTHING over
+        the adopter's record."""
+        clock, a, b = self._two_replicas()
+        a.put(_entry("s1", epoch=5))
+        a.snapshot(str(tmp_path))
+        clock.advance(11.0)
+        assert b.adopt(str(tmp_path), "s1") is not None  # stolen
+        b.snapshot(str(tmp_path))  # rep-b's record at epoch 5 on disk
+        rec_before = snap.read_record(str(tmp_path), "s1")
+        # the zombie wakes up and tries to spool
+        stats = a.snapshot(str(tmp_path))
+        assert stats == {"written": 0, "skipped": 1}
+        assert a.registry.counter(SNAPSHOT_SKIPPED).get(
+            {"reason": "lease_lost"}) == 1.0
+        assert a.registry.counter(DELTA_EVICTIONS).get(
+            {"reason": "lease_lost"}) == 1.0
+        assert len(a) == 0  # the chain is gone from the zombie
+        assert snap.read_record(str(tmp_path), "s1") == rec_before
+
+    def test_establishment_ownership_supersedes_foreign_lease(
+            self, tmp_path):
+        """DeltaSessionTable.own (the establish path): the client's
+        re-establishment force-takes the lease even while unexpired —
+        the old owner's incarnation is obsolete by the client's own
+        authority — and discards the obsolete record."""
+        clock, a, b = self._two_replicas()
+        a.put(_entry("s1", epoch=5))
+        a.snapshot(str(tmp_path))  # rep-a owns the lease, record on disk
+        b.put(_entry("s1", epoch=9))  # client re-established at rep-b
+        b.own("s1", str(tmp_path))
+        assert snap.lease_state(str(tmp_path), "s1")["owner"] == "rep-b"
+        assert snap.read_record(str(tmp_path), "s1") is None
+        # rep-a's next pass drops its zombie instead of livelocking
+        stats = a.snapshot(str(tmp_path))
+        assert stats["skipped"] == 1 and len(a) == 0
+
+
+    def test_catalog_epoch_pin_refuses_adoption(self, tmp_path,
+                                                monkeypatch):
+        """KT_CATALOG_EPOCH guards adopt-on-miss exactly like the boot
+        restore: a failed-over chain packed against another epoch's
+        prices must not serve warm."""
+        clock, a, _b = self._two_replicas()
+        a.put(_entry("s1"))
+        a.snapshot(str(tmp_path))
+        a.clear("stop")
+        monkeypatch.setenv("KT_CATALOG_EPOCH", "7")
+        c = DeltaSessionTable(registry=Registry(), clock=clock, capacity=8,
+                              replica="rep-c", lease_s=10.0)
+        assert c.adopt(str(tmp_path), "s1") is None
+        assert c.registry.counter(SESSION_ADOPTIONS).get(
+            {"outcome": "refused"}) == 1.0
+
+    def test_gc_reaps_orphans_but_not_leased_records(self, tmp_path):
+        """Unbounded-spool guard: a dead replica's records whose clients
+        never return are reaped once their BYTES are idle past the
+        session TTL — but an unexpired lease (a live sibling, or an
+        in-flight adoption) is hands-off, and fresh records are never
+        touched."""
+        clock = FakeClock(start=1000.0)
+        dead = DeltaSessionTable(registry=Registry(), clock=clock,
+                                 capacity=8, replica="dead", lease_s=1.0,
+                                 ttl_s=5.0)
+        for sid in ("orphan", "claimed", "fresh"):
+            dead.put(_entry(sid))
+        dead.snapshot(str(tmp_path))
+        # "claimed" stays lease-held (a live sibling steals it after the
+        # dead owner's lease expires); "orphan"'s lease just expires
+        clock.advance(2.0)  # past the dead replica's 1s lease
+        snap.claim_lease(str(tmp_path), "claimed", "live-sib",
+                         clock.now(), 10_000.0)
+        clock.advance(98.0)  # past ttl_s
+        # record age is WALL-clock mtime (a live writer refreshes every
+        # pass): backdate the idle records, keep "fresh" current
+        for sid in ("orphan", "claimed"):
+            path = snap.session_path(str(tmp_path), sid)
+            os.utime(path, (os.stat(path).st_atime,
+                            os.stat(path).st_mtime - 3600.0))
+        reaper = DeltaSessionTable(registry=Registry(), clock=clock,
+                                   capacity=8, replica="reaper",
+                                   lease_s=1.0, ttl_s=5.0)
+        reaper._gc_orphans(str(tmp_path))
+        remaining = set(snap.list_sessions(str(tmp_path)))
+        assert "orphan" not in remaining      # reaped
+        assert "claimed" in remaining         # unexpired lease: hands-off
+        assert "fresh" in remaining           # bytes still fresh
+
+    def test_fleetwide_drain_establishment_raises_typed(self, fleet_env):
+        """Every replica draining at once (rolling-restart tail): an
+        establishment has no sibling to re-home to — the facade raises
+        the typed, retriable SolverDraining, never a fake 'no live
+        endpoint' transport error (the replicas are alive)."""
+        from karpenter_tpu.service.client import (
+            DeltaSession, FleetClient, SolverDraining,
+        )
+
+        chaos, reps, provs, catalog, _spool = fleet_env
+        for rep in reps:
+            rep["service"].drain()
+        fc = FleetClient([r["sock"] for r in reps], timeout=60.0,
+                         retries=0, backoff_s=0.01)
+        sess = DeltaSession(reps[0]["sock"], timeout=60.0, client=fc)
+        with pytest.raises(SolverDraining):
+            sess.solve(chaos.make_pods(40, "fd"), provs, catalog)
+        sess.close()
+
+
+# --------------------------------------------------------------------------
+class TestAdoptionRaces:
+    def test_concurrent_adoption_exactly_one_winner(self, tmp_path):
+        """Two replicas adopting the same orphaned session concurrently
+        over one shared spool: exactly one wins the lease and holds the
+        chain; the loser is counted lease_held (or finds the record
+        already consumed) and holds nothing."""
+        clock = FakeClock(start=1000.0)
+        writer = DeltaSessionTable(registry=Registry(), clock=clock,
+                                   capacity=8, replica="dead", lease_s=1.0)
+        writer.put(_entry("hot", epoch=7))
+        writer.snapshot(str(tmp_path))
+        clock.advance(2.0)  # the writer is dead; its lease expired
+        tables = [DeltaSessionTable(registry=Registry(), clock=clock,
+                                    capacity=8, replica=f"surv-{i}",
+                                    lease_s=10.0)
+                  for i in range(4)]
+        results = {}
+        barrier = threading.Barrier(len(tables))
+
+        def adopt(i):
+            barrier.wait()
+            results[i] = tables[i].adopt(str(tmp_path), "hot")
+
+        threads = [threading.Thread(target=adopt, args=(i,))
+                   for i in range(len(tables))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        winners = [i for i, e in results.items() if e is not None]
+        assert len(winners) == 1, results
+        holder = tables[winners[0]]
+        assert holder.get("hot").epoch == 7
+        losers = [t for i, t in enumerate(tables) if i != winners[0]]
+        assert all(len(t) == 0 for t in losers)
+        outcomes = {}
+        for t in tables:
+            for lk, v in t.registry.counter(
+                    SESSION_ADOPTIONS).values.items():
+                if v:
+                    outcomes[dict(lk)["outcome"]] = \
+                        outcomes.get(dict(lk)["outcome"], 0) + int(v)
+        # one successful adoption ("stolen" normally; "adopted" when the
+        # winner lost the yank micro-race but won the re-create)
+        assert outcomes.get("stolen", 0) + outcomes.get("adopted", 0) == 1
+        # every loser was counted (lease_held against the winner, or
+        # missing when it lost the race after the record was consumed)
+        assert sum(outcomes.values()) == len(tables)
+
+
+# --------------------------------------------------------------------------
+@pytest.fixture
+def fleet_env(tmp_path, monkeypatch, small_catalog):
+    """Three in-process replicas on unix sockets sharing one spool +
+    a drain-ready client kit."""
+    monkeypatch.setenv("KT_SESSION_SNAPSHOT_S", "0.0001")
+    monkeypatch.setenv("KT_SESSION_LEASE_S", "0.4")
+    chaos = _chaos_drive()
+    spool = str(tmp_path / "spool")
+    reps = [chaos._build_replica(f"unix:{tmp_path}/r{i}.sock", spool,
+                                 f"replica-{i}", 0.4, 0.0001)
+            for i in range(3)]
+    provs = [Provisioner(name="default").with_defaults()]
+    yield chaos, reps, provs, small_catalog, spool
+    for rep in reps:
+        try:
+            rep["srv"].stop(grace=None)
+            rep["service"].close()
+        except Exception:  # noqa: BLE001 — teardown
+            pass
+
+
+class TestDrainHandshake:
+    def test_drain_refuses_new_sessions_typed(self, fleet_env):
+        from karpenter_tpu.metrics import DELTA_RPC
+        from karpenter_tpu.service.client import DeltaSession, SolverDraining
+
+        chaos, reps, provs, catalog, _spool = fleet_env
+        rep = reps[0]
+        rep["service"].drain()
+        sess = DeltaSession(rep["sock"], timeout=60.0)
+        with pytest.raises(SolverDraining):
+            sess.solve(chaos.make_pods(40, "dr"), provs, catalog)
+        assert rep["reg"].counter(DELTA_RPC).get(
+            {"outcome": "drain_refused"}) == 1.0
+        sess.close()
+
+    def test_drain_handshake_rehomes_warm_under_sanitizer(self, fleet_env):
+        """The full handshake under KT_SANITIZE=1: a served delta carries
+        the DRAINING hint and hands its chain off (record + released
+        lease + drop), the fleet client re-homes, and the sibling adopts
+        and serves the next delta WARM — zero re-establishes, lock
+        discipline clean under the runtime order-asserting proxies."""
+        from karpenter_tpu.analysis import sanitize
+        from karpenter_tpu.service.client import DeltaSession, FleetClient
+
+        chaos, reps, provs, catalog, spool = fleet_env
+        pre = sanitize.installed()
+        if not pre:
+            sanitize.install()
+        try:
+            socks = [r["sock"] for r in reps]
+            fc = FleetClient(socks, timeout=60.0, retries=1,
+                             backoff_s=0.02)
+            sess = DeltaSession(socks[0], timeout=60.0, client=fc)
+            sess.solve(chaos.make_pods(120, "dh"), provs, catalog)
+            sess.solve_delta(added=chaos.make_pods(2, "dh1"))
+            home = fc.endpoint_for(sess.session_id)
+            victim = next(r for r in reps if r["sock"] == home)
+            victim["service"].drain()
+            epoch_before = sess.epoch
+            # this delta is SERVED by the drainer (warm) + hands off
+            sess.solve_delta(added=chaos.make_pods(2, "dh2"))
+            assert sess.epoch == epoch_before + 1
+            assert fc.states()[home] == "draining"
+            assert victim["reg"].counter(DELTA_EVICTIONS).get(
+                {"reason": "drain"}) == 1.0
+            with victim["pipe"]._delta_tab._lock:
+                assert sess.session_id not in \
+                    victim["pipe"]._delta_tab._sessions
+            # next delta re-homes to a sibling, which ADOPTS — warm
+            cur = sess.solve_delta(added=chaos.make_pods(2, "dh3"))
+            assert sess.full_resends == 1  # ZERO re-establishes
+            assert sess.epoch == epoch_before + 2
+            new_home = fc.endpoint_for(sess.session_id)
+            assert new_home != home
+            adopter = next(r for r in reps if r["sock"] == new_home)
+            assert adopter["reg"].counter(SESSION_ADOPTIONS).get(
+                {"outcome": "adopted"}) == 1.0
+            with adopter["pipe"]._delta_tab._lock:
+                entry = adopter["pipe"]._delta_tab._sessions[
+                    sess.session_id]
+            assert entry.prev.assignments == cur.assignments
+            sess.close()
+        finally:
+            if not pre:
+                sanitize.uninstall()
+
+
+class TestFleetClient:
+    def test_requires_endpoints(self, monkeypatch):
+        from karpenter_tpu.service.client import FleetClient
+
+        monkeypatch.delenv("KT_FLEET_ENDPOINTS", raising=False)
+        with pytest.raises(ValueError):
+            FleetClient()
+
+    def test_env_endpoints_parse(self, monkeypatch):
+        from karpenter_tpu.service.client import FleetClient
+
+        monkeypatch.setenv("KT_FLEET_ENDPOINTS",
+                           "unix:/tmp/a.sock, unix:/tmp/b.sock")
+        fc = FleetClient(registry=Registry())
+        assert fc.endpoints == ["unix:/tmp/a.sock", "unix:/tmp/b.sock"]
+        fc.close()
+
+    def test_rendezvous_routing_is_stable_and_spread(self):
+        from karpenter_tpu.service.client import FleetClient
+
+        eps = [f"unix:/tmp/e{i}.sock" for i in range(3)]
+        fc = FleetClient(eps, registry=Registry())
+        homes = {}
+        for i in range(60):
+            sid = f"session-{i}"
+            home = fc.endpoint_for(sid)
+            assert fc.endpoint_for(sid) == home  # stable
+            homes.setdefault(home, 0)
+            homes[home] += 1
+        assert len(homes) == 3  # every endpoint serves some sessions
+        # one endpoint dead -> ONLY its sessions move, deterministically
+        dead = max(homes, key=homes.get)
+        fc._mark(dead, "dead")
+        fc._last_probe = {ep: float("inf") for ep in eps}  # no revival
+        for i in range(60):
+            sid = f"session-{i}"
+            home = fc.endpoint_for(sid)
+            assert home != dead
+            if fc.rendezvous(sid)[0] != dead:
+                assert home == fc.rendezvous(sid)[0]  # unmoved
+        fc.close()
+
+    def test_metrics_zero_init_and_states(self):
+        from karpenter_tpu.service.client import FleetClient
+
+        reg = Registry()
+        eps = ["unix:/tmp/x.sock", "unix:/tmp/y.sock"]
+        fc = FleetClient(eps, registry=reg)
+        from karpenter_tpu.metrics import FLEET_FAILOVER_REASONS
+
+        for reason in FLEET_FAILOVER_REASONS:
+            assert reg.counter(FLEET_FAILOVERS).has({"reason": reason})
+        assert reg.gauge(FLEET_ENDPOINTS).get({"state": "known"}) == 2.0
+        assert reg.gauge(FLEET_ENDPOINTS).get({"state": "healthy"}) == 2.0
+        assert fc.states() == {ep: "healthy" for ep in eps}
+        fc.close()
+
+
+class TestFleetFailoverWarm:
+    def test_kill_one_replica_adopts_warm(self, fleet_env):
+        """Hard-kill the session's home replica: after the lease TTL the
+        re-routed delta is served WARM by a steal-adopting survivor —
+        zero re-establishing solves, chain byte-equal to the client
+        view."""
+        from karpenter_tpu.service.client import DeltaSession, FleetClient
+
+        chaos, reps, provs, catalog, _spool = fleet_env
+        socks = [r["sock"] for r in reps]
+        fc = FleetClient(socks, timeout=60.0, retries=0, backoff_s=0.01)
+        sess = DeltaSession(socks[0], timeout=60.0, client=fc)
+        sess.solve(chaos.make_pods(150, "kw"), provs, catalog)
+        for k in range(2):
+            sess.solve_delta(added=chaos.make_pods(2, f"kw{k}"))
+        chaos._settle_spool(reps)
+        home = fc.endpoint_for(sess.session_id)
+        victim = next(r for r in reps if r["sock"] == home)
+        chaos._hard_kill(victim)
+        time.sleep(0.7)  # past the 0.4s lease TTL
+        epoch_before = sess.epoch
+        cur = sess.solve_delta(added=chaos.make_pods(2, "kwpost"))
+        assert sess.full_resends == 1          # ZERO re-establishes
+        assert sess.epoch == epoch_before + 1  # the chain continued
+        new_home = fc.endpoint_for(sess.session_id)
+        assert new_home != home
+        adopter = next(r for r in reps if r["sock"] == new_home)
+        assert adopter["reg"].counter(SESSION_ADOPTIONS).get(
+            {"outcome": "stolen"}) == 1.0
+        with adopter["pipe"]._delta_tab._lock:
+            entry = adopter["pipe"]._delta_tab._sessions[sess.session_id]
+        assert entry.prev.assignments == cur.assignments
+        sess.close()
+
+
+class TestFleetChaosSmoke:
+    """Tier-1 rung of `make chaos-fleet`: the kill-one-of-three scenario
+    over real gRPC on unix sockets — lease-steal adoption, zero
+    re-establishes, per-step byte-parity vs the fault-free oracle and
+    the single-owner audit all asserted inside the harness."""
+
+    def test_seeded_kill_one_of_three_recovers_warm(self):
+        chaos = _chaos_drive()
+        board = chaos.run_fleet(replicas=3, clients=4, pods_n=320,
+                                pre_steps=2, post_steps=2, churn=3,
+                                seed=12, mode="kill", verbose=False)
+        assert board["victim_sessions"] >= 1
+        assert board["extra_resends"] == 0
+        assert board["adoptions"].get("stolen", 0) \
+            >= board["victim_sessions"]
+
+    def test_seeded_drain_one_of_three_rehomes_warm(self):
+        chaos = _chaos_drive()
+        board = chaos.run_fleet(replicas=3, clients=4, pods_n=320,
+                                pre_steps=2, post_steps=2, churn=3,
+                                seed=12, mode="drain", verbose=False)
+        assert board["victim_sessions"] >= 1
+        assert board["extra_resends"] == 0
+        assert board["adoptions"].get("adopted", 0) \
+            >= board["victim_sessions"]
+
+
+# --------------------------------------------------------------------------
+class TestStatuszFleet:
+    def test_fleet_block_surfaces_ownership_and_endpoints(self, tmp_path):
+        from karpenter_tpu.obs.export import statusz
+        from karpenter_tpu.service.client import FleetClient
+
+        reg = Registry()
+        clock = FakeClock(start=1000.0)
+        writer = DeltaSessionTable(registry=Registry(), clock=clock,
+                                   capacity=8, replica="dead", lease_s=1.0)
+        writer.put(_entry("s1", epoch=3))
+        writer.snapshot(str(tmp_path))
+        clock.advance(2.0)
+        tab = DeltaSessionTable(registry=reg, clock=clock, capacity=8,
+                                replica="surv", lease_s=10.0)
+        assert tab.adopt(str(tmp_path), "s1") is not None
+        fc = FleetClient(["unix:/tmp/zz.sock"], registry=reg)
+        doc = statusz(reg)
+        assert doc["fleet"]["sessions_owned"] == 1.0
+        assert doc["fleet"]["leases_owned"] == 1.0
+        assert doc["fleet"]["adoptions"]["stolen"] == 1.0
+        assert doc["fleet"]["endpoints"]["known"] == 1.0
+        fc.close()

@@ -947,6 +947,100 @@ class TestKT016FaultPlaneDiscipline:
         assert "KT016" not in rules_of(lint(src, self.SVC))
 
 
+class TestKT017SpoolFacadeDiscipline:
+    """ISSUE 13: the session spool's record/lease primitives
+    (service/snapshot.py) may only be driven by the DeltaSessionTable
+    facade (service/delta.py) — a drive-by spool access from the server
+    or client layer bypasses the exactly-one-owner lease protocol."""
+
+    SVC = "karpenter_tpu/service/server.py"
+
+    def test_fires_on_lease_primitive_in_server_layer(self):
+        src = """
+        from . import snapshot as snap
+
+        class Pipe:
+            def _serve(self, sid):
+                snap.claim_lease(self._spool_dir, sid, "me", 0.0, 10.0)
+        """
+        findings = lint(src, self.SVC)
+        assert "KT017" in rules_of(findings)
+        assert any("lease API" in f.message for f in findings)
+
+    def test_fires_on_record_read_in_client_layer(self):
+        src = """
+        from . import snapshot as snap
+
+        def peek(dir_path, sid):
+            return snap.read_record(dir_path, sid)
+        """
+        assert "KT017" in rules_of(
+            lint(src, "karpenter_tpu/service/client.py"))
+
+    def test_fires_on_bare_name_call(self):
+        src = """
+        from .snapshot import release_lease
+
+        def cleanup(dir_path, sid):
+            release_lease(dir_path, sid, "me")
+        """
+        assert "KT017" in rules_of(lint(src, self.SVC))
+
+    def test_snapshot_py_is_the_api_home(self):
+        src = """
+        def claim_lease(dir_path, sid, owner, now, ttl_s):
+            return lease_path(dir_path, sid)
+        """
+        assert "KT017" not in rules_of(
+            lint(src, "karpenter_tpu/service/snapshot.py"))
+
+    def test_delta_py_is_the_facade(self):
+        src = """
+        from . import snapshot as snap
+
+        class DeltaSessionTable:
+            def adopt(self, dir_path, sid):
+                blob = snap.read_record(dir_path, sid)
+                return blob
+        """
+        assert "KT017" not in rules_of(
+            lint(src, "karpenter_tpu/service/delta.py"))
+
+    def test_out_of_scope_dirs_are_quiet(self):
+        # the chaos harness and tests peek deliberately; solver/ has no
+        # spool business and is out of scope
+        src = """
+        from karpenter_tpu.service import snapshot as snap
+
+        def peek(d, sid):
+            return snap.read_record(d, sid)
+        """
+        assert "KT017" not in rules_of(
+            lint(src, "karpenter_tpu/solver/tpu.py"))
+
+    def test_table_facade_calls_are_quiet(self):
+        # driving the spool THROUGH the table is the sanctioned shape
+        src = """
+        class Pipe:
+            def _serve(self, sid):
+                entry = self._delta_tab.adopt(self._spool_dir, sid)
+                self._delta_tab.handoff(sid, self._spool_dir)
+                return entry
+        """
+        assert "KT017" not in rules_of(lint(src, self.SVC))
+
+    def test_suppression_with_reason(self):
+        src = """
+        from . import snapshot as snap
+
+        class Pipe:
+            def _debug(self, sid):
+                # ktlint: allow[KT017] read-only statusz forensics dump
+                return snap.lease_state(self._spool_dir, sid)
+        """
+        assert "KT017" not in rules_of(lint(src, self.SVC))
+
+
 class TestSuppressionGrammar:
     SRC = """
     import time

@@ -266,6 +266,78 @@ class TestResilienceSeries:
             {"kind": "session_wipe", "site": "session_table"})
 
 
+class TestFleetSeries:
+    """ISSUE 13: the fleet-failover families are born at zero — adoption
+    outcomes + the lease gauge from DeltaSessionTable construction, and
+    endpoint states + failover reasons from FleetClient construction —
+    and survive into expose()."""
+
+    def test_adoption_families_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            SESSION_ADOPTION_OUTCOMES,
+            SESSION_ADOPTIONS,
+            SESSION_LEASES,
+        )
+        from karpenter_tpu.service.delta import DeltaSessionTable
+
+        reg = Registry()
+        DeltaSessionTable(registry=reg)
+        for outcome in SESSION_ADOPTION_OUTCOMES:
+            assert series_exists(reg.counter(SESSION_ADOPTIONS),
+                                 {"outcome": outcome})
+        assert reg.gauge(SESSION_LEASES).has()
+        text = reg.expose()
+        assert ('karpenter_solver_session_adoptions_total'
+                '{outcome="lease_held"} 0') in text
+        assert 'karpenter_solver_session_leases_owned 0' in text
+
+    def test_fleet_client_families_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            FLEET_ENDPOINT_STATES,
+            FLEET_ENDPOINTS,
+            FLEET_FAILOVER_REASONS,
+            FLEET_FAILOVERS,
+        )
+        from karpenter_tpu.service.client import FleetClient
+
+        reg = Registry()
+        fc = FleetClient(["unix:/tmp/never.sock"], registry=reg)
+        try:
+            for reason in FLEET_FAILOVER_REASONS:
+                assert series_exists(reg.counter(FLEET_FAILOVERS),
+                                     {"reason": reason})
+            for state in FLEET_ENDPOINT_STATES:
+                assert series_exists(reg.gauge(FLEET_ENDPOINTS),
+                                     {"state": state})
+            text = reg.expose()
+            assert ('karpenter_fleet_failovers_total'
+                    '{reason="death"} 0') in text
+            assert 'karpenter_fleet_endpoints{state="known"} 1' in text
+        finally:
+            fc.close()
+
+    def test_new_label_values_in_evict_and_skip_families(self):
+        """The populations grown by ISSUE 13 ('drain'/'lease_lost'
+        evictions, 'lease_lost' snapshot skips, 'drain_refused' RPC
+        outcomes) are zero-inited like the rest of their families."""
+        from karpenter_tpu.metrics import (
+            DELTA_EVICTIONS,
+            DELTA_RPC,
+            SNAPSHOT_SKIPPED,
+        )
+        from karpenter_tpu.service.delta import DeltaSessionTable
+
+        reg = Registry()
+        DeltaSessionTable(registry=reg)
+        for reason in ("drain", "lease_lost"):
+            assert series_exists(reg.counter(DELTA_EVICTIONS),
+                                 {"reason": reason})
+        assert series_exists(reg.counter(SNAPSHOT_SKIPPED),
+                             {"reason": "lease_lost"})
+        assert series_exists(reg.counter(DELTA_RPC),
+                             {"outcome": "drain_refused"})
+
+
 class TestAdmissionSeries:
     """ISSUE 5: the admission subsystem's full label population is born at
     zero from AdmissionControl construction — classes x shed reasons,
