@@ -2,7 +2,7 @@ PYTHON ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
 
-.PHONY: all test native proto bench clean battletest lint obs-demo overload-demo chaos chaos-fleet
+.PHONY: all test native proto bench clean battletest lint obs-demo overload-demo chaos chaos-fleet multihost-dryrun
 
 all: native proto
 
@@ -94,6 +94,18 @@ chaos-fleet:
 	      --mode $$mode --seed $$seed || exit 1; \
 	  done; \
 	done
+
+# multi-host megabatch dryrun (ISSUE 14): 2 real jax.distributed
+# processes x 4 virtual CPU devices each serve one coalesced megabatch
+# SPMD — per-host fences read EXACTLY 1/2 of the whole-batch bytes
+# (addressable shards only), foreign slots resolve typed SlotNotOwned
+# with the true owner, owned slots byte-identical to single-process
+# serial solves; then the single-process lone-request A/B (per-host
+# fence vs whole-batch readback).  Skips cleanly when the jaxlib has no
+# gloo CPU collectives (the tests/test_parallel.py capability probe).
+multihost-dryrun:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/dryrun_multihost.py
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/dryrun_multihost.py --lone-ab
 
 clean:
 	rm -f karpenter_tpu/solver/_native*.so

@@ -5,11 +5,12 @@ driver builds one :class:`~karpenter_tpu.analysis.callgraph.Project` per
 run and shares it.  The catalog lives in docs/ANALYSIS.md."""
 
 from . import (kt001, kt002, kt003, kt004, kt005, kt006, kt007, kt008, kt009,
-               kt010, kt011, kt012, kt013, kt014, kt015, kt016, kt017)
+               kt010, kt011, kt012, kt013, kt014, kt015, kt016, kt017,
+               kt018)
 
 ALL_RULES = (kt001, kt002, kt003, kt004, kt005, kt006, kt007, kt008, kt009,
-             kt010, kt011, kt012, kt013, kt014, kt015, kt016, kt017)
+             kt010, kt011, kt012, kt013, kt014, kt015, kt016, kt017, kt018)
 
 __all__ = ["ALL_RULES", "kt001", "kt002", "kt003", "kt004", "kt005", "kt006",
            "kt007", "kt008", "kt009", "kt010", "kt011", "kt012", "kt013",
-           "kt014", "kt015", "kt016", "kt017"]
+           "kt014", "kt015", "kt016", "kt017", "kt018"]

@@ -1,0 +1,109 @@
+"""KT018 — whole-batch readback of a mesh-sharded megabatch carry.
+
+ISSUE 14 made megabatch fences PER-HOST: on a multi-process mesh each
+serving process reads back only its ``jax.process_index()``-addressable
+slot shards (``solver/tpu.read_slot_rows`` — the sanctioned accessor) and
+demuxes exactly the slots it owns.  The bug class this rule pins is the
+one that round removed: a ``.results()``/extraction path calling
+``np.asarray`` / ``np.array`` / ``jax.device_get`` directly on the
+slot-stacked carry — on a multi-host mesh that is a WHOLE-batch D2H, so
+every host pays DCN latency (and memory) for slots it does not own, and
+on arrays with non-addressable shards it simply crashes.
+
+Mechanics (a lexical convention rule, the KT002/KT016 precedent): in the
+serving-path files, any call to the readback functions whose argument
+expression references the stacked-carry naming convention —
+``carry_b`` / ``ys_b`` (names, attributes, or subscripts of either) — is
+a finding, except inside ``read_slot_rows`` itself (the accessor owns
+its raw reads, annotated ``allow[KT018]`` line-by-line anyway).  The
+single-solve ``carry`` (no ``_b``) is out of scope: its result is
+genuinely global.
+
+Deliberate exceptions carry ``# ktlint: allow[KT018] <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..ktlint import Finding, dotted_name
+
+ID = "KT018"
+TITLE = "whole-batch readback of a mesh-sharded megabatch carry"
+HINT = ("route stacked-carry reads through solver/tpu.read_slot_rows "
+        "(the addressable-shard accessor): a raw np.asarray/device_get "
+        "on carry_b/ys_b reads the WHOLE batch — every host pays DCN for "
+        "slots it does not own; a deliberate exception needs "
+        "`# ktlint: allow[KT018] <reason>`")
+
+#: serving-path scope (the KT011 file set: where megabatch carries live)
+SCOPE_FILES = (
+    "solver/tpu.py", "solver/scheduler.py", "solver/consolidation.py",
+    "service/server.py", "batcher.py",
+)
+#: the readback callables
+READBACKS = {"asarray", "array", "device_get"}
+#: the slot-stacked carry naming convention (dim 0 = request slot)
+STACKED_NAMES = {"carry_b", "ys_b"}
+#: the sanctioned accessor — its own raw reads are the implementation
+ACCESSOR = "read_slot_rows"
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(p.endswith(s) for s in SCOPE_FILES)
+
+
+def _leaf(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _mentions_stacked(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in STACKED_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in STACKED_NAMES:
+            return True
+    return False
+
+
+def _walk_outside_accessor(tree: ast.AST):
+    """Yield Call nodes, skipping the body of the accessor function."""
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == ACCESSOR:
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check(files) -> List[Finding]:
+    out: List[Finding] = []
+    for f in files:
+        if not _in_scope(f.path):
+            continue
+        for call in _walk_outside_accessor(f.tree):
+            name = _leaf(call)
+            if name not in READBACKS:
+                continue
+            if not any(_mentions_stacked(a) for a in call.args):
+                continue
+            where = dotted_name(call.func) or name
+            out.append(Finding(
+                ID, f.path, call.lineno,
+                f"`{where}(...)` reads a slot-stacked megabatch carry "
+                "(carry_b/ys_b) whole — on a multi-host mesh that pays "
+                "DCN for every foreign slot (or crashes on "
+                "non-addressable shards); use the addressable-shard "
+                "accessor read_slot_rows",
+                hint=HINT,
+            ))
+    return out

@@ -151,6 +151,17 @@ class SlotCoalescer(Generic[T]):
       (``poll``/``flush``, clocked through the injectable Clock so
       FakeClock tests are deterministic).
 
+    **Mixed-bucket unification** (ISSUE 14): an optional ``unify(held_key,
+    new_key)`` hook — the scheduler's ``unify_buckets`` — may return a
+    MERGED key instead of None when the two compile buckets can share one
+    program (one's dims dominate the other's); the arriving item then
+    JOINS the held batch under the merged key instead of forcing a
+    "bucket" flush, so a host-major mesh dispatch serves both shapes in
+    one flush instead of two serial ones.  ``on_unify`` fires per
+    unification (metrics hook).  Slot packing stays host-major-contiguous
+    by construction: items keep arrival order and the dispatch pads at
+    the END, so a partially-full flush lights whole hosts first.
+
     Single-threaded by contract: the pipeline's dispatcher thread owns it,
     exactly like ``InflightQueue``'s producer side.  The coalescer never
     executes anything — it only decides batch boundaries; the caller
@@ -161,10 +172,15 @@ class SlotCoalescer(Generic[T]):
         max_slots: int = 8,
         max_wait: float = 0.0,
         clock: Optional[Clock] = None,
+        unify: Optional[Callable[[Hashable, Hashable],
+                                 Optional[Hashable]]] = None,
+        on_unify: Optional[Callable[[], None]] = None,
     ) -> None:
         self.max_slots = max(1, max_slots)
         self.max_wait = max(0.0, max_wait)
         self.clock = clock or Clock()
+        self.unify = unify
+        self.on_unify = on_unify
         self._key: Optional[Hashable] = None
         self._items: List[T] = []
         self._first_at: Optional[float] = None
@@ -193,10 +209,27 @@ class SlotCoalescer(Generic[T]):
         """Admit one item; returns the list of ``(reason, key, items)``
         batches this admission flushed, oldest first.  A ``None`` key first
         flushes the held batch (bucket change), then flushes the item alone
-        — unbatchable requests never wait behind a deadline."""
+        — unbatchable requests never wait behind a deadline.  A different
+        non-None key first consults ``unify``: a merged key re-keys the
+        held batch and the item joins it (no flush)."""
         out = []
         if self._items and (key is None or key != self._key):
-            out.append(("bucket", self._key, self._take()))
+            merged = None
+            if key is not None and self.unify is not None:
+                # the hook is a scheduler contract, but a facade's probe
+                # must never fail the dispatcher (the _bucket_of idiom)
+                try:
+                    merged = self.unify(self._key, key)
+                # ktlint: allow[KT005] unification is an optimization —
+                # a failing hook just keeps the two-flush path
+                except Exception:
+                    merged = None
+            if merged is not None:
+                self._key = merged
+                if self.on_unify is not None:
+                    self.on_unify()
+            else:
+                out.append(("bucket", self._key, self._take()))
         if key is None:
             out.append(("bucket", None, [item]))
             return out

@@ -289,6 +289,18 @@ CONSOLIDATION_SWEEPS = "karpenter_solver_consolidation_sweeps_total"
 CONSOLIDATION_SWEEP_SLOTS = "karpenter_solver_consolidation_sweep_slots"
 CONSOLIDATION_SWEEP_DURATION = (
     "karpenter_solver_consolidation_sweep_duration_seconds")
+MULTIHOST_FENCE_BYTES = "karpenter_solver_multihost_fence_bytes_total"
+#: the per-host fence's byte accounting scopes: what this process actually
+#: read (its addressable slot shards) vs what a whole-batch readback would
+#: have transferred — read/whole per host converges to 1/N at N hosts
+MULTIHOST_FENCE_SCOPES = ("read", "whole")
+MULTIHOST_SLOTS = "karpenter_solver_multihost_slots_total"
+#: per-host demux ownership of real (non-padding) megabatch slots
+MULTIHOST_SLOT_OWNERSHIP = ("owned", "foreign")
+MULTIHOST_FORWARDS = "karpenter_solver_multihost_forwards_total"
+#: forwarding-shim outcomes for foreign-slot requests
+MULTIHOST_FORWARD_OUTCOMES = ("forwarded", "error", "unrouted")
+MULTIHOST_UNIFIED = "karpenter_solver_multihost_unified_flushes_total"
 
 #: metric inventory: name -> (type, labels, help).  docs/METRICS.md is
 #: generated from this table (``karpenter-tpu metrics-doc``), mirroring the
@@ -668,6 +680,37 @@ INVENTORY = {
         "histogram", (),
         "Wall time of one consolidation what-if sweep (all candidates, "
         "either path), seconds."),
+    MULTIHOST_FENCE_BYTES: (
+        "counter", ("scope",),
+        "Per-host megabatch fence byte accounting (ISSUE 14): 'read' is "
+        "what this serving process actually transferred D2H (only its "
+        "jax.process_index()-addressable slot shards of the carry), "
+        "'whole' is what the legacy whole-batch readback would have "
+        "transferred.  read/whole per host sits at ~1/N on an N-host "
+        "mesh; KT_MULTIHOST=0 forces the legacy path (read == whole)."),
+    MULTIHOST_SLOTS: (
+        "counter", ("ownership",),
+        "Real (non-padding) megabatch slots demuxed by a multi-process "
+        "fence, by ownership: 'owned' (this process held the slot's "
+        "shards, extracted and responded locally) vs 'foreign' (another "
+        "host owns it — resolved typed SlotNotOwned and handed to the "
+        "forwarding shim)."),
+    MULTIHOST_FORWARDS: (
+        "counter", ("outcome",),
+        "Foreign-slot requests routed through the cross-host result-"
+        "forwarding shim (parallel/forward.py, KT_MULTIHOST_PEERS): "
+        "'forwarded' (served by the owning host over the fleet "
+        "transport), 'error' (the owner's endpoint failed), 'unrouted' "
+        "(shim disabled / owner unknown — the typed error surfaced to "
+        "the caller)."),
+    MULTIHOST_UNIFIED: (
+        "counter", (),
+        "Mixed-bucket flushes whose dims UNIFIED into the dominant "
+        "bucket's program (solver/tpu.py unify_mega_keys): the whole "
+        "flush shared one mesh dispatch instead of serial per-bucket "
+        "ones.  Counted once per unified DISPATCH, at the collector's "
+        "group merge (the coalescer's unify join feeds the same flush, "
+        "so it does not count separately)."),
 }
 
 

@@ -172,7 +172,30 @@ def launch_dryrun(
     retries: int = 2,
 ) -> List[str]:
     """Spawn ``n_processes`` distributed workers on this machine (virtual
-    CPU devices) and return their stdout tails; raises on any failure.
+    CPU devices) and return their stdout tails; raises on any failure."""
+    return launch_workers(
+        [sys.executable, "-m", "karpenter_tpu.parallel.distributed"],
+        n_processes, local_devices, timeout=timeout, port=port,
+        retries=retries)
+
+
+def launch_workers(
+    worker_cmd: List[str],
+    n_processes: int = 2,
+    local_devices: int = 2,
+    *,
+    timeout: float = 600.0,
+    port: int = 0,
+    retries: int = 2,
+) -> List[str]:
+    """Spawn ``n_processes`` copies of ``worker_cmd`` wired into one
+    ``jax.distributed`` job over virtual CPU devices (the way multi-host
+    is validated without N real hosts) and return their stdout tails;
+    raises on any failure.  Each worker receives the standard coordination
+    flags (``--coordinator/--num-processes/--process-id/--local-devices``)
+    appended to ``worker_cmd`` — the multihost dryrun
+    (scripts/dryrun_multihost.py) and the plain distributed worker both
+    ride this one launcher.
 
     The coordinator port is picked by bind-and-release, which is racy
     (another process can grab it before worker 0 binds), so a launch that
@@ -184,7 +207,8 @@ def launch_dryrun(
     attempts = 1 + (max(0, retries) if port == 0 else 0)
     for _ in range(attempts):
         try:
-            return _launch_once(n_processes, local_devices, timeout, port)
+            return _launch_once(worker_cmd, n_processes, local_devices,
+                                timeout, port)
         except RuntimeError as e:
             last_err = e
             msg = str(e).lower()
@@ -196,7 +220,8 @@ def launch_dryrun(
 
 
 def _launch_once(
-    n_processes: int, local_devices: int, timeout: float, port: int,
+    worker_cmd: List[str], n_processes: int, local_devices: int,
+    timeout: float, port: int,
 ) -> List[str]:
     import socket
 
@@ -215,11 +240,11 @@ def _launch_once(
     procs = []
     for pid in range(n_processes):
         procs.append(subprocess.Popen(
-            [sys.executable, "-m", "karpenter_tpu.parallel.distributed",
-             "--coordinator", coordinator,
-             "--num-processes", str(n_processes),
-             "--process-id", str(pid),
-             "--local-devices", str(local_devices)],
+            list(worker_cmd) + [
+                "--coordinator", coordinator,
+                "--num-processes", str(n_processes),
+                "--process-id", str(pid),
+                "--local-devices", str(local_devices)],
             env=env, cwd=repo_root,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         ))

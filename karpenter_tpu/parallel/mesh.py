@@ -153,6 +153,71 @@ def axis_sharding(mesh: Mesh, *names: str) -> NamedSharding:
     return NamedSharding(mesh, P(*names))
 
 
+# ---------------------------------------------------------------------------
+# host-ownership map (ISSUE 14: per-host fences over addressable shards)
+# ---------------------------------------------------------------------------
+# The slot axis shards over :func:`slot_mesh`'s flattened, HOST-MAJOR device
+# order, so a padded B_pad-slot megabatch splits into n_dev contiguous
+# blocks of B_pad/n_dev slots and every host's slots form ONE contiguous
+# range.  These pure-host helpers derive who owns what, so each serving
+# process can fence and demux exactly its own slots
+# (solver/tpu.py PendingMegaSolve.results) instead of paying DCN latency to
+# read the whole batch back.
+
+
+def _owner_blocks(proc_of_dev: Sequence[int], n_slots: int) -> tuple:
+    """Owner process index per slot, given the flattened (host-major)
+    per-device process indexes.  ``n_slots`` must divide evenly over the
+    devices (the sharded rung ladder guarantees it — ``_mega_rung`` floors
+    at the device count and doubles)."""
+    n_dev = len(proc_of_dev)
+    if n_slots % n_dev:
+        raise ValueError(
+            f"{n_slots} slots do not divide over {n_dev} devices: the "
+            "sharded rung ladder should have padded to a multiple")
+    per_dev = n_slots // n_dev
+    return tuple(proc_of_dev[s // per_dev] for s in range(n_slots))
+
+
+def multihost(mesh: Optional[Mesh]) -> bool:
+    """True when ``mesh`` spans more than one process — the regime where a
+    whole-batch fence pays DCN for slots this host does not own."""
+    if mesh is None:
+        return False
+    procs = {getattr(d, "process_index", 0)
+             for d in mesh.devices.reshape(-1)}
+    return len(procs) > 1
+
+
+def slot_hosts(mesh: Mesh, n_slots: int) -> tuple:
+    """Owner process index for each of ``n_slots`` padded request slots of
+    a megabatch sharded over :func:`slot_mesh` — host-major contiguous by
+    construction (each host's slots are one contiguous block)."""
+    flat = mesh.devices.reshape(-1)
+    return _owner_blocks(
+        [getattr(d, "process_index", 0) for d in flat], n_slots)
+
+
+def local_slot_range(
+    mesh: Mesh, n_slots: int, process_index: Optional[int] = None,
+) -> Tuple[int, int]:
+    """The contiguous ``[start, stop)`` slot range this process owns in a
+    ``n_slots``-padded megabatch (empty range when the process holds no
+    device of the mesh).  Defaults to ``jax.process_index()``."""
+    if process_index is None:
+        process_index = jax.process_index()
+    owners = slot_hosts(mesh, n_slots)
+    mine = [s for s, p in enumerate(owners) if p == process_index]
+    if not mine:
+        return (0, 0)
+    lo, hi = mine[0], mine[-1] + 1
+    # host-major contiguity is a layout INVARIANT (slot_mesh's flatten);
+    # a hole would mean the ownership map and the sharding disagree
+    assert hi - lo == len(mine), (
+        f"process {process_index} owns non-contiguous slots {mine}")
+    return (lo, hi)
+
+
 def mesh_signature(mesh: Optional[Mesh]) -> tuple:
     """Hashable (axis, size) fingerprint of a mesh for compile-bucket keys:
     two schedulers over different meshes run different partitioned programs,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set
 
@@ -253,6 +254,17 @@ class FleetClient:
     answers revives the endpoint (a replaced pod on the same address).
     Draining endpoints revive the same way once their replacement serves.
 
+    CLASSIC (session-less) solves route by BUCKET AFFINITY (ISSUE 14
+    satellite, ROADMAP item 1 remnant): the request's compile-signature
+    proxy — pod-count rung, catalog rung, provisioner count — rendezvous-
+    hashes over the fleet, so repeat shapes land on the replica whose jit
+    cache and tensorize cache already warmed them, instead of every
+    sessionless solve hashing ``""`` onto one replica.  When the affinity
+    home is dead/draining the request falls back to the LEAST-LOADED
+    healthy endpoint (fewest in-flight RPCs through this client) rather
+    than piling onto the next rendezvous winner.
+    ``KT_FLEET_BUCKET_AFFINITY=0`` restores the legacy hash-of-"" route.
+
     Knobs: ``KT_FLEET_ENDPOINTS`` (comma-separated targets) when no
     explicit endpoint list is given.  Endpoint states are exported as
     ``karpenter_fleet_endpoints{state}`` and re-homes as
@@ -292,6 +304,13 @@ class FleetClient:
                                        for ep in self.endpoints}
         self._last_probe: Dict[str, float] = {ep: 0.0
                                               for ep in self.endpoints}
+        #: classic-solve bucket affinity (KT_FLEET_BUCKET_AFFINITY)
+        self._bucket_affinity = (
+            os.environ.get("KT_FLEET_BUCKET_AFFINITY", "1") != "0")
+        #: endpoint -> RPCs in flight through THIS client (the
+        #: least-loaded fallback's signal); guarded-by: _load_lock
+        self._inflight: Dict[str, int] = {ep: 0 for ep in self.endpoints}
+        self._load_lock = threading.Lock()
         faults_mod.zero_init_recovery(self._registry)
         fo = self._registry.counter(FLEET_FAILOVERS)
         for reason in FLEET_FAILOVER_REASONS:
@@ -408,20 +427,88 @@ class FleetClient:
                 fallback = ep  # an all-draining fleet still serves deltas
         return fallback
 
+    @staticmethod
+    def bucket_affinity_key(request) -> str:
+        """Compile-signature PROXY of a classic solve request, computed
+        client-side: pod-count rung (power of two — the shape-bucketing
+        direction the server's solve_dims rungs quantize), instance-type
+        rung, provisioner count, and whether new nodes are allowed.  Two
+        requests with the same proxy very likely share server-side
+        compile buckets and tensorize-cache shapes, so routing repeat
+        shapes to one replica rides its warm programs; a proxy collision
+        merely shares a replica, never a wrong result."""
+        n_pods = len(getattr(request, "pods", ()) or ())
+        n_types = len(getattr(request, "instance_types", ()) or ())
+        n_provs = len(getattr(request, "provisioners", ()) or ())
+        g = 1 << (n_pods - 1).bit_length() if n_pods > 0 else 0
+        c = 1 << (n_types - 1).bit_length() if n_types > 0 else 0
+        allow = getattr(request, "allow_new_nodes", True)
+        return f"bucket:g{g}:c{c}:p{n_provs}:a{int(bool(allow))}"
+
+    def _least_loaded(self, exclude: set) -> Optional[str]:
+        """The healthy endpoint with the fewest in-flight RPCs through
+        this client (ties broken by endpoint order) — the classic-solve
+        fallback when the affinity home is down: spreading by load beats
+        piling every orphaned bucket onto the next rendezvous winner."""
+        with self._load_lock:
+            loads = dict(self._inflight)
+        best = None
+        for ep in self.endpoints:
+            if ep in exclude or self._state.get(ep) != "healthy":
+                continue
+            if best is None or loads.get(ep, 0) < loads.get(best, 0):
+                best = ep
+        return best
+
+    def _classic_endpoint(self, key: str,
+                          exclude: set) -> Optional[str]:
+        """Routing for session-LESS solves: the bucket-affinity home
+        (rendezvous winner for the request's compile-signature proxy)
+        when it is healthy, else the least-loaded healthy endpoint
+        (affinity miss), else the standard walk (drain fallbacks +
+        revival probes)."""
+        order = self.rendezvous(key)
+        home = next((ep for ep in order if ep not in exclude), None)
+        if home is not None:
+            state = self._state[home]
+            if state in ("dead", "draining") and self._revive_due(home):
+                self._probe(home)
+                state = self._state[home]
+            if state == "healthy":
+                return home
+        fallback = self._least_loaded(exclude)
+        if fallback is not None:
+            return fallback
+        return self.endpoint_for(key, exclude=exclude)
+
     # ---- SolverClient surface -------------------------------------------
     def solve_raw(self, request: pb.SolveRequest,
                   timeout: Optional[float] = None) -> pb.SolveResponse:
         sid = getattr(request, "session_id", "")
         establish = bool(sid) and not bool(getattr(request, "delta", False))
+        classic_key = None
+        if not sid and self._bucket_affinity:
+            classic_key = self.bucket_affinity_key(request)
         tried: set = set()
         while True:
-            ep = self.endpoint_for(sid, exclude=tried)
+            if classic_key is not None:
+                ep = self._classic_endpoint(classic_key, tried)
+            else:
+                ep = self.endpoint_for(sid, exclude=tried)
             if ep is None:
                 raise SolveRetriesExhausted(
                     f"no live solver endpoint (of {len(self.endpoints)}) "
                     f"for session {sid or '<none>'}", len(tried))
             try:
-                resp = self._clients[ep].solve_raw(request, timeout=timeout)
+                with self._load_lock:
+                    self._inflight[ep] = self._inflight.get(ep, 0) + 1
+                try:
+                    resp = self._clients[ep].solve_raw(request,
+                                                       timeout=timeout)
+                finally:
+                    with self._load_lock:
+                        self._inflight[ep] = max(
+                            0, self._inflight.get(ep, 0) - 1)
             except grpc.RpcError as err:
                 code = (err.code()
                         if callable(getattr(err, "code", None)) else None)

@@ -39,6 +39,15 @@ of every surviving session WARM instead of paying one re-establishing
 full solve per client; ``KT_CATALOG_EPOCH`` (optional) refuses spools
 from any OTHER catalog epoch (older or newer — rollbacks too).
 
+Meshed composition (ISSUE 14): on a mesh-configured scheduler the inline
+delta shortcut survives because the displaced-subproblem solves route
+through the HOST-LOCAL single-shard programs
+(``BatchScheduler.solve_delta`` under ``_host_local``; ``KT_DELTA_LOCAL=0``
+reverts) — a sub-ms step must not pay sharded dispatch plus a mesh-wide
+fence; only the full-solve fallbacks (threshold/guard/reseed — whole-
+cluster work) keep the sharded program.  Session state here is mesh-
+agnostic: chains carry host objects, never device buffers.
+
 Fleet handoff (ISSUE 13): the spool is SESSION-ADDRESSABLE — one record
 file + one ownership lease per session (``service/snapshot.py``) — so on
 a SHARED volume any replica can :meth:`DeltaSessionTable.adopt` a

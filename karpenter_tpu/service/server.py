@@ -38,11 +38,17 @@ from ..metrics import (
     MEGABATCH_FLUSH,
     MEGABATCH_FLUSH_REASONS,
     MEGABATCH_SLOTS,
+    MULTIHOST_FENCE_BYTES,
+    MULTIHOST_FENCE_SCOPES,
+    MULTIHOST_SLOT_OWNERSHIP,
+    MULTIHOST_SLOTS,
+    MULTIHOST_UNIFIED,
     Registry,
     registry as default_registry,
 )
 from ..obs import tracer_for
 from ..obs.trace import NULL_TRACE, Tracer
+from ..parallel.forward import ResultForwarder, SlotNotOwned
 from ..solver.guard import DeviceHang
 from ..solver.scheduler import BatchScheduler
 from ..solver.tpu import MEGA_MAX_SLOTS, max_mega_slots, mesh_shardable
@@ -166,13 +172,19 @@ class SolvePipeline:
                 self.max_slots = min(max(self.max_slots, n_dev),
                                      max_mega_slots(mesh))
         #: an unshardable mesh on a megabatching backend serves every
-        #: request as its own single-request serial flush (bucket_key
-        #: rejects before any other probe): count those flushes under
-        #: mesh_serial, not 'bucket', so degradation stays visible in
-        #: flush units (bucket_key itself only logs — counting per probe
-        #: there would double-count each request and mix units)
+        #: request as its own single-request serial flush: count those
+        #: flushes under mesh_serial, not 'bucket', so degradation stays
+        #: visible in flush units.  The verdict is the SCHEDULER's
+        #: construction-time ``mega_unshardable`` (ISSUE 14 satellite:
+        #: hoisted so the per-request bucket probe disappears —
+        #: _bucket_of short-circuits on this flag without calling
+        #: bucket_key at all); facades without the attribute fall back to
+        #: the pipeline-side computation.
+        sched_verdict = getattr(scheduler, "mega_unshardable", None)
+        if sched_verdict is None:
+            sched_verdict = mesh is not None and not mesh_shardable(mesh)
         self._mesh_unshardable = (
-            mesh is not None and not mesh_shardable(mesh)
+            bool(sched_verdict)
             and getattr(scheduler, "backend", None) in ("auto", "tpu"))
         self.max_wait = max(0.0, max_wait_ms) / 1000.0
         self._clock = clock or Clock()
@@ -206,16 +218,51 @@ class SolvePipeline:
             gauge.set(0, labels)
         self._inflight: InflightQueue = InflightQueue(
             depth=depth, on_depth=lambda d: gauge.set(d, labels))
-        #: dispatcher-owned: batch boundaries for the megabatch path
+        #: dispatcher-owned: batch boundaries for the megabatch path.
+        #: The scheduler's ``unify_buckets`` (when it has one) lets a held
+        #: flush admit a dominated mixed-bucket request so both shapes
+        #: share one mesh dispatch (ISSUE 14 host-aware coalescing)
         self._coal: SlotCoalescer = SlotCoalescer(
             max_slots=self.max_slots, max_wait=self.max_wait,
-            clock=self._clock)
+            clock=self._clock,
+            # no on_unify counting here: the COLLECTOR counts unified
+            # dispatches (submit_many's group merge re-derives the same
+            # unification) — counting the coalescer join too would tally
+            # one logical unification twice
+            unify=getattr(scheduler, "unify_buckets", None))
         # zero-init every flush-reason series (KT003: a counter born at its
         # first increment loses that increment to rate()/increase())
         flush = self.registry.counter(MEGABATCH_FLUSH)
         for reason in MEGABATCH_FLUSH_REASONS:
             flush.inc({"reason": reason}, value=0.0)
         self.registry.histogram(MEGABATCH_SLOTS)
+        # multi-host serving families at 0 from construction (KT003) —
+        # the pipeline re-zero-inits like the flush reasons above, for
+        # facade schedulers without the BatchScheduler init
+        fence_c = self.registry.counter(MULTIHOST_FENCE_BYTES)
+        for scope in MULTIHOST_FENCE_SCOPES:
+            fence_c.inc({"scope": scope}, value=0.0)
+        slots_c = self.registry.counter(MULTIHOST_SLOTS)
+        for ownership in MULTIHOST_SLOT_OWNERSHIP:
+            slots_c.inc({"ownership": ownership}, value=0.0)
+        self.registry.counter(MULTIHOST_UNIFIED).inc(value=0.0)
+        #: cross-host result-forwarding shim (ISSUE 14): a megabatch slot
+        #: whose RPC arrived here but whose shards another host owns
+        #: resolves SlotNotOwned; the shim re-routes it to the owning
+        #: host's endpoint (KT_MULTIHOST_PEERS) over the fleet transport.
+        #: Null-enabled by default — single-process serving never
+        #: produces foreign slots.
+        self._forwarder = ResultForwarder(registry=self.registry)
+        self._forwarder.zero_init()
+        #: lazily-built bounded pool for forwarding RPCs (foreign slots
+        #: arrive per flush on a multi-host mesh — per-request thread
+        #: spawn would churn unboundedly under burst); None until the
+        #: first foreign slot, shut down in stop()
+        self._fwd_pool = None
+        #: dispatcher-owned: the admitted priority class per in-hand
+        #: future, so a forwarded foreign slot re-dispatches in ITS class
+        #: on the owning host (cleared by _unhand with the _in_hand entry)
+        self._fwd_pclass: dict = {}
         # admission control (docs/ADMISSION.md): the bounded priority queue
         # + breaker + brownout front door.  None = construct from env
         # (KT_ADMISSION=0 disables); False = force off (bench A/B runs).
@@ -421,6 +468,12 @@ class SolvePipeline:
             # replacement (counted so a restart storm is visible as
             # eviction reason "stop", not mystery unknowns)
             self._delta_tab.clear("stop")
+        if self._fwd_pool is not None:
+            # queued forwards resolve their futures from pool threads;
+            # wait=False — stop() must not block on a peer RPC, and
+            # _resolve tolerates the stopped-pipeline double-fail
+            self._fwd_pool.shutdown(wait=False)
+        self._forwarder.close()
 
     def drain(self) -> None:
         """Enter graceful-drain mode (the fleet handshake, docs/
@@ -537,6 +590,13 @@ class SolvePipeline:
         facades, test doubles)."""
         if self.max_slots <= 1:
             return None
+        if self._mesh_unshardable:
+            # construction-time verdict (scheduler.mega_unshardable): no
+            # sharded megabatch program exists for this mesh, so the
+            # per-request probe — and its tensorize — is skipped entirely;
+            # _flush labels the resulting single-request flushes
+            # mesh_serial
+            return None
         bucket = getattr(self.scheduler, "bucket_key", None)
         if bucket is None:
             return None
@@ -619,6 +679,7 @@ class SolvePipeline:
         return self._host_sched
 
     def _unhand(self, fut: Future) -> None:
+        self._fwd_pclass.pop(fut, None)
         try:
             self._in_hand.remove(fut)
         except ValueError:
@@ -639,6 +700,13 @@ class SolvePipeline:
                 # honest per-request latency: this RPC's enqueue → respond
                 # wall time, not the megabatch-amortized device time
                 result.solve_ms = (time.perf_counter() - t_wall) * 1000.0
+            except SlotNotOwned as err:
+                # the per-host fence demuxed this slot to another host
+                # (multi-process mesh): route it through the forwarding
+                # shim — NOT a device failure, so the breaker never sees
+                # it, and the owner-host RPC runs off-thread so
+                # batchmates' finalization is never stalled behind it
+                self._forward_foreign(kwargs, fut, err, t_wall)
             # ktlint: allow[KT005] per-request failure fans to ITS RPC
             # thread only; batchmates still resolve
             except BaseException as err:  # noqa: BLE001
@@ -648,6 +716,44 @@ class SolvePipeline:
                 self._feed_breaker(fut, None)
                 _resolve(fut, result=result)
             self._unhand(fut)
+
+    def _forward_foreign(self, kwargs: dict, fut: Future,
+                         err: SlotNotOwned, t_wall) -> None:
+        """Resolve a foreign-slot future via the cross-host forwarding
+        shim on its own thread (the RPC to the owning host must not stall
+        the dispatcher); a disabled shim resolves the typed SlotNotOwned
+        inline (counted 'unrouted')."""
+        fwd = self._forwarder
+        # read the admitted class NOW (dispatcher thread) — _unhand
+        # clears the ledger entry right after this returns
+        pclass = self._fwd_pclass.get(fut, "")
+        if not fwd.enabled():
+            try:
+                fwd.forward(kwargs, err, priority=pclass)
+            # ktlint: allow[KT005] the typed SlotNotOwned (or the shim's
+            # wrapped transport error) fans to the waiting RPC thread
+            except BaseException as exc:  # noqa: BLE001
+                _resolve(fut, exc=exc)
+            return
+        kwargs = dict(kwargs)
+
+        def run():
+            try:
+                result = fwd.forward(kwargs, err, priority=pclass)
+                result.solve_ms = (time.perf_counter() - t_wall) * 1000.0
+            # ktlint: allow[KT005] forwarding failure fans to ITS RPC
+            # thread only, typed by the shim
+            except BaseException as exc:  # noqa: BLE001
+                _resolve(fut, exc=exc)
+            else:
+                _resolve(fut, result=result)
+
+        if self._fwd_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._fwd_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="slot-forward")
+        self._fwd_pool.submit(run)
 
     def _dispatch_single(self, kwargs: dict, fut: Future, t_enq, t_wall,
                          scheduler: Optional[BatchScheduler] = None) -> None:
@@ -1078,8 +1184,15 @@ class SolvePipeline:
                         self._drain(self._inflight.pop_to(0))
                     continue
                 if self._adm is not None:
-                    host_reason = self._adm.route_host(
-                        kwargs.pop("_pclass", "") or "")
+                    pclass = kwargs.pop("_pclass", "") or ""
+                    if pclass:
+                        # remember the admitted class for the forwarding
+                        # shim: a foreign-slot re-dispatch must carry it,
+                        # or the owning host re-admits an already-admitted
+                        # critical request as default-class and can shed
+                        # it (cleared by _unhand on every resolution path)
+                        self._fwd_pclass[fut] = pclass
+                    host_reason = self._adm.route_host(pclass)
                     if host_reason is not None:
                         # breaker open / brownout rung 3+: this solve takes
                         # the host FFD tier — flush anything held first so

@@ -457,9 +457,15 @@ def _warm_sweep(solver, entries: List[dict], slots: int, sig: tuple,
     XLA)."""
 
     def thunk():
+        from .tpu import read_slot_rows
+
         pending = solver.solve_many_prepared(entries, min_slots=slots,
                                              mesh=mesh)
-        np.asarray(pending.carry_b[7])  # fence: the compile has landed
+        # fence: the compile has landed.  Through the addressable-shard
+        # accessor (KT018): on a multi-process mesh the warm thread owns
+        # only its local shards — a whole-batch read would crash (and
+        # pay DCN) for a result it discards anyway
+        read_slot_rows([pending.carry_b[7]], local_only=mesh is not None)
         solver._mark_ready(sig)
 
     solver.warm_custom(sig, thunk)

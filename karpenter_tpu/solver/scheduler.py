@@ -13,6 +13,7 @@ import copy
 import os
 import threading
 import time
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import logging
@@ -21,6 +22,11 @@ from ..metrics import (
     MEGABATCH_FLUSH,
     MEGABATCH_FLUSH_REASONS,
     MEGABATCH_SLOTS,
+    MULTIHOST_FENCE_BYTES,
+    MULTIHOST_FENCE_SCOPES,
+    MULTIHOST_SLOT_OWNERSHIP,
+    MULTIHOST_SLOTS,
+    MULTIHOST_UNIFIED,
     PRECOMPILE_DURATION,
     SCHEDULING_DURATION,
     SOLVER_BACKEND_DURATION,
@@ -56,7 +62,10 @@ from .tpu import (
     SlotsExhausted,
     TpuSolver,
     _mesh_size,
+    mega_key_at_slots,
+    mega_key_dims,
     mesh_shardable,
+    unify_mega_keys,
 )
 from .types import SimNode, SolveResult
 
@@ -79,6 +88,14 @@ MAX_RELAXATION_WAVES = 8
 #: rows and remaining limit headroom (karpenter.sh_provisioners.yaml:160-173
 #: limits + :305-314 weights).
 MAX_RESIDUE_WAVES = 6
+
+
+def _delta_local_enabled() -> bool:
+    """Meshed delta steps route through the host-local single-shard
+    program by default (ISSUE 14: the sub-ms displaced-subproblem solves
+    must not pay sharded dispatch + mesh fence); ``KT_DELTA_LOCAL=0``
+    keeps them on the scheduler's mesh."""
+    return os.environ.get("KT_DELTA_LOCAL", "1") != "0"
 
 
 def _compile_behind_enabled() -> bool:
@@ -286,14 +303,35 @@ class _MegaCollector:
 
     def dispatch(self) -> None:
         self._slots = [None] * len(self.entries)
+        sigs: List[tuple] = []
         groups: Dict[tuple, List[int]] = {}
         for i, e in enumerate(self.entries):
             key = self.solver.mega_signature(
                 e["st"], existing_nodes=e["existing_nodes"],
                 max_nodes=e["max_nodes"], slots=1, mesh=self.mesh,
             )
+            sigs.append(key)
             groups.setdefault(key, []).append(i)
-        for idxs in groups.values():
+        # host-aware mixed-bucket unification (ISSUE 14): merge shape
+        # buckets whose dims UNIFY (one dominates component-wise —
+        # solver/tpu.unify_mega_keys) so the whole flush shares ONE mesh
+        # dispatch at the dominant bucket's program instead of serial
+        # per-bucket dispatches; dominated requests pad up via
+        # target_dims, byte-identical to their own-bucket solves
+        merged: List[list] = []  # [unified_key, idxs, n_source_buckets]
+        for key, idxs in groups.items():
+            for m in merged:
+                u = unify_mega_keys(m[0], key)
+                if u is not None:
+                    m[0] = u
+                    m[1].extend(idxs)
+                    m[2] += 1
+                    break
+            else:
+                merged.append([key, list(idxs), 1])
+        for ukey, idxs, n_src in merged:
+            idxs.sort()  # slot order == arrival order, like the old path
+            unified = n_src > 1
             use_mega = len(idxs) > 1 and mesh_shardable(self.mesh)
             if len(idxs) > 1 and not mesh_shardable(self.mesh):
                 # device count past the slot-rung ladder: this mesh cannot
@@ -303,22 +341,23 @@ class _MegaCollector:
                     f"{_mesh_size(self.mesh)}-device mesh exceeds the "
                     f"{MEGA_MAX_SLOTS}-slot rung ladder")
             if use_mega:
-                first = self.entries[idxs[0]]
-                mega_sig = self.solver.mega_signature(
-                    first["st"], existing_nodes=first["existing_nodes"],
-                    max_nodes=first["max_nodes"], slots=len(idxs),
-                    mesh=self.mesh,
-                )
+                mega_sig = mega_key_at_slots(ukey, len(idxs), self.mesh)
                 if not self.solver.ready(mega_sig):
                     # callers must never eat a cold compile (the compile-
                     # behind contract): serve this flush from the compiled
-                    # single program, compile the slot-rung program behind
+                    # single program, compile the slot-rung program behind.
+                    # Warm from an entry OF the dominant bucket, so the
+                    # compiled program is the one a unified flush runs.
                     if self.warm is not None:
-                        self.warm(first, len(idxs))
+                        warm_i = next(
+                            (i for i in idxs if sigs[i] == ukey), idxs[0])
+                        self.warm(self.entries[warm_i], len(idxs))
                     use_mega = False
                     self._mesh_serial("sharded slot-rung program still "
                                       "compiling behind")
             if use_mega:
+                if unified and self.registry is not None:
+                    self.registry.counter(MULTIHOST_UNIFIED).inc()
                 reqs = [
                     dict(
                         st=self.entries[i]["st"],
@@ -329,10 +368,13 @@ class _MegaCollector:
                     )
                     for i in idxs
                 ]
+                target = mega_key_dims(ukey) if unified else None
                 try:
                     handle = self._guarded(
-                        lambda reqs=reqs: self.solver.solve_many_async(
-                            reqs, mesh=self.mesh))
+                        lambda reqs=reqs, target=target:
+                        self.solver.solve_many_async(
+                            reqs, mesh=self.mesh, target_dims=target,
+                            registry=self.registry))
                 except DeviceHang as err:
                     # hang at H2D dispatch: fan to every slot — each
                     # request's _finish_mega degrades to the warm tier
@@ -483,10 +525,35 @@ class BatchScheduler:
         for reason in MEGABATCH_FLUSH_REASONS:
             self.registry.counter(MEGABATCH_FLUSH).inc(
                 {"reason": reason}, value=0.0)
+        # multi-host serving families (ISSUE 14): per-host fence byte
+        # accounting, slot-ownership demux counts, unified-flush counts —
+        # all exist at 0 from construction (KT003)
+        fence_c = self.registry.counter(MULTIHOST_FENCE_BYTES)
+        for scope in MULTIHOST_FENCE_SCOPES:
+            fence_c.inc({"scope": scope}, value=0.0)
+        slots_c = self.registry.counter(MULTIHOST_SLOTS)
+        for ownership in MULTIHOST_SLOT_OWNERSHIP:
+            slots_c.inc({"ownership": ownership}, value=0.0)
+        self.registry.counter(MULTIHOST_UNIFIED).inc(value=0.0)
         # a meshed scheduler degrading a would-be sharded megabatch to
         # serial dispatches logs once per process (the metric carries the
         # ongoing count; the log explains the first occurrence)
         self._mesh_serial_logged = False  # guarded-by: _cold_lock
+        #: the unshardable-mesh verdict, hoisted to construction (ISSUE 14
+        #: satellite): a mesh whose device count exceeds the slot-rung
+        #: ladder can never serve a sharded megabatch, so per-request
+        #: probes (bucket_key) return None immediately instead of walking
+        #: the log-once path per queued request — the verdict is logged
+        #: ONCE, here, where it is decided
+        self.mega_unshardable = (
+            mesh is not None and not mesh_shardable(mesh))
+        if self.mega_unshardable and backend in ("auto", "tpu"):
+            logger.info(
+                "mesh of %d devices exceeds the %d-slot rung ladder: "
+                "megabatching is off for this scheduler; flushes serve "
+                "serially and count under karpenter_solver_megabatch_"
+                "flush_total{reason=\"mesh_serial\"}",
+                _mesh_size(mesh), MEGA_MAX_SLOTS)
         # warm-start delta series exist before the first solve_delta call
         from .warmstart import zero_init_metrics as _ws_zero_init
 
@@ -599,8 +666,25 @@ class BatchScheduler:
         # the relax rung is a $-for-latency trade the sub-ms delta path
         # must not pay: displaced-subproblem scans always skip it, and the
         # FULL-solve boundaries (threshold/guard fallbacks — already
-        # paying a whole re-solve) run it only when KT_RELAX_DELTA=1
+        # paying a whole re-solve) run it only when KT_RELAX_DELTA=1.
+        # A MESHED scheduler's displaced subproblems route through the
+        # host-local single-shard program (ISSUE 14, KT_DELTA_LOCAL):
+        # these are sub-ms steps that fit one chip — the sharded program
+        # would pay cross-host dispatch and a mesh-wide fence per step,
+        # which is exactly the transfer tax the delta path exists to
+        # avoid.  The FULL-solve fallbacks keep the mesh: a whole-cluster
+        # re-solve is the workload the sharded program is built for.
+        use_local = self.mesh is not None and _delta_local_enabled()
+
         def _solve(pods, existing, unavail, relax=False):
+            if use_local:
+                with self._host_local():
+                    return self.solve(
+                        pods, provisioners, instance_types,
+                        existing_nodes=existing, daemonsets=daemonsets,
+                        unavailable=unavail or None, trace=trace,
+                        relax=relax,
+                    )
             return self.solve(
                 pods, provisioners, instance_types,
                 existing_nodes=existing, daemonsets=daemonsets,
@@ -608,8 +692,12 @@ class BatchScheduler:
             )
 
         def _solve_full(pods, existing, unavail):
-            return _solve(pods, existing, unavail,
-                          relax=None if relax_delta_enabled() else False)
+            return self.solve(
+                pods, provisioners, instance_types,
+                existing_nodes=existing, daemonsets=daemonsets,
+                unavailable=unavail or None, trace=trace,
+                relax=None if relax_delta_enabled() else False,
+            )
 
         return warmstart.delta_solve(
             prev, added, removed, iced,
@@ -677,24 +765,16 @@ class BatchScheduler:
         sharded megabatch serially: count it so meshed-serving degradation
         is visible (the acceptance dashboards watch this stay near zero),
         log the first occurrence with the why.  ``count=False`` logs only —
-        used when the count is owned elsewhere: bucket_key's per-REQUEST
-        unshardable-mesh rejections are counted in FLUSH units by the
-        pipeline (each None key resolves into its own single-request
-        serial flush), and a pipeline-owned submit_many flush
-        (flush_reason=) counts once at collector dispatch — counting here
-        too would double-count and mix units with the per-flush
-        full/deadline/bucket reasons."""
+        used when the count is owned elsewhere: a pipeline-owned
+        submit_many flush (flush_reason=) counts once at collector
+        dispatch — counting here too would double-count and mix units
+        with the per-flush full/deadline/bucket reasons.  (The old
+        per-request caller — bucket_key probing an unshardable mesh — is
+        gone: that verdict is hoisted onto ``mega_unshardable`` at
+        construction, so this now only runs at flush dispatch.)"""
         if count:
             self.registry.counter(MEGABATCH_FLUSH).inc(
                 {"reason": "mesh_serial"})
-        # ktlint: allow[KT004] deliberate lock-free fast path: bucket_key
-        # calls this per queued request on unshardable-mesh schedulers —
-        # after the first log there is nothing left to do, and taking
-        # _cold_lock here would contend with cold-compile bookkeeping on
-        # the dispatcher's hot path (the flag only ever flips False→True
-        # under the lock below; a stale read costs one duplicate log)
-        if self._mesh_serial_logged:
-            return
         with self._cold_lock:
             first = not self._mesh_serial_logged
             self._mesh_serial_logged = True
@@ -703,6 +783,35 @@ class BatchScheduler:
                 "meshed scheduler served a megabatch flush serially (%s); "
                 "counted under karpenter_solver_megabatch_flush_total"
                 "{reason=\"mesh_serial\"}", detail)
+
+    def unify_buckets(self, held_key: tuple,
+                      new_key: tuple) -> Optional[tuple]:
+        """Mixed-bucket unification hook for the pipeline's SlotCoalescer
+        (ISSUE 14): the DOMINANT of two megabatch bucket keys when one
+        subsumes the other (solver/tpu.unify_mega_keys), else None.  A
+        held flush can then admit a dominated request and the whole batch
+        shares one mesh dispatch at the dominant bucket's program —
+        dominated requests pad up at dispatch (target_dims), results
+        byte-identical to their own-bucket solves."""
+        return unify_mega_keys(held_key, new_key)
+
+    @contextmanager
+    def _host_local(self):
+        """Scoped mesh override: the enclosed solve waves run the
+        HOST-LOCAL single-shard programs (mesh=None) instead of the
+        scheduler's mesh — the delta fast path's route for sub-ms
+        displaced-subproblem steps on a meshed scheduler.  Safe under the
+        scheduler's documented single-dispatcher contract (the thread
+        that runs submit/solve/solve_delta owns every solve section —
+        concurrent solves were never allowed); readiness probes and
+        compile-behind warms inside the scope target the host-local
+        programs, so the first local step rides the warm host tier while
+        its single-shard program compiles behind, like any cold shape."""
+        prev, self.mesh = self.mesh, None
+        try:
+            yield
+        finally:
+            self.mesh = prev
 
     def bucket_key(self, kwargs: dict) -> Optional[tuple]:
         """Megabatch shape bucket of one queued solve request, or None when
@@ -716,13 +825,11 @@ class BatchScheduler:
         lands in the cache, so the real solve's tensorize is a hit."""
         if self.backend not in ("auto", "tpu"):
             return None
-        if not mesh_shardable(self.mesh):
-            # the slot axis cannot pad to one-slot-per-chip on this mesh;
-            # the request keeps the sharded single-solve path (log only —
-            # the pipeline counts the resulting single-request flush)
-            self._note_mesh_serial(
-                f"{_mesh_size(self.mesh)}-device mesh exceeds the "
-                f"{MEGA_MAX_SLOTS}-slot rung ladder", count=False)
+        if self.mega_unshardable:
+            # the slot axis cannot pad to one-slot-per-chip on this mesh —
+            # a verdict hoisted to (and logged at) construction, so the
+            # per-request probe is one attribute read; the pipeline counts
+            # the resulting single-request flushes under mesh_serial
             return None
         if self._tensorize_cache is None:
             return None  # bucketing leans on cached tensorize; without it

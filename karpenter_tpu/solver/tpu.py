@@ -984,6 +984,123 @@ def mesh_shardable(mesh) -> bool:
     return _mesh_size(mesh) <= MEGA_MAX_SLOTS
 
 
+#: the megabatch bucket-key components that are PADDED axis rungs — two
+#: buckets differing only here can share one dispatch when one dominates
+#: (building the smaller request at the larger rungs is the normal padding
+#: path `_host_arrays` already runs for every solve)
+UNIFIABLE_DIMS = ("G", "C", "NR", "NE_pad", "S", "P")
+#: the non-dims tail components `_mega_key_tail` appends — derived FROM
+#: the tail builder (plus the mesh fingerprint it conditionally adds), so
+#: key-splitting can never drift from key construction (KT014's
+#: single-source contract)
+_MEGA_TAIL_NAMES = tuple(
+    k for k, _ in _mega_key_tail(1, 0, 0, None)) + ("mesh",)
+
+
+def unify_mega_keys(a: tuple, b: tuple) -> Optional[tuple]:
+    """The DOMINANT of two megabatch bucket keys when one subsumes the
+    other, else None — the host-aware coalescer's mixed-bucket unification
+    (ISSUE 14): a flush holding bucket A can admit a bucket-B request iff
+    every axis rung of one key >= the other's and everything else (vocab
+    key positions, track, mesh fingerprint, slot rung) matches exactly;
+    the dominated requests then build their tensors at the dominant dims
+    (``solve_many_async(target_dims=...)``) and the whole flush runs ONE
+    mesh dispatch instead of two serial ones.
+
+    Domination-only on purpose: the unified program IS the dominant
+    bucket's own program, which real traffic already warms — a
+    component-wise-max of divergent keys would mint programs nothing
+    precompiles (the KT014 compile-surface discipline)."""
+    if a == b:
+        return a
+    da, db = dict(a), dict(b)
+    if set(da) != set(db):
+        return None
+    a_dom = b_dom = True
+    for k, va in da.items():
+        vb = db[k]
+        if va == vb:
+            continue
+        if k not in UNIFIABLE_DIMS:
+            return None
+        if va < vb:
+            a_dom = False
+        else:
+            b_dom = False
+    if a_dom:
+        return a
+    if b_dom:
+        return b
+    return None
+
+
+def mega_key_dims(key: tuple) -> dict:
+    """The solve_dims dict embedded in a megabatch bucket key (everything
+    but the `_mega_key_tail` components) — what a unified dispatch passes
+    to ``_host_arrays(dims=...)`` so dominated requests pad to the
+    dominant bucket's rungs."""
+    return {k: v for k, v in dict(key).items() if k not in _MEGA_TAIL_NAMES}
+
+
+def mega_key_at_slots(key: tuple, slots: int, mesh) -> tuple:
+    """Re-key a slots=1 megabatch bucket key at a real flush size: the
+    dims part stays, the tail is re-derived for ``slots`` — the signature
+    a unified flush's readiness/warm bookkeeping probes (single-sourced
+    through `_mega_key_tail` like every other mega key)."""
+    d = dict(key)
+    dims_part = tuple(sorted(
+        (k, v) for k, v in d.items() if k not in _MEGA_TAIL_NAMES))
+    return dims_part + _mega_key_tail(slots, d["zk"], d["ck"], mesh)
+
+
+def multihost_fence_enabled() -> bool:
+    """Per-host megabatch fences (read only the process-addressable slot
+    shards) — default on; ``KT_MULTIHOST=0`` forces the legacy whole-batch
+    readback (the bench A/B and an emergency kill switch)."""
+    import os
+
+    return os.environ.get("KT_MULTIHOST", "1") != "0"
+
+
+def read_slot_rows(arrays, *, local_only: bool = False):
+    """Fence + read the leading (request-slot) axis of stacked megabatch
+    arrays — THE addressable-shard accessor (ktlint KT018's sanctioned
+    home): serving-path extraction must route mesh-sharded carry reads
+    through here, never a raw ``np.asarray``/``device_get`` on the whole
+    array, which on a multi-host mesh pays DCN latency (and memory) for
+    every slot other hosts own.
+
+    ``local_only`` reads ONLY ``jax.process_index()``-addressable shards
+    (single-process: that is every shard, byte-identical to the whole
+    read); otherwise one whole-array D2H per array (the single-device /
+    kill-switch path).  Returns ``(rows, bytes_read, bytes_total)`` where
+    ``rows[k][s]`` is slot ``s`` of array ``k`` — only locally-owned slots
+    are present under ``local_only`` on a multi-process mesh."""
+    rows: List[dict] = []
+    bytes_read = 0
+    bytes_total = 0
+    for arr in arrays:
+        bytes_total += int(getattr(arr, "nbytes", 0) or 0)
+        per: Dict[int, np.ndarray] = {}
+        if local_only:
+            for shard in arr.addressable_shards:
+                # D2H of the LOCAL shard only: this np.asarray is the
+                # per-host fence — it blocks until the shard's slots
+                # finish and transfers just their bytes
+                data = np.asarray(shard.data)  # ktlint: allow[KT018] the accessor itself
+                start = shard.index[0].start or 0
+                for j in range(data.shape[0]):
+                    per[start + j] = data[j]
+                bytes_read += int(data.nbytes)
+        else:
+            a = np.asarray(arr)  # ktlint: allow[KT018] the accessor itself
+            for s in range(a.shape[0]):
+                per[s] = a[s]
+            bytes_read += int(a.nbytes)
+        rows.append(per)
+    return rows, bytes_read, bytes_total
+
+
 @partial(jax.jit, static_argnames=("NR", "Z", "track", "zone_key", "ct_key"))
 def _run_scan_many(consts_b, feas_b, init_b, NR: int, Z: int, track: bool,
                    zone_key: int, ct_key: int):
@@ -1858,6 +1975,8 @@ class TpuSolver:
         *,
         min_slots: Optional[int] = None,
         mesh=None,
+        target_dims: Optional[dict] = None,
+        registry=None,
     ) -> "PendingMegaSolve":
         """Dispatch B independent, signature-compatible solve requests as
         ONE vmapped device program over padded request slots, WITHOUT
@@ -1881,8 +2000,17 @@ class TpuSolver:
         data-parallel dimension over the flattened mesh (one slot per chip,
         parallel/mesh.py slot_mesh), so a mesh-configured scheduler's
         coalesced flush lights every device — still ONE dispatch and ONE
-        batch-wide fence.  Per-slot programs are the single-device ones
-        (results byte-identical to unmeshed serial solves)."""
+        batch-wide fence (per-HOST fences on a multi-process mesh: each
+        serving process reads only its addressable slot shards).  Per-slot
+        programs are the single-device ones (results byte-identical to
+        unmeshed serial solves).
+
+        ``target_dims`` builds every request at caller-chosen padded dims
+        (a UNIFIED mixed-bucket flush: dominated requests pad up to the
+        dominant bucket's rungs — see :func:`unify_mega_keys`); the usual
+        per-request `solve_dims` bucketing is bypassed, so callers own the
+        compile-ladder consequences (the `_host_arrays(dims=...)`
+        contract).  ``registry`` observes the per-host fence metrics."""
         assert requests, "empty megabatch"
         if len(requests) > MEGA_MAX_SLOTS:
             # a silent truncation would compile at shape B while marking the
@@ -1920,6 +2048,9 @@ class TpuSolver:
             np_consts, feas, np_init, dims = self._host_arrays(
                 st, r["existing_nodes"], node_budget=nb,
                 track_assignments=track, full_nr=full_nr,
+                # unified flush: every request pads to the dominant
+                # bucket's rungs, so one program serves the mixed batch
+                dims=dict(target_dims) if target_dims is not None else None,
             )
             entries.append(dict(
                 r=r, np_consts=np_consts, feas=feas, np_init=np_init,
@@ -1928,7 +2059,7 @@ class TpuSolver:
             ))
         return self._dispatch_prepared(entries, n_slots=n_slots, track=track,
                                        zone_key=zone_key, ct_key=ct_key,
-                                       t0=t0, mesh=mesh)
+                                       t0=t0, mesh=mesh, registry=registry)
 
     def solve_many_prepared(
         self,
@@ -1936,6 +2067,7 @@ class TpuSolver:
         *,
         min_slots: Optional[int] = None,
         mesh=None,
+        registry=None,
     ) -> "PendingMegaSolve":
         """Dispatch PRE-BUILT megabatch entries as one vmapped device
         program, without fencing — the consolidation sweep's entry point:
@@ -1962,11 +2094,12 @@ class TpuSolver:
             track=r0["track_assignments"],
             zone_key=st0.vocab.key_id[L.ZONE],
             ct_key=st0.vocab.key_id[L.CAPACITY_TYPE], t0=t0, mesh=mesh,
+            registry=registry,
         )
 
     def _dispatch_prepared(
         self, entries, *, n_slots: int, track: bool, zone_key: int,
-        ct_key: int, t0: float, mesh=None,
+        ct_key: int, t0: float, mesh=None, registry=None,
     ) -> "PendingMegaSolve":
         """Stack + dispatch prepared entries (shared by the request path and
         :meth:`solve_many_prepared`); validates the one-bucket invariant."""
@@ -2058,7 +2191,7 @@ class TpuSolver:
         return PendingMegaSolve(
             solver=self, entries=entries, carry_b=carry_b, ys_b=ys_b,
             t0=t0, t_starts=t_starts, track=track, B=B, B_pad=B_pad,
-            mega_key=mega_key, mesh=mesh,
+            mega_key=mega_key, mesh=mesh, registry=registry,
         )
 
     def solve_many(
@@ -2319,12 +2452,19 @@ class PendingTpuSolve:
 
 class PendingMegaSolve:
     """Handle for an async-dispatched megabatch (``solve_many_async``):
-    ``results()`` performs the ONE batch-wide D2H fence, then per-slot
-    extraction.  Idempotent; per-slot slot-exhaustion semantics match
-    ``solve_many``."""
+    ``results()`` performs the ONE batch-wide D2H fence — a PER-HOST fence
+    on a meshed dispatch: only the ``jax.process_index()``-addressable
+    slot shards are read back (:func:`read_slot_rows`), so on a
+    multi-process mesh each serving process pays D2H for exactly the slots
+    it owns instead of DCN latency for the whole batch — then per-slot
+    extraction of the owned slots.  Slots another host owns resolve to a
+    typed :class:`~karpenter_tpu.parallel.forward.SlotNotOwned` in their
+    position (the per-slot boxed-outcome contract); the serving layer's
+    forwarding shim routes those to the owning host.  Idempotent; per-slot
+    slot-exhaustion semantics match ``solve_many``."""
 
     def __init__(self, solver, entries, carry_b, ys_b, t0, t_starts, track,
-                 B, B_pad, mega_key, mesh=None) -> None:
+                 B, B_pad, mega_key, mesh=None, registry=None) -> None:
         self.solver = solver
         self.entries = entries
         self.carry_b = carry_b
@@ -2340,20 +2480,59 @@ class PendingMegaSolve:
         #: ladder covers), like the sibling retry sites in solve() and
         #: PendingTpuSolve
         self.mesh = mesh
+        self.registry = registry
+        #: per-host fence accounting, populated by results(): bytes this
+        #: process actually read vs what a whole-batch readback would
+        #: have, and the [start, stop) slot range it owns
+        self.fence_bytes_read = 0
+        self.fence_bytes_total = 0
+        self.owned_slots: Tuple[int, int] = (0, B_pad)
         self._outputs: Optional[List[object]] = None
 
     # ktlint: fence the megabatch handle's one D2H read completes ALL
-    # request slots (the whole point: B solves, one device round trip)
+    # locally-owned request slots (the whole point: B solves, one device
+    # round trip per host — addressable shards only on a meshed dispatch)
     def results(self) -> List[object]:
         if self._outputs is not None:
             return self._outputs
         s = self.solver
-        np.asarray(self.carry_b[7])  # the one fence for the WHOLE batch
+        # per-host fence (ISSUE 14): meshed dispatches read ONLY the
+        # process-addressable slot shards of the carry — single-process
+        # meshes own every shard (byte-identical to the whole read), and
+        # KT_MULTIHOST=0 forces the legacy whole-batch readback
+        per_host = self.mesh is not None and multihost_fence_enabled()
+        owners: Optional[tuple] = None
+        if per_host:
+            from ..parallel.mesh import local_slot_range, multihost
+
+            if multihost(self.mesh):
+                from ..parallel.mesh import slot_hosts
+
+                owners = slot_hosts(self.mesh, self.B_pad)
+                self.owned_slots = local_slot_range(self.mesh, self.B_pad)
+        # fence element 7 (n_used) first so elapsed_ms spans dispatch ->
+        # fence completion exactly like the single-solve handle; the
+        # remaining carry reads are post-fence extraction traffic
+        rows7, br, bt = read_slot_rows([self.carry_b[7]],
+                                       local_only=per_host)
         elapsed_ms = (time.perf_counter() - self.t0) * 1000.0
         s._mark_ready(self.mega_key)
+        rest = [x for k, x in enumerate(self.carry_b) if k != 7]
+        if self.track:
+            rest.append(self.ys_b)
+        rows_rest, br2, bt2 = read_slot_rows(rest, local_only=per_host)
+        self.fence_bytes_read = br + br2
+        self.fence_bytes_total = bt + bt2
+        if per_host and self.registry is not None:
+            from ..metrics import MULTIHOST_FENCE_BYTES
 
-        carry_np = [np.asarray(x) for x in self.carry_b]
-        ys_np = np.asarray(self.ys_b) if self.track else None
+            c = self.registry.counter(MULTIHOST_FENCE_BYTES)
+            c.inc({"scope": "read"}, value=float(self.fence_bytes_read))
+            c.inc({"scope": "whole"}, value=float(self.fence_bytes_total))
+        carry_rows = list(rows_rest[:len(self.carry_b) - 1])
+        carry_rows.insert(7, rows7[0])
+        ys_rows = rows_rest[-1] if self.track else None
+        lo, hi = self.owned_slots
         outputs: List[object] = []
         for i, e in enumerate(self.entries):
             r = e["r"]
@@ -2362,8 +2541,18 @@ class PendingMegaSolve:
                 "megabatch", self.t_starts[i], trace.now(),
                 slot=i, slots=self.B_pad, occupied=self.B,
             )
-            carry_i = tuple(x[i] for x in carry_np)
-            ys_i = ys_np[i] if ys_np is not None else None
+            if not (lo <= i < hi):
+                # another host's slot: this process holds no shard of it.
+                # A typed, boxed per-slot outcome — the serving layer's
+                # forwarding shim (parallel/forward.py) re-routes it to
+                # the owning host over the fleet transport
+                from ..parallel.forward import SlotNotOwned
+
+                outputs.append(SlotNotOwned(
+                    i, owners[i] if owners else -1))
+                continue
+            carry_i = tuple(x[i] for x in carry_rows)
+            ys_i = ys_rows[i] if ys_rows is not None else None
             try:
                 retried = s._maybe_retry_exhausted(
                     carry_i, e["est_dims"], e["full_dims"], e["full_nr"],
@@ -2389,6 +2578,16 @@ class PendingMegaSolve:
                     r["st"], carry_i, ys_i, r["existing_nodes"], e["NE"],
                     elapsed_ms, elapsed_ms,
                 ))
+        if owners is not None and self.registry is not None:
+            from ..metrics import MULTIHOST_SLOTS
+            from ..parallel.forward import SlotNotOwned
+
+            n_foreign = sum(1 for o in outputs
+                            if isinstance(o, SlotNotOwned))
+            slots_c = self.registry.counter(MULTIHOST_SLOTS)
+            slots_c.inc({"ownership": "foreign"}, value=float(n_foreign))
+            slots_c.inc({"ownership": "owned"},
+                        value=float(len(outputs) - n_foreign))
         self._outputs = outputs
         return outputs
 

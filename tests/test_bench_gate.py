@@ -473,6 +473,58 @@ class TestFleetFailoverGates:
         assert benchmod.check_budgets({"value": 100.0}) == {}
 
 
+class TestMultihostFenceGates:
+    """ISSUE 14 budget gates (measure_multihost_fence): per-host fence
+    reads ~1/N of the whole-batch bytes at N processes, per-slot demux
+    byte-identical to single-process serial, and the per-host readback
+    machinery never taxes a lone meshed flush past the standard
+    single-latency budget."""
+
+    GOOD = {"multihost_processes": 2,
+            "multihost_fence_frac": 0.5,
+            "multihost_parity": True,
+            "multihost_lone_latency_ratio": 0.97}
+
+    def test_within_budgets_clean(self):
+        assert benchmod.check_budgets(dict(self.GOOD)) == {}
+
+    def test_whole_batch_fence_frac_flagged(self):
+        # a host reading (nearly) the whole batch back is exactly the
+        # DCN-transfer-tax bug class this round removes
+        out = benchmod.check_budgets(
+            dict(self.GOOD, multihost_fence_frac=1.0))
+        assert any("DCN for slots they do not own" in f
+                   for f in out["budget_flags"])
+
+    def test_exact_share_with_tolerance_clean(self):
+        assert benchmod.check_budgets(
+            dict(self.GOOD, multihost_fence_frac=0.6)) == {}
+
+    def test_parity_divergence_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, multihost_parity=False))
+        assert any("byte-identical" in f for f in out["budget_flags"])
+
+    def test_lone_latency_tax_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, multihost_lone_latency_ratio=1.31))
+        assert any("lone meshed flush" in f for f in out["budget_flags"])
+
+    def test_skipped_run_not_flagged(self):
+        # a jaxlib without gloo CPU collectives publishes
+        # multihost_skipped and none of the gated fields
+        assert benchmod.check_budgets(
+            {"multihost_skipped": "no gloo"}) == {}
+
+    def test_fleet_jit_cache_regression_flagged(self):
+        out = benchmod.check_budgets(
+            {"cold_restart_first_ms": 8000.0,
+             "cold_restart_second_ms": 2000.0,
+             "cold_restart_fleet_ms": 9000.0})
+        assert any("shared fleet jit cache" in f
+                   for f in out["budget_flags"])
+
+
 @pytest.mark.slow
 def test_500k_pod_solve_stretch():
     """ISSUE 6 stretch rung: the solve bench ceiling lifted from 50k

@@ -166,8 +166,17 @@ def test_inventory_metrics_are_emitted(small_catalog):
     # ::TestFleetSeries and exercised end to end by tests/test_fleet.py
     fleet_family = {m for m in INVENTORY if m.startswith("karpenter_fleet_")}
 
+    # the multihost forwarding shim is service-side (SolvePipeline's
+    # ResultForwarder) like the admission precedent: full-population
+    # zero-init asserted by tests/test_metrics_init.py::TestMultihostSeries
+    # and exercised by tests/test_multihost.py (the scheduler-side
+    # multihost families — fence bytes, slot ownership, unified flushes —
+    # ARE emitted here via BatchScheduler's zero-init)
+    multihost_shim = {m for m in INVENTORY
+                      if m.startswith("karpenter_solver_multihost_forwards")}
+
     missing = (set(INVENTORY) - emitted - admission_family - delta_family
-               - resilience_family - fleet_family
+               - resilience_family - fleet_family - multihost_shim
                - {REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES})
     assert not missing, (
         f"documented metrics never emitted: {sorted(missing)} "

@@ -1041,6 +1041,101 @@ class TestKT017SpoolFacadeDiscipline:
         assert "KT017" not in rules_of(lint(src, self.SVC))
 
 
+class TestKT018AddressableShardFence:
+    """ISSUE 14: megabatch extraction must fence through the
+    addressable-shard accessor (solver/tpu.read_slot_rows) — a raw
+    np.asarray / device_get on the slot-stacked carry (carry_b/ys_b) is
+    the whole-batch-readback bug class the per-host fence removed: every
+    host pays DCN for slots it does not own."""
+
+    TPU = "karpenter_tpu/solver/tpu.py"
+
+    def test_fires_on_whole_batch_asarray_in_results(self):
+        src = """
+        import numpy as np
+
+        class PendingMegaSolve:
+            def results(self):
+                np.asarray(self.carry_b[7])
+                return [np.asarray(x) for x in self.carry_b]
+        """
+        findings = lint(src, self.TPU)
+        assert "KT018" in rules_of(findings)
+        assert any("read_slot_rows" in (f.hint or "") for f in findings)
+
+    def test_fires_on_device_get_of_stacked_ys(self):
+        src = """
+        import jax
+
+        def demux(handle):
+            return jax.device_get(handle.ys_b)
+        """
+        assert "KT018" in rules_of(
+            lint(src, "karpenter_tpu/service/server.py"))
+
+    def test_fires_on_bare_stacked_name(self):
+        src = """
+        import numpy as np
+
+        def fence(carry_b):
+            np.asarray(carry_b[7])
+        """
+        assert "KT018" in rules_of(lint(src, self.TPU))
+
+    def test_accessor_function_is_the_sanctioned_home(self):
+        src = """
+        import numpy as np
+
+        def read_slot_rows(arrays, local_only=False):
+            carry_b = arrays[0]
+            return np.asarray(carry_b)
+        """
+        assert "KT018" not in rules_of(lint(src, self.TPU))
+
+    def test_accessor_routed_read_is_quiet(self):
+        src = """
+        class PendingMegaSolve:
+            def results(self):
+                rows, br, bt = read_slot_rows(
+                    [self.carry_b[7]], local_only=True)
+                return rows
+        """
+        assert "KT018" not in rules_of(lint(src, self.TPU))
+
+    def test_single_solve_carry_is_out_of_scope(self):
+        # the single-solve handle's carry is genuinely global: its one
+        # result needs every shard, so the whole read is the contract
+        src = """
+        import numpy as np
+
+        class PendingTpuSolve:
+            def result(self):
+                np.asarray(self.carry[7])
+        """
+        assert "KT018" not in rules_of(lint(src, self.TPU))
+
+    def test_out_of_scope_files_are_quiet(self):
+        # scripts/tests/dryruns read carries deliberately
+        src = """
+        import numpy as np
+
+        def probe(handle):
+            return np.asarray(handle.carry_b[7])
+        """
+        assert "KT018" not in rules_of(
+            lint(src, "scripts/chaos_drive.py"))
+
+    def test_suppression_with_reason(self):
+        src = """
+        import numpy as np
+
+        def fence(carry_b):
+            # ktlint: allow[KT018] single-process unit fixture readback
+            np.asarray(carry_b[7])
+        """
+        assert "KT018" not in rules_of(lint(src, self.TPU))
+
+
 class TestSuppressionGrammar:
     SRC = """
     import time
@@ -1099,8 +1194,10 @@ class TestPackageGate:
         assert active == [], "\n".join(f.format() for f in active)
         # every suppression in the tree carries a reason by construction
         # (reason-less ones surface as KT000 above); the count is a canary
-        # against silent suppression creep
-        assert len(suppressed) < 40
+        # against silent suppression creep (bumped PR 14: the KT018
+        # accessor's own two raw reads + the coalescer unify-hook guard
+        # and forwarder shutdown KT005s)
+        assert len(suppressed) < 45
 
     def test_main_exit_codes(self, tmp_path):
         bad = tmp_path / "karpenter_tpu" / "bad.py"

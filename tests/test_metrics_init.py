@@ -451,3 +451,53 @@ class TestWarmstartAndSweepSeries:
         text = reg.expose()
         assert ('karpenter_solver_consolidation_sweeps_total'
                 '{path="batched"} 0') in text
+
+
+class TestMultihostSeries:
+    """ISSUE 14: the multi-host serving families are born at zero — fence
+    byte scopes, slot ownership, and unified flushes from BatchScheduler
+    (and SolvePipeline) construction, forward outcomes from the
+    pipeline's ResultForwarder — and survive into expose()."""
+
+    def test_scheduler_families_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            MULTIHOST_FENCE_BYTES,
+            MULTIHOST_FENCE_SCOPES,
+            MULTIHOST_SLOT_OWNERSHIP,
+            MULTIHOST_SLOTS,
+            MULTIHOST_UNIFIED,
+        )
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        reg = Registry()
+        BatchScheduler(backend="oracle", registry=reg)
+        for scope in MULTIHOST_FENCE_SCOPES:
+            assert series_exists(reg.counter(MULTIHOST_FENCE_BYTES),
+                                 {"scope": scope})
+        for ownership in MULTIHOST_SLOT_OWNERSHIP:
+            assert series_exists(reg.counter(MULTIHOST_SLOTS),
+                                 {"ownership": ownership})
+        assert series_exists(reg.counter(MULTIHOST_UNIFIED))
+        text = reg.expose()
+        assert ('karpenter_solver_multihost_fence_bytes_total'
+                '{scope="read"} 0') in text
+        assert ('karpenter_solver_multihost_slots_total'
+                '{ownership="foreign"} 0') in text
+        assert 'karpenter_solver_multihost_unified_flushes_total 0' in text
+
+    def test_forward_outcomes_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            MULTIHOST_FORWARD_OUTCOMES,
+            MULTIHOST_FORWARDS,
+        )
+        from karpenter_tpu.parallel.forward import ResultForwarder
+
+        reg = Registry()
+        fwd = ResultForwarder(peers=[], registry=reg)
+        fwd.zero_init()
+        for outcome in MULTIHOST_FORWARD_OUTCOMES:
+            assert series_exists(reg.counter(MULTIHOST_FORWARDS),
+                                 {"outcome": outcome})
+        text = reg.expose()
+        assert ('karpenter_solver_multihost_forwards_total'
+                '{outcome="unrouted"} 0') in text
