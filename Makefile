@@ -2,7 +2,7 @@ PYTHON ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
 
-.PHONY: all test native proto bench clean battletest lint obs-demo overload-demo chaos chaos-fleet multihost-dryrun
+.PHONY: all test native proto bench clean battletest lint obs-demo obs-fleet-demo overload-demo chaos chaos-fleet multihost-dryrun
 
 all: native proto
 
@@ -47,6 +47,17 @@ bench:
 # p50/p99 over the run plus the recent per-solve trace trees
 obs-demo:
 	JAX_PLATFORMS=cpu $(PYTHON) -m karpenter_tpu.operator --demo --small --pods 60 --tracez
+
+# fleet-tracing demo (docs/OBSERVABILITY.md fleet section, ISSUE 15):
+# 3 unix-socket replicas sharing one spool, each with its own obs HTTP
+# endpoint; a delta session establishes, its home replica is hard-killed
+# mid-chain, the chain continues WARM on a steal-adopting sibling, and
+# the merged /fleetz view is fetched over real HTTP from a survivor —
+# printing per-replica load, the session-ownership map, and the
+# session's cross-replica trace timeline (ONE remote-parent-linked tree
+# spanning the dead replica's establishment and the sibling's deltas)
+obs-fleet-demo:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/fleet_trace_demo.py
 
 # admission demo (docs/ADMISSION.md): 4x closed-loop overdrive of mixed
 # critical/best_effort clients through the solve pipeline with tight

@@ -403,6 +403,15 @@ RESTART_FIRST_DELTA_P50_BUDGET_MS = 250.0
 #: single-latency budget (SINGLE_LATENCY_REGRESSION_MAX)
 MULTIHOST_FENCE_FRAC_TOLERANCE = 1.25
 
+#: replay-fidelity gates (ISSUE 15): the trace-replay harness must
+#: reproduce the capture's inter-arrival p50 within this relative error
+#: (virtual time — achieved sends scaled back by the speedup), with the
+#: class mix intact and zero replay errors.  The tolerance absorbs sleep
+#: granularity and closed-loop session chains (a delta cannot leave
+#: before its predecessor's epoch ack), not systemic serialization —
+#: a replay that flattened bursts into uniform load fails this.
+REPLAY_INTERARRIVAL_P50_TOL = 0.25
+
 #: overload gates (ISSUE 5): under a 4x closed-loop overdrive, critical p99
 #: must stay within this multiple of its unloaded p99 (admission reserves
 #: capacity for the high class instead of queueing it behind the burst) ...
@@ -484,6 +493,25 @@ def check_budgets(rec):
         flags.append(
             f"admitted-path single-solve overhead {adm_ov:.2f}% exceeds "
             f"the {ADMISSION_OVERHEAD_BUDGET_PCT:.0f}% admission budget")
+    # trace-replay fidelity gates (ISSUE 15): the harness the self-tuning
+    # gates will ride must reproduce the traffic it claims to.  Trace-
+    # context PROPAGATION overhead needs no separate gate — the wire
+    # fields ride every traced solve, so it lands inside the existing
+    # <=2% trace_overhead_pct budget above.
+    rp_err = rec.get("replay_interarrival_p50_err")
+    if rp_err is not None and rp_err > REPLAY_INTERARRIVAL_P50_TOL:
+        flags.append(
+            f"replayed inter-arrival p50 off by {rp_err:.1%} vs the "
+            f"capture (tolerance {REPLAY_INTERARRIVAL_P50_TOL:.0%}) — "
+            "the replay harness is distorting the traffic shape")
+    if rec.get("replay_class_mix_match") is False:
+        flags.append(
+            "replayed class mix diverged from the capture (dropped or "
+            "errored requests) — replay is not reproducing the workload")
+    if rec.get("replay_errors"):
+        flags.append(
+            f"{rec['replay_errors']:.0f} replayed request(s) errored "
+            "against a healthy in-process replica")
     # sharded megabatch gates (ISSUE 7): a meshed pipeline must serve
     # coalesced flushes strictly above its serial-dispatch baseline, and
     # the coalescer must not tax a lone meshed request
@@ -1513,7 +1541,21 @@ def measure_delta_serving(pods_n: int = 20_000, churn: int = 8,
     srv, _port = make_server(service, host=sock)
     try:
         pods = _warmstart_pods(pods_n, "dw")
-        sess = DeltaSession(sock, timeout=600.0)
+        # client-side tracing OFF for the measured session: a journey
+        # trace context would make the server adopt (and fully trace)
+        # every RPC regardless of its own 1-in-16 sampling — the
+        # measured configuration is the server-sampled one above
+        # (origin-side journey sampling is KT_TRACE_SAMPLE_EVERY at the
+        # client, session-granular; docs/OBSERVABILITY.md)
+        prev_trace = os.environ.get("KT_TRACE")
+        os.environ["KT_TRACE"] = "0"
+        try:
+            sess = DeltaSession(sock, timeout=600.0)
+        finally:
+            if prev_trace is None:
+                os.environ.pop("KT_TRACE", None)
+            else:
+                os.environ["KT_TRACE"] = prev_trace
         t0 = time.perf_counter()
         cur = sess.solve(pods, provs, catalog)
         establish_ms = (time.perf_counter() - t0) * 1000.0
@@ -1620,6 +1662,77 @@ def _delta_off_parity(target: str, provs, catalog) -> bool:
 
     return (canon(r_off) == canon(r_plain)
             and r_off.infeasible == r_plain.infeasible)
+
+
+def measure_replay_fidelity(n: int = 60, mean_rate: float = 5.0,
+                            speedup: float = 4.0, seed: int = 9):
+    """Trace-replay fidelity (ISSUE 15, obs/replay.py): synthesize a
+    seeded BURSTY capture (Markov-modulated 8x bursts — the flash-crowd
+    shape the self-tuning gates will ride), replay it through a real
+    gRPC replica on a unix socket, and compare the achieved
+    inter-arrival distribution + class mix against the capture.
+
+    Two passes: the FIDELITY run at speedup 1 — real-time gaps, so the
+    burst p50 (~25 ms at this rate) sits well above both driver-sleep
+    noise and one oracle RPC's service time (per-session chains are
+    CLOSED-LOOP: a delta cannot leave before its predecessor's epoch
+    ack, so a capture hotter than the service rate measures the
+    protocol floor, not the harness) — and a SPEEDUP run at ``speedup``
+    exercising the time-compression knob, whose p50 error is published
+    un-gated (compressed burst gaps approach scheduler-noise scale by
+    design).  Gates (check_budgets): speedup-1 inter-arrival p50 within
+    REPLAY_INTERARRIVAL_P50_TOL, class mix intact on BOTH runs, zero
+    replay errors."""
+    import tempfile
+
+    from karpenter_tpu.metrics import Registry
+    from karpenter_tpu.obs import replay
+    from karpenter_tpu.service.server import SolverService, make_server
+    from karpenter_tpu.solver.scheduler import BatchScheduler
+
+    records = replay.synthesize(n=n, shape="bursty", seed=seed,
+                                mean_rate=mean_rate, n_pods=30, churn=3,
+                                sessions=4)
+    reg = Registry()
+    sched = BatchScheduler(backend="oracle", registry=reg,
+                           compile_behind=False)
+    service = SolverService(sched, registry=reg)
+    sock = f"unix:{tempfile.mkdtemp(prefix='kt-replay-')}/solver.sock"
+    srv, _port = make_server(service, host=sock)
+    try:
+        rp = replay.Replayer(sock, registry=reg)
+        fid = replay.fidelity(records, rp.run(records, speedup=1.0))
+        p50_err = fid["interarrival_p50_err"]
+        if p50_err is not None and p50_err > REPLAY_INTERARRIVAL_P50_TOL:
+            # breach hygiene (repo idiom): a loaded-host blip does not
+            # reproduce on an independent run; a real harness defect does
+            rp2 = replay.Replayer(sock, registry=Registry())
+            fid2 = replay.fidelity(records, rp2.run(records, speedup=1.0))
+            if fid2["interarrival_p50_err"] is not None:
+                p50_err = min(p50_err, fid2["interarrival_p50_err"])
+        rp_s = replay.Replayer(sock, registry=Registry())
+        fid_s = replay.fidelity(records, rp_s.run(records,
+                                                  speedup=speedup))
+        return {
+            "replay_interarrival_p50_err": (
+                None if p50_err is None else round(p50_err, 4)),
+            "replay_interarrival_p90_err": (
+                None if fid["interarrival_p90_err"] is None
+                else round(fid["interarrival_p90_err"], 4)),
+            "replay_speedup_p50_err": (
+                None if fid_s["interarrival_p50_err"] is None
+                else round(fid_s["interarrival_p50_err"], 4)),
+            "replay_class_mix_match": (fid["class_mix_match"]
+                                       and fid_s["class_mix_match"]),
+            "replay_errors": fid["errors"] + fid_s["errors"],
+            "replay_sheds": fid["sheds"] + fid_s["sheds"],
+            "replay_requests": fid["n_sent"],
+            "replay_shape": "bursty",
+            "replay_speedup": speedup,
+        }
+    finally:
+        srv.stop(grace=None)
+        service.close()
 
 
 def measure_restart_recovery():
@@ -2120,6 +2233,7 @@ def run_bench():
     restart_recovery = measure_restart_recovery()
     fleet_failover = measure_fleet_failover()
     multihost = measure_multihost_fence()
+    replay_fidelity = measure_replay_fidelity()
     warm_ms, warm_cold, nowarm_ms, warmcold_err = measure_warm_coldstart()
 
     rec_cold = {
@@ -2165,6 +2279,7 @@ def run_bench():
         **restart_recovery,
         **fleet_failover,
         **multihost,
+        **replay_fidelity,
         "cost_ratio_vs_ffd": round(cost_ratio, 4),
         "tpu_nodes": len(out.result.nodes),
         "ffd_nodes": len(oracle.nodes),

@@ -32,7 +32,9 @@ HINT = ("write `with tracer.start(...) as trace:` / `with trace.span(...)"
         "`# ktlint: allow[KT007] <reason>`")
 
 #: method names that always indicate a span/trace opening, any receiver
-ALWAYS = {"start_span", "start_trace"}
+#: (start_remote is the KT019 server-entry facade — its result is a live
+#: trace and leaks exactly like a bare start)
+ALWAYS = {"start_span", "start_trace", "start_remote"}
 #: receiver-gated method names: only when the receiver's final segment is a
 #: trace/tracer (so `thread.start()` / `server.start()` never match)
 GATED = {"start", "span"}

@@ -176,6 +176,22 @@ TRACE_TRACES = "karpenter_trace_traces_total"
 TRACE_SPAN_DURATION = "karpenter_trace_span_duration_seconds"
 TRACE_RING_EVICTIONS = "karpenter_trace_ring_evictions_total"
 FLIGHT_DUMPS = "karpenter_trace_flight_recorder_dumps_total"
+# ---- fleet-wide tracing (ISSUE 15: wire-propagated trace context) -------
+TRACE_REMOTE_SPANS = "karpenter_trace_remote_spans_total"
+#: how each server-side RPC trace rooted (KT003 zero-init source, shared by
+#: Tracer construction): 'adopted' (the request carried a wire trace
+#: context and this trace joined the remote parent's tree) vs 'local' (no
+#: context on the wire — an old client, a direct call, or an unsampled
+#: origin; the trace rooted locally)
+TRACE_REMOTE_OUTCOMES = ("adopted", "local")
+# ---- trace-replay harness (ISSUE 15: obs/replay.py) ---------------------
+REPLAY_REQUESTS = "karpenter_replay_requests_total"
+#: replayed-request outcomes (KT003 zero-init source): 'ok' (served),
+#: 'shed' (typed admission shed/deadline — the replayed traffic found the
+#: server's protection posture, which is a result, not an error),
+#: 'error' (transport or server failure)
+REPLAY_OUTCOMES = ("ok", "shed", "error")
+REPLAY_LAG = "karpenter_replay_lag_seconds"
 ADMISSION_ADMITTED = "karpenter_admission_admitted_total"
 ADMISSION_SHED = "karpenter_admission_shed_total"
 ADMISSION_QUEUE_DEPTH = "karpenter_admission_queue_depth"
@@ -443,7 +459,30 @@ INVENTORY = {
         "device_hang (hang-guard trip), degraded_solve (warm-tier serve "
         "while the device tier is latched unhealthy), budget_breach (a "
         "trace exceeded KT_TRACE_SLOW_S), sanitizer_error (KT_SANITIZE "
-        "lock-discipline violation)."),
+        "lock-discipline violation).  Each dump's JSON envelope (and its "
+        "KT_FLIGHT_DIR file name) carries the dumping replica_id and, "
+        "when attributable, the session_id, so a fleet's dumps correlate "
+        "offline."),
+    TRACE_REMOTE_SPANS: (
+        "counter", ("outcome",),
+        "Server-side RPC traces by how they rooted (fleet-wide tracing, "
+        "docs/OBSERVABILITY.md): 'adopted' — the request carried a wire "
+        "trace context (trace_id + parent_span on SolveRequest) and this "
+        "replica's trace joined the remote parent's tree, so the whole "
+        "cross-replica request renders as ONE tree in /fleetz; 'local' — "
+        "no context on the wire (old client, direct call, unsampled "
+        "origin) and the trace rooted locally."),
+    REPLAY_REQUESTS: (
+        "counter", ("outcome",),
+        "Requests driven through the real gRPC stack by the trace-replay "
+        "harness (obs/replay.py), by outcome: 'ok' (served), 'shed' "
+        "(typed admission shed or deadline — replayed traffic probing the "
+        "server's overload posture), 'error' (transport/server failure)."),
+    REPLAY_LAG: (
+        "histogram", (),
+        "Scheduled-send vs actual-send lag of each replayed request, "
+        "seconds — the replayer's own pacing fidelity (a loaded driver "
+        "host shows up here, not as silently distorted inter-arrivals)."),
     ADMISSION_ADMITTED: (
         "counter", ("class",),
         "Solve requests admitted into the bounded priority queue, by "

@@ -22,11 +22,11 @@ import threading
 from typing import Optional
 
 from .recorder import FlightRecorder
-from .trace import NULL_SPAN, NULL_TRACE, Span, Trace, Tracer
+from .trace import NULL_SPAN, NULL_TRACE, Span, Trace, Tracer, replica_id
 
 __all__ = [
     "FlightRecorder", "NULL_SPAN", "NULL_TRACE", "Span", "Trace", "Tracer",
-    "default_flight", "default_tracer", "tracer_for",
+    "default_flight", "default_tracer", "replica_id", "tracer_for",
 ]
 
 # RLock: default_tracer() resolves default_flight() while holding it

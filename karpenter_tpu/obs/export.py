@@ -11,7 +11,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 
 from ..metrics import (
     ADMISSION_ADMITTED,
@@ -44,7 +44,9 @@ from ..metrics import (
     TRACE_TRACES,
     Registry,
 )
+from ..metrics import DELTA_RPC
 from .recorder import ANOMALY_REASONS, FlightRecorder
+from .trace import replica_id
 
 _BREAKER_STATES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
 
@@ -68,14 +70,24 @@ def _series(metric, label: str) -> dict:
     return out
 
 
-def statusz(registry: Registry, flight: Optional[FlightRecorder] = None) -> dict:
+def statusz(registry: Registry, flight: Optional[FlightRecorder] = None,
+            extra: Optional[Callable[[], dict]] = None) -> dict:
     """One-page operational snapshot: backend health, cache hit rates,
-    inflight depth, fallback counters, flight-recorder state."""
+    inflight depth, fallback counters, flight-recorder state.  ``extra``
+    is the serving layer's provider hook (SolverService.statusz_extra:
+    the per-session block + the service's replica identity) — merged
+    last, so the serving layer can extend the document without obs/
+    importing service/."""
     hits = _series(registry.counter(TENSORIZE_CACHE_HITS), "tier")
     n_hits = sum(hits.values())
     n_miss = registry.counter(TENSORIZE_CACHE_MISSES).get()
     total = n_hits + n_miss
     doc = {
+        # which replica answered (fleet merges key on it); the flight
+        # recorder's construction-time identity when one is attached,
+        # else the process identity
+        "replica_id": (flight.replica if flight is not None
+                       else replica_id()),
         "device": {
             "healthy": registry.gauge(SOLVER_DEVICE_HEALTHY).get() == 1.0,
             "hangs": registry.counter(SOLVER_DEVICE_HANGS).get(),
@@ -138,6 +150,11 @@ def statusz(registry: Registry, flight: Optional[FlightRecorder] = None) -> dict
                                "reason"),
             "last_sessions": registry.gauge(SNAPSHOT_SESSIONS).get(),
         }
+    rpc = registry.counter(DELTA_RPC)
+    if rpc.values:
+        # delta serving is live (the table zero-inits the family): the
+        # per-outcome partition — /fleetz sums these across replicas
+        doc["delta_rpc"] = _series(rpc, "outcome")
     adoptions = registry.counter(SESSION_ADOPTIONS)
     endpoints = registry.gauge(FLEET_ENDPOINTS)
     if any(adoptions.values.values()) or endpoints.values \
@@ -173,6 +190,13 @@ def statusz(registry: Registry, flight: Optional[FlightRecorder] = None) -> dict
                 if flight.last_dump() else None
             ),
         }
+    if extra is not None:
+        try:
+            doc.update(extra() or {})
+        # ktlint: allow[KT005] a failing provider must not take /statusz
+        # down — the page is the thing an operator reads DURING incidents
+        except Exception:  # noqa: BLE001
+            doc["extra_error"] = "statusz extra provider raised"
     return doc
 
 
@@ -209,9 +233,16 @@ def render_tracez(flight: FlightRecorder, limit: int = 8) -> str:
 
 
 def serve(registry: Registry, flight: FlightRecorder, port: int = 0,
-          host: str = "127.0.0.1") -> "tuple[ThreadingHTTPServer, int]":
-    """Start the sidecar observability server: /tracez, /statusz, /metrics.
-    Returns (server, bound_port); ``server.shutdown()`` stops it."""
+          host: str = "127.0.0.1",
+          extra: Optional[Callable[[], dict]] = None,
+          peers: Optional[list] = None,
+          ) -> "tuple[ThreadingHTTPServer, int]":
+    """Start the sidecar observability server: /tracez, /statusz,
+    /metrics, /fleetz.  ``extra`` extends /statusz (the serving layer's
+    session block); ``peers`` are sibling obs base URLs for the /fleetz
+    fan-out (default ``KT_OBS_PEERS``, comma-separated — include THIS
+    replica's own URL so the merged view is whole).  Returns
+    (server, bound_port); ``server.shutdown()`` stops it."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # silence
@@ -223,8 +254,16 @@ def serve(registry: Registry, flight: FlightRecorder, port: int = 0,
                 body = json.dumps(tracez(flight), default=str).encode()
                 code = 200
             elif self.path.startswith("/statusz"):
-                body = json.dumps(statusz(registry, flight),
+                body = json.dumps(statusz(registry, flight, extra=extra),
                                   default=str).encode()
+                code = 200
+            elif self.path.startswith("/fleetz"):
+                from .fleet import env_peers, fleetz
+
+                body = json.dumps(
+                    fleetz(peers if peers is not None else env_peers(),
+                           local=(registry, flight, extra)),
+                    default=str).encode()
                 code = 200
             elif self.path.startswith("/metrics"):
                 body, ctype, code = registry.expose().encode(), "text/plain", 200

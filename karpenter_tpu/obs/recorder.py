@@ -30,6 +30,7 @@ from ..metrics import (
     registry as default_registry,
 )
 from ..utils.clock import Clock
+from .trace import replica_id
 
 logger = logging.getLogger(__name__)
 
@@ -72,6 +73,13 @@ class FlightRecorder:
         self.clock = clock or Clock()
         self.registry = registry or default_registry
         self.dump_dir = dump_dir
+        #: which replica this recorder belongs to (ISSUE 15): stamped on
+        #: every dump envelope AND its KT_FLIGHT_DIR file name, so a
+        #: fleet sharing one dump volume never interleaves (or clobbers)
+        #: two replicas' dumps, and offline correlation can join a dump
+        #: to its /fleetz hop.  Captured at construction, like the
+        #: session table's lease identity.
+        self.replica = replica_id()
         self.slow_trace_s = slow_trace_s
         self.min_dump_interval_s = min_dump_interval_s
         self._lock = threading.Lock()
@@ -79,6 +87,13 @@ class FlightRecorder:
         self._events: deque = deque(maxlen=max(1, events_capacity))  # guarded-by: _lock
         self._dumps: deque = deque(maxlen=max(1, dump_capacity))  # guarded-by: _lock
         self._last_dump_at: Dict[str, float] = {}           # guarded-by: _lock
+        #: dump times inside the current interval — the GLOBAL storm cap:
+        #: per-(reason, replica, session) keys stop distinct incidents
+        #: suppressing each other, but a fleet-wide outage touching N
+        #: sessions must still produce a bounded number of ring
+        #: snapshots per interval, not N  # guarded-by: _lock
+        self._recent_dumps: deque = deque()
+        self.max_dumps_per_interval = 4
         self._n_dumped = 0                                  # guarded-by: _lock
         # zero-init every reason series + the eviction counter so the first
         # incident of each kind survives rate()/increase() (KT003)
@@ -148,20 +163,46 @@ class FlightRecorder:
         return out
 
     # ---- anomaly dumps --------------------------------------------------
-    def anomaly(self, reason: str, detail: str = "", trace=None) -> Optional[dict]:
+    def anomaly(self, reason: str, detail: str = "", trace=None,
+                session_id: str = "") -> Optional[dict]:
         """Record an anomaly: snapshot the ring (traces + events + counter
         deltas since the last dump) into a dump dict, count it, keep it,
         and write it to ``dump_dir`` when configured.  ``trace`` is the
         in-flight trace at the anomaly site (serialized mid-solve — open
-        spans carry ``end: null``).  Returns the dump, or None when
-        rate-limited (same reason within ``min_dump_interval_s``)."""
+        spans carry ``end: null``); ``session_id`` attributes the dump to
+        a delta session when the site knows one.  Returns the dump, or
+        None when rate-limited — the rate key is (reason, replica,
+        session), so two replicas sharing a recorder (or two sessions'
+        distinct incidents) never suppress each other's first dump,
+        while a GLOBAL cap (``max_dumps_per_interval``) keeps a
+        fleet-wide outage touching N sessions at a bounded number of
+        ring snapshots per interval, not N."""
         label = reason if reason in ANOMALY_REASONS else "other"
+        # a trace that crossed the wire knows its session even when the
+        # anomaly site did not pass one
+        if not session_id and trace is not None:
+            root_attrs = getattr(getattr(trace, "root", None),
+                                 "attrs", None) or {}
+            session_id = str(root_attrs.get("session_id", "") or "")
+        rate_key = f"{label}|{self.replica}|{session_id}"
         now = self.clock.now()
         with self._lock:
-            last = self._last_dump_at.get(label)
-            if last is not None and now - last < self.min_dump_interval_s:
+            # stale keys can never suppress again — pruning here bounds
+            # the map at (dumps within one interval), not (sessions ever
+            # seen by a long-lived server)
+            stale = [k for k, t in self._last_dump_at.items()
+                     if now - t >= self.min_dump_interval_s]
+            for k in stale:
+                del self._last_dump_at[k]
+            while self._recent_dumps and \
+                    now - self._recent_dumps[0] >= self.min_dump_interval_s:
+                self._recent_dumps.popleft()
+            if rate_key in self._last_dump_at:
                 return None
-            self._last_dump_at[label] = now
+            if len(self._recent_dumps) >= self.max_dumps_per_interval:
+                return None
+            self._last_dump_at[rate_key] = now
+            self._recent_dumps.append(now)
             self._n_dumped += 1
             seq = self._n_dumped
             traces = [t.to_dict() for t in self._traces]
@@ -178,6 +219,8 @@ class FlightRecorder:
             "reason": label,
             "detail": detail,
             "at": now,
+            "replica_id": self.replica,
+            "session_id": session_id,
             "trace": trace.to_dict() if trace is not None else None,
             "traces": traces,
             "events": events,
@@ -200,9 +243,13 @@ class FlightRecorder:
             return ""
         try:
             os.makedirs(self.dump_dir, exist_ok=True)
+            # replica-qualified name: two replicas sharing one dump
+            # volume have independent seq counters, so an unqualified
+            # name would silently overwrite the sibling's dump
             path = os.path.join(
                 self.dump_dir,
-                f"flight-{dump['seq']:04d}-{dump['reason']}.json")
+                f"flight-{dump['replica_id']}-{dump['seq']:04d}-"
+                f"{dump['reason']}.json")
             with open(path, "w") as f:
                 json.dump(dump, f, indent=2, default=str)
             return path

@@ -41,6 +41,8 @@ import threading
 from typing import Dict, List, Optional
 
 from ..metrics import (
+    TRACE_REMOTE_OUTCOMES,
+    TRACE_REMOTE_SPANS,
     TRACE_SPAN_DURATION,
     TRACE_TRACES,
     Registry,
@@ -55,16 +57,37 @@ MAX_SPANS_PER_TRACE = 512
 _TRACE_IDS = itertools.count(1)
 
 
+def replica_id() -> str:
+    """This process's stable trace-origin identity: ``KT_REPLICA_ID`` (the
+    deploy sets the pod name — the same identity the session-lease
+    protocol uses) or a host-pid fallback.  Trace ids are PREFIXED with it
+    (``replica-0-t000042``) so two replicas' locally-minted ids can never
+    collide and a forwarded / failed-over hop joins exactly its parent's
+    tree in the /fleetz merge.  Read per call, not at import: in-process
+    fleet harnesses construct replicas under different env."""
+    env = os.environ.get("KT_REPLICA_ID", "")
+    if env:
+        return env
+    import socket
+
+    return f"{socket.gethostname()}-{os.getpid()}"
+
+
 class Span:
     """One timed, attributed phase of a trace.  Obtained from
     :meth:`Trace.span` (context manager) or :meth:`Trace.record`
     (pre-closed); never constructed directly by instrumentation."""
 
-    __slots__ = ("name", "t0", "t1", "attrs", "children", "_trace")
+    __slots__ = ("name", "span_id", "t0", "t1", "attrs", "children",
+                 "_trace")
 
     def __init__(self, trace: "Trace", name: str, t0: float,
-                 attrs: Optional[dict] = None) -> None:
+                 attrs: Optional[dict] = None, span_id: str = "") -> None:
         self.name = name
+        #: trace-local id (``s1`` = root, ``s2``...), carried on the wire
+        #: as ``parent_span`` so a remote child hop can attach under THIS
+        #: span in the /fleetz cross-replica tree
+        self.span_id = span_id
         self.t0 = t0
         self.t1: Optional[float] = None
         self.attrs: Dict[str, object] = dict(attrs or ())
@@ -96,6 +119,7 @@ class Span:
         """Serialize (caller holds the trace lock; see Trace.to_dict)."""
         out: dict = {
             "name": self.name,
+            "span_id": self.span_id,
             "start": self.t0,
             "end": self.t1,
             "duration_ms": (None if self.t1 is None
@@ -114,6 +138,7 @@ class _NullSpan:
     __slots__ = ()
 
     name = ""
+    span_id = ""
     attrs: dict = {}
     children: list = []
     done = True
@@ -158,6 +183,11 @@ class _NullTrace:
     def annotate(self, **attrs) -> None:
         return None
 
+    def wire_context(self) -> "tuple[str, str]":
+        """No context crosses the wire for an unsampled/disabled trace —
+        the remote side roots locally (counted ``local``)."""
+        return ("", "")
+
     def spans(self) -> list:
         return []
 
@@ -182,15 +212,20 @@ class Trace:
     hands the finished trace to the tracer (metrics + flight recorder)."""
 
     def __init__(self, tracer: "Tracer", name: str,
-                 attrs: Optional[dict] = None) -> None:
+                 attrs: Optional[dict] = None,
+                 trace_id: Optional[str] = None) -> None:
         self._tracer = tracer
         self._clock = tracer.clock
-        self.trace_id = f"t{next(_TRACE_IDS):06d}"
+        # replica-prefixed so two replicas' locally-minted ids can never
+        # collide in a fleet merge; a remote-parented trace ADOPTS the
+        # origin's id instead (Tracer.start_remote) — one request, one id
+        self.trace_id = (trace_id
+                         or f"{tracer.replica}-t{next(_TRACE_IDS):06d}")
         self.name = name
         self._lock = threading.Lock()
         self._n_spans = 1           # guarded-by: _lock
         self._n_dropped = 0         # guarded-by: _lock
-        self.root = Span(self, name, self._clock.now(), attrs)
+        self.root = Span(self, name, self._clock.now(), attrs, span_id="s1")
         self._open = threading.local()  # per-thread open-span stack
 
     # ---- time -----------------------------------------------------------
@@ -221,7 +256,8 @@ class Trace:
                 self.root.attrs["spans_dropped"] = self._n_dropped
                 return NULL_SPAN
             self._n_spans += 1
-            sp = Span(self, name, self._clock.now(), attrs)
+            sp = Span(self, name, self._clock.now(), attrs,
+                      span_id=f"s{self._n_spans}")
             parent.children.append(sp)
         stack.append(sp)
         return sp
@@ -237,7 +273,7 @@ class Trace:
                 self.root.attrs["spans_dropped"] = self._n_dropped
                 return NULL_SPAN
             self._n_spans += 1
-            sp = Span(self, name, t0, attrs)
+            sp = Span(self, name, t0, attrs, span_id=f"s{self._n_spans}")
             sp.t1 = t1
             self.root.children.append(sp)
         return sp
@@ -258,6 +294,16 @@ class Trace:
         """Attach attributes to the root span (backend, batch size, cost,
         served_cold, ...)."""
         self._annotate_span(self.root, attrs)
+
+    def wire_context(self) -> "tuple[str, str]":
+        """The ``(trace_id, parent_span)`` pair a wire-crossing send site
+        attaches to its request (ktlint KT019 pins the discipline): the
+        remote side opens its child trace under this thread's innermost
+        OPEN span (the root when none), so the hop lands exactly where
+        the RPC happened in the tree."""
+        stack = self._stack()
+        return (self.trace_id,
+                stack[-1].span_id if stack else self.root.span_id)
 
     # ---- completion / introspection -------------------------------------
     def finish(self) -> "Trace":
@@ -325,12 +371,19 @@ class Tracer:
         if sample_every is None:
             sample_every = int(os.environ.get("KT_TRACE_SAMPLE_EVERY", "1"))
         self.sample_every = max(1, sample_every)
+        #: this tracer's trace-id prefix + the replica_id attr every
+        #: adopted hop carries (captured at construction: in-process fleet
+        #: harnesses build replicas under different KT_REPLICA_ID env)
+        self.replica = replica_id()
         self._lock = threading.Lock()
         self._n_started = 0  # guarded-by: _lock
         # zero-init so the series exists from the first scrape (KT003), and
         # register the span-duration family so the documented metric is
         # visible before the first trace completes
         self.registry.counter(TRACE_TRACES).inc(value=0.0)
+        remote = self.registry.counter(TRACE_REMOTE_SPANS)
+        for outcome in TRACE_REMOTE_OUTCOMES:
+            remote.inc({"outcome": outcome}, value=0.0)
         self.registry.histogram(TRACE_SPAN_DURATION)
 
     def start(self, name: str, **attrs):
@@ -345,6 +398,37 @@ class Tracer:
         if not sampled:
             return NULL_TRACE
         return Trace(self, name, attrs)
+
+    def start_remote(self, name: str, trace_id: str, parent_span: str,
+                     **attrs):
+        """Begin a trace that may ADOPT a remote parent — the server-entry
+        facade (ktlint KT019: every entry that decodes a wire trace
+        context must open its trace through here; KT007 covers the
+        context-manager form).  With a non-empty ``trace_id`` the trace
+        joins the remote tree: it reuses the ORIGIN's trace id (so the
+        /fleetz merge groups the hops into one tree), records the parent
+        span id + this replica's identity on its root, and BYPASSES
+        sampling — the origin already made the sampling decision, and a
+        half-sampled tree is worse than none.  With an empty ``trace_id``
+        (old client, direct call, unsampled origin) this is exactly
+        :meth:`start`.  Counted into
+        ``karpenter_trace_remote_spans_total{outcome}`` per trace actually
+        opened."""
+        if not self.enabled:
+            return NULL_TRACE
+        if not trace_id:
+            trace = self.start(name, **attrs)
+            if trace:
+                self.registry.counter(TRACE_REMOTE_SPANS).inc(
+                    {"outcome": "local"})
+            return trace
+        attrs = dict(attrs)
+        attrs["replica_id"] = self.replica
+        if parent_span:
+            attrs["remote_parent"] = parent_span
+        self.registry.counter(TRACE_REMOTE_SPANS).inc(
+            {"outcome": "adopted"})
+        return Trace(self, name, attrs, trace_id=trace_id)
 
     def _finish(self, trace: Trace) -> None:
         trace.finish()

@@ -417,6 +417,22 @@ class Operator:
                         default=str).encode()
                     ctype = "application/json"
                     self.send_response(200)
+                elif self.path.startswith("/fleetz"):
+                    # fleet-merged view (ISSUE 15, obs/fleet.py): fans out
+                    # to the solver replicas' obs endpoints (KT_OBS_PEERS)
+                    # and merges load/ownership/trace trees — the operator
+                    # mounts the same document the solver sidecars serve,
+                    # with ITS hops (the "remote" spans the reconciler
+                    # cut) contributed from memory
+                    from karpenter_tpu.obs import fleet as obs_fleet
+
+                    body = json.dumps(
+                        obs_fleet.fleetz(obs_fleet.env_peers(),
+                                         local=(op.registry, op.flight,
+                                                None)),
+                        default=str).encode()
+                    ctype = "application/json"
+                    self.send_response(200)
                 else:
                     body = b"not found"
                     self.send_response(404)

@@ -32,6 +32,8 @@ import os
 import threading
 from typing import Callable, Dict, List, Optional
 
+from ..obs.trace import NULL_TRACE
+
 logger = logging.getLogger(__name__)
 
 
@@ -134,38 +136,48 @@ class ResultForwarder:
             self._count("unrouted")
             raise err
         endpoint = self.endpoint_of(err.owner)
-        if self.transport is not None:
-            try:
-                out = self.transport(endpoint, kwargs)
-            except Exception:
-                self._count("error")
-                raise
-            self._count("forwarded")
-            return out
-        if endpoint is None:
-            self._count("unrouted")
-            raise err
-        from ..service import codec
+        # fleet-wide tracing (ISSUE 15): the forwarded hop is a CHILD of
+        # the originating flush's trace — the "forward" span crosses the
+        # wire as the remote parent, so the owner host's trace attaches
+        # under it and /fleetz renders the foreign slot inside the
+        # request's own tree instead of as an orphan on another replica
+        trace = kwargs.get("trace") or NULL_TRACE
+        with trace.span("forward", slot=err.slot, owner=err.owner,
+                        endpoint=endpoint or ""):
+            if self.transport is not None:
+                try:
+                    out = self.transport(endpoint, kwargs)
+                except Exception:
+                    self._count("error")
+                    raise
+                self._count("forwarded")
+                return out
+            if endpoint is None:
+                self._count("unrouted")
+                raise err
+            from ..service import codec
 
-        req = codec.encode_request(
-            kwargs["pods"], kwargs["provisioners"],
-            kwargs["instance_types"],
-            existing_nodes=kwargs.get("existing_nodes", ()),
-            daemonsets=kwargs.get("daemonsets", ()),
-            unavailable=kwargs.get("unavailable"),
-            allow_new_nodes=kwargs.get("allow_new_nodes", True),
-            max_new_nodes=kwargs.get("max_new_nodes"),
-            priority=priority or None,
-        )
-        try:
-            resp = self._client(endpoint).solve_raw(req)
-        except Exception as exc:
-            self._count("error")
-            raise RuntimeError(
-                f"forwarding slot {err.slot} to owning host "
-                f"{err.owner} ({endpoint}) failed: {exc}") from exc
-        self._count("forwarded")
-        return codec.decode_response(resp)
+            wire_tid, wire_parent = trace.wire_context()
+            req = codec.encode_request(
+                kwargs["pods"], kwargs["provisioners"],
+                kwargs["instance_types"],
+                existing_nodes=kwargs.get("existing_nodes", ()),
+                daemonsets=kwargs.get("daemonsets", ()),
+                unavailable=kwargs.get("unavailable"),
+                allow_new_nodes=kwargs.get("allow_new_nodes", True),
+                max_new_nodes=kwargs.get("max_new_nodes"),
+                priority=priority or None,
+                trace_id=wire_tid, parent_span=wire_parent,
+            )
+            try:
+                resp = self._client(endpoint).solve_raw(req)
+            except Exception as exc:
+                self._count("error")
+                raise RuntimeError(
+                    f"forwarding slot {err.slot} to owning host "
+                    f"{err.owner} ({endpoint}) failed: {exc}") from exc
+            self._count("forwarded")
+            return codec.decode_response(resp)
 
     def close(self) -> None:
         with self._lock:

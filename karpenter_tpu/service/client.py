@@ -702,10 +702,13 @@ class RemoteScheduler:
         # only the local-fallback solve below honors the caller's value
         trace = trace or NULL_TRACE
         if self._remote_ok():
-            # the trace stays operator-side: the wire carries no context, so
-            # the whole RPC is one "remote" span here and the sidecar cuts
-            # its own trace (its /tracez has the per-phase breakdown)
+            # fleet-wide tracing (ISSUE 15): the "remote" span's wire
+            # context crosses with the request, so the sidecar's trace
+            # opens as a CHILD of this span (same trace id, remote parent
+            # linked) instead of an unrelated tree — /fleetz renders the
+            # operator hop and the sidecar hop as one request
             with trace.span("remote", target=self.target) as span:
+                wire_tid, wire_parent = trace.wire_context()
                 req = codec.encode_request(
                     pods, provisioners, instance_types,
                     existing_nodes=existing_nodes, daemonsets=daemonsets,
@@ -714,6 +717,7 @@ class RemoteScheduler:
                     priority=self.priority,
                     deadline_ms=(self.deadline_s * 1000.0
                                  if self.deadline_s else None),
+                    trace_id=wire_tid, parent_span=wire_parent,
                 )
                 # the wire deadline budget also bounds the RPC itself: a
                 # caller with 250ms left must not block 60s on the channel
@@ -779,6 +783,12 @@ class RemoteScheduler:
                                        "solve from the local fallback",
                                        err.code(), exc_info=True)
                 else:
+                    # which replica actually served (after any fleet
+                    # failover re-route): stamped on the span so the
+                    # client-side tree names the serving hop
+                    served_by = getattr(resp, "replica_id", "") or ""
+                    if served_by:
+                        span.annotate(replica=served_by)
                     result = codec.decode_response(resp)
                     # re-attach real PodSpecs to returned nodes (wire carries
                     # names only)
@@ -904,6 +914,37 @@ class DeltaSession:
         self.priority = parse_class(priority) if priority else ""
         self.deadline_s = deadline_s
         self.enabled = delta_enabled()
+        # fleet-wide tracing (ISSUE 15): the session's JOURNEY trace id —
+        # one stable, origin-prefixed id for the session's whole life, so
+        # every hop it touches (establish on its home, deltas on a
+        # steal-adopting sibling after a kill, drain handoffs) adopts the
+        # same id server-side and /fleetz renders the journey as ONE
+        # timeline.  The SAMPLING decision is made HERE, at the origin,
+        # at session granularity: the server-side facade deliberately
+        # bypasses sampling for adopted contexts (a half-sampled tree is
+        # worse than none), so an unconditional journey id would defeat
+        # KT_TRACE_SAMPLE_EVERY on the sub-ms delta hot path entirely.
+        # 1-in-N SESSIONS trace their whole journey, decided
+        # deterministically from the session id so a client restart (or
+        # a second client of the same session) keeps the same decision.
+        # KT_TRACE=0 client-side sends no context at all.
+        self._trace_id = ""
+        if os.environ.get("KT_TRACE", "1") != "0":
+            import hashlib
+
+            from ..obs.trace import replica_id as _origin_id
+
+            every = max(1, int(os.environ.get("KT_TRACE_SAMPLE_EVERY",
+                                              "1")))
+            digest = int.from_bytes(
+                hashlib.sha256(self.session_id.encode()).digest()[:8],
+                "big")
+            if digest % every == 0:
+                self._trace_id = (
+                    f"{_origin_id()}-sess-{self.session_id[:12]}")
+        #: which replica served the last RPC (SolveResponse.replica_id) —
+        #: "" against pre-tracing servers
+        self.last_replica = ""
         # --- cluster ledger (ground truth the caller has asserted) ---
         self._pods: Optional[Dict[str, PodSpec]] = None  # None: no solve yet
         self._provisioners: List[Provisioner] = []
@@ -1052,6 +1093,13 @@ class DeltaSession:
             removed_pods=list(self._pend_rm),
             reclaimed_nodes=list(self._pend_reclaim),
             catalog_epoch=self._catalog_epoch,
+            # "s1" = the establishment hop's root (root span ids are "s1"
+            # by construction): every delta hop attaches under the
+            # journey's establishing hop in the /fleetz tree — including
+            # hops served by an ADOPTING sibling after failover, which is
+            # what makes the whole journey ONE remote-parent-linked tree
+            trace_id=self._trace_id, parent_span="s1" if self._trace_id
+            else "",
         )
         self.delta_rpcs += 1
         reply = codec.decode_delta_reply(self._rpc(req))
@@ -1124,7 +1172,12 @@ class DeltaSession:
         rpc_timeout = (min(self.client.timeout, self.deadline_s)
                        if self.deadline_s else None)
         try:
-            return self.client.solve_raw(req, timeout=rpc_timeout)
+            resp = self.client.solve_raw(req, timeout=rpc_timeout)
+            # the serving replica's identity (stamped server-side): after
+            # a fleet failover this names the ADOPTING sibling — the
+            # client-visible half of the session's journey timeline
+            self.last_replica = getattr(resp, "replica_id", "") or ""
+            return resp
         except grpc.RpcError as err:
             code = (err.code()
                     if callable(getattr(err, "code", None)) else None)
@@ -1184,6 +1237,7 @@ class DeltaSession:
             backend=self.backend, priority=self.priority,
             deadline_ms=(self.deadline_s * 1000.0
                          if self.deadline_s else None),
+            trace_id=self._trace_id,
             **session_kw,
         )
         self.full_resends += 1

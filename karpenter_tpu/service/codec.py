@@ -151,6 +151,8 @@ def encode_request(
     removed_pods: Sequence[str] = (),
     reclaimed_nodes: Sequence[str] = (),
     catalog_epoch: int = 0,
+    trace_id: str = "",
+    parent_span: str = "",
 ) -> pb.SolveRequest:
     # admission fields (docs/ADMISSION.md): "" / 0 are the backward-
     # compatible wire defaults — the server folds them into its configured
@@ -158,14 +160,17 @@ def encode_request(
     # one that sent nothing.  The delta-session fields (ARCHITECTURE.md
     # round 14) default the same way: an empty session_id is a classic
     # full solve; delta=True reuses `pods` for the ADDED pods and
-    # `unavailable` for the newly ICE'd offerings.
+    # `unavailable` for the newly ICE'd offerings.  The trace context
+    # (ISSUE 15) defaults to "no context": the server roots locally.
     req = pb.SolveRequest(allow_new_nodes=allow_new_nodes, backend=backend,
                           priority_class=priority or "",
                           deadline_ms=float(deadline_ms or 0.0),
                           session_id=session_id or "",
                           base_epoch=int(base_epoch or 0),
                           delta=bool(delta),
-                          catalog_epoch=int(catalog_epoch or 0))
+                          catalog_epoch=int(catalog_epoch or 0),
+                          trace_id=trace_id or "",
+                          parent_span=parent_span or "")
     req.removed_pods.extend(removed_pods)
     req.reclaimed_nodes.extend(reclaimed_nodes)
     req.pods.extend(encode_pod(p) for p in pods)
@@ -339,6 +344,16 @@ def decode_request(req: pb.SolveRequest):
         allow_new_nodes=req.allow_new_nodes,
         max_new_nodes=req.max_new_nodes if req.has_max_new_nodes else None,
     )
+
+
+def decode_trace_fields(req: pb.SolveRequest) -> "Tuple[str, str]":
+    """The wire trace context of a SolveRequest: ``(trace_id,
+    parent_span)``.  ``("", "")`` — old clients, unsampled origins —
+    means "no remote parent"; every server entry that reads this must
+    open its trace through ``Tracer.start_remote`` (ktlint KT019), which
+    maps the empty context to a plain local start."""
+    return (getattr(req, "trace_id", "") or "",
+            getattr(req, "parent_span", "") or "")
 
 
 def decode_delta_fields(req: pb.SolveRequest) -> Optional[dict]:

@@ -152,6 +152,14 @@ class SessionEntry:
     #: must never persist it — docs/RESILIENCE.md).  Transient: never
     #: serialized.
     in_step: bool = False
+    #: adoption provenance (ISSUE 15, transient like in_step): how this
+    #: entry arrived ("" established here, "adopted" free-lease claim,
+    #: "stolen" expired-lease steal) and WHOSE lease guarded the record —
+    #: the /statusz session block and the session_adopt/session_steal
+    #: lifecycle spans read these so a failed-over chain's journey is
+    #: diagnosable without grepping the spool
+    adopt_how: str = ""
+    adopted_from: str = ""
 
 
 @dataclass
@@ -695,6 +703,15 @@ class DeltaSessionTable:
             # race.
             _count("missing")
             return None
+        # provenance BEFORE the claim rewrites it: whose lease guarded the
+        # record is the "adopted_from" the lifecycle span + /statusz show
+        try:
+            prior = snap.lease_state(dir_path, session_id)
+        # ktlint: allow[KT005] provenance is observability, not protocol —
+        # an unreadable lease file must not fail the adoption
+        except Exception:  # noqa: BLE001
+            prior = None
+        prior_owner = str((prior or {}).get("owner", "") or "")
         if self._faults:
             effect = self._faults.fire("adopt")
             if effect is not None and effect.kind == "lease_steal":
@@ -761,6 +778,9 @@ class DeltaSessionTable:
                 instance_types=d["instance_types"],
                 daemonsets=tuple(d.get("daemonsets") or ()),
                 unavailable=set(d.get("unavailable") or ()),
+                adopt_how="stolen" if how == "stolen" else "adopted",
+                adopted_from=(prior_owner
+                              if prior_owner != self.replica else ""),
             )
             with self._lock:
                 entry.last_used = now + self._skew
@@ -909,6 +929,32 @@ class DeltaSessionTable:
     def leases_owned(self) -> int:
         with self._lock:
             return len(self._owned)
+
+    def sessions_status(self) -> Dict[str, dict]:
+        """Per-session diagnostic view for the /statusz session block
+        (ISSUE 15): chain epoch, seconds since the last served delta,
+        the current lease owner (this replica when we hold the spool
+        lease), and — for failed-over chains — which replica it was
+        adopted/stolen from, so a stuck chain is diagnosable from one
+        HTTP GET instead of grepping the spool.  Reads table state only
+        (no disk); entry CONTENTS are limited to scalars the dispatcher
+        writes atomically, so the snapshot under ``_lock`` is safe."""
+        now = self.clock.now()
+        with self._lock:
+            now += self._skew
+            return {
+                sid: {
+                    "epoch": int(e.epoch),
+                    "last_delta_age_s": round(max(0.0, now - e.last_used),
+                                              3),
+                    "lease_owner": (self.replica if sid in self._owned
+                                    else ""),
+                    "adopted_from": e.adopted_from,
+                    "adopt_how": e.adopt_how,
+                    "in_step": bool(e.in_step),
+                }
+                for sid, e in self._sessions.items()
+            }
 
 
 def zero_init_metrics(registry: Registry) -> None:

@@ -941,8 +941,14 @@ class SolvePipeline:
                 # establishment supersedes any incarnation a sibling's
                 # lease still guards — without this a session re-homed by
                 # a routing flap livelocks between the stale lease holder
-                # and the replica actually serving it
+                # and the replica actually serving it.  Lifecycle span:
+                # the session's lease CLAIM, the first event of its
+                # journey timeline (docs/OBSERVABILITY.md span taxonomy).
+                t0c = trace.now()
                 tab.own(sid, self._spool_dir)
+                trace.record("session_claim", t0c, trace.now(),
+                             session_id=sid, replica_id=tab.replica,
+                             epoch=epoch0)
             return _counted(_full_reply(result, epoch0, "establish"),
                             "establish")
         # ---- incremental step -------------------------------------------
@@ -954,8 +960,20 @@ class SolvePipeline:
             # claim + record consume) serves this very delta WARM.  Every
             # adoption outcome is counted; an unexpired sibling lease
             # refuses typed and the client pays the PR-10 exactly-one
-            # re-establish instead.
+            # re-establish instead.  Lifecycle span: "session_steal" when
+            # the previous owner's lease had expired (the dead-replica
+            # path), "session_adopt" otherwise — with the adopted-from
+            # replica, so the journey timeline shows WHERE the chain came
+            # from (docs/OBSERVABILITY.md span taxonomy).
+            t0a = trace.now()
             entry = tab.adopt(self._spool_dir, sid)
+            if entry is not None:
+                trace.record(
+                    "session_steal" if entry.adopt_how == "stolen"
+                    else "session_adopt",
+                    t0a, trace.now(), session_id=sid,
+                    replica_id=tab.replica, epoch=entry.epoch,
+                    adopted_from=entry.adopted_from)
         if entry is None or entry.epoch != info["base_epoch"]:
             # evicted / never established / epoch mismatch after a lost
             # response: the only safe answer is "re-establish" — applying
@@ -979,8 +997,14 @@ class SolvePipeline:
                 # the acked epoch, lease RELEASED, entry dropped), and
                 # the reply carries the DRAINING hint so the client
                 # re-homes before this pod dies — the adopting sibling
-                # serves the session's next delta warm
+                # serves the session's next delta warm.  Lifecycle span:
+                # the handoff is the journey event that explains the
+                # replica change the next hop's adopt span completes.
+                t0h = trace.now()
                 tab.handoff(sid, self._spool_dir)
+                trace.record("session_drain_handoff", t0h, trace.now(),
+                             session_id=sid, replica_id=tab.replica,
+                             epoch=reply.epoch)
                 reply.state = "draining"
             return reply, outcome
         # ktlint: allow[KT005] re-raised after eviction: the RPC thread
@@ -1294,6 +1318,27 @@ class SolverService:
         for pipe in pipes:
             pipe.drain()
 
+    def statusz_extra(self) -> dict:
+        """The serving layer's /statusz extension (ISSUE 15): this
+        replica's identity plus the per-session block — chain epoch,
+        last-delta age, lease owner, adopted-from — aggregated over every
+        backend pipeline's session table.  Handed to
+        :func:`obs.export.statusz` / ``serve(extra=...)`` so obs/ never
+        imports service/."""
+        out: dict = {"replica_id": self.tracer.replica,
+                     "draining": False}
+        sessions: dict = {}
+        with self._direct_lock:
+            pipes = list(self._pipelines.values())
+        for pipe in pipes:
+            out["draining"] = out["draining"] or pipe.draining()
+            tab = pipe._delta_tab
+            if tab is not None:
+                sessions.update(tab.sessions_status())
+        if sessions:
+            out["sessions"] = sessions
+        return out
+
     def close(self) -> None:
         # latch closed + snapshot under the lock (a late first RPC racing
         # shutdown must neither resize the dict mid-iteration nor construct
@@ -1331,16 +1376,23 @@ class SolverService:
         sched = self._scheduler_for(request.backend)
         pclass = parse_class(getattr(request, "priority_class", ""))
         deadline_s = self._deadline_of(request, context)
+        wire_trace, wire_parent = codec.decode_trace_fields(request)
         # one trace per RPC, threaded through the pipeline's dispatch/
         # finalize boundary via the kwargs dict (the dispatcher records the
         # queue-wait "window" span on it; the scheduler opens tensorize/
         # dispatch/fence/reseat under it); "respond" covers the encode back
-        # onto the wire
+        # onto the wire.  A request carrying a wire trace context ADOPTS
+        # the remote parent (start_remote): the hop keeps the ORIGIN's
+        # trace id, so a request crossing replicas — establishment here,
+        # deltas on a steal-adopting sibling, a forwarded foreign slot —
+        # renders as ONE tree in /fleetz.
         try:
-            with self.tracer.start(
-                "solve", rpc="Solve", backend=sched.backend,
+            with self.tracer.start_remote(
+                "solve", wire_trace, wire_parent,
+                rpc="Solve", backend=sched.backend,
                 n_pods=len(kwargs.get("pods", ())), priority_class=pclass,
                 delta=bool(sess and sess["delta"]),
+                **({"session_id": sess["session_id"]} if sess else {}),
             ) as trace:
                 kwargs["trace"] = trace
                 if self._pipelined:
@@ -1379,6 +1431,10 @@ class SolverService:
                         resp = codec.encode_delta_reply(result)
                     else:
                         resp = codec.encode_response(result)
+                    # which replica served: failover-aware clients stamp
+                    # this on their "remote" span, and offline dump
+                    # correlation keys on it
+                    resp.replica_id = self.tracer.replica
         except SolveDeadlineError as err:
             # shed BEFORE tensorize/dispatch: the wire contract is
             # DEADLINE_EXCEEDED for expired budgets, RESOURCE_EXHAUSTED for
@@ -1573,8 +1629,11 @@ def main(argv=None) -> int:
         # server stays on loopback in the same-pod sidecar topology
         obs_host = ("127.0.0.1" if args.host.startswith("unix:")
                     else args.host)
+        # the session block rides /statusz and KT_OBS_PEERS arms the
+        # /fleetz fan-out (docs/OBSERVABILITY.md fleet tracing)
         _obs_server, obs_port = obs_serve(
-            service.registry, flight, port=args.obs_port, host=obs_host)
+            service.registry, flight, port=args.obs_port, host=obs_host,
+            extra=service.statusz_extra)
         print(f"observability on http://{obs_host}:{obs_port}/tracez")
     # graceful shutdown (ISSUE 12/13, docs/RESILIENCE.md): SIGTERM — the
     # kubelet's pod-termination signal, reinforced by deploy/solver.yaml's

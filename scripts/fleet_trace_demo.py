@@ -1,0 +1,123 @@
+"""Fleet-tracing demo (`make obs-fleet-demo`, ISSUE 15).
+
+Three in-process solver replicas on unix sockets share one session
+spool, each serving its own observability HTTP endpoint (/statusz with
+the session block, /tracez, /fleetz with the peer fan-out).  A delta
+session establishes on its rendezvous home, churns, the home is
+HARD-KILLED mid-chain, and the session continues WARM on a
+steal-adopting sibling — then the merged /fleetz view is fetched over
+real HTTP from a surviving replica and printed, with the session's
+cross-replica trace timeline: ONE tree, establishment rooted on the
+dead replica, the surviving deltas linked under it, the
+`session_steal` lifecycle span naming where the chain came from.
+
+The victim's gRPC plane dies but its obs endpoint stays up — the
+post-mortem topology: an obs sidecar outliving its serving process is
+exactly when the fleet view must still assemble the dead replica's hops.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import pathlib
+import sys
+import tempfile
+import time
+import urllib.request
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def _chaos_drive():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_drive", str(ROOT / "scripts" / "chaos_drive.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    import os
+
+    os.environ.setdefault("KT_SESSION_SNAPSHOT_S", "0.0001")
+    os.environ.setdefault("KT_SESSION_LEASE_S", "0.4")
+
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.obs.export import serve as obs_serve
+    from karpenter_tpu.obs.fleet import render_fleetz
+    from karpenter_tpu.service.client import DeltaSession, FleetClient
+
+    chaos = _chaos_drive()
+    tmp = tempfile.mkdtemp(prefix="kt-fleet-demo-")
+    spool = f"{tmp}/spool"
+    print("== obs-fleet-demo: 3 replicas, one spool, kill-one mid-chain ==")
+    reps = [chaos._build_replica(f"unix:{tmp}/r{i}.sock", spool,
+                                 f"replica-{i}", 0.4, 0.0001)
+            for i in range(3)]
+    obs_servers, obs_urls = [], []
+    for rep in reps:
+        flight = rep["service"].tracer.flight
+        srv, port = obs_serve(rep["reg"], flight, port=0,
+                              extra=rep["service"].statusz_extra)
+        obs_servers.append(srv)
+        obs_urls.append(f"http://127.0.0.1:{port}")
+    # every replica fans /fleetz out to the full peer list (itself
+    # included — the merge dedupes by replica_id)
+    os.environ["KT_OBS_PEERS"] = ",".join(obs_urls)
+
+    provs = [Provisioner(name="default").with_defaults()]
+    catalog = generate_catalog(full=False)
+    socks = [r["sock"] for r in reps]
+    fc = FleetClient(socks, timeout=60.0, retries=0, backoff_s=0.01)
+    sess = DeltaSession(socks[0], timeout=60.0, client=fc)
+    print(f"establishing session {sess.session_id[:12]} "
+          f"(journey trace {sess._trace_id}) ...")
+    sess.solve(chaos.make_pods(150, "fd"), provs, catalog)
+    for k in range(2):
+        sess.solve_delta(added=chaos.make_pods(2, f"fd{k}"))
+    print(f"  served by {sess.last_replica}, epoch {sess.epoch}")
+    chaos._settle_spool(reps)
+    home = fc.endpoint_for(sess.session_id)
+    victim = next(r for r in reps if r["sock"] == home)
+    print(f"hard-killing {victim['replica']} (no drain, no lease "
+          "release) ...")
+    chaos._hard_kill(victim)
+    time.sleep(0.7)  # past the lease TTL: the chain becomes stealable
+    sess.solve_delta(added=chaos.make_pods(2, "fdpost"))
+    print(f"  next delta served WARM by {sess.last_replica} "
+          f"(epoch {sess.epoch}, full re-establishes: "
+          f"{sess.full_resends - 1})")
+
+    # the merged view, over real HTTP from a SURVIVING replica
+    survivor_url = next(u for u, r in zip(obs_urls, reps)
+                        if r is not victim)
+    with urllib.request.urlopen(f"{survivor_url}/fleetz",
+                                timeout=10.0) as resp:
+        doc = json.loads(resp.read().decode())
+    print()
+    print(render_fleetz(doc))
+    journey = next((t for t in doc.get("traces", ())
+                    if t.get("session_id") == sess.session_id), None)
+    ok = (journey is not None and journey["n_hops"] >= 3
+          and len({h["replica"] for h in journey["hops"]}) >= 2
+          and all(h["parent_hop"] == 0 for h in journey["hops"][1:]))
+    verdict = ("ONE cross-replica tree, remote-parent linked — OK"
+               if ok else "FAILED to assemble")
+    print()
+    print(f"journey: {verdict}")
+    sess.close()
+    fc.close()
+    for srv in obs_servers:
+        srv.shutdown()
+    for rep in reps:
+        if rep["alive"]:
+            rep["srv"].stop(grace=None)
+            rep["service"].close()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

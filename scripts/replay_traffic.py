@@ -1,0 +1,129 @@
+"""Trace-replay driver (ISSUE 15; docs/OBSERVABILITY.md replay section).
+
+Record, synthesize, and replay request-shape captures through the real
+gRPC stack at programmable speedup:
+
+    # synthesize a bursty capture
+    python scripts/replay_traffic.py --synthesize /tmp/burst.jsonl \
+        --shape bursty --n 200 --rate 20
+
+    # record a capture from a live replica's /tracez
+    python scripts/replay_traffic.py --record /tmp/live.jsonl \
+        --tracez http://127.0.0.1:9101/tracez
+
+    # replay against a live endpoint (or omit --target for an
+    # in-process solver on a unix socket)
+    python scripts/replay_traffic.py --replay /tmp/burst.jsonl \
+        --speedup 4 --target 127.0.0.1:50151
+
+Prints one JSON line: the replay report + fidelity verdict (the same
+numbers ``bench.py``'s ``measure_replay_fidelity`` gates).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def _record_from_tracez(url: str):
+    import urllib.request
+
+    from karpenter_tpu.obs import replay
+
+    with urllib.request.urlopen(url, timeout=5.0) as resp:  # noqa: S310
+        doc = json.loads(resp.read().decode())
+    return replay.capture_from_traces(doc.get("traces") or ())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="replay-traffic")
+    ap.add_argument("--synthesize", metavar="PATH",
+                    help="write a synthetic capture to PATH")
+    ap.add_argument("--record", metavar="PATH",
+                    help="write a capture recorded from --tracez to PATH")
+    ap.add_argument("--tracez", default="http://127.0.0.1:9101/tracez",
+                    help="the /tracez URL --record reads")
+    ap.add_argument("--replay", metavar="PATH",
+                    help="replay the capture at PATH")
+    ap.add_argument("--shape", default="bursty",
+                    choices=["bursty", "diurnal", "uniform"])
+    ap.add_argument("--n", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="mean request rate, 1/s (synthesize)")
+    ap.add_argument("--sessions", type=int, default=4)
+    ap.add_argument("--pods", type=int, default=40)
+    ap.add_argument("--churn", type=int, default=4)
+    ap.add_argument("--speedup", type=float, default=1.0)
+    ap.add_argument("--target", default="",
+                    help="solver endpoint; empty spins an in-process "
+                         "oracle replica on a unix socket")
+    args = ap.parse_args(argv)
+
+    from karpenter_tpu.obs import replay
+
+    if args.synthesize:
+        recs = replay.synthesize(
+            n=args.n, shape=args.shape, seed=args.seed,
+            mean_rate=args.rate, n_pods=args.pods, churn=args.churn,
+            sessions=args.sessions)
+        replay.save_capture(args.synthesize, recs,
+                            source=f"synthetic:{args.shape}",
+                            meta={"seed": args.seed, "rate": args.rate})
+        print(json.dumps({"written": args.synthesize, "records": len(recs),
+                          "shape": args.shape}))
+        return 0
+    if args.record:
+        recs = _record_from_tracez(args.tracez)
+        if not recs:
+            print(json.dumps({"error": f"no request traces at "
+                                       f"{args.tracez}"}))
+            return 1
+        replay.save_capture(args.record, recs, source=args.tracez)
+        print(json.dumps({"written": args.record, "records": len(recs)}))
+        return 0
+    if not args.replay:
+        ap.error("one of --synthesize / --record / --replay is required")
+
+    records, header = replay.load_capture(args.replay)
+    srv = service = None
+    target = args.target
+    if not target:
+        import tempfile
+
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.service.server import SolverService, make_server
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        reg = Registry()
+        service = SolverService(
+            BatchScheduler(backend="oracle", registry=reg), registry=reg)
+        target = f"unix:{tempfile.mkdtemp(prefix='kt-replay-')}/solver.sock"
+        srv, _ = make_server(service, host=target)
+    try:
+        rp = replay.Replayer(target)
+        report = rp.run(records, speedup=args.speedup)
+        fid = replay.fidelity(records, report)
+        print(json.dumps({
+            "capture": {"path": args.replay,
+                        "source": header.get("source", "")},
+            "target": target, "speedup": args.speedup,
+            "outcomes": report["outcomes"],
+            **{k: v for k, v in fid.items()},
+        }, default=str))
+        return 0 if fid["class_mix_match"] and not fid["errors"] else 1
+    finally:
+        if srv is not None:
+            srv.stop(grace=None)
+        if service is not None:
+            service.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -175,8 +175,17 @@ def test_inventory_metrics_are_emitted(small_catalog):
     multihost_shim = {m for m in INVENTORY
                       if m.startswith("karpenter_solver_multihost_forwards")}
 
+    # the replay family is DRIVER-side (obs/replay.Replayer): zero-inited
+    # at its construction, asserted by tests/test_metrics_init.py::
+    # TestFleetTracingSeries and exercised end to end by
+    # tests/test_fleet_trace.py::TestReplayCapture (the trace-remote
+    # family, by contrast, IS emitted here via the Tracer's zero-init)
+    replay_family = {m for m in INVENTORY
+                     if m.startswith("karpenter_replay_")}
+
     missing = (set(INVENTORY) - emitted - admission_family - delta_family
                - resilience_family - fleet_family - multihost_shim
+               - replay_family
                - {REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES})
     assert not missing, (
         f"documented metrics never emitted: {sorted(missing)} "

@@ -1,0 +1,489 @@
+"""ISSUE 15 — fleet-wide distributed tracing + the trace-replay harness.
+
+Five layers, cheapest first:
+
+- ``TestWireContext`` — span ids, ``wire_context()``, replica-prefixed
+  trace ids, and the ``start_remote`` adoption facade (sampling bypass,
+  remote-parent stamping, the adopted/local counter).
+- ``TestFlightDumpEnvelope`` — dumps carry ``replica_id``/``session_id``
+  in the JSON envelope, the file name, and the rate-limit key.
+- ``TestFleetzMerge`` / ``TestReplayCapture`` — the /fleetz merge and
+  the replay capture format, against injected documents (no HTTP).
+- ``TestForwardedSlotJoins`` — a forwarded foreign slot's hop attaches
+  under the originating trace's ``forward`` span over real gRPC.
+- ``TestCrossReplicaJourney`` — the acceptance criterion: establish on
+  replica A, kill A, delta on B — ONE remote-parent-linked trace tree
+  in the /fleetz merge, over real gRPC under KT_SANITIZE=1.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from karpenter_tpu.metrics import Registry, TRACE_REMOTE_SPANS
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.obs import fleet as obs_fleet
+from karpenter_tpu.obs import replay as obs_replay
+from karpenter_tpu.obs.export import statusz, tracez
+from karpenter_tpu.obs.recorder import FlightRecorder
+from karpenter_tpu.obs.trace import NULL_TRACE, Tracer
+from karpenter_tpu.utils.clock import FakeClock
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _chaos_drive():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_drive", os.path.join(REPO, "scripts", "chaos_drive.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------------------
+class TestWireContext:
+    def test_span_ids_and_wire_context_follow_open_span(self):
+        tracer = Tracer(registry=Registry(), enabled=True)
+        with tracer.start("solve") as trace:
+            assert trace.root.span_id == "s1"
+            tid, parent = trace.wire_context()
+            assert tid == trace.trace_id and parent == "s1"
+            with trace.span("remote") as sp:
+                assert sp.span_id == "s2"
+                assert trace.wire_context() == (trace.trace_id, "s2")
+            assert trace.wire_context() == (trace.trace_id, "s1")
+        d = trace.to_dict()
+        assert d["span_id"] == "s1"
+        assert d["spans"][0]["span_id"] == "s2"
+
+    def test_null_trace_sends_no_context(self):
+        assert NULL_TRACE.wire_context() == ("", "")
+
+    def test_trace_ids_are_replica_prefixed(self, monkeypatch):
+        monkeypatch.setenv("KT_REPLICA_ID", "replica-7")
+        tracer = Tracer(registry=Registry(), enabled=True)
+        with tracer.start("solve") as trace:
+            assert trace.trace_id.startswith("replica-7-t")
+
+    def test_start_remote_adopts_id_parent_and_replica(self, monkeypatch):
+        monkeypatch.setenv("KT_REPLICA_ID", "replica-b")
+        reg = Registry()
+        tracer = Tracer(registry=reg, enabled=True)
+        with tracer.start_remote("solve", "replica-a-t000042", "s3",
+                                 rpc="Solve") as trace:
+            assert trace.trace_id == "replica-a-t000042"
+            assert trace.root.attrs["remote_parent"] == "s3"
+            assert trace.root.attrs["replica_id"] == "replica-b"
+        assert reg.counter(TRACE_REMOTE_SPANS).get(
+            {"outcome": "adopted"}) == 1.0
+
+    def test_start_remote_bypasses_sampling_for_adopted_context(self):
+        # the origin already made the sampling decision: a sampled-out
+        # child would leave a half-sampled tree
+        reg = Registry()
+        tracer = Tracer(registry=reg, enabled=True, sample_every=1000)
+        with tracer.start_remote("solve", "origin-t000001", "s1") as tr:
+            assert tr  # real trace despite 1-in-1000 sampling
+        with tracer.start_remote("solve", "", "") as tr:
+            assert not tr  # contextless falls back to normal sampling
+        assert reg.counter(TRACE_REMOTE_SPANS).get(
+            {"outcome": "adopted"}) == 1.0
+        # an unsampled local start opens no trace, so none is counted
+        assert reg.counter(TRACE_REMOTE_SPANS).get(
+            {"outcome": "local"}) == 0.0
+
+    def test_start_remote_disabled_is_null(self):
+        tracer = Tracer(registry=Registry(), enabled=False)
+        assert not tracer.start_remote("solve", "x-t000001", "s1")
+
+
+# --------------------------------------------------------------------------
+class TestFlightDumpEnvelope:
+    def test_dump_envelope_and_filename_carry_replica(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.setenv("KT_REPLICA_ID", "replica-3")
+        flight = FlightRecorder(registry=Registry(), clock=FakeClock(),
+                                dump_dir=str(tmp_path))
+        dump = flight.anomaly("device_hang", detail="x",
+                              session_id="sess-1")
+        assert dump["replica_id"] == "replica-3"
+        assert dump["session_id"] == "sess-1"
+        assert os.path.basename(dump["path"]).startswith(
+            "flight-replica-3-")
+        with open(dump["path"]) as f:
+            assert json.load(f)["replica_id"] == "replica-3"
+
+    def test_rate_limit_keys_on_replica_and_session(self):
+        clock = FakeClock()
+        flight = FlightRecorder(registry=Registry(), clock=clock)
+        assert flight.anomaly("degraded_solve", session_id="a") is not None
+        # same reason, same session, inside the interval: suppressed
+        assert flight.anomaly("degraded_solve", session_id="a") is None
+        # a DIFFERENT session's incident is not suppressed by a's dump
+        assert flight.anomaly("degraded_solve", session_id="b") is not None
+
+    def test_session_id_read_off_the_trace_root(self):
+        reg = Registry()
+        flight = FlightRecorder(registry=reg, clock=FakeClock())
+        tracer = Tracer(registry=reg, clock=flight.clock, enabled=True)
+        with tracer.start_remote("solve", "o-t000001", "s1",
+                                 session_id="sess-9") as trace:
+            dump = flight.anomaly("device_hang", trace=trace)
+        assert dump["session_id"] == "sess-9"
+
+
+# --------------------------------------------------------------------------
+def _hop(trace_id, name, start, span_id="s1", attrs=None, spans=()):
+    return {"trace_id": trace_id, "name": name, "span_id": span_id,
+            "start": start, "end": start + 0.01, "duration_ms": 10.0,
+            "attrs": dict(attrs or {}), "spans": list(spans)}
+
+
+class TestFleetzMerge:
+    def test_hops_group_by_trace_id_and_link_remote_parents(self):
+        origin = _hop("op-t000001", "solve", 1.0, spans=[
+            _hop("op-t000001", "remote", 1.001, span_id="s2")])
+        child = _hop("op-t000001", "solve", 1.002,
+                     attrs={"remote_parent": "s2",
+                            "replica_id": "replica-1"})
+        merged = obs_fleet.assemble_traces(
+            {"operator": [origin], "replica-1": [child]})
+        assert len(merged) == 1
+        m = merged[0]
+        assert m["n_hops"] == 2
+        assert m["hops"][0]["parent_hop"] == -1
+        assert m["hops"][1]["parent_hop"] == 0
+        assert m["hops"][1]["replica"] == "replica-1"
+
+    def test_session_journey_attaches_deltas_under_establishment(self):
+        tid = "cli-sess-abc"
+        est = _hop(tid, "solve", 1.0,
+                   attrs={"session_id": "abc", "replica_id": "replica-0"})
+        d1 = _hop(tid, "solve", 2.0,
+                  attrs={"session_id": "abc", "remote_parent": "s1",
+                         "replica_id": "replica-0"})
+        d2 = _hop(tid, "solve", 3.0,
+                  attrs={"session_id": "abc", "remote_parent": "s1",
+                         "replica_id": "replica-2"})
+        merged = obs_fleet.assemble_traces(
+            {"replica-0": [est, d1], "replica-2": [d2]})
+        m = merged[0]
+        assert m["session_id"] == "abc"
+        assert [h["parent_hop"] for h in m["hops"]] == [-1, 0, 0]
+        # rendering is exercised too (the demo's journey view)
+        out = obs_fleet.render_journey(m)
+        assert "replica-2" in out and tid in out
+
+    def test_fleetz_merges_status_and_flags_unreachable(self):
+        docs = {
+            "http://r0/statusz": {
+                "replica_id": "replica-0", "inflight_depth": {"tpu": 1.0},
+                "delta_rpc": {"delta": 5.0, "establish": 1.0},
+                "sessions": {"abc": {"epoch": 7, "lease_owner":
+                                     "replica-0"}},
+                "traces_recorded": 3.0},
+            "http://r0/tracez": {"traces": [_hop("a-t1", "solve", 1.0)]},
+            "http://r1/statusz": {
+                "replica_id": "replica-1",
+                "delta_rpc": {"delta": 2.0},
+                "sessions": {"xyz": {"epoch": 2, "lease_owner":
+                                     "replica-1"}},
+                "traces_recorded": 1.0},
+            "http://r1/tracez": {"traces": []},
+        }
+
+        def fetch(url):
+            if url.startswith("http://dead"):
+                raise OSError("connection refused")
+            return docs[url]
+
+        doc = obs_fleet.fleetz(["http://r0", "http://r1", "http://dead"],
+                               fetch=fetch)
+        assert set(doc["replicas"]) == {"replica-0", "replica-1"}
+        assert doc["delta_rpc"] == {"delta": 7.0, "establish": 1.0}
+        assert doc["sessions"]["abc"]["owner"] == "replica-0"
+        assert doc["sessions"]["xyz"]["owner"] == "replica-1"
+        assert doc["unreachable"][0]["url"] == "http://dead"
+        assert doc["session_conflicts"] == {}
+        out = obs_fleet.render_fleetz(doc)
+        assert "replica-0" in out and "UNREACHABLE" in out
+
+    def test_duplicate_replica_and_ownership_conflict(self):
+        status = {"replica_id": "replica-0",
+                  "sessions": {"abc": {"epoch": 1}}}
+        docs = {"http://a/statusz": status, "http://a/tracez": {},
+                "http://b/statusz": status, "http://b/tracez": {},
+                "http://c/statusz": {"replica_id": "replica-1",
+                                     "sessions": {"abc": {"epoch": 1}}},
+                "http://c/tracez": {}}
+        doc = obs_fleet.fleetz(["http://a", "http://b", "http://c"],
+                               fetch=lambda u: docs[u])
+        # self-listed peer deduped by replica_id; true conflicts surfaced
+        assert len(doc["replicas"]) == 2
+        assert doc["session_conflicts"] == {"abc": ["replica-0",
+                                                    "replica-1"]}
+
+
+# --------------------------------------------------------------------------
+class TestReplayCapture:
+    def test_synthesize_is_deterministic_and_shaped(self):
+        a = obs_replay.synthesize(n=50, shape="bursty", seed=3)
+        b = obs_replay.synthesize(n=50, shape="bursty", seed=3)
+        assert a == b
+        assert len(a) == 50
+        assert all(x["t"] <= y["t"] for x, y in zip(a, a[1:]))
+        kinds = {r["kind"] for r in a}
+        assert "establish" in kinds and "delta" in kinds
+        # a session's first touch establishes, later touches are deltas
+        seen = set()
+        for r in a:
+            if not r["session"]:
+                continue
+            assert r["kind"] == ("delta" if r["session"] in seen
+                                 else "establish")
+            seen.add(r["session"])
+
+    def test_save_load_roundtrip_and_version_refusal(self, tmp_path):
+        recs = obs_replay.synthesize(n=10, shape="uniform", seed=1)
+        path = str(tmp_path / "cap.jsonl")
+        obs_replay.save_capture(path, recs, source="test")
+        loaded, header = obs_replay.load_capture(path)
+        assert loaded == [
+            {k: r[k] for k in obs_replay.RECORD_FIELDS} for r in recs]
+        assert header["source"] == "test"
+        bad = str(tmp_path / "bad.jsonl")
+        with open(bad, "w") as f:
+            f.write(json.dumps({"kind": obs_replay.CAPTURE_KIND,
+                                "version": 99}) + "\n")
+        with pytest.raises(obs_replay.ReplayCaptureError):
+            obs_replay.load_capture(bad)
+        with open(bad, "w") as f:
+            f.write(json.dumps({"kind": "something-else",
+                                "version": 1}) + "\n")
+        with pytest.raises(obs_replay.ReplayCaptureError):
+            obs_replay.load_capture(bad)
+
+    def test_capture_from_traces_reads_root_attrs(self):
+        traces = [
+            {"trace_id": "a-t1", "start": 10.0,
+             "attrs": {"rpc": "Solve", "n_pods": 40,
+                       "priority_class": "batch",
+                       "session_id": "abc", "delta": False}},
+            {"trace_id": "a-t2", "start": 10.5,
+             "attrs": {"rpc": "Solve", "n_pods": 4,
+                       "priority_class": "critical",
+                       "session_id": "abc", "delta": True}},
+            {"trace_id": "a-t3", "start": 11.0, "attrs": {}},  # not an RPC
+        ]
+        cap = obs_replay.capture_from_traces(traces)
+        assert [r["kind"] for r in cap] == ["establish", "delta"]
+        assert cap[0]["t"] == 0.0 and cap[1]["t"] == 0.5
+        assert cap[1]["class"] == "critical" and cap[1]["churn"] == 4
+
+    def test_replay_drives_real_grpc_and_reports_fidelity(self, tmp_path,
+                                                          small_catalog):
+        from karpenter_tpu.service.server import SolverService, make_server
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        recs = obs_replay.synthesize(n=12, shape="uniform", seed=5,
+                                     mean_rate=30.0, n_pods=12, churn=2,
+                                     sessions=2)
+        reg = Registry()
+        service = SolverService(
+            BatchScheduler(backend="oracle", registry=reg), registry=reg)
+        sock = f"unix:{tmp_path}/rp.sock"
+        srv, _ = make_server(service, host=sock)
+        try:
+            rp = obs_replay.Replayer(sock, registry=reg,
+                                     catalog=small_catalog)
+            report = rp.run(recs, speedup=4.0)
+            fid = obs_replay.fidelity(recs, report)
+            assert report["n"] == 12
+            assert report["outcomes"].get("ok") == 12
+            assert fid["class_mix_match"] is True
+            assert fid["errors"] == 0
+            from karpenter_tpu.metrics import REPLAY_REQUESTS
+
+            assert reg.counter(REPLAY_REQUESTS).get(
+                {"outcome": "ok"}) == 12.0
+        finally:
+            srv.stop(grace=None)
+            service.close()
+
+
+# --------------------------------------------------------------------------
+class TestForwardedSlotJoins:
+    def test_forwarded_slot_is_a_child_of_the_originating_flush(
+            self, tmp_path, small_catalog):
+        """A SlotNotOwned slot re-routed through the forwarding shim over
+        real gRPC: the owner host's trace adopts the origin's trace id
+        under the 'forward' span — the foreign slot renders INSIDE the
+        originating request's tree."""
+        from karpenter_tpu.parallel.forward import (
+            ResultForwarder,
+            SlotNotOwned,
+        )
+        from karpenter_tpu.service.server import SolverService, make_server
+        from karpenter_tpu.solver.scheduler import BatchScheduler
+
+        chaos = _chaos_drive()
+        reg_b = Registry()
+        service_b = SolverService(
+            BatchScheduler(backend="oracle", registry=reg_b),
+            registry=reg_b)
+        sock_b = f"unix:{tmp_path}/owner.sock"
+        srv_b, _ = make_server(service_b, host=sock_b)
+        reg_a = Registry()
+        tracer_a = Tracer(registry=reg_a, enabled=True)
+        provs = [Provisioner(name="default").with_defaults()]
+        try:
+            fwd = ResultForwarder(peers=[sock_b], registry=reg_a)
+            assert fwd.enabled()
+            with tracer_a.start("solve", rpc="Solve") as trace:
+                kwargs = {"pods": chaos.make_pods(16, "fw"),
+                          "provisioners": provs,
+                          "instance_types": list(small_catalog),
+                          "trace": trace}
+                result = fwd.forward(kwargs, SlotNotOwned(3, owner=0))
+            assert result.assignments  # the owner actually served it
+            fspan = next(sp for sp in trace.spans()
+                         if sp.name == "forward")
+            assert fspan.attrs["slot"] == 3 and fspan.attrs["owner"] == 0
+            # the owner's hop: same trace id, remote parent = the
+            # forward span
+            flight_b = service_b.tracer.flight
+            hops = [t for t in flight_b.traces()
+                    if t.trace_id == trace.trace_id]
+            assert len(hops) == 1
+            assert hops[0].root.attrs["remote_parent"] == fspan.span_id
+            merged = obs_fleet.assemble_traces({
+                "origin": [trace.to_dict()],
+                "owner": [hops[0].to_dict()]})
+            assert merged[0]["n_hops"] == 2
+            assert merged[0]["hops"][1]["parent_hop"] == 0
+            fwd.close()
+        finally:
+            srv_b.stop(grace=None)
+            service_b.close()
+
+
+# --------------------------------------------------------------------------
+@pytest.fixture
+def fleet_env(tmp_path, monkeypatch, small_catalog):
+    """Three in-process replicas on unix sockets sharing one spool (the
+    test_fleet.py fixture, rebuilt here so this module stands alone)."""
+    monkeypatch.setenv("KT_SESSION_SNAPSHOT_S", "0.0001")
+    monkeypatch.setenv("KT_SESSION_LEASE_S", "0.4")
+    chaos = _chaos_drive()
+    spool = str(tmp_path / "spool")
+    reps = [chaos._build_replica(f"unix:{tmp_path}/r{i}.sock", spool,
+                                 f"replica-{i}", 0.4, 0.0001)
+            for i in range(3)]
+    provs = [Provisioner(name="default").with_defaults()]
+    yield chaos, reps, provs, small_catalog, spool
+    for rep in reps:
+        try:
+            rep["srv"].stop(grace=None)
+            rep["service"].close()
+        except Exception:  # noqa: BLE001 — teardown
+            pass
+
+
+def _fleet_doc(reps):
+    """The /fleetz merge over the in-process replicas' real documents —
+    injected fetch, so the merge contract is pinned without HTTP."""
+    docs = {}
+    for rep in reps:
+        flight = rep["service"].tracer.flight
+        docs[f"http://{rep['replica']}/statusz"] = statusz(
+            rep["reg"], flight, extra=rep["service"].statusz_extra)
+        docs[f"http://{rep['replica']}/tracez"] = tracez(flight)
+    return obs_fleet.fleetz(
+        [f"http://{rep['replica']}" for rep in reps],
+        fetch=lambda url: docs[url])
+
+
+class TestCrossReplicaJourney:
+    def test_kill_home_mid_chain_yields_one_trace_tree(self, fleet_env):
+        """The acceptance criterion over real gRPC under KT_SANITIZE=1:
+        a session established on replica A and continued on replica B
+        after A's death renders as ONE remote-parent-linked trace tree
+        in /fleetz, with the steal lifecycle span naming A."""
+        from karpenter_tpu.analysis import sanitize
+        from karpenter_tpu.service.client import DeltaSession, FleetClient
+
+        chaos, reps, provs, catalog, _spool = fleet_env
+        pre = sanitize.installed()
+        if not pre:
+            sanitize.install()
+        try:
+            socks = [r["sock"] for r in reps]
+            fc = FleetClient(socks, timeout=60.0, retries=0,
+                             backoff_s=0.01)
+            sess = DeltaSession(socks[0], timeout=60.0, client=fc)
+            sess.solve(chaos.make_pods(120, "tj"), provs, catalog)
+            sess.solve_delta(added=chaos.make_pods(2, "tj1"))
+            chaos._settle_spool(reps)
+            home = fc.endpoint_for(sess.session_id)
+            victim = next(r for r in reps if r["sock"] == home)
+            chaos._hard_kill(victim)
+            time.sleep(0.7)  # past the 0.4s lease TTL
+            sess.solve_delta(added=chaos.make_pods(2, "tj2"))
+            assert sess.full_resends == 1  # ZERO re-establishes
+            adopter = next(r for r in reps
+                           if r["sock"] == fc.endpoint_for(sess.session_id))
+            assert adopter is not victim
+            # the client saw the serving replica change hands
+            assert sess.last_replica == adopter["replica"]
+
+            # every hop of the session shares the ONE journey trace id
+            assert sess._trace_id
+            hops_by_replica = {}
+            for rep in reps:
+                flight = rep["service"].tracer.flight
+                hops = [t.to_dict() for t in flight.traces()
+                        if t.trace_id == sess._trace_id]
+                if hops:
+                    hops_by_replica[rep["replica"]] = hops
+            assert victim["replica"] in hops_by_replica
+            assert adopter["replica"] in hops_by_replica
+
+            # the adopter's hop carries the steal lifecycle span naming A
+            steal = [sp for hop in hops_by_replica[adopter["replica"]]
+                     for sp in obs_fleet._walk_spans(hop)
+                     if sp["name"] == "session_steal"]
+            assert steal, "no session_steal span on the adopting hop"
+            assert steal[0]["attrs"]["adopted_from"] == victim["replica"]
+            assert steal[0]["attrs"]["session_id"] == sess.session_id
+
+            # /fleetz: ONE tree, establishment rooted on A, B's delta
+            # hop linked under it via the remote parent
+            doc = _fleet_doc([r for r in reps if r["alive"]] + [victim])
+            m = next(t for t in doc["traces"]
+                     if t["trace_id"] == sess._trace_id)
+            assert m["session_id"] == sess.session_id
+            assert m["n_hops"] >= 3  # establish + pre-kill + post-kill
+            replicas_in_tree = {h["replica"] for h in m["hops"]}
+            assert {victim["replica"],
+                    adopter["replica"]} <= replicas_in_tree
+            est = m["hops"][0]
+            assert est["parent_hop"] == -1
+            assert est["replica"] == victim["replica"]
+            for hop in m["hops"][1:]:
+                assert hop["parent_hop"] == 0  # linked, not just grouped
+
+            # the /statusz session block on the adopter names the chain
+            sessions = doc["sessions"]
+            info = sessions[sess.session_id]
+            assert info["owner"] == adopter["replica"]
+            assert info["epoch"] == sess.epoch
+            assert info["adopted_from"] == victim["replica"]
+            assert info["adopt_how"] == "stolen"
+            assert info["lease_owner"] == adopter["replica"]
+            sess.close()
+        finally:
+            if not pre:
+                sanitize.uninstall()

@@ -453,6 +453,45 @@ class TestWarmstartAndSweepSeries:
                 '{path="batched"} 0') in text
 
 
+class TestFleetTracingSeries:
+    """ISSUE 15: the fleet-tracing and replay families are born at zero —
+    remote-span outcomes from Tracer construction, replay outcomes from
+    Replayer construction — and survive into expose()."""
+
+    def test_remote_span_outcomes_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            TRACE_REMOTE_OUTCOMES,
+            TRACE_REMOTE_SPANS,
+        )
+        from karpenter_tpu.obs.trace import Tracer
+
+        reg = Registry()
+        Tracer(registry=reg, enabled=True)
+        for outcome in TRACE_REMOTE_OUTCOMES:
+            assert series_exists(reg.counter(TRACE_REMOTE_SPANS),
+                                 {"outcome": outcome})
+        assert ('karpenter_trace_remote_spans_total'
+                '{outcome="adopted"} 0') in reg.expose()
+
+    def test_replay_outcomes_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            REPLAY_LAG,
+            REPLAY_OUTCOMES,
+            REPLAY_REQUESTS,
+        )
+        from karpenter_tpu.obs.replay import Replayer
+
+        reg = Registry()
+        Replayer("unix:/tmp/never.sock", registry=reg, catalog=[],
+                 provisioners=[])
+        for outcome in REPLAY_OUTCOMES:
+            assert series_exists(reg.counter(REPLAY_REQUESTS),
+                                 {"outcome": outcome})
+        assert reg.histogram(REPLAY_LAG) is not None
+        assert ('karpenter_replay_requests_total'
+                '{outcome="shed"} 0') in reg.expose()
+
+
 class TestMultihostSeries:
     """ISSUE 14: the multi-host serving families are born at zero — fence
     byte scopes, slot ownership, and unified flushes from BatchScheduler
