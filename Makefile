@@ -2,7 +2,7 @@ PYTHON ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
 
-.PHONY: all test native proto bench clean battletest lint obs-demo obs-fleet-demo overload-demo chaos chaos-fleet multihost-dryrun
+.PHONY: all test native proto bench clean battletest lint obs-demo obs-fleet-demo overload-demo chaos chaos-fleet multihost-dryrun hier-demo
 
 all: native proto
 
@@ -117,6 +117,15 @@ chaos-fleet:
 multihost-dryrun:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/dryrun_multihost.py
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/dryrun_multihost.py --lone-ab
+
+# million-pod hierarchical walk (ISSUE 16): partition the real 1M-pod
+# group shape into megabatch blocks, run a CPU-sized hierarchical solve
+# end to end (one vmapped block wave, dual price loop under a contended
+# provisioner limit, warm-start repair + cross-block tail repack), and
+# judge the dev-host 1M scale model against the 250 ms budget — the
+# same model bench.py measure_hierarchical gates in check_budgets.
+hier-demo:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/hier_demo.py
 
 clean:
 	rm -f karpenter_tpu/solver/_native*.so

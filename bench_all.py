@@ -571,7 +571,7 @@ def main():
     picked = [int(x) for x in args.configs.split(",") if x.strip()]
     import os
 
-    from bench import arm_watchdog, ensure_backend
+    from bench import LAST_PROBE, arm_watchdog, ensure_backend
 
     arm_watchdog(float(os.environ.get("BENCH_DEADLINE_S", "3000")),
                  metric="bench_all_sweep")
@@ -582,7 +582,11 @@ def main():
         except Exception as e:  # one bad config must not kill the sweep
             rec = {"metric": f"c{n}", "value": None, "unit": "ms",
                    "vs_baseline": None, "error": f"{type(e).__name__}: {e}"[:500]}
-        rec = {"config": n, **rec}
+        # whether the one-per-sweep backend probe came from the PR-5
+        # verdict cache (the BENCH r05 cold-start-tax fix) — surfaced on
+        # every config line so tail parsers see it wherever they cut
+        rec = {"config": n, **rec,
+               "probe_cached": LAST_PROBE.get("cached")}
         print(json.dumps(rec), flush=True)
 
 

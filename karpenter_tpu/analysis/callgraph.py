@@ -41,7 +41,7 @@ import os
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from .ktlint import SourceFile, dotted_name
+from .ktlint import SourceFile, dotted_name, file_nodes
 
 #: bump when the summary format changes — stale caches are discarded, never
 #: migrated (the extraction is cheap; correctness of the cache is not)
@@ -338,7 +338,7 @@ def summarize(f: SourceFile) -> FileSummary:
     pkg_parts = mod.split(".") if _is_pkg(f.path) else mod.split(".")[:-1]
 
     # imports
-    for node in ast.walk(f.tree):
+    for node in file_nodes(f):
         if isinstance(node, ast.Import):
             for a in node.names:
                 summ.imports[a.asname or a.name.split(".")[0]] = (

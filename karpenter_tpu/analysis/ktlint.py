@@ -91,6 +91,14 @@ class SourceFile:
     malformed: List[int]
     #: ``def`` linenos annotated as KT001 fences
     fence_lines: set
+    #: lazily cached whole-tree artifacts (file_nodes/file_parents): every
+    #: rule iterates the package's ASTs, and 20+ rules each re-running
+    #: ``ast.walk``/``parents_map`` over 110 files was ~70% of the cold
+    #: package lint's wall — the speed gate's budget is shared by ALL rules
+    _nodes: Optional[List[ast.AST]] = dataclasses.field(
+        default=None, repr=False, compare=False)
+    _parents: Optional[Dict[ast.AST, ast.AST]] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
 
 def load_source(text: str, path: str) -> SourceFile:
@@ -144,6 +152,35 @@ def parents_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
         for child in ast.iter_child_nodes(node):
             parents[child] = node
     return parents
+
+
+def file_nodes(f: SourceFile) -> List[ast.AST]:
+    """The file's whole-tree preorder walk, computed once and shared by
+    every rule (use instead of ``ast.walk(f.tree)`` for root walks;
+    subtree walks still call ``ast.walk`` directly)."""
+    if f._nodes is None:
+        f._nodes = list(ast.walk(f.tree))
+    return f._nodes
+
+
+def file_parents(f: SourceFile) -> Dict[ast.AST, ast.AST]:
+    """The file's child->parent map, computed once and shared by every
+    rule (use instead of ``parents_map(f.tree)``)."""
+    if f._parents is None:
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in file_nodes(f):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        f._parents = parents
+    return f._parents
+
+
+def file_functions(f: SourceFile):
+    """Cached :func:`iter_functions` over the file's whole tree."""
+    funcs = getattr(f, "_functions", None)
+    if funcs is None:
+        funcs = f._functions = iter_functions(f.tree)
+    return funcs
 
 
 def iter_functions(tree: ast.AST):

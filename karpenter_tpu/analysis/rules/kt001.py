@@ -27,7 +27,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ..ktlint import Finding, SourceFile, dotted_name, iter_functions
+from ..ktlint import Finding, SourceFile, dotted_name, file_functions
 
 ID = "KT001"
 TITLE = "implicit host↔device sync outside the fence set"
@@ -85,7 +85,7 @@ def check(files) -> List[Finding]:
     for f in files:
         if _hot_suffix(f.path) is None:
             continue
-        for qual, fn, nested in iter_functions(f.tree):
+        for qual, fn, nested in file_functions(f):
             if nested:
                 continue  # closures scan with their enclosing method
             if fn.lineno in f.fence_lines:

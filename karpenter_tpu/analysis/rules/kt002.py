@@ -19,7 +19,7 @@ from __future__ import annotations
 import ast
 from typing import List, Set
 
-from ..ktlint import Finding
+from ..ktlint import Finding, file_nodes
 
 ID = "KT002"
 TITLE = "raw time.time()/time.monotonic() outside utils/clock.py"
@@ -30,10 +30,10 @@ EXEMPT_SUFFIX = "utils/clock.py"
 CLOCK_CALLS = {"time", "monotonic"}
 
 
-def _time_aliases(tree: ast.AST) -> Set[str]:
+def _time_aliases(f) -> Set[str]:
     """Every name the ``time`` module is bound to in this file."""
     aliases: Set[str] = set()
-    for n in ast.walk(tree):
+    for n in file_nodes(f):
         if isinstance(n, ast.Import):
             for alias in n.names:
                 if alias.name == "time":
@@ -46,8 +46,8 @@ def check(files) -> List[Finding]:
     for f in files:
         if f.path.endswith(EXEMPT_SUFFIX):
             continue
-        aliases = _time_aliases(f.tree)
-        for n in ast.walk(f.tree):
+        aliases = _time_aliases(f)
+        for n in file_nodes(f):
             if (isinstance(n, ast.Call)
                     and isinstance(n.func, ast.Attribute)
                     and n.func.attr in CLOCK_CALLS

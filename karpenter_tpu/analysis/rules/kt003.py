@@ -29,7 +29,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Tuple
 
-from ..ktlint import Finding
+from ..ktlint import Finding, file_nodes
 
 ID = "KT003"
 TITLE = "labeled counter series never zero-inited"
@@ -69,14 +69,14 @@ def check(files) -> List[Finding]:
     for f in files:
         # counters bound to locals: name -> metric (file-scoped, conservative)
         varmap: Dict[str, str] = {}
-        for n in ast.walk(f.tree):
+        for n in file_nodes(f):
             if isinstance(n, ast.Assign):
                 metric = _metric_of_counter_call(n.value)
                 if metric is not None:
                     for t in n.targets:
                         if isinstance(t, ast.Name):
                             varmap[t.id] = metric
-        for n in ast.walk(f.tree):
+        for n in file_nodes(f):
             hit = _inc_call(n)
             if hit is None:
                 continue

@@ -26,7 +26,7 @@ import ast
 import re
 from typing import Dict, List, Optional, Tuple
 
-from ..ktlint import Finding, GUARDED_RE, parents_map
+from ..ktlint import Finding, GUARDED_RE, file_nodes, file_parents
 
 ID = "KT004"
 TITLE = "guarded-by attribute accessed outside its lock"
@@ -49,9 +49,9 @@ def _declarations(f) -> List[Tuple[int, str, str]]:
     return out
 
 
-def _enclosing_class(tree: ast.AST, lineno: int) -> Optional[ast.ClassDef]:
+def _enclosing_class(f, lineno: int) -> Optional[ast.ClassDef]:
     best = None
-    for node in ast.walk(tree):
+    for node in file_nodes(f):
         if isinstance(node, ast.ClassDef) and \
                 node.lineno <= lineno <= (node.end_lineno or node.lineno):
             if best is None or node.lineno > best.lineno:  # innermost
@@ -91,13 +91,13 @@ def check(files) -> List[Finding]:
         by_class: Dict[ast.ClassDef, Dict[str, str]] = {}
         decl_lines = set()
         for lineno, attr, lock in decls:
-            cls = _enclosing_class(f.tree, lineno)
+            cls = _enclosing_class(f, lineno)
             if cls is None:
                 continue  # module-level guarded-by: nothing to scope to
             by_class.setdefault(cls, {})[attr] = lock
             decl_lines.add((attr, lineno))
         for cls, attrs in by_class.items():
-            parents = parents_map(cls)
+            parents = file_parents(f)
             for n in ast.walk(cls):
                 if not (isinstance(n, ast.Attribute)
                         and isinstance(n.value, ast.Name)

@@ -14,7 +14,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ..ktlint import Finding
+from ..ktlint import Finding, file_nodes
 
 ID = "KT005"
 TITLE = "broad except without re-raise, log, or suppression"
@@ -51,7 +51,7 @@ def _handled(handler: ast.ExceptHandler) -> bool:
 def check(files) -> List[Finding]:
     out: List[Finding] = []
     for f in files:
-        for n in ast.walk(f.tree):
+        for n in file_nodes(f):
             if not isinstance(n, ast.Try):
                 continue
             for handler in n.handlers:

@@ -19,7 +19,7 @@ from __future__ import annotations
 import ast
 from typing import List, Set
 
-from ..ktlint import Finding, dotted_name
+from ..ktlint import Finding, dotted_name, file_nodes
 
 ID = "KT006"
 TITLE = "float64/random nondeterminism in jitted solver code"
@@ -45,16 +45,16 @@ def _is_jit_expr(node: ast.AST) -> bool:
     return False
 
 
-def _jit_scopes(tree: ast.AST) -> List[ast.AST]:
+def _jit_scopes(f) -> List[ast.AST]:
     jit_wrapped_names: Set[str] = set()
-    for n in ast.walk(tree):
+    for n in file_nodes(f):
         # jax.jit(fn)(...) / run = jax.jit(fn, ...) — fn becomes jitted
         if (isinstance(n, ast.Call) and _is_jit_expr(n.func)
                 and not isinstance(n.func, ast.Call) and n.args
                 and isinstance(n.args[0], ast.Name)):
             jit_wrapped_names.add(n.args[0].id)
     scopes = []
-    for n in ast.walk(tree):
+    for n in file_nodes(f):
         if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if any(_is_jit_expr(d) for d in n.decorator_list):
@@ -64,8 +64,8 @@ def _jit_scopes(tree: ast.AST) -> List[ast.AST]:
     return scopes
 
 
-def _scan_scope(scope: ast.AST, f, seen: set, out: List[Finding]) -> None:
-    for n in ast.walk(scope):
+def _scan_scope(nodes, f, seen: set, out: List[Finding]) -> None:
+    for n in nodes:
         key = None
         if isinstance(n, ast.Attribute):
             d = dotted_name(n)
@@ -92,8 +92,8 @@ def check(files) -> List[Finding]:
     for f in files:
         seen: set = set()
         if any(f.path.endswith(s) for s in KERNEL_SUFFIXES):
-            _scan_scope(f.tree, f, seen, out)
+            _scan_scope(file_nodes(f), f, seen, out)
             continue
-        for scope in _jit_scopes(f.tree):
-            _scan_scope(scope, f, seen, out)
+        for scope in _jit_scopes(f):
+            _scan_scope(ast.walk(scope), f, seen, out)
     return out

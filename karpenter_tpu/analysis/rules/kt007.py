@@ -22,7 +22,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ..ktlint import Finding, dotted_name, parents_map
+from ..ktlint import Finding, dotted_name, file_nodes, file_parents
 
 ID = "KT007"
 TITLE = "trace/span started without a `with` context manager"
@@ -49,8 +49,8 @@ def _tracer_receiver(recv: str) -> bool:
 def check(files) -> List[Finding]:
     out: List[Finding] = []
     for f in files:
-        parents = parents_map(f.tree)
-        for n in ast.walk(f.tree):
+        parents = file_parents(f)
+        for n in file_nodes(f):
             if not (isinstance(n, ast.Call)
                     and isinstance(n.func, ast.Attribute)):
                 continue

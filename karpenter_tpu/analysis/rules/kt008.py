@@ -28,7 +28,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
-from ..ktlint import Finding, iter_functions
+from ..ktlint import Finding, file_functions, file_nodes
 
 ID = "KT008"
 TITLE = "jitted callable off the registered bucket grid"
@@ -105,7 +105,7 @@ def check(files) -> List[Finding]:
         if not any(f.path.startswith(d) for d in SERVING_DIRS):
             continue
         # (1) jit applications inside function bodies = per-call wrappers
-        for qual, fn, _nested in iter_functions(f.tree):
+        for qual, fn, _nested in file_functions(f):
             for stmt in fn.body:
                 for n in ast.walk(stmt):
                     if isinstance(n, ast.FunctionDef):
@@ -130,7 +130,7 @@ def check(files) -> List[Finding]:
                             "(and compile cache) per call: silent recompile "
                             "on the serving path", hint=HINT))
         # (2) off-grid static shape args, anywhere in the file
-        for n in ast.walk(f.tree):
+        for n in file_nodes(f):
             app = _jit_application(n)
             if app is None:
                 continue

@@ -25,7 +25,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ..ktlint import Finding, dotted_name, parents_map
+from ..ktlint import Finding, dotted_name, file_nodes, file_parents
 
 ID = "KT009"
 TITLE = "RPC-path rejection without a shed-metric increment"
@@ -98,8 +98,8 @@ def check(files) -> List[Finding]:
     for f in files:
         if not _in_scope(f.path):
             continue
-        parents = parents_map(f.tree)
-        for n in ast.walk(f.tree):
+        parents = file_parents(f)
+        for n in file_nodes(f):
             if not (isinstance(n, ast.Call) and _is_shed_ctor(n)):
                 continue
             func = _enclosing_function(n, parents)

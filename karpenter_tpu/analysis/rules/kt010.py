@@ -24,7 +24,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ..ktlint import Finding, _is_suppressed, dotted_name, parents_map
+from ..ktlint import Finding, _is_suppressed, dotted_name, file_nodes, file_parents
 
 ID = "KT010"
 TITLE = "per-candidate solver call inside a controller loop"
@@ -79,8 +79,8 @@ def check(files) -> List[Finding]:
     for f in files:
         if not _in_scope(f.path):
             continue
-        parents = parents_map(f.tree)
-        for n in ast.walk(f.tree):
+        parents = file_parents(f)
+        for n in file_nodes(f):
             if not isinstance(n, ast.Call):
                 continue
             name = _callee(n)

@@ -29,7 +29,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
-from ..ktlint import Finding, dotted_name, iter_functions
+from ..ktlint import Finding, dotted_name, file_functions
 
 ID = "KT011"
 TITLE = "sharding/layout construction on the per-call serving path"
@@ -82,7 +82,7 @@ def check(files) -> List[Finding]:
     for f in files:
         if not _in_scope(f.path):
             continue
-        for qual, fn, nested in iter_functions(f.tree):
+        for qual, fn, nested in file_functions(f):
             if nested:
                 continue  # closures walk with their enclosing function
             for stmt in fn.body:

@@ -60,7 +60,7 @@ import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..callgraph import Project, build_project
-from ..ktlint import Finding, SourceFile
+from ..ktlint import Finding, SourceFile, file_nodes
 from .kt008 import BUCKET_GRID_STATICS
 
 ID = "KT014"
@@ -262,7 +262,7 @@ def check(files, project: Optional[Project] = None) -> List[Finding]:
         # (3) single-source key tail: "mega_slots" literal outside
         # _mega_key_tail anywhere in the serving tree
         for f in files:
-            for node in ast.walk(f.tree):
+            for node in file_nodes(f):
                 if isinstance(node, ast.Constant) \
                         and node.value == "mega_slots":
                     if f is tpu and tailfn is not None \
@@ -527,13 +527,13 @@ def check(files, project: Optional[Project] = None) -> List[Finding]:
             if f.path.endswith(("test_lint.py", "kt014.py", "kt008.py")):
                 continue
             static_arg_nodes = set()
-            for node in ast.walk(f.tree):
+            for node in file_nodes(f):
                 if isinstance(node, ast.Call):
                     for kw in node.keywords:
                         if kw.arg == "static_argnames":
                             for n2 in ast.walk(kw.value):
                                 static_arg_nodes.add(id(n2))
-            for node in ast.walk(f.tree):
+            for node in file_nodes(f):
                 if not (isinstance(node, ast.Constant)
                         and node.value == "relax_iters"):
                     continue

@@ -33,7 +33,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ..ktlint import Finding, dotted_name, parents_map
+from ..ktlint import Finding, dotted_name, file_nodes, file_parents
 
 ID = "KT015"
 TITLE = "delta-session discipline (unlocked table / uncounted full solve)"
@@ -123,8 +123,8 @@ def check(files) -> List[Finding]:
     for f in files:
         if not _in_scope(f.path):
             continue
-        parents = parents_map(f.tree)
-        for n in ast.walk(f.tree):
+        parents = file_parents(f)
+        for n in file_nodes(f):
             # ---- part 1: unlocked session-table access ------------------
             if isinstance(n, ast.Attribute) and n.attr == TABLE_ATTR:
                 func = _enclosing_function(n, parents)

@@ -36,7 +36,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
-from ..ktlint import Finding, dotted_name, parents_map
+from ..ktlint import Finding, dotted_name, file_nodes, file_parents
 
 ID = "KT016"
 TITLE = "fault-plane discipline (raw random / uncounted recovery)"
@@ -141,8 +141,8 @@ def check(files) -> List[Finding]:
         if HOME in path:
             continue
         in_scope = _in_scope(f.path)
-        parents = parents_map(f.tree)
-        for n in ast.walk(f.tree):
+        parents = file_parents(f)
+        for n in file_nodes(f):
             # ---- part 1: raw random / fault-env probes ------------------
             if in_scope and isinstance(n, ast.Import):
                 for alias in n.names:

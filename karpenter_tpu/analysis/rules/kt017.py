@@ -26,7 +26,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
-from ..ktlint import Finding, dotted_name
+from ..ktlint import Finding, dotted_name, file_nodes
 
 ID = "KT017"
 TITLE = "session-spool access outside the snapshot.py lease API"
@@ -66,7 +66,7 @@ def check(files) -> List[Finding]:
     for f in files:
         if not _in_scope(f.path):
             continue
-        for n in ast.walk(f.tree):
+        for n in file_nodes(f):
             if not isinstance(n, ast.Call):
                 continue
             name = _leaf(n)

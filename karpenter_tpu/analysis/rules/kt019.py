@@ -32,7 +32,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
-from ..ktlint import Finding, dotted_name
+from ..ktlint import Finding, dotted_name, file_nodes
 
 ID = "KT019"
 TITLE = "wire-crossing send/receive without trace-context discipline"
@@ -66,7 +66,7 @@ def _leaf(call: ast.Call) -> Optional[str]:
 
 def _check_send(f) -> List[Finding]:
     out: List[Finding] = []
-    for n in ast.walk(f.tree):
+    for n in file_nodes(f):
         if not isinstance(n, ast.Call) or _leaf(n) != ENCODER:
             continue
         if any(kw.arg == "trace_id" for kw in n.keywords):
@@ -85,7 +85,7 @@ def _check_send(f) -> List[Finding]:
 
 def _check_serve(f) -> List[Finding]:
     out: List[Finding] = []
-    for fn in ast.walk(f.tree):
+    for fn in file_nodes(f):
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         decodes = [n for n in ast.walk(fn)

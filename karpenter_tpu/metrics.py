@@ -317,6 +317,21 @@ MULTIHOST_FORWARDS = "karpenter_solver_multihost_forwards_total"
 #: forwarding-shim outcomes for foreign-slot requests
 MULTIHOST_FORWARD_OUTCOMES = ("forwarded", "error", "unrouted")
 MULTIHOST_UNIFIED = "karpenter_solver_multihost_unified_flushes_total"
+HIER_SOLVES = "karpenter_solver_hier_solves_total"
+#: routing outcomes for batches at/above KT_HIER_THRESHOLD (KT003 zero-init
+#: source — solver/hierarchy.py inits from it): 'hierarchical' (block
+#: decomposition served the batch), 'fallback_cold' (the block program was
+#: still compiling — flat served, compile-behind warm started),
+#: 'fallback_structure' (one reachability component, inexpressible pods, or
+#: an existing-node batch — flat IS the right program), 'fallback_degraded'
+#: (a block wave hit the hang guard or errored; flat's degradation ladder
+#: served)
+HIER_PATHS = ("hierarchical", "fallback_cold", "fallback_structure",
+              "fallback_degraded")
+HIER_BLOCKS = "karpenter_solver_hier_blocks"
+HIER_PRICE_ITERATIONS = "karpenter_solver_hier_price_iterations"
+HIER_REPAIR_PODS = "karpenter_solver_hier_repair_pods"
+HIER_DURATION = "karpenter_solver_hier_duration_seconds"
 
 #: metric inventory: name -> (type, labels, help).  docs/METRICS.md is
 #: generated from this table (``karpenter-tpu metrics-doc``), mirroring the
@@ -750,6 +765,34 @@ INVENTORY = {
         "ones.  Counted once per unified DISPATCH, at the collector's "
         "group merge (the coalescer's unify join feeds the same flush, "
         "so it does not count separately)."),
+    HIER_SOLVES: (
+        "counter", ("path",),
+        "Batches at/above KT_HIER_THRESHOLD pods by routing outcome: "
+        "'hierarchical' (block decomposition + price reconciliation "
+        "served), 'fallback_cold' (block program still compiling; flat "
+        "served while compile-behind warms), 'fallback_structure' (one "
+        "coupling component / inexpressible pods / existing-node batch — "
+        "flat is the right program), 'fallback_degraded' (a block wave "
+        "hung or errored; flat's degradation ladder served)."),
+    HIER_BLOCKS: (
+        "histogram", (),
+        "Weakly-coupled blocks per hierarchical solve after LPT packing "
+        "of the constraint-reachability components into megabatch slots."),
+    HIER_PRICE_ITERATIONS: (
+        "histogram", (),
+        "Price-ascent waves actually run per hierarchical solve (0 = no "
+        "shared-capacity contention after the first block wave; capped at "
+        "KT_HIER_PRICE_ITERS)."),
+    HIER_REPAIR_PODS: (
+        "histogram", (),
+        "Straggler pods re-seated by the host-side repair pass after the "
+        "price budget expired (limit-evicted nodes' pods + block-"
+        "infeasible pods)."),
+    HIER_DURATION: (
+        "histogram", (),
+        "End-to-end hierarchical solve duration, seconds (partition + "
+        "block waves + price loop + repair; excludes tensorize, reported "
+        "separately like flat's solve_ms)."),
 }
 
 

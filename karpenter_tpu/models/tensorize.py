@@ -222,6 +222,31 @@ def device_inexpressible(pod: PodSpec) -> bool:
     return nz > 1 or nh > 1
 
 
+def pack_feasibility(feas: np.ndarray) -> np.ndarray:
+    """Pack a boolean/float feasibility tensor to ``int8`` (1 feasible /
+    0 not).  The hierarchical hot path (solver/hierarchy.py) streams
+    ``[G, C]`` feasibility through the packed score kernel every price
+    wave; int8 cuts the HBM bytes 4× vs the float32 layout the relax rung
+    materializes — and on the host it quarters what the block builder
+    copies per wave."""
+    f = np.asarray(feas)
+    if f.dtype == np.int8:
+        return f
+    return (f != 0).astype(np.int8)
+
+
+def pack_scores(scores: np.ndarray) -> np.ndarray:
+    """Pack a float score/price vector to bfloat16 for the packed kernel.
+    bf16 keeps float32's exponent range — the 3.0e38 infeasible sentinel
+    survives the round trip exactly — while halving the bytes; 8 mantissa
+    bits are plenty for ORDERING on-demand prices (the kernel only ever
+    compares, and both the Pallas and lax programs upcast to float32 the
+    same way, so parity holds bit-for-bit)."""
+    import ml_dtypes  # ships with jax; host-importable without a backend
+
+    return np.asarray(scores, dtype=ml_dtypes.bfloat16)
+
+
 def _ffd_magnitude(requests: Mapping[str, float]) -> float:
     """Deterministic FFD sort key: CPU cores + memory scaled at 4GiB/core +
     GPU heavily weighted.  Both solvers (oracle + TPU) share this exact key,

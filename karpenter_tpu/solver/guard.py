@@ -114,7 +114,19 @@ class DeviceGuard:
         """Run ``fn`` with the hang deadline; raise :class:`DeviceHang` on
         timeout (latching unhealthy), else return/raise exactly what ``fn``
         did."""
-        if not self.enabled:
+        return self._run(self.timeout_s, fn, args, kwargs)
+
+    def run_budgeted(self, fn, *args, budget_frac: float = 1.0, **kwargs):
+        """Like :meth:`run` with ``budget_frac`` of the deadline.  The
+        hierarchical solver dispatches up to ``1 + KT_HIER_PRICE_ITERS``
+        block waves per batch; splitting the whole-solve deadline across
+        them keeps a wedged tunnel latching in the same bounded time as one
+        flat solve instead of ``waves ×`` longer."""
+        frac = min(max(budget_frac, 0.0), 1.0)
+        return self._run(self.timeout_s * frac, fn, args, kwargs)
+
+    def _run(self, timeout_s: float, fn, args, kwargs):
+        if not self.enabled or timeout_s <= 0:
             return fn(*args, **kwargs)
         box: dict = {}
         done = threading.Event()
@@ -133,11 +145,11 @@ class DeviceGuard:
 
         t = threading.Thread(target=work, daemon=True, name="kt-device-call")
         t.start()
-        if not done.wait(self.timeout_s):
+        if not done.wait(timeout_s):
             _ABANDONED.append(t)
             self._mark_unhealthy()
             raise DeviceHang(
-                f"device call exceeded {self.timeout_s:.0f}s; device tier "
+                f"device call exceeded {timeout_s:.0f}s; device tier "
                 "latched unhealthy (warm host tiers serve until a probe "
                 "succeeds)"
             )

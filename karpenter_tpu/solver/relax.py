@@ -103,6 +103,15 @@ _TAU = 64.0
 _ETA = 1.0
 
 
+def mirror_eta(t):
+    """Step size η/√(1+t/8) of the mirror-descent ladder at iteration ``t``
+    (float — pass ``t.astype(jnp.float32)`` from traced code).  One source
+    for the schedule: the relax rung's multiplicative-weights loop and the
+    hierarchical price ascent (solver/hierarchy.py) share it so the two
+    rungs decay in lockstep."""
+    return _ETA / jnp.sqrt(1.0 + t / 8.0)
+
+
 def relax_enabled() -> bool:
     return os.environ.get("KT_RELAX", "1") != "0"
 
@@ -228,7 +237,7 @@ def _relax_program(req, counts, feas, alloc_inv, price, x0,
         gmin = jnp.min(jnp.where(feas, g, jnp.inf), axis=1, keepdims=True)
         gmax = jnp.max(jnp.where(feas, g, -jnp.inf), axis=1, keepdims=True)
         spread = jnp.maximum(gmax - gmin, 1e-12)
-        eta = _ETA / jnp.sqrt(1.0 + t.astype(jnp.float32) / 8.0)
+        eta = mirror_eta(t.astype(jnp.float32))
         x = renorm(x * jnp.exp(-eta * (g - gmin) / spread))
         f = cost(x)
         better = f < bf

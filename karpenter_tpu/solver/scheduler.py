@@ -562,6 +562,13 @@ class BatchScheduler:
         from .relax import zero_init_metrics as _rx_zero_init
 
         _rx_zero_init(self.registry)
+        # hierarchical-routing series exist before the first 100k+ batch
+        from .hierarchy import zero_init_hier_metrics as _hier_zero_init
+
+        _hier_zero_init(self.registry)
+        # hierarchical re-entrancy depth: repair solves issued from inside
+        # solve_hierarchical must never route hierarchically themselves
+        self._hier_depth = 0
 
     def _device_health_changed(self, healthy: bool) -> None:
         self.registry.gauge(SOLVER_DEVICE_HEALTHY).set(1 if healthy else 0)
@@ -1428,6 +1435,18 @@ class BatchScheduler:
                 self.registry.histogram(SOLVER_BACKEND_DURATION).observe(
                     time.perf_counter() - t0, {"backend": "oracle"}
                 )
+        if self._route_hier(pods, existing_nodes, allow_new_nodes,
+                            max_new_nodes):
+            from .hierarchy import solve_hierarchical
+
+            result = solve_hierarchical(
+                self, pods, provisioners, instance_types,
+                daemonsets=daemonsets, unavailable=unavailable, trace=trace,
+            )
+            if result is not None:
+                return result
+            # None = flat is the right (or only warm) program for this
+            # batch — the hier metrics label recorded why; fall through
         return self._solve_tpu(
             pods, provisioners, instance_types, existing_nodes, daemonsets,
             unavailable, allow_new_nodes, max_new_nodes, dispatch=dispatch,
@@ -1687,6 +1706,29 @@ class BatchScheduler:
         compiles behind (_cold_solve) — that is where its 50k-in-224ms
         speed, not its packing polish, is the right trade."""
         return self.backend == "auto" and n_pods <= self.native_batch_limit
+
+    def _route_hier(self, pods, existing_nodes, allow_new_nodes,
+                    max_new_nodes) -> bool:
+        """Hierarchical routing gate: flat below ``KT_HIER_THRESHOLD`` pods
+        (default 100k), block decomposition at/above it — greenfield
+        batches only (no existing nodes, unbounded budget: the delta chain
+        and retry waves keep flat's exact placed-snapshot semantics), on a
+        healthy device tier, with no device-inexpressible pods (the flat
+        path owns that oracle carve-out)."""
+        from .hierarchy import hier_threshold
+
+        thr = hier_threshold()
+        return (
+            thr > 0
+            and not getattr(self, "_hier_depth", 0)
+            and self.backend in ("auto", "tpu")
+            and len(pods) >= thr
+            and not existing_nodes
+            and allow_new_nodes
+            and max_new_nodes is None
+            and self._guard.healthy
+            and not any(device_inexpressible(p) for p in pods)
+        )
 
     def _route_native(self, st, n_pods: int) -> bool:
         """Forced native backend only.  The auto policy no longer serves

@@ -1174,6 +1174,47 @@ def _node_budget(st: SolveTensors, NE: int, max_nodes: Optional[int]) -> int:
     return max(1, max_nodes)
 
 
+def zone_share_matrix(st: SolveTensors, pad_g: int, Z: int) -> np.ndarray:
+    """``[G+pad, Z]`` even split over each group's eligible zones — the
+    counts-INdependent factor of :func:`host_count_arrays`, memoized on the
+    tensors (like ``_nr_est_cache``): the hierarchical block builder
+    (solver/hierarchy.py) rebuilds the suffix projections once per block
+    per price wave and must not re-walk every group's zone requirements
+    each time."""
+    cache = getattr(st, "_zone_share_cache", None)
+    key = (pad_g, Z)
+    if cache is not None and cache[0] == key:
+        return cache[1]
+    G = st.G
+    zone_share = np.zeros((G + pad_g, Z), dtype=np.float32)
+    for gi, grp in enumerate(st.groups):
+        vs = grp.requirements.get(L.ZONE)
+        ok = np.zeros(Z, dtype=bool)
+        for zi, zname in enumerate(st.zone_names):
+            ok[zi] = vs.contains(zname)
+        if not ok.any():
+            ok[:] = True
+        zone_share[gi] = ok.astype(np.float32) / float(ok.sum())
+    st._zone_share_cache = (key, zone_share)
+    return zone_share
+
+
+def suffix_projection(demand_z: np.ndarray, count_z: np.ndarray):
+    """``(suffix_res[G, Z, R], suffix_cnt[G, Z])`` — the later-group
+    backfill suffix sums of per-zone demand.  Shared by
+    :func:`host_count_arrays` and the hierarchical block builder's masked
+    per-block recompute (one source for the cumsum orientation)."""
+    suffix_res = np.concatenate(
+        [np.cumsum(demand_z[::-1], axis=0)[::-1][1:],
+         np.zeros((1,) + demand_z.shape[1:])]
+    ).astype(np.float32)
+    suffix_cnt = np.concatenate(
+        [np.cumsum(count_z[::-1], axis=0)[::-1][1:],
+         np.zeros((1, count_z.shape[1]))]
+    ).astype(np.float32)
+    return suffix_res, suffix_cnt
+
+
 def host_count_arrays(st: SolveTensors, pad_g: int, Z: int):
     """The counts-dependent host tensors of one solve: padded counts +
     requests and the PER-ZONE suffix projection of later-group demand
@@ -1193,30 +1234,14 @@ def host_count_arrays(st: SolveTensors, pad_g: int, Z: int):
     tensors that depend on the counts vector: the consolidation sweep
     (solver/consolidation.py) derives every candidate what-if from one
     shared base build and recomputes just this per candidate."""
-    G = st.G
     np_counts = np.pad(st.counts, (0, pad_g), constant_values=0)
     np_requests = np.pad(st.requests, ((0, pad_g), (0, 0)),
                          constant_values=0)
     demand = (np_counts[:, None] * np_requests).astype(np.float32)   # [G, R]
-    zone_share = np.zeros((G + pad_g, Z), dtype=np.float32)
-    for gi, grp in enumerate(st.groups):
-        vs = grp.requirements.get(L.ZONE)
-        ok = np.zeros(Z, dtype=bool)
-        for zi, zname in enumerate(st.zone_names):
-            ok[zi] = vs.contains(zname)
-        if not ok.any():
-            ok[:] = True
-        zone_share[gi] = ok.astype(np.float32) / float(ok.sum())
+    zone_share = zone_share_matrix(st, pad_g, Z)
     demand_z = demand[:, None, :] * zone_share[:, :, None]           # [G, Z, R]
     count_z = np_counts[:, None].astype(np.float32) * zone_share     # [G, Z]
-    np_suffix_res = np.concatenate(
-        [np.cumsum(demand_z[::-1], axis=0)[::-1][1:],
-         np.zeros((1,) + demand_z.shape[1:])]
-    ).astype(np.float32)                                             # [G, Z, R]
-    np_suffix_cnt = np.concatenate(
-        [np.cumsum(count_z[::-1], axis=0)[::-1][1:],
-         np.zeros((1, Z))]
-    ).astype(np.float32)                                             # [G, Z]
+    np_suffix_res, np_suffix_cnt = suffix_projection(demand_z, count_z)
     return np_counts, np_requests, np_suffix_res, np_suffix_cnt
 
 

@@ -8,6 +8,7 @@ judged on the whole distribution before touching the ceilings.
     python scripts/fuzz_sweep.py --delta [n_seeds] [chain_len]
     python scripts/fuzz_sweep.py --delta-wire [n_seeds] [chain_len]
     python scripts/fuzz_sweep.py --relax [n_seeds]
+    python scripts/fuzz_sweep.py --hier [n_seeds]
 
 ``--cached`` re-solves every scenario a second time through ONE scheduler
 instance, so the second pass runs the incremental tensorize cache
@@ -29,6 +30,15 @@ solves the scenario, ``relax.refine`` refines it, and the sweep asserts
 construction, proven under fuzz, not just claimed), (b) the ground-truth
 validator passes on the shipped solution, and (c) the schedulable-pod set
 is unchanged.  Prints the outcome histogram.
+
+``--hier`` (ISSUE 16) fuzzes the hierarchical decomposition
+(solver/hierarchy.py): per seed, (a) a block-disjoint scenario (distinct
+zone pins + spread selectors per deployment) must ship flat's EXACT
+placement (node-name-independent canonical compare), (b) the LPT
+partition must never split a constraint-reachability component across
+blocks — asserted structurally on random adversarial scenarios under
+forced block pressure — and (c) on an overlapping scenario the repair
+pass must leave no pod unseated that flat seats.
 
 ``--delta-wire`` (ISSUE 10) drives the same random churn chains through a
 REAL gRPC client/server pair — ``DeltaSession`` against an in-process
@@ -60,11 +70,13 @@ from karpenter_tpu.solver import reference
 from karpenter_tpu.solver.scheduler import BatchScheduler
 
 argv = [a for a in sys.argv[1:]
-        if a not in ("--cached", "--delta", "--delta-wire", "--relax")]
+        if a not in ("--cached", "--delta", "--delta-wire", "--relax",
+                     "--hier")]
 cached = "--cached" in sys.argv[1:]
 delta = "--delta" in sys.argv[1:]
 delta_wire = "--delta-wire" in sys.argv[1:]
 relax_mode = "--relax" in sys.argv[1:]
+hier_mode = "--hier" in sys.argv[1:]
 catalog = generate_catalog(full=False)
 
 
@@ -363,9 +375,130 @@ def run_relax_seeds(n_seeds: int) -> int:
     return failures
 
 
+def _hier_fuzz_scenario(seed: int, disjoint: bool):
+    """Seed-varied deployment blocks — distinct spread selectors per
+    deployment make each one its own coupling component; ``disjoint``
+    additionally pins every deployment to its own zone, removing flat's
+    last coupling channels (per-zone suffix backfill, co-residency) — the
+    byte-parity construction."""
+    import random
+
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.catalog import DEFAULT_ZONES
+    from karpenter_tpu.models.instancetype import GIB
+    from karpenter_tpu.models.pod import (LabelSelector, PodSpec,
+                                          TopologySpreadConstraint)
+
+    rng = random.Random(77_000 + seed)
+    nd = len(DEFAULT_ZONES) if disjoint else rng.randint(2, 5)
+    pods = []
+    for d in range(nd):
+        sel = LabelSelector.of({"app": f"fz{seed}-{d}"})
+        node_sel = ({L.ZONE: DEFAULT_ZONES[d % len(DEFAULT_ZONES)]}
+                    if disjoint else {})
+        cpu = 0.25 * rng.randint(1, 8)
+        mem = GIB * (0.5 + rng.randint(0, 5))
+        for i in range(rng.randint(20, 120)):
+            pods.append(PodSpec(
+                name=f"fz{seed}-{d}-{i}", labels={"app": f"fz{seed}-{d}"},
+                requests={"cpu": cpu, "memory": mem},
+                node_selector=dict(node_sel),
+                topology_spread=[TopologySpreadConstraint(
+                    1, L.ZONE, "DoNotSchedule", sel)],
+                owner_key=f"fz{seed}-{d}"))
+    return pods
+
+
+def run_hier_seeds(n_seeds: int) -> int:
+    """Hierarchical-decomposition fuzz (ISSUE 16); returns the number of
+    failing seeds.  Per seed: disjoint byte-parity, component-never-split
+    under forced block pressure, repair completeness vs flat."""
+    import numpy as np
+
+    from bench import _placement_canon
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.models.tensorize import tensorize
+    from karpenter_tpu.solver import hierarchy as H
+
+    provs = [Provisioner(name="default").with_defaults()]
+    sched = BatchScheduler(backend="tpu", compile_behind=False)
+    failures = 0
+    for seed in range(n_seeds):
+        problems = []
+        # (a) block-disjoint: hier must ship flat's exact placement.
+        # relax=False on the flat reference: the flat scheduler path runs
+        # the PR-11 relax rung's min(scan, relax+round) select on top of
+        # the device scan, which can repack f64-epsilon-cheaper cost TIES
+        # into different (equally priced) nodes; megabatch slots skip that
+        # rung by design, so the decomposition's byte-parity claim is
+        # scan-vs-scan
+        dpods = _hier_fuzz_scenario(seed, disjoint=True)
+        dflat = sched.solve(dpods, provs, catalog, relax=False)
+        dhier = H.solve_hierarchical(sched, dpods, provs, catalog)
+        if dhier is None:
+            problems.append("disjoint: hierarchical path fell back")
+        elif _placement_canon(dflat) != _placement_canon(dhier):
+            # byte parity is the primary claim, but the flat scan and the
+            # vmapped megabatch program are DIFFERENT compiled graphs —
+            # their f32 score arithmetic can round a genuine price tie
+            # (e.g. 2x m5.large vs 1x m5.xlarge) to opposite picks in the
+            # last ulp.  A mismatch is acceptable ONLY as such a tie: same
+            # pods seated, no infeasibility drift, and the node-cost
+            # totals bitwise-equal at f32 (the scan's own accumulation
+            # precision).  Anything wider is a real decomposition bug.
+            fcost = np.float32(sum(n.price for n in dflat.nodes))
+            hcost = np.float32(sum(n.price for n in dhier.nodes))
+            tie = (set(dflat.assignments) == set(dhier.assignments)
+                   and set(dflat.infeasible) == set(dhier.infeasible)
+                   and fcost.tobytes() == hcost.tobytes())
+            if not tie:
+                diff = sum(1 for pn, v in _placement_canon(dflat).items()
+                           if _placement_canon(dhier).get(pn) != v)
+                problems.append(f"disjoint: {diff} pod placement(s) "
+                                "diverged from flat beyond an f32 cost tie")
+        # (b) never split a reachability component, even under block
+        # pressure (fewer bins than components forces LPT packing) — on
+        # the adversarial random scenarios, whose affinity/spread webs
+        # produce multi-group components
+        cpods, cprovs, unav = random_scenario(seed, catalog)
+        st = tensorize(cpods, cprovs, catalog, unavailable=unav)
+        comps = H.coupling_components(st)
+        for max_blocks in (2, 3):
+            masks = H.partition_blocks(st, comps, max_blocks)
+            for ci, comp in enumerate(comps):
+                owners = {bi for bi, m in enumerate(masks)
+                          if bool(np.any(m[comp]))}
+                whole = any(bool(np.all(m[comp])) for m in masks)
+                if len(owners) != 1 or not whole:
+                    problems.append(
+                        f"component {ci} split across blocks {owners} "
+                        f"at max_blocks={max_blocks}")
+        # (c) repair completeness on an OVERLAPPING scenario (shared
+        # zones): no pod flat seats may end up unseated hierarchically
+        opods = _hier_fuzz_scenario(seed, disjoint=False)
+        oflat = sched.solve(opods, provs, catalog, relax=False)
+        ohier = H.solve_hierarchical(sched, opods, provs, catalog)
+        if ohier is None:
+            problems.append("overlap: hierarchical path fell back")
+        else:
+            lost = sorted(set(oflat.assignments) - set(ohier.assignments))
+            if lost:
+                problems.append(
+                    f"overlap: {len(lost)} pod(s) flat seats are unseated "
+                    f"hierarchically (e.g. {lost[:3]})")
+        tag = "OK " if not problems else "FAIL"
+        print(f"hier seed {seed}: {tag}"
+              + (f" {problems}" if problems else ""))
+        failures += bool(problems)
+    return failures
+
+
 if relax_mode:
     n_seeds = int(argv[0]) if len(argv) > 0 else 25
     sys.exit(1 if run_relax_seeds(n_seeds) else 0)
+if hier_mode:
+    n_seeds = int(argv[0]) if len(argv) > 0 else 12
+    sys.exit(1 if run_hier_seeds(n_seeds) else 0)
 if delta_wire:
     n_seeds = int(argv[0]) if len(argv) > 0 else 10
     chain_len = int(argv[1]) if len(argv) > 1 else 4

@@ -117,6 +117,94 @@ def top_kernels(xplane_path: str, k: int = 10):
 
 
 # ---------------------------------------------------------------------------
+# hierarchical host-stage profile (numpy only — never imports jax)
+# ---------------------------------------------------------------------------
+
+#: measured dev-host entry-build rate (ms per group): the per-block entry
+#: construction (counts mask + zone-share suffix projection) is group-
+#: count-bound numpy work, but building it needs the solver's jax-backed
+#: base arrays — this script stays jax-free, so it projects from the rate
+#: bench.measure_hierarchical measured (docs/PROFILE.md round 13:
+#: 21.7 ms / 400 groups)
+_ENTRIES_MS_PER_GROUP = 0.055
+
+
+def _profile_hier() -> int:
+    """Host-stage ladder for the ISSUE-16 decomposition.  Everything here
+    is numpy: scenario build, tensorize, constraint-reachability
+    partition, LPT block packing, and the scale-model wall projection.
+    The entry build and the block wave need jax (they are projected from
+    measured rates instead); ``bench.py measure_hierarchical`` owns the
+    measured end-to-end numbers.  Asserts jax was never imported."""
+    # the package __init__ imports jax (config-layer pin) when
+    # JAX_PLATFORMS is exported — drop it; nothing below needs a backend
+    os.environ.pop("JAX_PLATFORMS", None)
+
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.catalog import DEFAULT_ZONES, generate_catalog
+    from karpenter_tpu.models.pod import (LabelSelector, PodSpec,
+                                          TopologySpreadConstraint)
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.models.tensorize import tensorize
+    from karpenter_tpu.solver.hierarchy import (block_budgets,
+                                                coupling_components,
+                                                partition_blocks,
+                                                scale_model)
+
+    GIB = 1024 ** 3
+    catalog = generate_catalog(full=False)
+    provs = [Provisioner(name="default").with_defaults()]
+    out = {"jax_imported": None, "rungs": []}
+    for n_target in (100_000, 500_000, 1_000_000):
+        # the real deployment shape at this rung (one group per 2500-pod
+        # deployment), carried by 25-pod proxies: every host stage below
+        # is group-count-bound, so the timings ARE the rung's timings
+        nd = max(2, n_target // 2500)
+        pods = []
+        for d in range(nd):
+            sel = LabelSelector.of({"app": f"hp{d}"})
+            pods.extend(
+                PodSpec(
+                    name=f"hp{d}-{i}",
+                    labels={"app": f"hp{d}"},
+                    requests={"cpu": 0.25 * (1 + d % 8),
+                              "memory": (0.5 + (d % 6)) * GIB},
+                    topology_spread=[TopologySpreadConstraint(
+                        1, L.ZONE, "DoNotSchedule", sel)],
+                    owner_key=f"hp{d}",
+                )
+                for i in range(25)
+            )
+        t0 = time.perf_counter()
+        st = tensorize(pods, provs, catalog)
+        tensorize_ms = (time.perf_counter() - t0) * 1000.0
+        t1 = time.perf_counter()
+        comps = coupling_components(st)
+        masks = partition_blocks(st, comps, 32)
+        budgets = block_budgets(st, masks)
+        partition_ms = (time.perf_counter() - t1) * 1000.0
+        # block budgets scale with REAL pod counts, not the 25-pod proxy
+        scale = n_target / max(1, len(pods))
+        entries_ms = _ENTRIES_MS_PER_GROUP * st.G
+        model = scale_model(
+            {"n_pods": n_target, "blocks": len(masks), "waves": 1,
+             "partition_ms": partition_ms, "entries_ms": entries_ms},
+            n_target)
+        out["rungs"].append({
+            "n_pods": n_target, "groups": st.G,
+            "components": len(comps), "blocks": len(masks),
+            "max_block_budget": int(round(max(budgets) * scale)),
+            "tensorize_ms": round(tensorize_ms, 2),
+            "partition_ms": round(partition_ms, 2),
+            "entries_ms_est": round(entries_ms, 2),
+            "model": model,
+        })
+    out["jax_imported"] = "jax" in sys.modules
+    print(json.dumps(out, indent=2))
+    return 1 if out["jax_imported"] else 0
+
+
+# ---------------------------------------------------------------------------
 # the measured solve
 # ---------------------------------------------------------------------------
 
@@ -137,6 +225,13 @@ def main(argv=None) -> int:
                          "floor) next to the precompile grid — for human "
                          "diffing when the ladder changes; pure stdlib, "
                          "no jax, exits immediately")
+    ap.add_argument("--hier", action="store_true",
+                    help="per-stage timings of the hierarchical "
+                         "decomposition's HOST stages (tensorize, "
+                         "partition, LPT block packing) at the 100k/500k/"
+                         "1M-pod group shapes, plus the dev-host scale-"
+                         "model wall projections (docs/PROFILE.md round "
+                         "13) — numpy only, never imports jax")
     args = ap.parse_args(argv)
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -147,6 +242,9 @@ def main(argv=None) -> int:
 
         print(json.dumps(surface(collect_package_files()), indent=2))
         return 0
+
+    if args.hier:
+        return _profile_hier()
 
     from bench import build_scenario
 

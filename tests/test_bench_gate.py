@@ -525,6 +525,70 @@ class TestMultihostFenceGates:
                    for f in out["budget_flags"])
 
 
+class TestHierarchicalGates:
+    """ISSUE 16 budget gates (measure_hierarchical): the dev-host scale
+    model must put 1M pods under the target, hierarchical must be
+    never-worse-than-flat on the overlap scenario, byte-identical on
+    block-disjoint batches, Pallas byte-compatible, and every block wave
+    exactly ONE device dispatch."""
+
+    GOOD = {"hier_model_1m_ms": 130.0, "hier_cost_ratio": 1.008,
+            "hier_infeasible_regressions": 0,
+            "hier_disjoint_parity": True, "hier_pallas_parity": True,
+            "hier_dispatches_per_wave": 1}
+
+    def test_within_budgets_clean(self):
+        assert benchmod.check_budgets(dict(self.GOOD)) == {}
+
+    def test_model_over_target_flagged(self):
+        out = benchmod.check_budgets(dict(self.GOOD, hier_model_1m_ms=251.0))
+        assert any("1M-pod hierarchical solve" in f
+                   for f in out["budget_flags"])
+        # the budget is a strict ceiling: AT the target also flags
+        out = benchmod.check_budgets(
+            dict(self.GOOD, hier_model_1m_ms=benchmod.HIER_MODEL_1M_BUDGET_MS))
+        assert any("1M-pod" in f for f in out["budget_flags"])
+
+    def test_cost_ratio_over_ceiling_flagged(self):
+        out = benchmod.check_budgets(dict(self.GOOD, hier_cost_ratio=1.03))
+        assert any("not reconciling cross-block contention" in f
+                   for f in out["budget_flags"])
+        # the 1.02 ceiling itself is inclusive-OK
+        assert benchmod.check_budgets(
+            dict(self.GOOD, hier_cost_ratio=benchmod.COST_PARITY_CEILING)
+        ) == {}
+
+    def test_infeasible_regression_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, hier_infeasible_regressions=3))
+        assert any("no straggler" in f for f in out["budget_flags"])
+
+    def test_disjoint_divergence_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, hier_disjoint_parity=False))
+        assert any("fully decoupled blocks" in f
+                   for f in out["budget_flags"])
+
+    def test_pallas_divergence_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, hier_pallas_parity=False))
+        assert any("KT_PALLAS" in f for f in out["budget_flags"])
+
+    def test_extra_dispatches_per_wave_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, hier_dispatches_per_wave=2.0))
+        assert any("ONE vmapped dispatch" in f for f in out["budget_flags"])
+
+    def test_fallback_error_flagged(self):
+        out = benchmod.check_budgets({"hier_error": "fell back"})
+        assert any("hierarchical bench fell back" in f
+                   for f in out["budget_flags"])
+
+    def test_phase_missing_not_flagged(self):
+        # absent keys must not fail other rounds' budget records
+        assert benchmod.check_budgets({"solve_p50_ms": 30.0}) == {}
+
+
 @pytest.mark.slow
 def test_500k_pod_solve_stretch():
     """ISSUE 6 stretch rung: the solve bench ceiling lifted from 50k

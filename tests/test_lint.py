@@ -2321,12 +2321,124 @@ class TestKT008RelaxCoverage:
         assert rules_of(findings) == ["KT011"]
 
 
+class TestKT020HierarchicalPath:
+    HIER = "karpenter_tpu/solver/hierarchy.py"
+
+    def test_fires_on_per_block_solve_in_for_loop(self):
+        src = """
+        def waves(self, solver, blocks):
+            outs = []
+            for entry in blocks:
+                outs.append(solver.solve_many_prepared([entry]))
+            return outs
+        """
+        findings = lint(src, self.HIER)
+        assert rules_of(findings) == ["KT020"]
+        assert "per iteration" in findings[0].message
+
+    def test_fires_on_wave_in_while_loop(self):
+        src = """
+        def ascend(self, entries):
+            while True:
+                outs = wave(entries)
+                if settled(outs):
+                    return outs
+        """
+        assert rules_of(lint(src, self.HIER)) == ["KT020"]
+
+    def test_fires_on_delta_solve_in_comprehension(self):
+        # a comprehension is the for-loop-of-dispatch spelled on one line
+        src = """
+        def repair(self, results):
+            return [delta_solve(r, added=r.stragglers) for r in results]
+        """
+        assert rules_of(lint(src, self.HIER)) == ["KT020"]
+
+    def test_fires_on_unpacked_float32_feasibility_astype(self):
+        src = """
+        import numpy as np
+
+        def score(self, st, prices):
+            feas = _host_feasibility(st).astype(np.float32)
+            return feas * prices
+        """
+        findings = lint(src, self.HIER)
+        assert rules_of(findings) == ["KT020"]
+        assert "int8" in findings[0].message
+
+    def test_fires_on_float32_feasibility_constructor(self):
+        src = """
+        import numpy as np
+
+        def build(self, G, C):
+            feas_wide = np.zeros((G, C), dtype=np.float32)
+            return feas_wide
+        """
+        assert rules_of(lint(src, self.HIER)) == ["KT020"]
+
+    def test_quiet_on_packed_feasibility(self):
+        src = """
+        def score(self, st, adj):
+            f_packed = pack_feasibility(_host_feasibility(st))
+            return packed_scan_scores(f_packed, pack_scores(adj))
+        """
+        assert lint(src, self.HIER) == []
+
+    def test_quiet_on_float32_prices(self):
+        # float32 is the PRICE dtype everywhere — only feasibility
+        # tensors must stay packed
+        src = """
+        import numpy as np
+
+        def adjust(self, cand_price, m):
+            base = np.asarray(cand_price, dtype=np.float32)
+            return base * m
+        """
+        assert lint(src, self.HIER) == []
+
+    def test_quiet_outside_hierarchy(self):
+        src = """
+        def waves(self, solver, blocks):
+            for entry in blocks:
+                solver.solve_many_prepared([entry])
+        """
+        assert lint(src, "karpenter_tpu/solver/consolidation.py") == []
+
+    def test_quiet_when_loop_body_is_a_deferred_callable(self):
+        src = """
+        def collect(self, solver, blocks):
+            thunks = []
+            for entry in blocks:
+                thunks.append(lambda e=entry: solver.solve([e]))
+            return thunks
+        """
+        assert lint(src, self.HIER) == []
+
+    def test_allow_on_loop_header_comment(self):
+        # the price-ascent shape: sequentially dependent waves
+        src = """
+        def ascend(self, entries, budget):
+            # ktlint: allow[KT020] price waves are sequentially dependent
+            for t in range(budget):
+                outs = wave(entries)
+        """
+        assert lint(src, self.HIER) == []
+
+    def test_reasonless_allow_is_malformed(self):
+        src = """
+        def ascend(self, entries, budget):
+            for t in range(budget):
+                outs = wave(entries)  # ktlint: allow[KT020]
+        """
+        assert "KT000" in rules_of(lint(src, self.HIER))
+
+
 class TestWholeProgramGates:
     def test_package_zero_findings_for_new_rules(self):
-        from karpenter_tpu.analysis.rules import kt012, kt013, kt014
+        from karpenter_tpu.analysis.rules import kt012, kt013, kt014, kt020
 
         active, _supp, n_files = analyze_package(
-            rules=[kt012, kt013, kt014])
+            rules=[kt012, kt013, kt014, kt020])
         assert n_files > 60
         assert active == [], "\n".join(f.format() for f in active)
 
