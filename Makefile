@@ -2,7 +2,7 @@ PYTHON ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
 
-.PHONY: all test native proto bench clean battletest lint obs-demo obs-fleet-demo overload-demo chaos chaos-fleet multihost-dryrun hier-demo
+.PHONY: all test native proto bench clean battletest lint modelcheck obs-demo obs-fleet-demo overload-demo chaos chaos-fleet multihost-dryrun hier-demo
 
 all: native proto
 
@@ -13,19 +13,35 @@ native: native/ffd.cpp
 
 proto: karpenter_tpu/service/solver_pb2.py
 
+# protoc is not in the image; gen_proto.py re-emits the module from the
+# protobuf runtime's serialized descriptor (idempotent, --check in CI)
 karpenter_tpu/service/solver_pb2.py: karpenter_tpu/service/solver.proto
-	cd karpenter_tpu/service && protoc --python_out=. solver.proto
+	$(PYTHON) scripts/gen_proto.py
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q
 
 # ktlint: the repo-specific AST analyzer (rule catalog in docs/ANALYSIS.md);
-# exits non-zero on any unsuppressed KT001-KT014 finding — the v2 suite
-# includes the whole-program call-graph passes (KT012 lock-order deadlocks,
-# KT013 interprocedural fence reachability, KT014 compile-surface audit);
+# exits non-zero on any unsuppressed KT001-KT022 finding — includes the
+# whole-program call-graph passes (KT012 lock-order deadlocks, KT013
+# interprocedural fence reachability, KT014 compile-surface audit) and the
+# v3 gates (KT021 proto wire-compat vs the golden descriptor, KT022
+# KT_* knob/README drift);
 # tests/test_lint.py speed-gates the full run (<5s cold, <1s warm cache)
 lint:
 	$(PYTHON) -m karpenter_tpu.analysis
+
+# protocol model checking (docs/ANALYSIS.md v3, ISSUE 17): bounded
+# exhaustive exploration of the delta-session epoch protocol and the
+# lease/claim/steal/drain protocol over ALL thread/replica interleavings
+# — exactly-one lease winner, per-session epoch monotonicity, no serve
+# from a half-mutated chain, drained-never-served-by-drainer, cumulative
+# retry convergence — plus the automaton-simulation relation the runtime
+# conformance checker (chaos-fleet + replay) judges traces against.
+# Prints state-space sizes; exits 1 with a counterexample trace on any
+# violation.  tests/test_model.py speed-gates the bounded config.
+modelcheck:
+	$(PYTHON) -m karpenter_tpu.analysis --model
 
 # the reference's battletest analog (Makefile:69-76: -race + randomized
 # order + random delays): lint gate, then widened seeded churn/fuzz/race
@@ -97,6 +113,10 @@ chaos:
 #   stale      spool rolled back to pre-kill records -> adoption succeeds
 #              but the epoch check refuses the stale chain: one typed
 #              re-establish per session, never a silent divergence
+# Every scenario also runs under the ISSUE-17 conformance tap: the
+# per-session protocol-transition sequences observed across the whole
+# fleet must each be a path of the model-checked session automaton
+# (analysis/conformance.py; violations fail the run).
 KT_FLEET_SEEDS ?= 23 24 25
 chaos-fleet:
 	for seed in $(KT_FLEET_SEEDS); do \

@@ -45,7 +45,7 @@ from .ktlint import SourceFile, dotted_name, file_nodes
 
 #: bump when the summary format changes — stale caches are discarded, never
 #: migrated (the extraction is cheap; correctness of the cache is not)
-SUMMARY_VERSION = 1
+SUMMARY_VERSION = 2  # v2: FileSummary.env_reads (KT022)
 
 #: parameter names treated as device-resident by convention (KT001's taint)
 TAINT_PARAMS = {"carry", "ys"}
@@ -112,6 +112,10 @@ class FileSummary:
     #: module-level names bound to jitted callables (KT013's taint needs
     #: "np.asarray(jitted(...))" to count as a device read)
     jitted: List[str] = dataclasses.field(default_factory=list)
+    #: [(lineno, pattern)] — every ``KT_*`` environment read in the file
+    #: (KT022); dynamically-suffixed keys (f-strings) become ``KT_FOO_*``
+    #: wildcard patterns
+    env_reads: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -125,7 +129,8 @@ class FileSummary:
         classes = {k: ClassSummary(**v) for k, v in d["classes"].items()}
         return cls(path=d["path"], module=d["module"], imports=d["imports"],
                    functions=funcs, classes=classes,
-                   module_locks=d["module_locks"], jitted=d["jitted"])
+                   module_locks=d["module_locks"], jitted=d["jitted"],
+                   env_reads=[tuple(e) for e in d.get("env_reads", [])])
 
 
 def module_name(path: str) -> str:
@@ -331,10 +336,74 @@ def _with_lock_ref(item: ast.withitem) -> Optional[str]:
     return None
 
 
+def _env_reads(f: SourceFile) -> List[Tuple[int, str]]:
+    """Every ``KT_*`` environment-variable READ in the file (KT022).
+
+    Matched shapes (the package's actual idioms — validated against every
+    knob in the tree, not a grep):
+
+    - ``os.environ.get("KT_X")`` / ``os.getenv("KT_X")`` /
+      ``os.environ.setdefault("KT_X", ...)``
+    - ``os.environ["KT_X"]`` in Load context (Store/Del are writes)
+    - one-hop module-constant indirection: ``NAME = "KT_X"`` then
+      ``environ.get(NAME)`` (admission/policy.py's DEFAULT_CLASS_ENV)
+    - wrapper helpers whose name mentions ``env`` called with a literal
+      key (``_env_int("KT_X", 4)``)
+    - f-string keys with a literal ``KT_`` head become WILDCARD patterns
+      (``f"KT_QUOTA_{cls}"`` -> ``KT_QUOTA_*``) — the README documents
+      those as a family row
+    """
+    consts: Dict[str, str] = {}
+    for node in ast.iter_child_nodes(f.tree):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and node.value.value.startswith("KT_"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    consts[t.id] = node.value.value
+
+    def key_of(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if node.value.startswith("KT_") else None
+        if isinstance(node, ast.Name):
+            return consts.get(node.id)
+        if isinstance(node, ast.JoinedStr) and node.values:
+            head = node.values[0]
+            if isinstance(head, ast.Constant) \
+                    and isinstance(head.value, str) \
+                    and head.value.startswith("KT_"):
+                return head.value + "*"
+        return None
+
+    out: List[Tuple[int, str]] = []
+    for n in file_nodes(f):
+        if isinstance(n, ast.Call):
+            d = dotted_name(n.func)
+            if d is None or not n.args:
+                continue
+            base = d.split(".")[-1]
+            direct = (base == "getenv" or d.endswith("environ.get")
+                      or d.endswith("environ.setdefault"))
+            wrapper = not direct and "env" in base.lower()
+            if direct or wrapper:
+                key = key_of(n.args[0])
+                if key is not None:
+                    out.append((n.lineno, key))
+        elif isinstance(n, ast.Subscript) and isinstance(n.ctx, ast.Load):
+            d = dotted_name(n.value)
+            if d is not None and d.endswith("environ"):
+                key = key_of(n.slice)
+                if key is not None:
+                    out.append((n.lineno, key))
+    return out
+
+
 def summarize(f: SourceFile) -> FileSummary:
     """Extract the whole-program facts for one parsed file."""
     mod = module_name(f.path)
     summ = FileSummary(path=f.path, module=mod)
+    summ.env_reads = _env_reads(f)
     pkg_parts = mod.split(".") if _is_pkg(f.path) else mod.split(".")[:-1]
 
     # imports
@@ -517,19 +586,27 @@ class SummaryCache:
         return cls(path=base / "cache.json")
 
     def get(self, f: SourceFile) -> FileSummary:
+        # keyed by (derived module, content hash), not raw path: an
+        # explicit-path run (`ktlint karpenter_tpu`) and the package run
+        # see the same file and must share one entry.  The module part
+        # matters — relative-import resolution in the summary depends on
+        # the path-derived module, so identical text seen under a
+        # different package spelling must NOT hit.
         sha = hashlib.sha256(f.text.encode()).hexdigest()
-        entry = self._entries.get(f.path)
-        if entry is not None and entry.get("sha") == sha:
+        key = f"{module_name(f.path)}:{sha}"
+        entry = self._entries.get(key)
+        if entry is not None:
             try:
                 summ = FileSummary.from_json(entry["summary"])
             except (KeyError, TypeError):
                 pass  # format drift inside one entry: re-extract
             else:
+                summ.path = f.path  # the caller's spelling of the path
                 self.hits += 1
                 return summ
         self.misses += 1
         summ = summarize(f)
-        self._entries[f.path] = {"sha": sha, "summary": summ.to_json()}
+        self._entries[key] = {"summary": summ.to_json()}
         return summ
 
     def save(self) -> None:

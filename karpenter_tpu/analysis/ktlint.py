@@ -225,16 +225,17 @@ def all_rules():
 
 
 def analyze_files(
-    files: Sequence[SourceFile], rules=None, cache=None,
+    files: Sequence[SourceFile], rules=None, cache=None, project=None,
 ) -> Tuple[List[Finding], List[Finding]]:
     """Run every rule over ``files``; returns ``(active, suppressed)``.
 
     Whole-program rules (``WHOLE_PROGRAM = True``) share ONE linked
     :class:`~karpenter_tpu.analysis.callgraph.Project`, built lazily and —
     when ``cache`` is a :class:`~karpenter_tpu.analysis.callgraph
-    .SummaryCache` — from content-hash-cached per-file summaries."""
+    .SummaryCache` — from content-hash-cached per-file summaries.  A
+    caller that already built a project for the same files (the
+    ``--lock-order`` driver path, tests) passes it in; no second walk."""
     raw: List[Finding] = []
-    project = None
     for rule in rules if rules is not None else all_rules():
         if getattr(rule, "WHOLE_PROGRAM", False):
             if project is None:
@@ -335,7 +336,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--lock-order", action="store_true",
                         help="print the KT012-derived global lock-"
                              "acquisition order and exit")
+    parser.add_argument("--model", action="store_true",
+                        help="model-check the delta-epoch and lease-"
+                             "failover protocols (bounded exhaustive "
+                             "exploration; exits 1 on violation)")
+    parser.add_argument("--max-states", type=int, default=500_000,
+                        help="state budget per model for --model")
+    parser.add_argument("--proto-golden", action="store_true",
+                        help="refresh the KT021 golden descriptor snapshot "
+                             "from the live solver.proto and exit")
     args = parser.parse_args(argv)
+
+    if args.proto_golden:
+        from .rules import kt021
+
+        out = kt021.write_golden()
+        print(f"wrote {out}")
+        return 0
+
+    if args.model:
+        from . import model
+
+        return model.main(fmt=args.format, max_states=args.max_states)
 
     rules = all_rules()
     if args.select:
@@ -356,15 +378,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     else:
         files = collect_package_files()
 
+    # ONE summary cache and ONE project build per invocation: the
+    # whole-program rules (KT012/KT013/KT014/KT022) and the --lock-order
+    # path all share it — explicit-path runs included, now that cache
+    # entries are keyed on (module, content-hash) rather than raw path.
+    from .callgraph import Project, SummaryCache
+
+    cache = SummaryCache.default()
+    project = None
+    if args.lock_order or any(getattr(r, "WHOLE_PROGRAM", False)
+                              for r in rules):
+        project = Project.build(files, cache=cache)
+
     if args.lock_order:
-        from .callgraph import SummaryCache
         from .rules import kt012
 
-        project = None
-        if not args.paths:
-            from .callgraph import Project
-
-            project = Project.build(files, cache=SummaryCache.default())
         graph = kt012.lock_graph(files, project)
         _nodes, edges, kinds = graph
         order = kt012.lock_order(files, project, graph=graph)
@@ -385,10 +413,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 print(f"  edge {s} -> {d}: {e.witness()}")
         return 0
 
-    from .callgraph import SummaryCache
-
-    cache = SummaryCache.default() if not args.paths else None
-    active, suppressed = analyze_files(files, rules=rules, cache=cache)
+    active, suppressed = analyze_files(files, rules=rules, cache=cache,
+                                       project=project)
     n_files = len(files)
 
     if args.format == "json":

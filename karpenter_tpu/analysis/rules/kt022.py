@@ -1,0 +1,133 @@
+"""KT022 — knob-inventory drift between code and the README knob table.
+
+The README's serving-knob table is the package's ONLY complete operator
+surface — deploy manifests, runbooks, and the chaos harness all copy env
+names out of it.  It drifts in both directions:
+
+- a PR adds a ``KT_*`` read and forgets the row: the knob exists, ships,
+  and nobody can discover it;
+- a PR renames or deletes a read and leaves the row: operators set an
+  env var the code no longer looks at, silently.
+
+The rule extracts every ``KT_*`` environment READ package-wide from the
+call-graph summaries (``FileSummary.env_reads`` — direct
+``environ.get``/``getenv``/``setdefault`` calls, Load-context
+subscripts, one-hop module-constant indirection, ``env``-named wrapper
+helpers, and f-string keys as ``KT_FOO_*`` wildcard patterns) and diffs
+the set against the README table's env column.  Matching is
+wildcard-aware in both directions (``fnmatch``): a documented
+``KT_ADMIT_*`` family row covers every per-class quota read, and a
+wildcard READ pattern is covered by any documented member.
+
+Whole-program: the extraction rides the same cached
+:class:`~karpenter_tpu.analysis.callgraph.Project` build every other
+interprocedural pass shares — no second AST walk.
+"""
+
+from __future__ import annotations
+
+from fnmatch import fnmatchcase
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from ..ktlint import Finding, package_root
+
+ID = "KT022"
+TITLE = "KT_* knob read/documentation drift against the README knob table"
+HINT = ("every KT_* env read needs a row in README.md's knob table (env "
+        "column; `KT_FOO_*` family rows cover dynamic keys), and every "
+        "documented knob needs a live read — delete stale rows when a "
+        "knob is removed")
+
+WHOLE_PROGRAM = True
+
+#: knobs the analysis toolchain itself reads — still documented, but a
+#: fixture run linting ONE file must not demand the whole package's reads
+_TABLE_HEADER_TOKEN = "env"
+
+
+def readme_knobs(text: str) -> List[Tuple[int, str]]:
+    """``(lineno, env_name)`` for every ``KT_*`` token in the env column
+    of the README's knob table (first markdown table whose header names an
+    ``env`` column).  Compound cells (``KT_RPC_RETRIES /
+    KT_RPC_BACKOFF_MS``) yield one entry per token."""
+    out: List[Tuple[int, str]] = []
+    env_col: Optional[int] = None
+    for i, line in enumerate(text.splitlines(), 1):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            env_col = None
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if env_col is None:
+            heads = [c.strip("`* ").lower() for c in cells]
+            if _TABLE_HEADER_TOKEN in heads:
+                env_col = heads.index(_TABLE_HEADER_TOKEN)
+            continue
+        if all(set(c) <= {"-", ":", " "} for c in cells):
+            continue  # the |---|---| separator row
+        if env_col >= len(cells):
+            continue
+        for token in cells[env_col].replace("/", " ").split():
+            token = token.strip("`,")
+            if token.startswith("KT_"):
+                out.append((i, token))
+    return out
+
+
+def _covered(pattern: str, others) -> bool:
+    return any(fnmatchcase(pattern, o) or fnmatchcase(o, pattern)
+               for o in others)
+
+
+def check(files, project=None, readme: Optional[str] = None,
+          ) -> List[Finding]:
+    if project is None:
+        from ..callgraph import build_project
+
+        project = build_project(files)
+    reads: Dict[str, Tuple[str, int]] = {}  # pattern -> first site
+    for summ in project.summaries:
+        for lineno, pattern in summ.env_reads:
+            if pattern not in reads:
+                reads[pattern] = (summ.path, lineno)
+    # the documented-not-read direction needs the WHOLE package's read
+    # set: a fixture run over a handful of files (or one file with a
+    # stray env read) must not accuse every documented knob of being
+    # dead.  Explicitly-passed readme text (the rule's own fixtures)
+    # always diffs both ways.
+    whole_package = readme is not None or len(files) > 20
+    if readme is None:
+        readme_path = package_root().parent / "README.md"
+        try:
+            readme = readme_path.read_text()
+        except OSError:
+            return []  # no README (vendored subset): nothing to diff
+    knobs = readme_knobs(readme)
+    documented = [k for _, k in knobs]
+    out: List[Finding] = []
+    for pattern in sorted(reads):
+        if not _covered(pattern, documented):
+            path, lineno = reads[pattern]
+            out.append(Finding(
+                ID, path, lineno,
+                f"`{pattern}` is read here but has no row in the README "
+                "knob table — the knob is undiscoverable",
+                hint=HINT,
+            ))
+    if not whole_package:
+        return out
+    read_patterns = list(reads)
+    seen = set()
+    for lineno, knob in knobs:
+        if knob in seen:
+            continue
+        seen.add(knob)
+        if not _covered(knob, read_patterns):
+            out.append(Finding(
+                ID, "README.md", lineno,
+                f"`{knob}` is documented in the knob table but no code "
+                "reads it — operators setting it change nothing",
+                hint=HINT,
+            ))
+    return out

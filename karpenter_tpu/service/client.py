@@ -970,6 +970,13 @@ class DeltaSession:
         # --- session wire state ---
         self._established = False
         self._epoch = 0
+        # chain-identity nonce, minted by the server at establishment and
+        # echoed on every delta: lets the server reject a delta whose
+        # base_epoch collides with a DIFFERENT chain lineage (spool
+        # rollback) instead of silently applying it.  "" until the first
+        # establishment — and forever against a pre-nonce server, which
+        # both sides treat as the legacy wildcard.
+        self._nonce = ""
         # --- unacked perturbation (cumulative since the last ack; kept
         # across typed sheds so nothing is lost, cleared on ack) ---
         self._pend_add: Dict[str, PodSpec] = {}
@@ -1093,6 +1100,7 @@ class DeltaSession:
             removed_pods=list(self._pend_rm),
             reclaimed_nodes=list(self._pend_reclaim),
             catalog_epoch=self._catalog_epoch,
+            session_nonce=self._nonce,
             # "s1" = the establishment hop's root (root span ids are "s1"
             # by construction): every delta hop attaches under the
             # journey's establishing hop in the /fleetz tree — including
@@ -1115,6 +1123,8 @@ class DeltaSession:
         # fleet-aware transport routes the next RPC to a sibling, which
         # adopts the chain and serves it warm (docs/RESILIENCE.md)
         self._epoch = reply.epoch
+        if reply.nonce:
+            self._nonce = reply.nonce
         if reply.full:
             self._apply_full(reply)
         else:
@@ -1254,6 +1264,9 @@ class DeltaSession:
                 "this automatically)")
         self._established = reply.state == "ok"
         self._epoch = reply.epoch
+        # the establishment reply carries the chain's fresh identity;
+        # a pre-nonce server leaves it "" (wildcard) and nothing changes
+        self._nonce = reply.nonce if self._established else ""
         self._apply_full(reply)
         self._clear_pending()
         self._last_ms = reply.solve_ms

@@ -153,6 +153,7 @@ def encode_request(
     catalog_epoch: int = 0,
     trace_id: str = "",
     parent_span: str = "",
+    session_nonce: str = "",
 ) -> pb.SolveRequest:
     # admission fields (docs/ADMISSION.md): "" / 0 are the backward-
     # compatible wire defaults — the server folds them into its configured
@@ -170,7 +171,8 @@ def encode_request(
                           delta=bool(delta),
                           catalog_epoch=int(catalog_epoch or 0),
                           trace_id=trace_id or "",
-                          parent_span=parent_span or "")
+                          parent_span=parent_span or "",
+                          session_nonce=session_nonce or "")
     req.removed_pods.extend(removed_pods)
     req.reclaimed_nodes.extend(reclaimed_nodes)
     req.pods.extend(encode_pod(p) for p in pods)
@@ -371,6 +373,10 @@ def decode_delta_fields(req: pb.SolveRequest) -> Optional[dict]:
         removed=list(getattr(req, "removed_pods", ())),
         reclaimed=list(getattr(req, "reclaimed_nodes", ())),
         catalog_epoch=int(getattr(req, "catalog_epoch", 0)),
+        # chain-identity nonce (ISSUE 17 divergence fix): "" from an old
+        # client is the legacy wildcard — the server's nonce check only
+        # fires when BOTH sides carry one
+        nonce=str(getattr(req, "session_nonce", "") or ""),
     )
 
 
@@ -383,6 +389,7 @@ def encode_delta_reply(reply) -> pb.SolveResponse:
         session_epoch=int(reply.epoch),
         session_state=reply.state,
         delta_mode=reply.mode,
+        session_nonce=getattr(reply, "nonce", "") or "",
     )
     for n in reply.nodes:
         out.nodes.append(pb.NewNode(
@@ -430,6 +437,7 @@ def decode_delta_reply(resp: pb.SolveResponse):
         nodes=nodes,
         removed_nodes=list(getattr(resp, "removed_nodes", ())),
         solve_ms=resp.solve_ms,
+        nonce=str(getattr(resp, "session_nonce", "") or ""),
     )
 
 

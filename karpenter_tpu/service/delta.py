@@ -100,6 +100,7 @@ from ..metrics import (
     Registry,
     registry as default_registry,
 )
+from ..obs import protocol
 from ..solver.types import SimNode, SolveResult, advance_node_counter
 from ..utils.clock import Clock
 from . import snapshot as snap
@@ -160,6 +161,16 @@ class SessionEntry:
     #: diagnosable without grepping the spool
     adopt_how: str = ""
     adopted_from: str = ""
+    #: per-incarnation identity, minted at establishment and persisted
+    #: with the record (ISSUE 17).  The epoch exact-match check alone
+    #: cannot survive a cross-replica re-home: a fresh table's epoch
+    #: floor never saw this session's history, so a rolled-back old-
+    #: incarnation record can collide with the new chain's acked epoch
+    #: and pass the check — the nonce pins WHICH incarnation an epoch
+    #: belongs to.  Empty = legacy (pre-nonce client/record): wildcard,
+    #: PR-10 semantics, so mixed-version fleets degrade instead of
+    #: hard-failing.
+    nonce: str = ""
 
 
 @dataclass
@@ -183,6 +194,9 @@ class DeltaReply:
     nodes: List[SimNode] = field(default_factory=list)
     removed_nodes: List[str] = field(default_factory=list)
     solve_ms: float = 0.0
+    #: the session incarnation's nonce, echoed to the client on every
+    #: reply so it can present it with the next step (empty = legacy)
+    nonce: str = ""
 
 
 class DeltaSessionTable:
@@ -298,6 +312,8 @@ class DeltaSessionTable:
                 if now - e.last_used > self.ttl_s]
         for sid in dead:
             self._note_epoch_locked(self._sessions[sid].epoch)
+            if protocol._SINK is not None:
+                protocol.emit(sid, "evict:ttl", replica=self.replica)
             del self._sessions[sid]
         if dead:
             self.registry.counter(DELTA_EVICTIONS).inc(
@@ -345,13 +361,19 @@ class DeltaSessionTable:
             self._sessions.move_to_end(entry.session_id)
             evicted = 0
             while len(self._sessions) > self.capacity:
-                _sid, old = self._sessions.popitem(last=False)
+                sid, old = self._sessions.popitem(last=False)
                 self._note_epoch_locked(old.epoch)
+                if protocol._SINK is not None:
+                    protocol.emit(sid, "evict:capacity",
+                                  replica=self.replica)
                 evicted += 1
             if evicted:
                 self.registry.counter(DELTA_EVICTIONS).inc(
                     {"reason": "capacity"}, value=float(evicted))
             self._gauge_locked()
+        if protocol._SINK is not None:
+            protocol.emit(entry.session_id, "establish",
+                          replica=self.replica, epoch=entry.epoch)
 
     def drop(self, session_id: str, reason: str = "error") -> None:
         """Evict one session.  The error path: a delta step that raised
@@ -362,9 +384,16 @@ class DeltaSessionTable:
         error-evicted session's spool RECORD dies with it: the last
         committed epoch on disk is clean, but a poisoned chain's client
         must re-establish from ground truth, not re-adopt and re-apply
-        onto state the server already failed to advance once.  A
-        ``lease_lost`` drop touches NO spool state — the record and lease
-        belong to the new owner now."""
+        onto state the server already failed to advance once — but ONLY
+        when the record is actually OURS.  The lease is re-read under
+        the spool lock first (ISSUE 17, pinned by the lease model's
+        ``record-owner-safety`` invariant): a zombie whose lease was
+        stolen while it was wedged may still be mid-step when the step
+        fails, and unconditionally removing the record here would
+        destroy the ADOPTER's durability — the one file that makes the
+        real owner's chain survive ITS next crash.  A ``lease_lost``
+        drop touches NO spool state — the record and lease belong to
+        the new owner now."""
         with self._lock:
             gone = self._sessions.pop(session_id, None)
             if gone is not None:
@@ -375,8 +404,24 @@ class DeltaSessionTable:
             self._leases_gauge_locked()
             self._gauge_locked()
         if gone is not None and reason == "error" and self.spool_dir:
-            snap.remove_record(self.spool_dir, session_id)
-            snap.release_lease(self.spool_dir, session_id, self.replica)
+            # ownership re-check + removal are one _spool_lock section so
+            # they cannot interleave with a concurrent adoption
+            with self._spool_lock:
+                try:
+                    lease = snap.lease_state(self.spool_dir, session_id)
+                # ktlint: allow[KT005] an unreadable lease file defaults
+                # to NOT ours — keeping a stale record costs one refused
+                # adoption; removing an adopter's record loses a chain
+                except Exception:  # noqa: BLE001
+                    lease = {"owner": ""}
+                owner = str((lease or {}).get("owner", "") or "")
+                if owner == self.replica:
+                    snap.remove_record(self.spool_dir, session_id)
+                    snap.release_lease(self.spool_dir, session_id,
+                                       self.replica)
+        if gone is not None and protocol._SINK is not None:
+            protocol.emit(session_id, "drop:" + reason,
+                          replica=self.replica, epoch=gone.epoch)
 
     def clear(self, reason: str = "stop") -> None:
         """Evict everything.  The graceful-shutdown path (``stop``) also
@@ -387,6 +432,7 @@ class DeltaSessionTable:
         not."""
         with self._lock:
             n = len(self._sessions)
+            cleared = list(self._sessions)
             for e in self._sessions.values():
                 self._note_epoch_locked(e.epoch)
             self._sessions.clear()
@@ -401,6 +447,10 @@ class DeltaSessionTable:
         if reason == "stop" and self.spool_dir:
             for sid in owned:
                 snap.release_lease(self.spool_dir, sid, self.replica)
+        if protocol._SINK is not None:
+            for sid in cleared:
+                protocol.emit(sid, "clear:" + reason,
+                              replica=self.replica)
 
     # ---- durability + fleet handoff (ISSUE 12/13, docs/RESILIENCE.md) ----
     def snapshot(self, dir_path: Optional[str] = None) -> dict:
@@ -462,6 +512,7 @@ class DeltaSessionTable:
         skipped = self.registry.counter(SNAPSHOT_SKIPPED)
         writes = self.registry.counter(SNAPSHOT_WRITES)
         written, n_skipped, errored = 0, 0, False
+        lease_lost: list = []
         for e in live:
             if e.in_step:
                 n_skipped += 1
@@ -476,7 +527,8 @@ class DeltaSessionTable:
                     provisioners=list(e.provisioners),
                     instance_types=list(e.instance_types),
                     daemonsets=list(e.daemonsets),
-                    unavailable=set(e.unavailable)))
+                    unavailable=set(e.unavailable),
+                    nonce=str(e.nonce)))
             # ktlint: allow[KT005] a chain mutating under the pickler can
             # raise anything; the entry is discarded as torn and counted
             except Exception:  # noqa: BLE001
@@ -509,11 +561,14 @@ class DeltaSessionTable:
                 except snap.LeaseHeld:
                     # stolen after our lease expired (a wedged interval,
                     # a paused container): the session belongs to its
-                    # adopter now — drop it, write NOTHING over their
-                    # record
+                    # adopter now — write NOTHING over their record.
+                    # The drop itself is deferred to after the locked
+                    # section: drop("lease_lost") touches no spool state,
+                    # and _spool_lock must stay single-acquisition
+                    # (KT012) — drop("error") re-acquires it
                     n_skipped += 1
                     skipped.inc({"reason": "lease_lost"})
-                    self.drop(e.session_id, "lease_lost")
+                    lease_lost.append(e.session_id)
                     continue
                 except OSError:
                     # a wedged lease MUTEX (a claimant died inside the
@@ -550,6 +605,11 @@ class DeltaSessionTable:
                 with self._lock:
                     self._owned.add(e.session_id)
                     self._leases_gauge_locked()
+                if protocol._SINK is not None:
+                    protocol.emit(e.session_id, "spool",
+                                  replica=self.replica, epoch=epoch0)
+        for sid in lease_lost:
+            self.drop(sid, "lease_lost")
         # sweep: owned records whose sessions are GONE (ttl/capacity/
         # wipe-evicted between passes) must not outlive them — a stale
         # record resurrected later is the divergence class restore-once
@@ -618,6 +678,8 @@ class DeltaSessionTable:
                 snap.remove_record(dir_path, sid)
                 snap.release_lease(dir_path, sid,
                                    str((lease or {}).get("owner", "")))
+            if protocol._SINK is not None:
+                protocol.emit(sid, "reap", replica=self.replica)
             self.registry.counter(DELTA_EVICTIONS).inc({"reason": "ttl"})
             logger.info("reaped orphaned session record %s (idle %.0fs)",
                         sid, age)
@@ -730,6 +792,9 @@ class DeltaSessionTable:
             logger.info("session %s not adopted: lease held by %s",
                         session_id, held.owner)
             _count("lease_held")
+            if protocol._SINK is not None:
+                protocol.emit(session_id, "adopt_refused",
+                              replica=self.replica, owner=held.owner)
             return None
         except OSError:
             # wedged lease mutex: typed cold outcome (the client pays
@@ -781,6 +846,8 @@ class DeltaSessionTable:
                 adopt_how="stolen" if how == "stolen" else "adopted",
                 adopted_from=(prior_owner
                               if prior_owner != self.replica else ""),
+                # legacy (pre-nonce) records adopt with the wildcard
+                nonce=str(d.get("nonce", "") or ""),
             )
             with self._lock:
                 entry.last_used = now + self._skew
@@ -809,6 +876,12 @@ class DeltaSessionTable:
             # re-creates it at the next committed epoch
             snap.remove_record(dir_path, session_id)
             _count("stolen" if how == "stolen" else "adopted")
+            if protocol._SINK is not None:
+                protocol.emit(
+                    session_id,
+                    "steal" if how == "stolen" else "adopt",
+                    replica=self.replica, epoch=entry.epoch,
+                    adopted_from=entry.adopted_from)
             return entry
         except snap.SnapshotRefused as err:
             logger.warning("session record %s refused; serving cold: %s",
@@ -848,6 +921,7 @@ class DeltaSessionTable:
         dir_path = dir_path or self.spool_dir
         if not dir_path:
             return False
+        lost = False
         with self._spool_lock:
             with self._lock:
                 e = self._sessions.get(session_id)
@@ -860,8 +934,10 @@ class DeltaSessionTable:
                     provisioners=list(e.provisioners),
                     instance_types=list(e.instance_types),
                     daemonsets=list(e.daemonsets),
-                    unavailable=set(e.unavailable))
+                    unavailable=set(e.unavailable),
+                    nonce=str(e.nonce))
                 catalog_epoch = int(e.catalog_epoch)
+                epoch0 = int(e.epoch)
             try:
                 snap.claim_lease(dir_path, session_id, self.replica,
                                  self.clock.now(), self.lease_s)
@@ -871,11 +947,11 @@ class DeltaSessionTable:
                 snap.write_record(dir_path, session_id, rec)
             except snap.LeaseHeld:
                 # a sibling already owns it (stolen while we were
-                # wedged): drop without touching their spool state
-                self.drop(session_id, "lease_lost")
-                faults_mod.count_recovery(self.registry, "snapshot_write",
-                                          "skipped")
-                return False
+                # wedged): drop without touching their spool state.  The
+                # drop runs AFTER the locked section (below): _spool_lock
+                # must stay single-acquisition (KT012) and drop("error")
+                # re-takes it
+                lost = True
             # ktlint: allow[KT005] a failing handoff write degrades to the
             # stop()-path snapshot (the session stays until shutdown);
             # counted so a drain that cannot spool is visible
@@ -886,17 +962,25 @@ class DeltaSessionTable:
                 faults_mod.count_recovery(self.registry, "snapshot_write",
                                           "failed")
                 return False
-            snap.release_lease(dir_path, session_id, self.replica)
-            with self._lock:
-                gone = self._sessions.pop(session_id, None)
-                if gone is not None:
-                    self._note_epoch_locked(gone.epoch)
-                    self.registry.counter(DELTA_EVICTIONS).inc(
-                        {"reason": "drain"})
-                self._owned.discard(session_id)
-                self._leases_gauge_locked()
-                self._gauge_locked()
-            return True
+            if not lost:
+                snap.release_lease(dir_path, session_id, self.replica)
+                with self._lock:
+                    gone = self._sessions.pop(session_id, None)
+                    if gone is not None:
+                        self._note_epoch_locked(gone.epoch)
+                        self.registry.counter(DELTA_EVICTIONS).inc(
+                            {"reason": "drain"})
+                    self._owned.discard(session_id)
+                    self._leases_gauge_locked()
+                    self._gauge_locked()
+                if protocol._SINK is not None:
+                    protocol.emit(session_id, "handoff",
+                                  replica=self.replica, epoch=epoch0)
+                return True
+        self.drop(session_id, "lease_lost")
+        faults_mod.count_recovery(self.registry, "snapshot_write",
+                                  "skipped")
+        return False
 
     def own(self, session_id: str,
             dir_path: Optional[str] = None) -> None:
@@ -925,6 +1009,8 @@ class DeltaSessionTable:
             with self._lock:
                 self._owned.add(session_id)
                 self._leases_gauge_locked()
+        if protocol._SINK is not None:
+            protocol.emit(session_id, "claim", replica=self.replica)
 
     def leases_owned(self) -> int:
         with self._lock:

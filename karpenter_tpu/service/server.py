@@ -16,6 +16,7 @@ import queue
 import signal
 import threading
 import time
+import uuid
 from concurrent import futures
 from concurrent.futures import Future
 from typing import Optional
@@ -46,7 +47,7 @@ from ..metrics import (
     Registry,
     registry as default_registry,
 )
-from ..obs import tracer_for
+from ..obs import protocol, tracer_for
 from ..obs.trace import NULL_TRACE, Tracer
 from ..parallel.forward import ResultForwarder, SlotNotOwned
 from ..solver.guard import DeviceHang
@@ -905,6 +906,9 @@ class SolvePipeline:
                 # establishing) sessions — the DRAINING hint sends the
                 # client to a sibling, which establishes there instead of
                 # binding a chain to a pod about to die
+                if protocol._SINK is not None:
+                    protocol.emit(sid, "drain_refused",
+                                  replica=tab.replica)
                 return _counted(DeltaReply(state="draining", full=False),
                                 "drain_refused")
             # establish (or re-establish): ONE classic full solve, and the
@@ -929,12 +933,21 @@ class SolvePipeline:
             # an exact-match epoch check against stale state is the one
             # silent-divergence path the protocol must close
             epoch0 = tab.next_epoch()
+            # chain-identity nonce (model-checker divergence fix, ISSUE
+            # 17): the epoch floor alone cannot protect against a spool
+            # ROLLBACK restoring an old incarnation's record — its epoch
+            # can collide with the new chain's acked epoch and the exact-
+            # match check would silently apply a delta across lineages.
+            # A per-establishment nonce makes chain identity explicit;
+            # "" (old clients, legacy spool records) stays a wildcard.
+            nonce0 = uuid.uuid4().hex[:16]
             tab.put(SessionEntry(
                 session_id=sid, prev=result, epoch=epoch0,
                 catalog_epoch=info["catalog_epoch"],
                 provisioners=provisioners, instance_types=instance_types,
                 daemonsets=kwargs.get("daemonsets") or (),
                 unavailable=set(kwargs.get("unavailable") or ()),
+                nonce=nonce0,
             ))
             if self._spool_dir:
                 # take spool ownership NOW (force-claim): the client's
@@ -949,8 +962,9 @@ class SolvePipeline:
                 trace.record("session_claim", t0c, trace.now(),
                              session_id=sid, replica_id=tab.replica,
                              epoch=epoch0)
-            return _counted(_full_reply(result, epoch0, "establish"),
-                            "establish")
+            reply = _full_reply(result, epoch0, "establish")
+            reply.nonce = nonce0
+            return _counted(reply, "establish")
         # ---- incremental step -------------------------------------------
         entry = tab.get(sid) if tab is not None else None
         if entry is None and tab is not None and self._spool_dir:
@@ -974,10 +988,24 @@ class SolvePipeline:
                     t0a, trace.now(), session_id=sid,
                     replica_id=tab.replica, epoch=entry.epoch,
                     adopted_from=entry.adopted_from)
-        if entry is None or entry.epoch != info["base_epoch"]:
+        nonce_mismatch = (entry is not None and entry.nonce
+                          and info.get("nonce")
+                          and entry.nonce != info["nonce"])
+        if entry is None or entry.epoch != info["base_epoch"] \
+                or nonce_mismatch:
             # evicted / never established / epoch mismatch after a lost
             # response: the only safe answer is "re-establish" — applying
-            # a delta onto the wrong base would silently diverge
+            # a delta onto the wrong base would silently diverge.  The
+            # nonce arm closes the cross-lineage collision the model
+            # checker found: a rolled-back old-incarnation record can
+            # re-reach the very epoch this client acked, and the epoch
+            # check alone would pass; matching chain IDENTITY (not just
+            # position) makes the collision typed instead of silent.
+            if protocol._SINK is not None:
+                protocol.emit(sid, "serve_unknown", replica=tab.replica,
+                              why=("nonce" if nonce_mismatch else
+                                   "epoch" if entry is not None
+                                   else "missing"))
             return _counted(DeltaReply(state="unknown", full=False),
                             "session_unknown")
         reseed = info["catalog_epoch"] != entry.catalog_epoch
@@ -985,12 +1013,19 @@ class SolvePipeline:
             # the catalog/price epoch moved and the new catalog is not
             # on the wire: every price the chain packed against is
             # stale, and there is nothing to re-pack with
+            if protocol._SINK is not None:
+                protocol.emit(sid, "serve_unknown", replica=tab.replica,
+                              why="catalog")
             return _counted(DeltaReply(state="unknown", full=False),
                             "session_unknown")
         try:
             reply, outcome = self._apply_delta_step(
                 entry, info, pods, provisioners, instance_types,
                 kwargs, reseed, trace, _counted)
+            # every incremental reply echoes the chain's identity nonce
+            # so the client keeps sending the right one across reseeds
+            # and guard-trip fallbacks (the chain object is the same)
+            reply.nonce = entry.nonce
             if self._draining and reply.state == "ok":
                 # drain handshake: the step was served (warm, committed),
                 # its chain is handed off to the shared spool (record at
@@ -1084,6 +1119,12 @@ class SolvePipeline:
             self._faults.fire("delta_commit")
         entry.epoch += 1
         entry.in_step = False
+        if protocol._SINK is not None:
+            # the COMMIT transition: the step is applied, the epoch is
+            # acked — the event conformance checks against the model
+            protocol.emit(entry.session_id, "commit",
+                          replica=self._delta_tab.replica,
+                          epoch=entry.epoch)
         if reseed:
             return _counted(
                 _full_reply(outcome.result, entry.epoch, "reseed"), "reseed")

@@ -559,6 +559,8 @@ def run_fleet(replicas=3, clients=6, pods_n=1200, pre_steps=3, post_steps=3,
         SolverDraining,
     )
     from karpenter_tpu.service import snapshot as snap
+    from karpenter_tpu.analysis import conformance
+    from karpenter_tpu.obs import protocol
 
     assert mode in ("kill", "drain", "kill-cold", "contend", "stale"), mode
     spooled = mode != "kill-cold"
@@ -573,6 +575,13 @@ def run_fleet(replicas=3, clients=6, pods_n=1200, pre_steps=3, post_steps=3,
     typed = {k: 0 for k in
              TYPED_ERRORS_DOC + ("SolverDraining", "LeaseHeld")}
     sessions = []
+    # conformance tap (ISSUE 17): every replica is in-process, so one
+    # process-global recorder sees the whole fleet's protocol
+    # transitions; the checker asserts each session's observed sequence
+    # is a path of the model-checked automaton
+    rec = protocol.TransitionRecorder()
+    prev_sink = protocol.installed()
+    protocol.install(rec)
     try:
         rng = random.Random(seed)
         per = max(20, pods_n // clients)
@@ -755,6 +764,7 @@ def run_fleet(replicas=3, clients=6, pods_n=1200, pre_steps=3, post_steps=3,
                 if v:
                     key = dict(lk).get("outcome", "")
                     adoptions[key] = adoptions.get(key, 0) + int(v)
+        report = conformance.check_events(rec.events_by_session())
         board = {
             "mode": mode, "seed": seed, "replicas": replicas,
             "clients": clients, "pods": per * clients,
@@ -764,12 +774,16 @@ def run_fleet(replicas=3, clients=6, pods_n=1200, pre_steps=3, post_steps=3,
             "post_steps_served": post_ok,
             "typed_errors": {k: v for k, v in typed.items() if v},
             "adoptions": adoptions,
+            "conformance": {"sessions": report.sessions,
+                            "events": report.events,
+                            "violations": len(report.violations)},
         }
         if verbose:
             print(f"fleet {mode} run clean:")
             for key, val in board.items():
                 print(f"  {key}: {val}")
         if strict:
+            assert report.ok, report.format()
             if mode in ("kill", "drain"):
                 assert extra == 0, (
                     f"{extra} re-establishing solve(s) on the warm "
@@ -798,6 +812,7 @@ def run_fleet(replicas=3, clients=6, pods_n=1200, pre_steps=3, post_steps=3,
                     "re-establish each, never serve the stale chain")
         return board
     finally:
+        protocol.install(prev_sink)
         for rep in reps + [oracle]:
             try:
                 rep["srv"].stop(grace=None)

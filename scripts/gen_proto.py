@@ -50,6 +50,13 @@ NEW_FIELDS = {
         # compatible by construction.
         (19, "trace_id", F.TYPE_STRING, F.LABEL_OPTIONAL),
         (20, "parent_span", F.TYPE_STRING, F.LABEL_OPTIONAL),
+        # chain-identity nonce (ISSUE 17): minted by the server at
+        # establishment, echoed by the client on every delta, so an
+        # epoch collision across chain LINEAGES (spool rollback) is a
+        # typed SESSION_UNKNOWN instead of a silent divergence.  "" on
+        # either side is the legacy wildcard — mixed-version fleets
+        # simply keep today's epoch-only check.
+        (21, "session_nonce", F.TYPE_STRING, F.LABEL_OPTIONAL),
     ],
     # session ack + delta-shaped responses: `assignments`/`nodes` carry only
     # the step's changes when `delta_mode` is an incremental tier;
@@ -63,6 +70,8 @@ NEW_FIELDS = {
         # failover-aware clients stamp it on their "remote" span so a
         # re-routed hop's serving replica is visible from the client side
         (9, "replica_id", F.TYPE_STRING, F.LABEL_OPTIONAL),
+        # chain-identity nonce echo (ISSUE 17, see SolveRequest 21)
+        (10, "session_nonce", F.TYPE_STRING, F.LABEL_OPTIONAL),
     ],
 }
 

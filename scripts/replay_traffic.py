@@ -108,16 +108,35 @@ def main(argv=None) -> int:
         srv, _ = make_server(service, host=target)
     try:
         rp = replay.Replayer(target)
-        report = rp.run(records, speedup=args.speedup)
+        if service is not None:
+            # in-process replica: tap its protocol transitions for the
+            # duration of the replay and conformance-check every
+            # session's observed sequence against the model automaton
+            # (ISSUE 17).  A remote --target's server-side events are
+            # not visible from this process.
+            from karpenter_tpu.analysis import conformance
+            from karpenter_tpu.obs import protocol
+
+            with protocol.recording() as rec:
+                report = rp.run(records, speedup=args.speedup)
+            conf = conformance.check_events(rec.events_by_session())
+            conf_json = conf.to_json()
+        else:
+            report = rp.run(records, speedup=args.speedup)
+            conf, conf_json = None, None
         fid = replay.fidelity(records, report)
         print(json.dumps({
             "capture": {"path": args.replay,
                         "source": header.get("source", "")},
             "target": target, "speedup": args.speedup,
             "outcomes": report["outcomes"],
+            **({"conformance": conf_json} if conf_json is not None
+               else {}),
             **{k: v for k, v in fid.items()},
         }, default=str))
-        return 0 if fid["class_mix_match"] and not fid["errors"] else 1
+        ok = fid["class_mix_match"] and not fid["errors"] \
+            and (conf is None or conf.ok)
+        return 0 if ok else 1
     finally:
         if srv is not None:
             srv.stop(grace=None)

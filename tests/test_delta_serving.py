@@ -215,6 +215,77 @@ class TestEpochAndSessionLoss:
         sess.close()
 
 
+class TestNonceIncarnationGuard:
+    """Wire-level regression fixtures for the first real divergence the
+    ISSUE-17 model checker found: a spool ROLLBACK can restore an old
+    incarnation's record whose epoch re-reaches the very epoch the live
+    client acked, and the exact-match epoch check alone would silently
+    apply a delta across chain lineages.  The fix is a per-establishment
+    chain-identity nonce ('' = legacy wildcard for mixed versions)."""
+
+    def test_nonce_round_trips_establishment_and_deltas(self, server,
+                                                        small_catalog):
+        service, port, _reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(_pods("p", 12), [prov], small_catalog)
+        entry = _entry(service, sess.session_id)
+        assert len(entry.nonce) == 16
+        int(entry.nonce, 16)  # hex, i.e. actually minted, not a default
+        assert sess._nonce == entry.nonce
+        # incremental replies keep echoing the SAME chain identity
+        sess.solve_delta(added=_pods("x", 2))
+        assert sess._nonce == entry.nonce
+        assert _entry(service, sess.session_id).nonce == entry.nonce
+        sess.close()
+
+    def test_colliding_epoch_foreign_nonce_is_typed_not_silent(
+            self, server, small_catalog):
+        """Same epoch, different lineage — the pre-nonce protocol's
+        silent-divergence path.  The server must answer 'unknown'
+        (why=nonce), costing exactly ONE transparent re-establish with
+        parity intact, never a delta applied across lineages."""
+        service, port, reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(_pods("p", 20), [prov], small_catalog)
+        # simulate the rollback: the table's record is a different
+        # incarnation that happens to sit at the client's acked epoch
+        _entry(service, sess.session_id).nonce = "f" * 16
+        fr = sess.full_resends
+        res = sess.solve_delta(added=_pods("x", 3), removed=["p-0"])
+        assert sess.full_resends == fr + 1      # exactly one
+        assert reg.counter(DELTA_RPC).get(
+            {"outcome": "session_unknown"}) == 1
+        entry2 = _entry(service, sess.session_id)
+        assert sess.established and sess.epoch == entry2.epoch
+        assert sess._nonce == entry2.nonce != "f" * 16  # fresh lineage
+        assert entry2.prev.assignments == res.assignments
+        assert all(f"x-{i}" in res.assignments for i in range(3))
+        assert "p-0" not in res.assignments
+        sess.close()
+
+    def test_legacy_empty_nonce_stays_a_wildcard(self, server,
+                                                 small_catalog):
+        """Mixed-version compatibility: a pre-nonce client (empty nonce
+        on the wire) and a pre-nonce spool record (empty nonce in the
+        entry) must both keep serving deltas — the guard only fires when
+        BOTH sides carry a nonce and they differ."""
+        service, port, reg = server
+        prov = Provisioner(name="default").with_defaults()
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(_pods("p", 12), [prov], small_catalog)
+        sess._nonce = ""                        # pre-nonce client
+        sess.solve_delta(added=_pods("x", 1))
+        _entry(service, sess.session_id).nonce = ""  # legacy record
+        sess._nonce = ""
+        sess.solve_delta(added=_pods("y", 1))
+        assert reg.counter(DELTA_RPC).get({"outcome": "delta"}) == 2
+        assert reg.counter(DELTA_RPC).get(
+            {"outcome": "session_unknown"}) == 0
+        sess.close()
+
+
 class TestTTLAndBounds:
     def test_ttl_eviction_under_sanitizer(self, small_catalog):
         """TTL eviction on a FakeClock with the KT_SANITIZE lock watcher

@@ -269,6 +269,32 @@ class TestAdoption:
         assert len(a) == 0  # the chain is gone from the zombie
         assert snap.read_record(str(tmp_path), "s1") == rec_before
 
+    def test_error_drop_never_removes_adopters_record(self, tmp_path):
+        """Regression fixture for the divergence the ISSUE-17 model
+        checker found (the lease model's `record-owner-safety`
+        invariant): a zombie replica whose lease was stolen while it was
+        wedged mid-step fails that step and drops the chain with
+        reason="error" — the drop must re-read the lease under the spool
+        lock and leave the ADOPTER's record alone, because that record
+        is the one file that makes the real owner's chain survive ITS
+        next crash."""
+        clock, a, b = self._two_replicas()
+        a.put(_entry("s1", epoch=5))
+        a.snapshot(str(tmp_path))
+        clock.advance(11.0)
+        assert b.adopt(str(tmp_path), "s1") is not None  # stolen
+        b.snapshot(str(tmp_path))
+        rec = snap.read_record(str(tmp_path), "s1")
+        assert rec is not None
+        a.drop("s1", "error")  # the zombie's failing step
+        assert snap.read_record(str(tmp_path), "s1") == rec, \
+            "drop(error) from a superseded replica destroyed the " \
+            "adopter's record"
+        assert snap.lease_state(str(tmp_path), "s1")["owner"] == "rep-b"
+        # while the REAL owner's error drop does remove its own record
+        b.drop("s1", "error")
+        assert snap.read_record(str(tmp_path), "s1") is None
+
     def test_establishment_ownership_supersedes_foreign_lease(
             self, tmp_path):
         """DeltaSessionTable.own (the establish path): the client's

@@ -419,11 +419,14 @@ class TestMetricsAndKnobs:
 
     def test_module_import_is_jax_free(self):
         # scripts/profile_solve.py --hier depends on this: partition +
-        # scale model must import without a backend
+        # scale model must import without a backend.  KT_SANITIZE is
+        # stripped too: the sanitizer's install wraps the solver-path
+        # classes at package import (pulling jax by design), which says
+        # nothing about hierarchy's own imports
         import subprocess
         import sys
         env = {k: v for k, v in os.environ.items()
-               if k not in ("JAX_PLATFORMS",)}
+               if k not in ("JAX_PLATFORMS", "KT_SANITIZE")}
         code = ("import sys; import karpenter_tpu.solver.hierarchy; "
                 "sys.exit(1 if 'jax' in sys.modules else 0)")
         assert subprocess.run([sys.executable, "-c", code],
