@@ -2,7 +2,7 @@ PYTHON ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
 
-.PHONY: all test native proto bench clean battletest lint modelcheck obs-demo obs-fleet-demo overload-demo chaos chaos-fleet multihost-dryrun hier-demo
+.PHONY: all test native proto bench clean battletest lint modelcheck obs-demo obs-fleet-demo overload-demo slo-demo chaos chaos-fleet multihost-dryrun hier-demo
 
 all: native proto
 
@@ -22,12 +22,12 @@ test:
 	$(PYTHON) -m pytest tests/ -x -q
 
 # ktlint: the repo-specific AST analyzer (rule catalog in docs/ANALYSIS.md);
-# exits non-zero on any unsuppressed KT001-KT022 finding — includes the
+# exits non-zero on any unsuppressed KT001-KT023 finding — includes the
 # whole-program call-graph passes (KT012 lock-order deadlocks, KT013
 # interprocedural fence reachability, KT014 compile-surface audit) and the
 # v3 gates (KT021 proto wire-compat vs the golden descriptor, KT022
 # KT_* knob/README drift);
-# tests/test_lint.py speed-gates the full run (<5s cold, <1s warm cache)
+# tests/test_lint.py speed-gates the full run (<5s cold, <1.5s warm cache)
 lint:
 	$(PYTHON) -m karpenter_tpu.analysis
 
@@ -74,6 +74,16 @@ obs-demo:
 # spanning the dead replica's establishment and the sibling's deltas)
 obs-fleet-demo:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/fleet_trace_demo.py
+
+# SLO burn-rate demo (docs/OBSERVABILITY.md SLO section, ISSUE 18): an
+# overdriven mixed-class replay against an in-process replica with
+# best_effort admission throttled to a trickle — best_effort sheds and
+# burns its availability budget to breach while critical rides its
+# reserved quota and stays green; prints the per-class /sloz verdict
+# table (multi-window burn rates, budget remaining) plus the occupancy
+# gauges, and exits non-zero if the split does not show
+slo-demo:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/slo_demo.py
 
 # admission demo (docs/ADMISSION.md): 4x closed-loop overdrive of mixed
 # critical/best_effort clients through the solve pipeline with tight

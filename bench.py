@@ -391,6 +391,11 @@ TENSORIZE_SHAPE_MAX_COLD_FRACTION = 0.75
 #: solve may be at most this much slower than sampling-OFF (ISSUE 3)
 TRACE_OVERHEAD_BUDGET_PCT = 2.0
 
+#: same contract for the time-series sampler + SLO recording (ISSUE 18):
+#: serving with the background sampler ticking and per-RPC SLO accounting
+#: live may be at most this much slower than with both off
+TS_OVERHEAD_BUDGET_PCT = 2.0
+
 #: megabatch gates (ISSUE 4): a coalescer that batches must BEAT serial
 #: dispatch under load, and a lone request must not pay for the machinery
 SINGLE_LATENCY_REGRESSION_MAX = 1.10
@@ -498,6 +503,13 @@ def check_budgets(rec):
         flags.append(
             f"trace overhead {ov:.2f}% exceeds the "
             f"{TRACE_OVERHEAD_BUDGET_PCT:.0f}% sampling-on budget")
+    # time-series sampler gate (ISSUE 18): same paired-median estimator,
+    # same 2% ceiling — telemetry must never become load
+    tso = rec.get("ts_overhead_pct")
+    if tso is not None and tso > TS_OVERHEAD_BUDGET_PCT:
+        flags.append(
+            f"time-series sampler overhead {tso:.2f}% exceeds the "
+            f"{TS_OVERHEAD_BUDGET_PCT:.0f}% sampler-on budget")
     # overload protection gates (ISSUE 5)
     ratio = rec.get("overload_critical_p99_ratio")
     if ratio is not None and ratio > OVERLOAD_CRITICAL_P99_MAX_RATIO:
@@ -847,6 +859,92 @@ def measure_trace_overhead(pairs: int = 11, solves: int = 2,
         # stall does not — confirm with a second independent measurement
         # and publish the smaller estimate
         pct2, off2, on2 = measure_trace_overhead(
+            pairs=pairs, solves=solves, confirm=False)
+        if pct2 < pct:
+            return pct2, off2, on2
+    return (pct,
+            round(statistics.median(offs) * 1000.0, 2),
+            round(statistics.median(ons) * 1000.0, 2))
+
+
+def measure_ts_overhead(pairs: int = 11, solves: int = 2,
+                        confirm: bool = True):
+    """Sampler-on vs sampler-off steady-state solve latency (ISSUE 18).
+
+    The trace-overhead estimator's twin: same oracle batch, GC parked,
+    alternating (off, on) pairs, per-pair relative deltas, median pair
+    published, confirm-on-breach rerun.  The 'on' arm runs what a
+    production replica actually pays per interval — a background
+    :class:`~karpenter_tpu.obs.timeseries.Sampler` OVERDRIVEN to tick
+    every 50ms (100x the 5s default, so even a short timing window
+    contains many ticks) plus per-solve SLO outcome recording — against
+    an arm with neither.  Tracing is held constant (off) across both
+    arms so the number isolates the sampler.  Returns
+    ``(overhead_pct, off_ms, on_ms)``.
+    """
+    from karpenter_tpu.metrics import Registry
+    from karpenter_tpu.models.catalog import generate_catalog
+    from karpenter_tpu.models.instancetype import GIB
+    from karpenter_tpu.models.pod import PodSpec
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.obs.slo import SloEngine
+    from karpenter_tpu.obs.timeseries import Sampler
+    from karpenter_tpu.obs.trace import Tracer
+    from karpenter_tpu.solver.scheduler import BatchScheduler
+
+    catalog = generate_catalog(full=False)
+    pods = [
+        PodSpec(name=f"t{d}-{i}", labels={"app": f"t{d}"},
+                requests={"cpu": 0.25 * (1 + d % 4),
+                          "memory": (0.5 + d % 3) * GIB},
+                owner_key=f"t{d}")
+        for d in range(8) for i in range(500)
+    ]
+    provs = [Provisioner(name="default").with_defaults()]
+    reg = Registry()
+    sched = BatchScheduler(backend="oracle", registry=reg,
+                           tracer=Tracer(enabled=False, registry=reg))
+    sampler = Sampler(reg, interval_s=0.05)
+    slo = SloEngine(reg, sampler=sampler)
+    sched.solve(pods, provs, catalog)  # warm caches/allocators
+
+    def timed(on: bool) -> float:
+        if on:
+            sampler.start()
+        try:
+            t0 = time.perf_counter()
+            for _ in range(solves):
+                r = sched.solve(pods, provs, catalog)
+                if on:
+                    slo.record("batch", "ok", solve_ms=r.solve_ms)
+            return (time.perf_counter() - t0) / solves
+        finally:
+            if on:
+                sampler.stop()
+
+    import gc
+    import statistics
+
+    deltas, offs, ons = [], [], []
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        for k in range(pairs):
+            gc.collect()
+            order = (False, True) if k % 2 == 0 else (True, False)
+            sample = {on: timed(on) for on in order}
+            offs.append(sample[False])
+            ons.append(sample[True])
+            deltas.append(
+                (sample[True] - sample[False]) / sample[False] * 100.0)
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+    pct = round(statistics.median(deltas), 2)
+    if confirm and pct > TS_OVERHEAD_BUDGET_PCT:
+        # breach hygiene (the trace gate's rule): a real regression
+        # reproduces, a host stall does not
+        pct2, off2, on2 = measure_ts_overhead(
             pairs=pairs, solves=solves, confirm=False)
         if pct2 < pct:
             return pct2, off2, on2
@@ -2463,6 +2561,7 @@ def run_bench():
 
     cold_ms, cold_nodes, cold_infeasible, cold_err = measure_coldstart()
     trace_overhead_pct, trace_off_ms, trace_on_ms = measure_trace_overhead()
+    ts_overhead_pct, ts_off_ms, ts_on_ms = measure_ts_overhead()
     throughput = measure_throughput()
     sharded = measure_sharded_throughput()
     overload = measure_overload()
@@ -2510,6 +2609,9 @@ def run_bench():
         "trace_overhead_pct": trace_overhead_pct,
         "trace_solve_off_ms": trace_off_ms,
         "trace_solve_on_ms": trace_on_ms,
+        "ts_overhead_pct": ts_overhead_pct,
+        "ts_solve_off_ms": ts_off_ms,
+        "ts_solve_on_ms": ts_on_ms,
         **throughput,
         **sharded,
         **overload,

@@ -1,0 +1,105 @@
+"""KT023 — metric family constructed on a Registry but missing from the
+metrics INVENTORY.
+
+``metrics.INVENTORY`` is the single source of truth for the metric
+surface: exposition emits ``# HELP``/``# TYPE`` from it, ``docs/METRICS.md``
+is generated from it (``karpenter-tpu metrics-doc --check`` gates drift),
+and the zero-init suite (tests/test_metrics_init.py) walks it.  A family
+constructed via ``registry.counter(...)`` / ``.gauge(...)`` /
+``.histogram(...)`` whose name never made it into the INVENTORY is
+invisible to all three — it scrapes without HELP text, misses the docs,
+and silently escapes the KT003 zero-init convention's runtime pin.  The
+ISSUE-18 SLO/time-series families tripled the construction sites, which
+is exactly when one slips through.
+
+Resolution is conservative: the argument must be a ``karpenter_``-prefixed
+string literal, a Name that resolves to one (module-level assignment in
+the scanned files, or a constant on ``karpenter_tpu.metrics``), or an
+``<mod>.CONST`` attribute resolving on the metrics module.  A dynamic
+name (loop variable over the INVENTORY itself, helper parameters) cannot
+be checked statically and is skipped, not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..ktlint import Finding, file_nodes
+
+ID = "KT023"
+TITLE = "metric family missing from the metrics INVENTORY"
+HINT = ("add the family to karpenter_tpu/metrics.py INVENTORY "
+        "(name -> (type, labels, help)) and regenerate docs/METRICS.md "
+        "with `karpenter-tpu metrics-doc` — exposition HELP text, the "
+        "generated docs, and the zero-init suite all walk the INVENTORY")
+
+_CTORS = ("counter", "gauge", "histogram")
+
+
+def _inventory() -> dict:
+    from ... import metrics
+
+    return metrics.INVENTORY
+
+
+def _module_constants() -> Dict[str, str]:
+    """Every ``karpenter_``-string constant on the real metrics module —
+    the names ``from ..metrics import X`` / ``metrics.X`` resolve to."""
+    from ... import metrics
+
+    out: Dict[str, str] = {}
+    for attr in dir(metrics):
+        if attr.startswith("_"):
+            continue
+        val = getattr(metrics, attr, None)
+        if isinstance(val, str) and val.startswith("karpenter_"):
+            out[attr] = val
+    return out
+
+
+def _resolve(arg: ast.AST, assigns: Dict[str, str],
+             mod_consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value if arg.value.startswith("karpenter_") else None
+    if isinstance(arg, ast.Name):
+        return assigns.get(arg.id) or mod_consts.get(arg.id)
+    if isinstance(arg, ast.Attribute):
+        # metrics.X / M.X — the attribute name is the constant's name
+        return mod_consts.get(arg.attr)
+    return None
+
+
+def check(files) -> List[Finding]:
+    inventory = _inventory()
+    mod_consts = _module_constants()
+    findings: List[Finding] = []
+    # module-level NAME = "karpenter_..." assigns across the scanned files
+    # (metrics.py itself plus any module declaring a local family name)
+    assigns: Dict[str, str] = {}
+    for f in files:
+        for n in file_nodes(f):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Constant) \
+                    and isinstance(n.value.value, str) \
+                    and n.value.value.startswith("karpenter_"):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        assigns[t.id] = n.value.value
+    for f in files:
+        for n in file_nodes(f):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _CTORS and n.args):
+                continue
+            name = _resolve(n.args[0], assigns, mod_consts)
+            if name is None or name in inventory:
+                continue
+            findings.append(Finding(
+                ID, f.path, n.lineno,
+                f"metric family `{name}` is constructed on a Registry "
+                "here but missing from metrics.INVENTORY — it will "
+                "scrape without HELP/TYPE, miss docs/METRICS.md, and "
+                "escape the zero-init suite",
+                hint=HINT,
+            ))
+    return findings

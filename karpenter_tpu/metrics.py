@@ -332,6 +332,41 @@ HIER_BLOCKS = "karpenter_solver_hier_blocks"
 HIER_PRICE_ITERATIONS = "karpenter_solver_hier_price_iterations"
 HIER_REPAIR_PODS = "karpenter_solver_hier_repair_pods"
 HIER_DURATION = "karpenter_solver_hier_duration_seconds"
+# ---- time-resolved telemetry (ISSUE 18: obs/timeseries.py sampler) ------
+TS_SAMPLES = "karpenter_ts_samples_total"
+TS_SERIES = "karpenter_ts_series"
+TS_SAMPLE_DURATION = "karpenter_ts_sample_duration_seconds"
+# ---- per-class SLOs (ISSUE 18: obs/slo.py burn-rate engine) -------------
+SLO_REQUESTS = "karpenter_slo_requests_total"
+#: per-request SLO accounting outcomes (KT003 zero-init source): 'ok'
+#: (served), 'shed' (typed admission shed / deadline — availability-bad
+#: by the objective's definition even though the protection worked),
+#: 'error' (unexpected server failure)
+SLO_REQUEST_OUTCOMES = ("ok", "shed", "error")
+#: the priority classes objectives are declared over — the admission
+#: vocabulary (admission.parse_class), shared so the SLO engine's label
+#: population can never drift from the admission queue's
+SLO_CLASSES = ("critical", "batch", "best_effort")
+SLO_LATENCY = "karpenter_slo_latency_seconds"
+SLO_BURN_RATE = "karpenter_slo_burn_rate"
+#: the declared objectives (label population for the burn/budget gauges)
+SLO_OBJECTIVES = ("availability", "latency")
+#: the burn-rate evaluation windows (labels; seconds in obs/slo.WINDOWS)
+SLO_WINDOW_NAMES = ("5m", "1h")
+SLO_BUDGET_REMAINING = "karpenter_slo_budget_remaining"
+SLO_VERDICT = "karpenter_slo_verdict"
+# ---- device-occupancy accounting (ISSUE 18: obs/occupancy.py) -----------
+OCCUPANCY_DEVICE_BUSY = "karpenter_occupancy_device_busy_share"
+OCCUPANCY_SLOT_FILL = "karpenter_occupancy_megabatch_slot_fill"
+OCCUPANCY_DELTA_INLINE = "karpenter_occupancy_delta_inline_fraction"
+# ---- /fleetz peer-fetch accounting (ISSUE 18 satellite) -----------------
+FLEET_PEER_FETCH = "karpenter_fleet_peer_fetch_total"
+#: per-peer /fleetz fan-out outcomes (KT003 zero-init source): 'ok'
+#: (both documents fetched and decoded), 'timeout' (the per-peer budget
+#: expired — a partitioned peer), 'error' (refused / bad JSON / HTTP
+#: failure).  Failed peers are marked stale in the merge, never dropped
+#: silently.
+FLEET_PEER_FETCH_OUTCOMES = ("ok", "timeout", "error")
 
 #: metric inventory: name -> (type, labels, help).  docs/METRICS.md is
 #: generated from this table (``karpenter-tpu metrics-doc``), mirroring the
@@ -793,6 +828,69 @@ INVENTORY = {
         "End-to-end hierarchical solve duration, seconds (partition + "
         "block waves + price loop + repair; excludes tensorize, reported "
         "separately like flat's solve_ms)."),
+    TS_SAMPLES: (
+        "counter", (),
+        "Registry snapshots taken by the time-series sampler "
+        "(obs/timeseries.py; one per KT_TS_INTERVAL_S tick)."),
+    TS_SERIES: (
+        "gauge", (),
+        "Distinct (family, label-set) series currently held in the "
+        "sampler's ring buffers (each bounded at KT_TS_CAPACITY points)."),
+    TS_SAMPLE_DURATION: (
+        "histogram", (),
+        "Wall time of one sampler tick (registry snapshot + occupancy "
+        "hooks), seconds — the sampler's own cost, gated <=2% of serving "
+        "by bench.py measure_ts_overhead."),
+    SLO_REQUESTS: (
+        "counter", ("class", "outcome"),
+        "Solve RPCs by priority class and SLO outcome: 'ok' served, "
+        "'shed' typed admission shed or deadline (availability-bad by "
+        "the objective even though the protection worked), 'error' "
+        "unexpected failure.  The availability objective's numerator/"
+        "denominator source."),
+    SLO_LATENCY: (
+        "histogram", ("class",),
+        "Served solve latency by priority class, seconds (solve_ms as "
+        "reported to the caller).  Windowed bucket deltas feed the "
+        "latency objective's p99-above-threshold burn rate."),
+    SLO_BURN_RATE: (
+        "gauge", ("class", "objective", "window"),
+        "Error-budget burn rate per class/objective/window: 1.0 burns "
+        "exactly the budget over the window; >= KT_SLO_FAST_BURN on a "
+        "short window pages (breach verdict).  Refreshed by each "
+        "SloEngine.evaluate() (/sloz)."),
+    SLO_BUDGET_REMAINING: (
+        "gauge", ("class", "objective"),
+        "Lifetime error budget remaining, 1.0 = untouched, <= 0 = "
+        "exhausted (breach).  budget = 1 - target."),
+    SLO_VERDICT: (
+        "gauge", ("class",),
+        "Per-class SLO verdict: -1 no_data, 0 ok, 1 warn (a window "
+        "burning faster than budget), 2 breach (budget exhausted or "
+        "fast-burn page)."),
+    OCCUPANCY_DEVICE_BUSY: (
+        "gauge", (),
+        "Share of wall time the device spent in dispatch/fence spans "
+        "over the last sampler interval (span-derived, scaled by the "
+        "tracer's sampling rate); ~1.0 = device-bound fleet, ~0 = "
+        "over-provisioned."),
+    OCCUPANCY_SLOT_FILL: (
+        "gauge", (),
+        "Mean occupied megabatch slots per dispatch over the last "
+        "sampler interval (windowed mean of "
+        "karpenter_solver_megabatch_slots); 0 when no megabatch was "
+        "dispatched in the window."),
+    OCCUPANCY_DELTA_INLINE: (
+        "gauge", (),
+        "Fraction of delta solves served inline on the RPC thread "
+        "(no dispatcher window span) over the last sampler interval — "
+        "high values mean the pipeline is idle enough that the delta "
+        "shortcut dominates."),
+    FLEET_PEER_FETCH: (
+        "counter", ("outcome",),
+        "Per-peer /fleetz fan-out fetches by outcome ('ok' / 'timeout' "
+        "/ 'error'); failed peers are marked stale in the merged view "
+        "instead of degrading the whole aggregation."),
 }
 
 

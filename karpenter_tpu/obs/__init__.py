@@ -10,6 +10,13 @@ Three pieces (docs/OBSERVABILITY.md has the operator-facing guide):
   trip, degraded solve, latency-budget breach, sanitizer error).
 - :mod:`.export` — ``/tracez`` + ``/statusz`` JSON documents, the sidecar
   observability HTTP server, and the terminal renderer.
+- :mod:`.timeseries` — the background registry sampler: bounded per-series
+  ring buffers answering windowed rate / percentile queries (off by
+  knob → falsy ``NULL_SAMPLER``).
+- :mod:`.slo` — per-priority-class objectives evaluated as multi-window
+  burn rates with error-budget accounting; the ``/sloz`` document.
+- :mod:`.occupancy` — device-busy share, megabatch slot occupancy and
+  delta inline fraction derived from the existing span stream.
 
 Process-default singletons mirror ``metrics.registry``: components accept
 an injected ``Tracer``; those constructed bare share :func:`default_tracer`
@@ -21,12 +28,17 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from .occupancy import OccupancyAccountant
 from .recorder import FlightRecorder
+from .slo import SloEngine, merge_sloz
+from .timeseries import NULL_SAMPLER, NullSampler, Sampler, sampler_for
 from .trace import NULL_SPAN, NULL_TRACE, Span, Trace, Tracer, replica_id
 
 __all__ = [
-    "FlightRecorder", "NULL_SPAN", "NULL_TRACE", "Span", "Trace", "Tracer",
-    "default_flight", "default_tracer", "replica_id", "tracer_for",
+    "FlightRecorder", "NULL_SAMPLER", "NULL_SPAN", "NULL_TRACE",
+    "NullSampler", "OccupancyAccountant", "Sampler", "SloEngine", "Span",
+    "Trace", "Tracer", "default_flight", "default_tracer", "merge_sloz",
+    "replica_id", "sampler_for", "tracer_for",
 ]
 
 # RLock: default_tracer() resolves default_flight() while holding it

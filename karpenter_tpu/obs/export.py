@@ -236,13 +236,20 @@ def serve(registry: Registry, flight: FlightRecorder, port: int = 0,
           host: str = "127.0.0.1",
           extra: Optional[Callable[[], dict]] = None,
           peers: Optional[list] = None,
+          sloz: Optional[Callable[[], dict]] = None,
           ) -> "tuple[ThreadingHTTPServer, int]":
     """Start the sidecar observability server: /tracez, /statusz,
-    /metrics, /fleetz.  ``extra`` extends /statusz (the serving layer's
-    session block); ``peers`` are sibling obs base URLs for the /fleetz
-    fan-out (default ``KT_OBS_PEERS``, comma-separated — include THIS
-    replica's own URL so the merged view is whole).  Returns
-    (server, bound_port); ``server.shutdown()`` stops it."""
+    /metrics, /fleetz, /sloz.  ``extra`` extends /statusz (the serving
+    layer's session block); ``peers`` are sibling obs base URLs for the
+    /fleetz fan-out (default ``KT_OBS_PEERS``, comma-separated — include
+    THIS replica's own URL so the merged view is whole); ``sloz`` is the
+    serving layer's SLO-document provider (SolverService.sloz — the
+    burn-rate evaluation), 404 when absent so old callers see exactly
+    the pre-SLO surface.  Returns (server, bound_port);
+    ``server.shutdown()`` stops it."""
+    from .fleet import zero_init as _fleet_zero_init
+
+    _fleet_zero_init(registry)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # silence
@@ -257,12 +264,18 @@ def serve(registry: Registry, flight: FlightRecorder, port: int = 0,
                 body = json.dumps(statusz(registry, flight, extra=extra),
                                   default=str).encode()
                 code = 200
+            elif self.path.startswith("/sloz"):
+                if sloz is None:
+                    body, code = b'{"error": "slo engine not wired"}', 404
+                else:
+                    body = json.dumps(sloz(), default=str).encode()
+                    code = 200
             elif self.path.startswith("/fleetz"):
                 from .fleet import env_peers, fleetz
 
                 body = json.dumps(
                     fleetz(peers if peers is not None else env_peers(),
-                           local=(registry, flight, extra)),
+                           local=(registry, flight, extra, sloz)),
                     default=str).encode()
                 code = 200
             elif self.path.startswith("/metrics"):

@@ -34,8 +34,10 @@ import os
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import metrics as M
 from .export import statusz, tracez
 from .recorder import _percentile
+from .slo import merge_sloz
 
 #: sibling obs endpoints for the /fleetz fan-out, comma-separated base
 #: URLs (include this replica's own URL on the OTHERS' lists; a replica
@@ -49,6 +51,15 @@ def env_peers() -> List[str]:
     return [p.strip().rstrip("/") for p in raw.split(",") if p.strip()]
 
 
+def zero_init(registry) -> None:
+    """Zero-init the peer-fetch outcome family (KT003) — called by
+    export.serve at sidecar startup, so the series exist before the
+    first /fleetz request fans out."""
+    c = registry.counter(M.FLEET_PEER_FETCH)
+    for outcome in M.FLEET_PEER_FETCH_OUTCOMES:
+        c.inc({"outcome": outcome}, 0.0)
+
+
 def _boxed(fn, *args):
     """(result, None) or (None, err) — pool workers must hand any
     per-peer failure back as data, never let one peer fail the map."""
@@ -58,6 +69,23 @@ def _boxed(fn, *args):
     # JSON) becomes an 'unreachable' row, never a failed /fleetz
     except Exception as err:  # noqa: BLE001
         return None, err
+
+
+def _fetch_outcome(err) -> str:
+    """Classify a per-peer fetch result for the accounting counter:
+    a timeout means a PARTITIONED peer (it cost the full per-peer
+    budget), anything else (refused / bad JSON / HTTP error) a dead or
+    broken one."""
+    if err is None:
+        return "ok"
+    if isinstance(err, TimeoutError):
+        return "timeout"
+    reason = getattr(err, "reason", None)
+    if isinstance(reason, TimeoutError):
+        return "timeout"
+    if "timed out" in str(err).lower():
+        return "timeout"
+    return "error"
 
 
 def _http_fetch(url: str, timeout: float = DEFAULT_TIMEOUT_S) -> dict:
@@ -194,13 +222,19 @@ def fleetz(peers: Optional[List[str]] = None,
     """Fan out to every peer's ``/statusz`` + ``/tracez`` and merge.
 
     ``local`` is the serving replica's own ``(registry, flight, extra)``
-    triple — its documents are built in memory (never a loopback HTTP
-    request into the very handler building this answer).  Peers whose
-    ``replica_id`` matches an already-merged replica are skipped, so
-    listing every replica (self included) in ``KT_OBS_PEERS`` uniformly
-    across the fleet double-counts nothing.  Unreachable peers land in
-    ``unreachable`` — a dead replica is exactly when the merged view
-    matters most, so a fetch failure must never fail the document."""
+    or ``(registry, flight, extra, sloz_fn)`` tuple — its documents are
+    built in memory (never a loopback HTTP request into the very handler
+    building this answer).  Peers whose ``replica_id`` matches an
+    already-merged replica are skipped, so listing every replica (self
+    included) in ``KT_OBS_PEERS`` uniformly across the fleet
+    double-counts nothing.  Unreachable peers land in ``unreachable``
+    (marked ``stale`` — their last-known numbers are simply absent from
+    the merge) and are counted per outcome into
+    ``karpenter_fleet_peer_fetch_total`` — a dead replica is exactly
+    when the merged view matters most, so a fetch failure must never
+    fail the document.  When any replica answers /sloz the merged doc
+    carries a fleet-wide ``slo`` block (burn rates recomputed from
+    summed numerators/denominators — obs/slo.merge_sloz)."""
     peers = list(peers or [])
     fetch = fetch or (lambda url: _http_fetch(url, timeout=timeout))
     replicas: Dict[str, dict] = {}
@@ -209,10 +243,20 @@ def fleetz(peers: Optional[List[str]] = None,
     conflicts: Dict[str, List[str]] = {}
     delta_total: Dict[str, float] = {}
     unreachable: List[dict] = []
+    slo_docs: List[dict] = []
+    local_registry = local[0] if local is not None else None
 
-    def _admit(rid: str, source: str, status: dict, traces: dict) -> None:
+    def _count_fetch(outcome: str) -> None:
+        if local_registry is not None:
+            local_registry.counter(M.FLEET_PEER_FETCH).inc(
+                {"outcome": outcome})
+
+    def _admit(rid: str, source: str, status: dict, traces: dict,
+               slo_doc: Optional[dict] = None) -> None:
         if rid in replicas:
             return  # self listed among the peers (the uniform config)
+        if isinstance(slo_doc, dict) and slo_doc.get("classes"):
+            slo_docs.append(slo_doc)
         replicas[rid] = {
             "source": source,
             "load": _load_of(status),
@@ -240,13 +284,21 @@ def fleetz(peers: Optional[List[str]] = None,
         hops[rid] = list(traces.get("traces") or ())
 
     if local is not None:
-        registry, flight, extra = local
+        registry, flight, extra = local[:3]
+        sloz_fn = local[3] if len(local) > 3 else None
         status = statusz(registry, flight, extra=extra)
+        local_slo, _ = (_boxed(sloz_fn) if sloz_fn is not None
+                        else (None, None))
         _admit(str(status.get("replica_id", "") or "local"), "local",
-               status, tracez(flight) if flight is not None else {})
+               status, tracez(flight) if flight is not None else {},
+               slo_doc=local_slo)
 
     def _pull(peer: str):
-        return fetch(f"{peer}/statusz"), fetch(f"{peer}/tracez")
+        status, traces = fetch(f"{peer}/statusz"), fetch(f"{peer}/tracez")
+        # /sloz separately boxed: a pre-SLO peer 404s here, and its
+        # status + traces must still merge
+        slo_doc, _slo_err = _boxed(fetch, f"{peer}/sloz")
+        return status, traces, slo_doc
 
     if peers:
         # concurrent fan-out: the per-peer fetches are independent, and a
@@ -262,15 +314,21 @@ def fleetz(peers: Optional[List[str]] = None,
             pulls = list(pool.map(
                 lambda p: (p, _boxed(_pull, p)), peers))
         for peer, (result, err) in pulls:
+            outcome = _fetch_outcome(err)
+            _count_fetch(outcome)
             if err is not None:
-                unreachable.append({"url": peer, "error": str(err)[:200]})
+                # stale: the peer stays visible as a row, just with no
+                # fresh numbers in the merge — never silently dropped
+                unreachable.append({"url": peer, "outcome": outcome,
+                                    "stale": True,
+                                    "error": str(err)[:200]})
                 continue
-            status, traces = result
+            status, traces, slo_doc = result
             _admit(str(status.get("replica_id", "") or peer), peer,
-                   status, traces)
+                   status, traces, slo_doc=slo_doc)
 
     merged = assemble_traces(hops, limit=trace_limit)
-    return {
+    doc = {
         "replicas": replicas,
         "sessions": sessions,
         "session_conflicts": conflicts,
@@ -278,7 +336,13 @@ def fleetz(peers: Optional[List[str]] = None,
         "spans": merged_span_stats(merged),
         "traces": merged,
         "unreachable": unreachable,
+        # partial: at least one peer did not contribute — consumers
+        # (the item-4 autoscaler) must treat sums as lower bounds
+        "partial": bool(unreachable),
     }
+    if slo_docs:
+        doc["slo"] = merge_sloz(slo_docs)
+    return doc
 
 
 # ---------------------------------------------------------------------------
@@ -305,6 +369,17 @@ def render_fleetz(doc: dict, trace_limit: int = 4) -> str:
         lines.append("-- delta rpc (fleet total) --")
         lines.append("  " + "  ".join(
             f"{k}={v:.0f}" for k, v in sorted(delta.items()) if v))
+    slo = (doc.get("slo") or {}).get("classes") or {}
+    if slo:
+        lines.append("-- fleet slo --")
+        for cls, info in slo.items():
+            avail = info.get("availability") or {}
+            life = avail.get("lifetime") or {}
+            lines.append(
+                f"  {cls:<12} verdict={info.get('verdict', '?'):<8} "
+                f"requests={life.get('total', 0):.0f} "
+                f"bad={life.get('bad', 0):.0f} "
+                f"avail_budget={avail.get('budget_remaining', 1.0):+.3f}")
     sessions = doc.get("sessions") or {}
     if sessions:
         lines.append("-- session ownership --")

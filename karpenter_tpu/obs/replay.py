@@ -315,7 +315,7 @@ class Replayer:
                 session.solve_delta(added=add, removed=rm)
                 session._live += [p.name for p in add]
             else:
-                sched = self._classic()
+                sched = self._classic(str(record.get("class", "") or ""))
                 sched.solve(
                     self.pods_factory(int(record.get("n_pods", 0) or 1),
                                       tag),
@@ -332,18 +332,26 @@ class Replayer:
             self._sent.append((sent_at * speedup, outcome, wall_ms,
                                str(record.get("class", "") or "")))
 
-    def _classic(self):
-        # one shared availability-first facade for sessionless solves
-        # (lazily built under the lock — pool workers race the first
-        # classic record; a capture may hold none at all)
+    def _classic(self, pclass: str = ""):
+        # one shared availability-first facade PER PRIORITY CLASS for
+        # sessionless solves (lazily built under the lock — pool workers
+        # race the first classic record; a capture may hold none at
+        # all).  Classes matter: the facade stamps its class on every
+        # request it sends, and the replica's per-class SLO accounting
+        # (obs/slo.py) judges the replayed capture class by class —
+        # un-classed classic solves would all fold into the server
+        # default.
         with self._lock:
-            if not hasattr(self, "_classic_sched"):
+            if not hasattr(self, "_classic_scheds"):
+                self._classic_scheds = {}
+            sched = self._classic_scheds.get(pclass)
+            if sched is None:
                 from ..service.client import RemoteScheduler
 
-                self._classic_sched = RemoteScheduler(
-                    self.target, timeout=self.timeout,
+                sched = self._classic_scheds[pclass] = RemoteScheduler(
+                    self.target, timeout=self.timeout, priority=pclass,
                     registry=self.registry)
-            return self._classic_sched
+            return sched
 
     def run(self, records: List[dict], speedup: float = 1.0) -> dict:
         """Replay; returns the report :func:`fidelity` consumes."""
@@ -408,8 +416,8 @@ class Replayer:
                     sess.close()
                 except Exception:  # ktlint: allow[KT005] teardown
                     pass
-            if hasattr(self, "_classic_sched"):
-                self._classic_sched.close()
+            for sched in getattr(self, "_classic_scheds", {}).values():
+                sched.close()
         with self._lock:
             sent = sorted(self._sent)
             implicit = self._implicit_establishes

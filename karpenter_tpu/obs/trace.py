@@ -377,6 +377,9 @@ class Tracer:
         self.replica = replica_id()
         self._lock = threading.Lock()
         self._n_started = 0  # guarded-by: _lock
+        #: finished-trace sinks beyond the flight recorder (the occupancy
+        #: accountant subscribes here); each called with the closed trace
+        self._sinks: List = []
         # zero-init so the series exists from the first scrape (KT003), and
         # register the span-duration family so the documented metric is
         # visible before the first trace completes
@@ -430,6 +433,18 @@ class Tracer:
             {"outcome": "adopted"})
         return Trace(self, name, attrs, trace_id=trace_id)
 
+    def add_sink(self, sink) -> None:
+        """Subscribe ``sink(trace)`` to every finished trace (append-only
+        list read without the lock — sinks are wired at service
+        construction, before traffic)."""
+        self._sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        try:
+            self._sinks.remove(sink)
+        except ValueError:
+            pass
+
     def _finish(self, trace: Trace) -> None:
         trace.finish()
         self.registry.counter(TRACE_TRACES).inc()
@@ -437,6 +452,14 @@ class Tracer:
         for sp in trace.spans():
             if sp.done:
                 hist.observe(sp.duration_s, {"span": sp.name})
+        for sink in self._sinks:
+            try:
+                sink(trace)
+            except Exception:  # noqa: BLE001 — same contract as the flight
+                # recorder below: observers never fail the solve path
+                logging.getLogger(__name__).warning(
+                    "trace sink failed for %s", trace.trace_id,
+                    exc_info=True)
         if self.flight is not None:
             try:
                 self.flight.add(trace)

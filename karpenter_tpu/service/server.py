@@ -44,10 +44,16 @@ from ..metrics import (
     MULTIHOST_SLOT_OWNERSHIP,
     MULTIHOST_SLOTS,
     MULTIHOST_UNIFIED,
+    OCCUPANCY_DELTA_INLINE,
+    OCCUPANCY_DEVICE_BUSY,
+    OCCUPANCY_SLOT_FILL,
     Registry,
     registry as default_registry,
 )
 from ..obs import protocol, tracer_for
+from ..obs.occupancy import OccupancyAccountant
+from ..obs.slo import WINDOWS as SLO_WINDOWS, SloEngine
+from ..obs.timeseries import sampler_for
 from ..obs.trace import NULL_TRACE, Tracer
 from ..parallel.forward import ResultForwarder, SlotNotOwned
 from ..solver.guard import DeviceHang
@@ -1319,6 +1325,22 @@ class SolverService:
         self._pipelines: dict = {}               # guarded-by: _direct_lock
         self._closed = False                     # guarded-by: _direct_lock
         self._direct_lock = threading.Lock()
+        # time-resolved telemetry (ISSUE 18): the background registry
+        # sampler (NULL_SAMPLER when KT_TS_INTERVAL_S <= 0), the span-
+        # stream occupancy accountant publishing its gauges on the
+        # sampler's tick, and the per-class SLO burn-rate engine whose
+        # windowed numbers come off the sampler's rings
+        self.sampler = sampler_for(self.registry, clock=self.tracer.clock)
+        self._occupancy = OccupancyAccountant(
+            self.registry, clock=self.tracer.clock,
+            sample_every=self.tracer.sample_every)
+        self.tracer.add_sink(self._occupancy.on_trace)
+        self.slo = SloEngine(self.registry, sampler=self.sampler,
+                             clock=self.tracer.clock,
+                             replica=self.tracer.replica)
+        if self.sampler:
+            self.sampler.add_hook(self._occupancy.tick)
+            self.sampler.start()
 
     def _scheduler_for(self, backend: str) -> BatchScheduler:
         if backend and backend != self.scheduler.backend:
@@ -1380,6 +1402,29 @@ class SolverService:
             out["sessions"] = sessions
         return out
 
+    def sloz(self) -> dict:
+        """The /sloz document provider (obs.export.serve(sloz=...)):
+        the burn-rate evaluation plus the occupancy gauges and the
+        sampler's coverage, so one page answers both 'are we meeting
+        the objectives' and 'are we provisioned for them'."""
+        doc = self.slo.evaluate()
+        doc["occupancy"] = {
+            "device_busy_share":
+                self.registry.gauge(OCCUPANCY_DEVICE_BUSY).get(),
+            "megabatch_slot_fill":
+                self.registry.gauge(OCCUPANCY_SLOT_FILL).get(),
+            "delta_inline_fraction":
+                self.registry.gauge(OCCUPANCY_DELTA_INLINE).get(),
+        }
+        doc["sampler"] = {
+            "enabled": bool(self.sampler),
+            "interval_s": self.sampler.interval_s,
+            "series": self.sampler.series_count(),
+            "coverage_s": self.sampler.coverage(
+                window_s=max(s for _, s in SLO_WINDOWS)),
+        }
+        return doc
+
     def close(self) -> None:
         # latch closed + snapshot under the lock (a late first RPC racing
         # shutdown must neither resize the dict mid-iteration nor construct
@@ -1391,6 +1436,8 @@ class SolverService:
             pipes = list(self._pipelines.values())
         for pipe in pipes:
             pipe.stop()
+        self.sampler.stop()
+        self.tracer.remove_sink(self._occupancy.on_trace)
 
     # ---- RPC methods -----------------------------------------------------
     @staticmethod
@@ -1427,6 +1474,14 @@ class SolverService:
         # trace id, so a request crossing replicas — establishment here,
         # deltas on a steal-adopting sibling, a forwarded foreign slot —
         # renders as ONE tree in /fleetz.
+        # SLO accounting (obs/slo.py): every Solve lands in exactly one
+        # outcome bucket for its class — 'ok' served, 'shed' a typed
+        # admission/deadline refusal (the protection worked, the caller
+        # still wasn't served), 'error' anything unexpected (including a
+        # context.abort raised for non-SLO reasons) — recorded in the
+        # finally so aborts (which raise) are counted too.
+        slo_outcome = "error"
+        slo_ms = None
         try:
             with self.tracer.start_remote(
                 "solve", wire_trace, wire_parent,
@@ -1476,19 +1531,25 @@ class SolverService:
                     # this on their "remote" span, and offline dump
                     # correlation keys on it
                     resp.replica_id = self.tracer.replica
+            slo_outcome = "ok"
+            slo_ms = float(getattr(result, "solve_ms", 0.0) or 0.0) or None
         except SolveDeadlineError as err:
             # shed BEFORE tensorize/dispatch: the wire contract is
             # DEADLINE_EXCEEDED for expired budgets, RESOURCE_EXHAUSTED for
             # everything else admission refused (client.py maps both back
             # to the typed errors — no silent retry into an overloaded
             # server).  Direct callers (context=None) get the typed raise.
+            slo_outcome = "shed"
             if context is None:
                 raise
             context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(err))
         except SolveShedError as err:
+            slo_outcome = "shed"
             if context is None:
                 raise
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(err))
+        finally:
+            self.slo.record(pclass, slo_outcome, solve_ms=slo_ms)
         return resp
 
     def Warm(self, request: pb.WarmRequest, context) -> pb.WarmResponse:
@@ -1674,8 +1735,9 @@ def main(argv=None) -> int:
         # /fleetz fan-out (docs/OBSERVABILITY.md fleet tracing)
         _obs_server, obs_port = obs_serve(
             service.registry, flight, port=args.obs_port, host=obs_host,
-            extra=service.statusz_extra)
-        print(f"observability on http://{obs_host}:{obs_port}/tracez")
+            extra=service.statusz_extra, sloz=service.sloz)
+        print(f"observability on http://{obs_host}:{obs_port}/tracez "
+              f"(+/statusz /sloz /fleetz /metrics)")
     # graceful shutdown (ISSUE 12/13, docs/RESILIENCE.md): SIGTERM — the
     # kubelet's pod-termination signal, reinforced by deploy/solver.yaml's
     # preStop sleep — first enters the DRAIN handshake: new sessions are

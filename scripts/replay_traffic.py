@@ -95,12 +95,17 @@ def main(argv=None) -> int:
     srv = service = None
     target = args.target
     if not target:
+        import os
         import tempfile
 
         from karpenter_tpu.metrics import Registry
         from karpenter_tpu.service.server import SolverService, make_server
         from karpenter_tpu.solver.scheduler import BatchScheduler
 
+        # overdrive the time-series sampler so even a short replay
+        # accrues enough ring history for windowed burn rates (the SLO
+        # verdict below); an explicit env still wins
+        os.environ.setdefault("KT_TS_INTERVAL_S", "0.5")
         reg = Registry()
         service = SolverService(
             BatchScheduler(backend="oracle", registry=reg), registry=reg)
@@ -125,6 +130,26 @@ def main(argv=None) -> int:
             report = rp.run(records, speedup=args.speedup)
             conf, conf_json = None, None
         fid = replay.fidelity(records, report)
+        slo_json = slo_ok = None
+        if service is not None:
+            # SLO verdict (ISSUE 18): one final sampler tick flushes the
+            # replay's last interval into the rings, then the burn-rate
+            # evaluation judges the replayed capture per class — the
+            # objective a self-tuning controller optimizes against
+            service.sampler.tick()
+            slo_doc = service.sloz()
+            slo_json = {
+                "verdicts": {cls: info["verdict"]
+                             for cls, info in slo_doc["classes"].items()},
+                "burn_5m": {
+                    cls: {obj: (info[obj]["windows"].get("5m") or {}
+                                ).get("burn_rate")
+                          for obj in ("availability", "latency")}
+                    for cls, info in slo_doc["classes"].items()},
+                "occupancy": slo_doc["occupancy"],
+            }
+            slo_ok = all(info["verdict"] != "breach"
+                         for info in slo_doc["classes"].values())
         print(json.dumps({
             "capture": {"path": args.replay,
                         "source": header.get("source", "")},
@@ -132,10 +157,12 @@ def main(argv=None) -> int:
             "outcomes": report["outcomes"],
             **({"conformance": conf_json} if conf_json is not None
                else {}),
+            **({"slo": slo_json} if slo_json is not None else {}),
             **{k: v for k, v in fid.items()},
         }, default=str))
         ok = fid["class_mix_match"] and not fid["errors"] \
-            and (conf is None or conf.ok)
+            and (conf is None or conf.ok) \
+            and (slo_ok is None or slo_ok)
         return 0 if ok else 1
     finally:
         if srv is not None:

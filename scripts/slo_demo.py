@@ -1,0 +1,95 @@
+"""SLO burn-rate demo (ISSUE 18; docs/OBSERVABILITY.md SLO section).
+
+Overdrive an in-process replica with a mixed-class capture while
+best_effort admission is throttled to a trickle: best_effort traffic
+sheds and burns its availability budget to breach, while critical rides
+its reserved quota and stays green.  Prints the per-class verdict table
+the /sloz document carries — the burn-rate ladder in one screen:
+
+    make slo-demo
+
+Exits 0 when the demo shows the expected split (best_effort burning,
+critical not breached).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import tempfile
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+# knobs before imports: throttle best_effort to a trickle (rate 2/s,
+# burst 2) while critical/batch stay effectively unthrottled, and
+# overdrive the sampler so the short replay accrues windowed history
+os.environ.setdefault("KT_ADMIT_BEST_EFFORT_RATE", "2")
+os.environ.setdefault("KT_ADMIT_BEST_EFFORT_BURST", "2")
+os.environ.setdefault("KT_TS_INTERVAL_S", "0.25")
+
+
+def main() -> int:
+    from karpenter_tpu.metrics import Registry
+    from karpenter_tpu.obs import replay
+    from karpenter_tpu.service.server import SolverService, make_server
+    from karpenter_tpu.solver.scheduler import BatchScheduler
+
+    records = replay.synthesize(
+        n=160, shape="bursty", seed=11, mean_rate=120.0, n_pods=24,
+        class_mix={"critical": 0.3, "batch": 0.2, "best_effort": 0.5})
+    reg = Registry()
+    service = SolverService(
+        BatchScheduler(backend="oracle", registry=reg), registry=reg)
+    target = f"unix:{tempfile.mkdtemp(prefix='kt-slo-demo-')}/solver.sock"
+    srv, _ = make_server(service, host=target)
+    try:
+        report = replay.Replayer(target).run(records, speedup=4.0)
+        service.sampler.tick()  # flush the last interval into the rings
+        doc = service.sloz()
+    finally:
+        srv.stop(grace=None)
+        service.close()
+
+    print("== slo-demo: overdriven mixed-class replay ==")
+    print(f"sent={report['n']} outcomes={report['outcomes']}")
+    print(f"targets: avail={doc['config']['avail_target']} "
+          f"latency={doc['config']['latency_target']} "
+          f"p99<={doc['config']['p99_ms']}ms "
+          f"fast_burn={doc['config']['fast_burn']}x")
+    print(f"{'class':<12} {'verdict':<8} {'requests':>8} {'shed+err':>8} "
+          f"{'avail_budget':>12} {'burn_5m':>8} {'burn_1h':>8}")
+    for cls, info in doc["classes"].items():
+        avail = info["availability"]
+        burns = []
+        for win in ("5m", "1h"):
+            w = avail["windows"].get(win)
+            burns.append("-" if not w or w["burn_rate"] is None
+                         else f"{w['burn_rate']:.2f}")
+        print(f"{cls:<12} {info['verdict']:<8} "
+              f"{avail['lifetime']['total']:>8.0f} "
+              f"{avail['lifetime']['bad']:>8.0f} "
+              f"{avail['budget_remaining']:>+12.3f} "
+              f"{burns[0]:>8} {burns[1]:>8}")
+    occ = doc["occupancy"]
+    print(f"occupancy: device_busy={occ['device_busy_share']:.3f} "
+          f"slot_fill={occ['megabatch_slot_fill']:.2f} "
+          f"delta_inline={occ['delta_inline_fraction']:.2f}")
+    print(json.dumps({"verdicts": {c: i["verdict"]
+                                   for c, i in doc["classes"].items()}}))
+
+    be = doc["classes"]["best_effort"]
+    crit = doc["classes"]["critical"]
+    ok = (be["availability"]["lifetime"]["bad"] > 0
+          and be["verdict"] in ("warn", "breach")
+          and crit["verdict"] != "breach")
+    if not ok:
+        print("demo FAILED: expected best_effort burning while critical "
+              "stays green", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

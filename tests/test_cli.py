@@ -175,6 +175,18 @@ def test_inventory_metrics_are_emitted(small_catalog):
     multihost_shim = {m for m in INVENTORY
                       if m.startswith("karpenter_solver_multihost_forwards")}
 
+    # the time-resolved telemetry plane (ISSUE 18) is service-side like
+    # admission: the sampler/SLO-engine/occupancy trio rides the solver
+    # SERVICE (server.make_server wires Sampler + SloEngine +
+    # OccupancyAccountant per replica), which this in-process controller
+    # scenario never constructs; full-population zero-init is asserted by
+    # tests/test_metrics_init.py::TestSloSeries and exercised end to end
+    # by tests/test_timeseries.py and scripts/slo_demo.py
+    slo_family = {m for m in INVENTORY
+                  if m.startswith("karpenter_ts_")
+                  or m.startswith("karpenter_slo_")
+                  or m.startswith("karpenter_occupancy_")}
+
     # the replay family is DRIVER-side (obs/replay.Replayer): zero-inited
     # at its construction, asserted by tests/test_metrics_init.py::
     # TestFleetTracingSeries and exercised end to end by
@@ -185,7 +197,7 @@ def test_inventory_metrics_are_emitted(small_catalog):
 
     missing = (set(INVENTORY) - emitted - admission_family - delta_family
                - resilience_family - fleet_family - multihost_shim
-               - replay_family
+               - replay_family - slo_family
                - {REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES})
     assert not missing, (
         f"documented metrics never emitted: {sorted(missing)} "

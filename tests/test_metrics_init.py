@@ -540,3 +540,89 @@ class TestMultihostSeries:
         text = reg.expose()
         assert ('karpenter_solver_multihost_forwards_total'
                 '{outcome="unrouted"} 0') in text
+
+
+class TestSloSeries:
+    """ISSUE 18: the SLO, time-series, occupancy, and peer-fetch families
+    are born at zero — request outcomes and per-class latency series from
+    SloEngine construction, sampler meta-families from Sampler
+    construction, occupancy gauges from OccupancyAccountant construction,
+    peer-fetch outcomes from fleet.zero_init — and survive into expose()."""
+
+    def test_slo_engine_families_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            SLO_BUDGET_REMAINING,
+            SLO_BURN_RATE,
+            SLO_CLASSES,
+            SLO_LATENCY,
+            SLO_OBJECTIVES,
+            SLO_REQUEST_OUTCOMES,
+            SLO_REQUESTS,
+            SLO_VERDICT,
+            SLO_WINDOW_NAMES,
+            _lkey,
+        )
+        from karpenter_tpu.obs.slo import SloEngine
+
+        reg = Registry()
+        SloEngine(reg)
+        for cls in SLO_CLASSES:
+            for outcome in SLO_REQUEST_OUTCOMES:
+                assert series_exists(reg.counter(SLO_REQUESTS),
+                                     {"class": cls, "outcome": outcome})
+            # the per-class latency series exist too, so the sampler's
+            # first tick anchors them before the first observation
+            assert _lkey({"class": cls}) in reg.histogram(
+                SLO_LATENCY).totals
+            assert reg.gauge(SLO_VERDICT).has({"class": cls})
+            for obj in SLO_OBJECTIVES:
+                assert reg.gauge(SLO_BUDGET_REMAINING).has(
+                    {"class": cls, "objective": obj})
+                assert reg.gauge(SLO_BUDGET_REMAINING).get(
+                    {"class": cls, "objective": obj}) == 1.0
+                for win in SLO_WINDOW_NAMES:
+                    assert reg.gauge(SLO_BURN_RATE).has(
+                        {"class": cls, "objective": obj, "window": win})
+        text = reg.expose()
+        assert ('karpenter_slo_requests_total'
+                '{class="best_effort",outcome="shed"} 0') in text
+        assert ('karpenter_slo_burn_rate{class="critical",'
+                'objective="availability",window="5m"} 0') in text
+
+    def test_sampler_and_occupancy_families_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            OCCUPANCY_DELTA_INLINE,
+            OCCUPANCY_DEVICE_BUSY,
+            OCCUPANCY_SLOT_FILL,
+            TS_SAMPLES,
+            TS_SERIES,
+        )
+        from karpenter_tpu.obs.occupancy import OccupancyAccountant
+        from karpenter_tpu.obs.timeseries import Sampler
+
+        reg = Registry()
+        Sampler(reg, interval_s=5.0)
+        OccupancyAccountant(reg)
+        assert series_exists(reg.counter(TS_SAMPLES))
+        assert reg.gauge(TS_SERIES).has()
+        for name in (OCCUPANCY_DEVICE_BUSY, OCCUPANCY_SLOT_FILL,
+                     OCCUPANCY_DELTA_INLINE):
+            assert reg.gauge(name).has()
+        text = reg.expose()
+        assert 'karpenter_ts_samples_total 0' in text
+        assert 'karpenter_occupancy_device_busy_share 0' in text
+
+    def test_peer_fetch_outcomes_born_at_zero(self):
+        from karpenter_tpu.metrics import (
+            FLEET_PEER_FETCH,
+            FLEET_PEER_FETCH_OUTCOMES,
+        )
+        from karpenter_tpu.obs import fleet
+
+        reg = Registry()
+        fleet.zero_init(reg)
+        for outcome in FLEET_PEER_FETCH_OUTCOMES:
+            assert series_exists(reg.counter(FLEET_PEER_FETCH),
+                                 {"outcome": outcome})
+        assert ('karpenter_fleet_peer_fetch_total'
+                '{outcome="timeout"} 0') in reg.expose()

@@ -1,0 +1,584 @@
+"""Time-resolved telemetry (ISSUE 18): the ring-buffer sampler, the SLO
+burn-rate engine, device-occupancy accounting, and the fleet SLO merge.
+
+Five surfaces:
+
+1. **Sampler math on a FakeClock** — windowed counter increase/rate with
+   reset awareness, the window-anchor rule (the delta covers the FULL
+   window, not window - interval), ring wrap at capacity, the None
+   answer before two samples, windowed quantiles from histogram bucket
+   deltas.
+2. **The NULL fast path** — ``sampler_for`` answers the falsy
+   NULL_SAMPLER when the interval knob is unset/<= 0, and every query
+   on it is None.
+3. **Occupancy accounting** — ``on_trace`` + ``tick`` turn the span
+   stream into the three gauges, with trace-sampling scale-up.
+4. **SloEngine** — lifetime budget accounting, windowed burn rates, the
+   verdict ladder (no_data / ok / warn / breach incl. fast-burn), and
+   /sloz over real HTTP.
+5. **The fleet merge** — burn rates recomputed from summed
+   numerators/denominators (never averaged), a dead peer accounted in
+   ``karpenter_fleet_peer_fetch_total`` and marked stale, timeout
+   classified separately from error.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+from karpenter_tpu import metrics as M
+from karpenter_tpu.metrics import Registry
+from karpenter_tpu.obs import FlightRecorder, export
+from karpenter_tpu.obs import fleet as obs_fleet
+from karpenter_tpu.obs.occupancy import OccupancyAccountant
+from karpenter_tpu.obs.slo import SloEngine, merge_sloz
+from karpenter_tpu.obs.timeseries import (
+    NULL_SAMPLER,
+    NullSampler,
+    Sampler,
+    sampler_for,
+)
+from karpenter_tpu.utils.clock import FakeClock
+
+REQS = "karpenter_test_requests_total"
+DEPTH = "karpenter_test_depth"
+LAT = "karpenter_test_latency_seconds"
+
+
+def _sampler(start=1000.0, interval=5.0, capacity=100):
+    clk = FakeClock(start)
+    reg = Registry()
+    return Sampler(reg, clock=clk, interval_s=interval,
+                   capacity=capacity), reg, clk
+
+
+class TestSamplerWindows:
+    def test_increase_and_rate_over_window(self):
+        s, reg, clk = _sampler()
+        c = reg.counter(REQS)
+        c.inc(value=0.0)  # KT003: the series must exist to be anchored
+        s.tick()
+        for _ in range(10):
+            c.inc(value=5.0)
+            clk.advance(5.0)
+            s.tick()
+        # 50 increments over 50 s of samples
+        assert s.increase(REQS, window_s=300.0) == 50.0
+        assert s.rate(REQS, window_s=300.0) == 1.0
+
+    def test_window_anchor_covers_full_window(self):
+        """The anchor is the newest sample AT/BEFORE now - window, so a
+        60 s query over 5 s samples deltas 60 s of traffic — not 55."""
+        s, reg, clk = _sampler()
+        c = reg.counter(REQS)
+        s.tick()
+        for _ in range(40):  # 200 s of history, 1 inc / 5 s
+            c.inc()
+            clk.advance(5.0)
+            s.tick()
+        assert s.increase(REQS, window_s=60.0) == 12.0
+        assert abs(s.rate(REQS, window_s=60.0) - 0.2) < 1e-12
+
+    def test_counter_reset_contributes_post_reset_value(self):
+        """A restart (value drops) must never produce a negative delta;
+        the post-reset value is the increase since the reset."""
+        s, reg, clk = _sampler()
+        c = reg.counter(REQS)
+        c.inc(value=0.0)
+        s.tick()
+        c.inc(value=100.0)
+        clk.advance(5.0)
+        s.tick()
+        # restart: the family is rebuilt from zero, then counts 3
+        reg.counters[REQS] = M.Counter()
+        reg.counter(REQS).inc(value=3.0)
+        clk.advance(5.0)
+        s.tick()
+        # 100 before the reset + the post-reset value, never -97
+        assert s.increase(REQS, window_s=300.0) == 103.0
+
+    def test_none_before_two_samples_and_empty_window(self):
+        s, reg, clk = _sampler()
+        reg.counter(REQS).inc()
+        assert s.increase(REQS, window_s=300.0) is None  # no samples
+        s.tick()
+        assert s.increase(REQS, window_s=300.0) is None  # one sample
+        # a series the registry never built answers None, not 0
+        assert s.rate("karpenter_test_ghost_total", window_s=300.0) is None
+        assert s.quantile(LAT, 0.99, window_s=300.0) is None
+
+    def test_ring_wraps_at_capacity_and_queries_survive(self):
+        s, reg, clk = _sampler(capacity=8)
+        c = reg.counter(REQS)
+        for _ in range(50):
+            c.inc()
+            clk.advance(5.0)
+            s.tick()
+        ring = s._rings[("counter", REQS, M._lkey(None))]
+        assert len(ring) == 8
+        # only the last 8 samples remain -> the widest answerable window
+        # is 7 intervals of traffic
+        assert s.increase(REQS, window_s=10_000.0) == 7.0
+
+    def test_gauge_stats(self):
+        s, reg, clk = _sampler()
+        g = reg.gauge(DEPTH)
+        for v in (1.0, 9.0, 4.0):
+            g.set(v)
+            clk.advance(5.0)
+            s.tick()
+        st = s.gauge_stats(DEPTH, window_s=300.0)
+        assert st["last"] == 4.0
+        assert st["min"] == 1.0 and st["max"] == 9.0
+
+    def test_windowed_quantile_from_bucket_deltas(self):
+        """Old observations outside the window must not drag the
+        quantile — only the bucket DELTAS answer."""
+        s, reg, clk = _sampler()
+        h = reg.histogram(LAT)
+        h.observe(0.002)  # the series must exist to be anchored
+        s.tick()
+        # 999 more fast observations, long ago
+        for _ in range(999):
+            h.observe(0.002)
+        clk.advance(5.0)
+        s.tick()
+        clk.advance(3600.0)
+        s.tick()
+        # recent window: 100 slow observations
+        for _ in range(100):
+            h.observe(0.8)
+        clk.advance(5.0)
+        s.tick()
+        q = s.quantile(LAT, 0.99, window_s=60.0)
+        assert q is not None and q > 0.5
+        # the lifetime histogram would have said ~2 ms
+        lifetime = s.quantile(LAT, 0.5, window_s=100_000.0)
+        assert lifetime is not None and lifetime < 0.01
+
+    def test_coverage_and_series_count(self):
+        s, reg, clk = _sampler()
+        reg.counter(REQS).inc()
+        assert s.coverage(300.0) is None
+        s.tick()
+        clk.advance(5.0)
+        s.tick()
+        assert s.coverage(300.0) == 5.0
+        assert s.series_count() >= 1
+
+    def test_hook_runs_each_tick_and_failure_is_contained(self):
+        s, reg, clk = _sampler()
+        seen = []
+        s.add_hook(seen.append)
+        s.add_hook(lambda now: 1 / 0)  # must not break the tick
+        s.tick()
+        clk.advance(5.0)
+        s.tick()
+        assert seen == [1000.0, 1005.0]
+        assert reg.counter(M.TS_SAMPLES).get() == 2.0
+
+    def test_start_stop_idempotent_real_thread(self):
+        reg = Registry()
+        s = Sampler(reg, interval_s=60.0, capacity=10)
+        try:
+            s.start()
+            s.start()  # idempotent
+            # start() takes one synchronous anchor tick
+            assert reg.counter(M.TS_SAMPLES).get() >= 1.0
+        finally:
+            s.stop()
+            s.stop()  # idempotent
+
+
+class TestNullSampler:
+    def test_sampler_for_interval_zero_is_null(self, monkeypatch):
+        monkeypatch.setenv("KT_TS_INTERVAL_S", "0")
+        s = sampler_for(Registry())
+        assert isinstance(s, NullSampler)
+        assert not s
+        assert s.tick() == 0.0
+        assert s.rate(REQS) is None and s.quantile(LAT, 0.99) is None
+        assert s.coverage() is None and s.series_count() == 0
+        s.start(), s.stop(), s.add_hook(lambda now: None)  # all no-ops
+
+    def test_sampler_for_reads_knobs(self, monkeypatch):
+        monkeypatch.setenv("KT_TS_INTERVAL_S", "2.5")
+        monkeypatch.setenv("KT_TS_CAPACITY", "33")
+        s = sampler_for(Registry())
+        assert s and s.interval_s == 2.5 and s.capacity == 33
+        monkeypatch.setenv("KT_TS_CAPACITY", "1")
+        assert sampler_for(Registry()).capacity == 2  # floor: need 2 samples
+        monkeypatch.setenv("KT_TS_INTERVAL_S", "garbage")
+        assert sampler_for(Registry()).interval_s == 5.0
+
+    def test_shared_null_singleton(self, monkeypatch):
+        monkeypatch.delenv("KT_TS_INTERVAL_S", raising=False)
+        monkeypatch.setenv("KT_TS_INTERVAL_S", "-1")
+        assert sampler_for(Registry()) is NULL_SAMPLER
+
+
+# --------------------------------------------------------------------------
+class _Span:
+    def __init__(self, name, duration_s=0.0, done=True, attrs=None):
+        self.name = name
+        self.duration_s = duration_s
+        self.done = done
+        self.attrs = attrs or {}
+
+
+class _Trace:
+    def __init__(self, spans):
+        self._spans = spans
+
+    def spans(self):
+        return list(self._spans)
+
+
+class TestOccupancy:
+    def test_device_busy_share_from_span_stream(self):
+        clk = FakeClock(100.0)
+        reg = Registry()
+        occ = OccupancyAccountant(reg, clock=clk)
+        occ.tick(100.0)  # baseline
+        # 3 traces x (dispatch 1 s + fence 0.5 s) over a 10 s interval
+        for _ in range(3):
+            occ.on_trace(_Trace([_Span("solve", 2.0),
+                                 _Span("dispatch", 1.0),
+                                 _Span("fence", 0.5),
+                                 _Span("device_dispatch", 0.9)]))
+        occ.tick(110.0)
+        # device_dispatch is dispatch's child -- counting it would
+        # double-book, so busy = 3 * 1.5 / 10
+        assert abs(reg.gauge(M.OCCUPANCY_DEVICE_BUSY).get() - 0.45) < 1e-9
+
+    def test_sample_every_scales_back_up(self):
+        clk = FakeClock(0.0)
+        reg = Registry()
+        occ = OccupancyAccountant(reg, clock=clk, sample_every=4)
+        occ.tick(0.0)
+        occ.on_trace(_Trace([_Span("dispatch", 1.0)]))  # stands for 4
+        occ.tick(10.0)
+        assert abs(reg.gauge(M.OCCUPANCY_DEVICE_BUSY).get() - 0.4) < 1e-9
+
+    def test_inline_fraction_and_slot_fill(self):
+        clk = FakeClock(0.0)
+        reg = Registry()
+        occ = OccupancyAccountant(reg, clock=clk)
+        occ.tick(0.0)
+        occ.on_trace(_Trace([_Span("delta", 0.01,
+                                   attrs={"inline": True})]))
+        occ.on_trace(_Trace([_Span("delta", 0.01)]))
+        occ.on_trace(_Trace([_Span("delta", 0.01)]))
+        occ.on_trace(_Trace([_Span("solve", 0.01)]))  # not a delta
+        reg.histogram(M.MEGABATCH_SLOTS).observe(6.0)
+        reg.histogram(M.MEGABATCH_SLOTS).observe(2.0)
+        occ.tick(5.0)
+        assert abs(reg.gauge(M.OCCUPANCY_DELTA_INLINE).get()
+                   - 1.0 / 3.0) < 1e-9
+        assert reg.gauge(M.OCCUPANCY_SLOT_FILL).get() == 4.0
+
+    def test_open_spans_do_not_count(self):
+        reg = Registry()
+        occ = OccupancyAccountant(reg, clock=FakeClock(0.0))
+        occ.tick(0.0)
+        occ.on_trace(_Trace([_Span("dispatch", 99.0, done=False)]))
+        occ.tick(10.0)
+        assert reg.gauge(M.OCCUPANCY_DEVICE_BUSY).get() == 0.0
+
+    def test_gauges_born_at_zero(self):
+        reg = Registry()
+        OccupancyAccountant(reg, clock=FakeClock(0.0))
+        for name in (M.OCCUPANCY_DEVICE_BUSY, M.OCCUPANCY_SLOT_FILL,
+                     M.OCCUPANCY_DELTA_INLINE):
+            assert reg.gauge(name).has()
+            assert reg.gauge(name).get() == 0.0
+
+
+# --------------------------------------------------------------------------
+def _engine(avail_target=0.9, latency_target=0.9, p99_ms=250.0,
+            fast_burn=14.0, replica="r0", start=1000.0):
+    clk = FakeClock(start)
+    reg = Registry()
+    sampler = Sampler(reg, clock=clk, interval_s=5.0, capacity=1000)
+    eng = SloEngine(reg, sampler=sampler, clock=clk, replica=replica,
+                    avail_target=avail_target,
+                    latency_target=latency_target, p99_ms=p99_ms,
+                    fast_burn=fast_burn)
+    return eng, sampler, clk, reg
+
+
+class TestSloEngine:
+    def test_no_traffic_is_no_data_not_breach(self):
+        eng, sampler, clk, reg = _engine()
+        doc = eng.evaluate()
+        for cls in M.SLO_CLASSES:
+            assert doc["classes"][cls]["verdict"] == "no_data"
+            assert doc["classes"][cls]["availability"][
+                "budget_remaining"] == 1.0
+
+    def test_windowed_burn_rate_and_budget(self):
+        eng, sampler, clk, reg = _engine(avail_target=0.9)
+        sampler.tick()
+        # 5% bad over the window against a 10% budget -> burn 0.5
+        for _ in range(95):
+            eng.record("critical", "ok", solve_ms=10.0)
+        for _ in range(5):
+            eng.record("critical", "shed")
+        clk.advance(10.0)
+        sampler.tick()
+        doc = eng.evaluate()
+        avail = doc["classes"]["critical"]["availability"]
+        w = avail["windows"]["5m"]
+        assert w["total"] == 100.0 and w["bad"] == 5.0
+        assert abs(w["burn_rate"] - 0.5) < 1e-9
+        assert abs(avail["budget_remaining"] - 0.5) < 1e-9
+        assert doc["classes"]["critical"]["verdict"] == "ok"
+        # the gauges mirror the doc (what /metrics scrapes)
+        assert abs(reg.gauge(M.SLO_BURN_RATE).get(
+            {"class": "critical", "objective": "availability",
+             "window": "5m"}) - 0.5) < 1e-9
+        assert reg.gauge(M.SLO_VERDICT).get({"class": "critical"}) == 0.0
+
+    def test_budget_exhaustion_is_breach(self):
+        eng, sampler, clk, reg = _engine(avail_target=0.9)
+        sampler.tick()
+        for _ in range(5):
+            eng.record("best_effort", "ok")
+        for _ in range(5):
+            eng.record("best_effort", "shed")  # 50% bad vs 10% budget
+        clk.advance(10.0)
+        sampler.tick()
+        doc = eng.evaluate()
+        be = doc["classes"]["best_effort"]
+        assert be["availability"]["budget_remaining"] <= 0
+        assert be["verdict"] == "breach"
+        # an untouched class stays no_data, unpolluted
+        assert doc["classes"]["critical"]["verdict"] == "no_data"
+
+    def test_fast_burn_breaches_before_budget_death(self):
+        # 3% bad burns the 1% budget at 3x: warn. At fast_burn=2 the
+        # short window escalates it to breach even with budget left.
+        eng, sampler, clk, reg = _engine(avail_target=0.99, fast_burn=2.0)
+        sampler.tick()
+        for _ in range(970):
+            eng.record("batch", "ok")
+        for _ in range(30):
+            eng.record("batch", "error")
+        clk.advance(10.0)
+        sampler.tick()
+        doc = eng.evaluate()
+        assert doc["classes"]["batch"]["verdict"] == "breach"
+
+    def test_slow_burn_is_warn(self):
+        """Window burning above budget with lifetime budget still in
+        hand: warn, not breach."""
+        eng, sampler, clk, reg = _engine(avail_target=0.99, fast_burn=14.0)
+        sampler.tick()
+        for _ in range(10_000):  # a long good history pads the budget
+            eng.record("batch", "ok")
+        clk.advance(5.0)
+        sampler.tick()
+        clk.advance(3600.0)  # the good history rolls out of the windows
+        for _ in range(980):
+            eng.record("batch", "ok")
+        for _ in range(20):
+            eng.record("batch", "shed")  # 2% bad -> burn 2.0 < 14
+        clk.advance(5.0)
+        sampler.tick()
+        doc = eng.evaluate()
+        batch = doc["classes"]["batch"]
+        w = batch["availability"]["windows"]["5m"]
+        assert w["total"] == 1000.0 and abs(w["burn_rate"] - 2.0) < 1e-9
+        assert batch["availability"]["budget_remaining"] > 0
+        assert batch["verdict"] == "warn"
+
+    def test_latency_objective_from_windowed_buckets(self):
+        eng, sampler, clk, reg = _engine(latency_target=0.5, p99_ms=100.0)
+        sampler.tick()
+        for _ in range(10):
+            eng.record("critical", "ok", solve_ms=10.0)   # good
+        for _ in range(30):
+            eng.record("critical", "ok", solve_ms=900.0)  # bad
+        clk.advance(10.0)
+        sampler.tick()
+        doc = eng.evaluate()
+        lat = doc["classes"]["critical"]["latency"]
+        w = lat["windows"]["5m"]
+        assert w["total"] == 40 and w["bad"] == 30
+        # 75% bad against a 50% budget -> burn 1.5
+        assert abs(w["burn_rate"] - 1.5) < 1e-9
+        assert lat["threshold_ms"] == 100.0
+
+    def test_unknown_class_and_outcome_are_coerced(self):
+        eng, sampler, clk, reg = _engine()
+        eng.record("mystery", "exploded")
+        assert reg.counter(M.SLO_REQUESTS).get(
+            {"class": "batch", "outcome": "error"}) == 1.0
+
+    def test_without_sampler_windows_are_none_lifetime_still_judges(self):
+        reg = Registry()
+        eng = SloEngine(reg, sampler=NULL_SAMPLER, clock=FakeClock(0.0),
+                        replica="r9", avail_target=0.9)
+        for _ in range(5):
+            eng.record("critical", "shed")
+        doc = eng.evaluate()
+        avail = doc["classes"]["critical"]["availability"]
+        assert avail["windows"]["5m"] is None
+        assert avail["budget_remaining"] <= 0
+        assert doc["classes"]["critical"]["verdict"] == "breach"
+
+
+class TestSlozHTTP:
+    def test_sloz_served_over_http(self):
+        eng, sampler, clk, reg = _engine()
+        sampler.tick()
+        eng.record("critical", "ok", solve_ms=5.0)
+        clk.advance(10.0)
+        sampler.tick()
+        flight = FlightRecorder(clock=clk, registry=reg)
+        server, port = export.serve(reg, flight, port=0,
+                                    sloz=eng.evaluate)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/sloz", timeout=10) as r:
+                doc = json.loads(r.read())
+            assert doc["replica_id"] == "r0"
+            assert set(doc["classes"]) == set(M.SLO_CLASSES)
+            assert doc["classes"]["critical"]["verdict"] == "ok"
+            # the new families survive the exposition round-trip too
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                text = r.read().decode()
+            assert "karpenter_slo_burn_rate" in text
+            assert "karpenter_fleet_peer_fetch_total" in text
+        finally:
+            server.shutdown()
+
+    def test_sloz_404_when_not_wired(self):
+        reg = Registry()
+        flight = FlightRecorder(clock=FakeClock(0.0), registry=reg)
+        server, port = export.serve(reg, flight, port=0)
+        try:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/sloz", timeout=10)
+                raise AssertionError("expected HTTP 404")
+            except urllib.error.HTTPError as err:
+                assert err.code == 404
+        finally:
+            server.shutdown()
+
+
+# --------------------------------------------------------------------------
+def _replica_doc(replica, ok, shed, avail_target=0.9):
+    """A real per-replica /sloz document with windowed history."""
+    eng, sampler, clk, reg = _engine(avail_target=avail_target,
+                                     replica=replica)
+    sampler.tick()
+    for _ in range(ok):
+        eng.record("critical", "ok", solve_ms=5.0)
+    for _ in range(shed):
+        eng.record("critical", "shed")
+    clk.advance(10.0)
+    sampler.tick()
+    return eng.evaluate()
+
+
+class TestFleetSloMerge:
+    def test_burn_rates_merge_by_redivision_not_averaging(self):
+        # r0: 10 requests, 5 bad (burn 5.0, breached); r1: 90 requests,
+        # 0 bad.  Fleet truth: 5/100 bad -> burn 0.5.  An average of
+        # per-replica burns would say 2.5.
+        a = _replica_doc("r0", ok=5, shed=5)
+        b = _replica_doc("r1", ok=90, shed=0)
+        merged = merge_sloz([a, b])
+        avail = merged["classes"]["critical"]["availability"]
+        assert avail["lifetime"] == {"total": 100.0, "bad": 5.0}
+        assert abs(avail["windows"]["5m"]["burn_rate"] - 0.5) < 1e-9
+        # per-replica verdicts preserved alongside the fleet one
+        assert merged["replicas"]["r0"]["critical"] == "breach"
+        assert merged["classes"]["critical"]["verdict"] == "ok"
+
+    def test_merge_distinguishes_no_sampler_from_zero_traffic(self):
+        with_hist = _replica_doc("r0", ok=0, shed=0)
+        merged = merge_sloz([with_hist])
+        w = merged["classes"]["critical"]["availability"]["windows"]["5m"]
+        assert w == {"total": 0, "bad": 0, "burn_rate": None}
+        # a replica with NO sampler answers None windows; merged stays None
+        reg = Registry()
+        eng = SloEngine(reg, sampler=NULL_SAMPLER, clock=FakeClock(0.0),
+                        replica="r1", avail_target=0.9)
+        merged = merge_sloz([eng.evaluate()])
+        assert merged["classes"]["critical"][
+            "availability"]["windows"]["5m"] is None
+
+    def test_fleetz_merges_slo_with_one_dead_peer(self):
+        peer_doc = _replica_doc("replica-1", ok=90, shed=0)
+        docs = {
+            "http://r1/statusz": {"replica_id": "replica-1"},
+            "http://r1/tracez": {"traces": []},
+            "http://r1/sloz": peer_doc,
+        }
+
+        def fetch(url):
+            if url.startswith("http://dead"):
+                raise OSError("connection refused")
+            return docs[url]
+
+        # the serving replica itself: registry + its own sloz provider
+        local_reg = Registry()
+        obs_fleet.zero_init(local_reg)
+        local_doc = _replica_doc("replica-0", ok=5, shed=5)
+        doc = obs_fleet.fleetz(
+            ["http://r1", "http://dead"],
+            local=(local_reg, None, None, lambda: local_doc),
+            fetch=fetch)
+        # merge: 5 bad / 100 total against the 10% budget -> burn 0.5
+        avail = doc["slo"]["classes"]["critical"]["availability"]
+        assert avail["lifetime"] == {"total": 100.0, "bad": 5.0}
+        assert abs(avail["windows"]["5m"]["burn_rate"] - 0.5) < 1e-9
+        assert set(doc["slo"]["replicas"]) == {"replica-0", "replica-1"}
+        # the dead peer: stale row, partial doc, outcome accounted
+        assert doc["partial"] is True
+        assert doc["unreachable"][0]["url"] == "http://dead"
+        assert doc["unreachable"][0]["stale"] is True
+        assert doc["unreachable"][0]["outcome"] == "error"
+        fetches = local_reg.counter(M.FLEET_PEER_FETCH)
+        assert fetches.get({"outcome": "ok"}) == 1.0
+        assert fetches.get({"outcome": "error"}) == 1.0
+        assert fetches.get({"outcome": "timeout"}) == 0.0
+        # the fleet renderer shows the merged verdicts
+        out = obs_fleet.render_fleetz(doc)
+        assert "fleet slo" in out and "critical" in out
+
+    def test_timeout_classified_separately(self):
+        def fetch(url):
+            raise TimeoutError("timed out")
+
+        local_reg = Registry()
+        obs_fleet.zero_init(local_reg)
+        doc = obs_fleet.fleetz(
+            ["http://slow"],
+            local=(local_reg, None, None, None), fetch=fetch)
+        assert doc["unreachable"][0]["outcome"] == "timeout"
+        assert local_reg.counter(M.FLEET_PEER_FETCH).get(
+            {"outcome": "timeout"}) == 1.0
+
+    def test_pre_slo_peer_404_keeps_status_in_merge(self):
+        """A peer running an older build 404s /sloz; its statusz/tracez
+        must still merge (the separate-boxing contract)."""
+        docs = {
+            "http://old/statusz": {"replica_id": "replica-old",
+                                   "delta_rpc": {"delta": 3.0}},
+            "http://old/tracez": {"traces": []},
+        }
+
+        def fetch(url):
+            if url.endswith("/sloz"):
+                raise urllib.error.HTTPError(url, 404, "nope", {}, None)
+            return docs[url]
+
+        doc = obs_fleet.fleetz(["http://old"], fetch=fetch)
+        assert "replica-old" in doc["replicas"]
+        assert doc["partial"] is False
+        assert "slo" not in doc
