@@ -2,7 +2,7 @@ PYTHON ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -shared -Wall -std=c++17
 
-.PHONY: all test native proto bench clean battletest lint modelcheck obs-demo obs-fleet-demo overload-demo slo-demo chaos chaos-fleet multihost-dryrun hier-demo
+.PHONY: all test native proto bench clean battletest lint modelcheck obs-demo obs-fleet-demo overload-demo slo-demo chaos chaos-fleet multihost-dryrun hier-demo tune-demo
 
 all: native proto
 
@@ -156,6 +156,16 @@ multihost-dryrun:
 # same model bench.py measure_hierarchical gates in check_budgets.
 hier-demo:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/hier_demo.py
+
+# self-tuning demo (docs/TUNING.md, ISSUE 19): replay a seeded bursty
+# capture three ways — static env-default knobs, the feedback controller
+# learning live (KT_TUNE=1 on a compressed cadence), and a fresh replica
+# judged on the learned posture with the controller off — then print the
+# before/after knob table and the throughput / critical-p99 scoreboard.
+# Exits non-zero if the learned posture breaks the never-worse contract
+# (the same gates bench.py check_budgets enforces).
+tune-demo:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/tune_demo.py
 
 clean:
 	rm -f karpenter_tpu/solver/_native*.so

@@ -115,7 +115,7 @@ class AdmissionControl:
         self.breaker = breaker if breaker is not None else CircuitBreaker(
             clock=self.clock, registry=self.registry)
         self.brownout = brownout if brownout is not None else \
-            BrownoutController(registry=self.registry)
+            BrownoutController(registry=self.registry, clock=self.clock)
         self.limiters: Dict[str, RateLimiter] = self.policy.limiters(
             clock=self.clock)
         self._lock = threading.Lock()
@@ -288,9 +288,11 @@ class AdmissionControl:
         return wait
 
     def observe_idle(self) -> None:
-        """Idle dispatcher tick: decay the brownout EWMA toward zero and
-        poll the breaker's counter feeds."""
-        self.brownout.observe(0.0)
+        """Idle dispatcher tick: decay the brownout EWMA toward zero (by
+        elapsed clock time — cadence-independent, so a stalled dispatcher
+        or FakeClock harness still recovers) and poll the breaker's
+        counter feeds."""
+        self.brownout.idle(self.clock.now())
         self.breaker.poll()
 
     def route_host(self, pclass: str) -> Optional[str]:

@@ -39,12 +39,21 @@ from ..metrics import (
     Registry,
     registry as default_registry,
 )
+from ..utils.clock import Clock
 from .policy import BEST_EFFORT, _env_float, rank
 
 logger = logging.getLogger(__name__)
 
 #: number of rungs on the ladder
 MAX_LEVEL = 4
+
+#: the idle-tick cadence the per-observation ``alpha`` was calibrated to
+#: (the dispatcher's 100ms idle poll): :meth:`BrownoutController.idle`
+#: decays by elapsed TIME at exactly the rate ``observe(0.0)`` decayed
+#: per tick at this cadence, so real-time behavior is unchanged while a
+#: stalled or FakeClock'd dispatcher no longer pins the ladder at its
+#: last loaded rung (ISSUE 19 satellite bugfix)
+IDLE_TICK_REF_S = 0.1
 
 
 class BrownoutController:
@@ -54,6 +63,7 @@ class BrownoutController:
         alpha: Optional[float] = None,
         slot_cap: Optional[int] = None,
         registry: Optional[Registry] = None,
+        clock: Optional[Clock] = None,
     ) -> None:
         if step_s is None:
             step_s = _env_float("KT_BROWNOUT_MS", 2000.0) / 1000.0
@@ -65,8 +75,12 @@ class BrownoutController:
         self.alpha = min(1.0, max(0.01, alpha))
         self._slot_cap = max(1, slot_cap)
         self.registry = registry or default_registry
+        self.clock = clock or Clock()
         self.ewma_s = 0.0
         self._level = 0
+        #: last observation/decay stamp on the injected clock — the
+        #: idle-decay path is TIME-based, not tick-counted
+        self._last_at: Optional[float] = None
         self.registry.gauge(ADMISSION_BROWNOUT_LEVEL).set(0)
 
     @property
@@ -89,7 +103,53 @@ class BrownoutController:
         flap at a boundary).  Returns the new level."""
         if not self.enabled:
             return 0
+        self._last_at = self.clock.now()
         self.ewma_s += self.alpha * (max(0.0, wait_s) - self.ewma_s)
+        return self._reeval()
+
+    def idle(self, now: Optional[float] = None) -> int:
+        """Idle-tick decay, by ELAPSED TIME on the injected clock.
+
+        The old path folded a fixed-alpha 0.0 sample per tick, which
+        tied the decay rate to the dispatcher's real-time poll cadence:
+        a stalled dispatcher (wedged fence, debugger) or a FakeClock
+        harness left the ladder stuck at its last loaded rung until the
+        next request.  Here the EWMA decays by ``(1-alpha)`` per
+        :data:`IDLE_TICK_REF_S` of elapsed clock time — identical to the
+        old behavior at the dispatcher's nominal 10Hz idle cadence, and
+        cadence-independent everywhere else.  Returns the new level."""
+        if not self.enabled:
+            return 0
+        if now is None:
+            now = self.clock.now()
+        if self._last_at is None:
+            self._last_at = now
+            return self._level
+        dt = max(0.0, now - self._last_at)
+        self._last_at = now
+        if dt > 0.0 and self.ewma_s > 0.0:
+            self.ewma_s *= (1.0 - self.alpha) ** (dt / IDLE_TICK_REF_S)
+        return self._reeval()
+
+    def retune(self, step_s: Optional[float] = None,
+               slot_cap: Optional[int] = None) -> None:
+        """Live knob application (tuning registry, ISSUE 19): move the
+        ladder's threshold scale and/or rung-2 slot cap, then requantize
+        the rung against the UNCHANGED EWMA — the dispatcher calls this
+        under its scheduler lock, so a mid-evaluation retune can never
+        tear a decision."""
+        changed = False
+        if step_s is not None and step_s != self.step_s:
+            self.step_s = step_s
+            changed = True
+        if slot_cap is not None:
+            self._slot_cap = max(1, int(slot_cap))
+        if changed and self.enabled:
+            self._reeval()
+
+    def _reeval(self) -> int:
+        """Requantize the rung from the current EWMA: engage at the rung
+        threshold, disengage below HALF of it (hysteresis)."""
         level = self._level
         while level < MAX_LEVEL and self.ewma_s >= self.threshold(level + 1):
             level += 1

@@ -45,7 +45,7 @@ from .ktlint import SourceFile, dotted_name, file_nodes
 
 #: bump when the summary format changes — stale caches are discarded, never
 #: migrated (the extraction is cheap; correctness of the cache is not)
-SUMMARY_VERSION = 2  # v2: FileSummary.env_reads (KT022)
+SUMMARY_VERSION = 3  # v3: env_reads gains the env= keyword shape (KT022)
 
 #: parameter names treated as device-resident by convention (KT001's taint)
 TAINT_PARAMS = {"carry", "ys"}
@@ -349,6 +349,10 @@ def _env_reads(f: SourceFile) -> List[Tuple[int, str]]:
       ``environ.get(NAME)`` (admission/policy.py's DEFAULT_CLASS_ENV)
     - wrapper helpers whose name mentions ``env`` called with a literal
       key (``_env_int("KT_X", 4)``)
+    - registry declarations binding an env key through an ``env=``
+      keyword (``KnobSpec(..., env="KT_X", ...)`` — the tuning
+      registry's knobs are READ through the spec's ``from_env``, whose
+      dynamic ``self.env`` lookup is invisible to the shapes above)
     - f-string keys with a literal ``KT_`` head become WILDCARD patterns
       (``f"KT_QUOTA_{cls}"`` -> ``KT_QUOTA_*``) — the README documents
       those as a family row
@@ -379,6 +383,11 @@ def _env_reads(f: SourceFile) -> List[Tuple[int, str]]:
     out: List[Tuple[int, str]] = []
     for n in file_nodes(f):
         if isinstance(n, ast.Call):
+            for kw in n.keywords:
+                if kw.arg == "env":
+                    key = key_of(kw.value)
+                    if key is not None:
+                        out.append((n.lineno, key))
             d = dotted_name(n.func)
             if d is None or not n.args:
                 continue

@@ -359,6 +359,18 @@ SLO_VERDICT = "karpenter_slo_verdict"
 OCCUPANCY_DEVICE_BUSY = "karpenter_occupancy_device_busy_share"
 OCCUPANCY_SLOT_FILL = "karpenter_occupancy_megabatch_slot_fill"
 OCCUPANCY_DELTA_INLINE = "karpenter_occupancy_delta_inline_fraction"
+# ---- self-tuning controller (ISSUE 19: tuning/) -------------------------
+TUNING_STEPS = "karpenter_tuning_steps_total"
+#: per-decision outcomes (KT003 zero-init source — tuning/controller.py
+#: inits the full knob x outcome population): 'applied' (a lattice step
+#: taken, probe window opened), 'kept' (the probe window confirmed the
+#: step), 'reverted' (the probe window regressed the objective — or a
+#: class went warn mid-probe — and the step was rolled back), 'frozen'
+#: (no move: a class burn rate was warn+), 'skipped' (no move: no
+#: windowed data, lattice edge, or knob frozen)
+TUNING_STEP_OUTCOMES = ("applied", "kept", "reverted", "frozen", "skipped")
+TUNING_KNOB_VALUE = "karpenter_tuning_knob_value"
+TUNING_STEP_DURATION = "karpenter_tuning_step_duration_seconds"
 # ---- /fleetz peer-fetch accounting (ISSUE 18 satellite) -----------------
 FLEET_PEER_FETCH = "karpenter_fleet_peer_fetch_total"
 #: per-peer /fleetz fan-out outcomes (KT003 zero-init source): 'ok'
@@ -886,6 +898,24 @@ INVENTORY = {
         "(no dispatcher window span) over the last sampler interval — "
         "high values mean the pipeline is idle enough that the delta "
         "shortcut dominates."),
+    TUNING_STEPS: (
+        "counter", ("knob", "outcome"),
+        "Feedback-controller decisions by knob and outcome: 'applied' a "
+        "lattice step taken (probe window opened), 'kept' the probe "
+        "window confirmed it, 'reverted' the window regressed the "
+        "objective and the step rolled back, 'frozen' no move while a "
+        "class burn rate was warn+, 'skipped' no move (no windowed "
+        "data, lattice edge, or frozen knob)."),
+    TUNING_KNOB_VALUE: (
+        "gauge", ("knob",),
+        "Current live value of each registry knob (bools as 0/1) — the "
+        "value serving decision points snapshot, env default or tuned "
+        "override."),
+    TUNING_STEP_DURATION: (
+        "histogram", (),
+        "Wall time of one controller decision (windowed reads + SLO "
+        "evaluation + the move), seconds — the controller's own cost, "
+        "gated <= 2% of serving by bench.py measure_tuning."),
     FLEET_PEER_FETCH: (
         "counter", ("outcome",),
         "Per-peer /fleetz fan-out fetches by outcome ('ok' / 'timeout' "

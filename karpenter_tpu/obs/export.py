@@ -237,16 +237,19 @@ def serve(registry: Registry, flight: FlightRecorder, port: int = 0,
           extra: Optional[Callable[[], dict]] = None,
           peers: Optional[list] = None,
           sloz: Optional[Callable[[], dict]] = None,
+          tunez: Optional[Callable[[], dict]] = None,
           ) -> "tuple[ThreadingHTTPServer, int]":
     """Start the sidecar observability server: /tracez, /statusz,
-    /metrics, /fleetz, /sloz.  ``extra`` extends /statusz (the serving
-    layer's session block); ``peers`` are sibling obs base URLs for the
-    /fleetz fan-out (default ``KT_OBS_PEERS``, comma-separated — include
-    THIS replica's own URL so the merged view is whole); ``sloz`` is the
-    serving layer's SLO-document provider (SolverService.sloz — the
-    burn-rate evaluation), 404 when absent so old callers see exactly
-    the pre-SLO surface.  Returns (server, bound_port);
-    ``server.shutdown()`` stops it."""
+    /metrics, /fleetz, /sloz, /tunez.  ``extra`` extends /statusz (the
+    serving layer's session block); ``peers`` are sibling obs base URLs
+    for the /fleetz fan-out (default ``KT_OBS_PEERS``, comma-separated —
+    include THIS replica's own URL so the merged view is whole);
+    ``sloz`` is the serving layer's SLO-document provider
+    (SolverService.sloz — the burn-rate evaluation) and ``tunez`` the
+    self-tuning view provider (SolverService.tunez — live knob table +
+    controller decision ring), each 404 when absent so old callers see
+    exactly the pre-SLO/pre-tuning surface.  Returns (server,
+    bound_port); ``server.shutdown()`` stops it."""
     from .fleet import zero_init as _fleet_zero_init
 
     _fleet_zero_init(registry)
@@ -269,6 +272,12 @@ def serve(registry: Registry, flight: FlightRecorder, port: int = 0,
                     body, code = b'{"error": "slo engine not wired"}', 404
                 else:
                     body = json.dumps(sloz(), default=str).encode()
+                    code = 200
+            elif self.path.startswith("/tunez"):
+                if tunez is None:
+                    body, code = b'{"error": "tuning not wired"}', 404
+                else:
+                    body = json.dumps(tunez(), default=str).encode()
                     code = 200
             elif self.path.startswith("/fleetz"):
                 from .fleet import env_peers, fleetz

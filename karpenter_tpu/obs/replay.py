@@ -101,19 +101,31 @@ def capture_from_traces(traces: Iterable[dict]) -> List[dict]:
     return rows
 
 
+#: the synthetic capture presets ``--synthesize --shape`` accepts
+SHAPES = ("uniform", "bursty", "diurnal", "burst-train")
+
+
 def synthesize(n: int = 120, shape: str = "bursty", seed: int = 7,
                mean_rate: float = 50.0, n_pods: int = 40, churn: int = 4,
                sessions: int = 4,
                class_mix: Optional[Dict[str, float]] = None,
-               classic_frac: float = 0.25) -> List[dict]:
+               classic_frac: float = 0.25,
+               period: Optional[float] = None,
+               amplitude: Optional[float] = None) -> List[dict]:
     """Generate a synthetic capture: ``n`` requests whose inter-arrivals
     follow ``shape`` — 'uniform' (Poisson at ``mean_rate``/s), 'bursty'
-    (Markov-modulated: 8x bursts alternating with 1/4x lulls, the
-    flash-crowd adversary), 'diurnal' (sinusoidal rate over the capture
-    span, the daily cycle compressed).  ``classic_frac`` of requests are
-    sessionless solves; the rest spread over ``sessions`` delta sessions
-    (first touch establishes).  Deterministic per seed."""
-    if shape not in ("uniform", "bursty", "diurnal"):
+    (Markov-modulated: ``amplitude``x bursts alternating with 1/4x
+    lulls at random flip times, the flash-crowd adversary), 'diurnal'
+    (sinusoidal rate over ``period``, the daily cycle compressed),
+    'burst-train' (deterministic square wave: ``amplitude``x on-phase
+    for 30% of each ``period``, 0.1x trough otherwise — the canonical
+    tuning/SLO-judgment shape: every run of a seed sees the identical
+    burst schedule).  ``period`` defaults to one cycle over the capture
+    span; ``amplitude`` defaults to 8 (peak-rate multiplier).
+    ``classic_frac`` of requests are sessionless solves; the rest
+    spread over ``sessions`` delta sessions (first touch establishes).
+    Deterministic per seed."""
+    if shape not in SHAPES:
         raise ValueError(f"unknown shape {shape!r}")
     mix = class_mix or {"batch": 0.7, "critical": 0.2, "best_effort": 0.1}
     classes, weights = zip(*sorted(mix.items()))
@@ -125,7 +137,10 @@ def synthesize(n: int = 120, shape: str = "bursty", seed: int = 7,
     # OPENS with a burst — the flash-crowd front the shape advertises
     burst = False
     next_flip = 0.0
-    period = max(1.0, n / mean_rate)  # one "day" over the capture span
+    if period is None:
+        period = max(1.0, n / mean_rate)  # one cycle over the capture span
+    period = max(1e-3, float(period))
+    amplitude = 8.0 if amplitude is None else max(1.0, float(amplitude))
     for i in range(n):
         if shape == "uniform":
             rate = mean_rate
@@ -133,11 +148,17 @@ def synthesize(n: int = 120, shape: str = "bursty", seed: int = 7,
             if t >= next_flip:
                 burst = not burst
                 next_flip = t + rng.uniform(0.05, 0.2) * period
-            rate = mean_rate * (8.0 if burst else 0.25)
+            rate = mean_rate * (amplitude if burst else 0.25)
+        elif shape == "burst-train":
+            # deterministic square wave: on-phase the first 30% of each
+            # period, trough the rest — the seeded regression shape
+            # (same seed = the identical burst schedule every run)
+            rate = mean_rate * (amplitude if (t % period) < 0.3 * period
+                                else 0.1)
         else:  # diurnal
             rate = mean_rate * (
-                0.25 + 0.75 * (1.0 + math.sin(2 * math.pi * t / period))
-                / 2.0)
+                0.25 + (amplitude / 8.0) * 0.75
+                * (1.0 + math.sin(2 * math.pi * t / period)) / 2.0)
         t += rng.expovariate(max(rate, 1e-6))
         pclass = rng.choices(classes, weights=weights)[0]
         if rng.random() < classic_frac:
@@ -423,15 +444,25 @@ class Replayer:
             implicit = self._implicit_establishes
         outcomes: Dict[str, int] = {}
         classes: Dict[str, int] = {}
-        for _t, outcome, _ms, pclass in sent:
+        # per-class latency + outcome breakdown: the self-tuning bench
+        # gate (bench.py measure_tuning) judges CRITICAL p99 and sheds
+        # separately — aggregate wall_ms would let a tuned run trade
+        # critical latency for batch throughput and still pass
+        by_class: Dict[str, dict] = {}
+        for _t, outcome, ms, pclass in sent:
             outcomes[outcome] = outcomes.get(outcome, 0) + 1
             if outcome != "error":
                 classes[pclass] = classes.get(pclass, 0) + 1
+            bc = by_class.setdefault(pclass, {"wall_ms": [], "outcomes": {}})
+            if outcome == "ok":
+                bc["wall_ms"].append(ms)
+            bc["outcomes"][outcome] = bc["outcomes"].get(outcome, 0) + 1
         return {
             "achieved": [t for t, _o, _ms, _c in sent],
             "outcomes": outcomes,
             "classes": classes,
             "wall_ms": [ms for _t, _o, ms, _c in sent],
+            "by_class": by_class,
             "implicit_establishes": implicit,
             "speedup": speedup,
             "n": len(sent),

@@ -59,6 +59,9 @@ from ..parallel.forward import ResultForwarder, SlotNotOwned
 from ..solver.guard import DeviceHang
 from ..solver.scheduler import BatchScheduler
 from ..solver.tpu import MEGA_MAX_SLOTS, max_mega_slots, mesh_shardable
+from ..tuning import TuningController, global_knobs, tune_enabled
+from ..tuning.controller import zero_init as tuning_zero_init
+from ..tuning.knobs import Knobs
 from ..utils.clock import Clock
 from . import codec
 from . import solver_pb2 as pb
@@ -152,16 +155,19 @@ class SolvePipeline:
                  max_slots: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
                  clock: Optional[Clock] = None,
-                 admission: Optional[AdmissionControl] = None) -> None:
+                 admission: Optional[AdmissionControl] = None,
+                 knobs: Optional[Knobs] = None) -> None:
         self.scheduler = scheduler
         self.registry = registry or default_registry
+        # the live knob registry (ISSUE 19, docs/TUNING.md): construction
+        # defaults read THROUGH it — an unset knob falls back to the env
+        # (KT_MAX_SLOTS / KT_MAX_WAIT_MS) exactly as before, a tuned
+        # override lands at the next _apply_knobs snapshot
+        self.knobs = knobs if knobs is not None else global_knobs()
         if max_slots is None:
-            max_slots = int(os.environ.get("KT_MAX_SLOTS",
-                                           str(DEFAULT_MAX_SLOTS)))
+            max_slots = int(self.knobs.get("max_slots"))
         if max_wait_ms is None:
-            max_wait_ms = float(os.environ.get("KT_MAX_WAIT_MS",
-                                               str(DEFAULT_MAX_WAIT_MS)))
-        self.max_slots = max(1, min(MEGA_MAX_SLOTS, max_slots))
+            max_wait_ms = float(self.knobs.get("max_wait_ms"))
         # meshed scheduler: the sharded megabatch pads its slot axis to the
         # mesh's device count (one slot per chip), so floor the flush size
         # there — a smaller cap would flush half-empty shards and serve the
@@ -172,12 +178,7 @@ class SolvePipeline:
         # max_slots=1 (batching disabled) is honored; an unshardable mesh
         # (device count past the slot-rung ladder) keeps the configured
         # cap and rides the serial path.
-        mesh = getattr(scheduler, "mesh", None)
-        if mesh is not None and self.max_slots > 1:
-            n_dev = int(mesh.devices.size)
-            if n_dev <= MEGA_MAX_SLOTS:
-                self.max_slots = min(max(self.max_slots, n_dev),
-                                     max_mega_slots(mesh))
+        self.max_slots = self._clamp_slots(max_slots)
         #: an unshardable mesh on a megabatching backend serves every
         #: request as its own single-request serial flush: count those
         #: flushes under mesh_serial, not 'bucket', so degradation stays
@@ -187,6 +188,7 @@ class SolvePipeline:
         #: _bucket_of short-circuits on this flag without calling
         #: bucket_key at all); facades without the attribute fall back to
         #: the pipeline-side computation.
+        mesh = getattr(scheduler, "mesh", None)
         sched_verdict = getattr(scheduler, "mega_unshardable", None)
         if sched_verdict is None:
             sched_verdict = mesh is not None and not mesh_shardable(mesh)
@@ -194,6 +196,11 @@ class SolvePipeline:
             bool(sched_verdict)
             and getattr(scheduler, "backend", None) in ("auto", "tpu"))
         self.max_wait = max(0.0, max_wait_ms) / 1000.0
+        #: the per-iteration atomic knob snapshot (_apply_knobs, under
+        #: _sched_lock); _inline_ok and _effective_max_wait read the
+        #: IMMUTABLE object, so a mid-flight tuner update can never tear
+        #: a flush or a brownout evaluation (ISSUE 19)
+        self._knob_snap = self.knobs.snapshot()
         self._clock = clock or Clock()
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
@@ -795,6 +802,10 @@ class SolvePipeline:
         scheduler access — the check protects class ORDERING: an inline
         delta must not overtake work already queued ahead of it."""
         return (not self._stop.is_set()
+                # live inline-routing knob: reads the last applied
+                # IMMUTABLE snapshot (best-effort like the rest of this
+                # probe; the registry knob lands via _apply_knobs)
+                and bool(self._knob_snap.inline_delta)
                 and not self._in_hand
                 and not len(self._inflight)
                 and not len(self._coal)
@@ -1184,19 +1195,55 @@ class SolvePipeline:
                 continue
             return kwargs, fut, t_enq, t_wall
 
-    def _apply_brownout(self) -> None:
-        """Dispatcher-owned knob application: the brownout ladder's first
-        two rungs act on the coalescer (stop holding batches open, bound
-        one flush's latency footprint).  Back at level 0 both revert."""
+    def _clamp_slots(self, n: int) -> int:
+        """Bound a slot-cap ask against the global ladder and (meshed
+        schedulers) floor/cap it at the mesh's device count / largest
+        in-ladder rung — the ONE slot-clamp used at construction and at
+        every live knob application, so a tuned cap can never flush
+        half-empty shards or overflow the sharded program."""
+        n = max(1, min(MEGA_MAX_SLOTS, int(n)))
+        mesh = getattr(self.scheduler, "mesh", None)
+        if mesh is not None and n > 1:
+            n_dev = int(mesh.devices.size)
+            if n_dev <= MEGA_MAX_SLOTS:
+                n = min(max(n, n_dev), max_mega_slots(mesh))
+        return n
+
+    def _apply_knobs(self) -> None:
+        """Dispatcher-owned knob application (caller holds _sched_lock):
+        ONE atomic registry snapshot per iteration drives the coalescer's
+        wait/slots, the brownout ladder's parameters, and the delta
+        inline gate.  A knob the registry never overrode keeps its
+        construction-time value byte-identically; a tuner update lands
+        WHOLE at the next iteration, never mid-flush (ISSUE 19).  The
+        brownout ladder's rungs then overlay the (possibly tuned) bases:
+        rung 1+ zeroes the wait, rung 2+ caps the slots; back at level 0
+        both revert."""
+        snap = self.knobs.snapshot()
+        self._knob_snap = snap
+        base_wait = (max(0.0, snap.max_wait_ms) / 1000.0
+                     if snap.is_overridden("max_wait_ms") else self.max_wait)
+        base_slots = (self._clamp_slots(snap.max_slots)
+                      if snap.is_overridden("max_slots") else self.max_slots)
         if self._adm is None:
+            self._coal.max_wait = base_wait
+            self._coal.max_slots = base_slots
             return
-        self._coal.max_wait = self._adm.brownout.max_wait(self.max_wait)
-        self._coal.max_slots = self._adm.brownout.slot_cap(self.max_slots)
+        if snap.is_overridden("brownout_ms"):
+            self._adm.brownout.retune(
+                step_s=max(0.0, snap.brownout_ms) / 1000.0)
+        if snap.is_overridden("brownout_slot_cap"):
+            self._adm.brownout.retune(slot_cap=int(snap.brownout_slot_cap))
+        self._coal.max_wait = self._adm.brownout.max_wait(base_wait)
+        self._coal.max_slots = self._adm.brownout.slot_cap(base_slots)
 
     def _effective_max_wait(self) -> float:
+        snap = self._knob_snap
+        base = (max(0.0, snap.max_wait_ms) / 1000.0
+                if snap.is_overridden("max_wait_ms") else self.max_wait)
         if self._adm is None:
-            return self.max_wait
-        return self._adm.brownout.max_wait(self.max_wait)
+            return base
+        return self._adm.brownout.max_wait(base)
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -1213,6 +1260,9 @@ class SolvePipeline:
                     # recovery doesn't need traffic to make progress
                     self._adm.observe_idle()
                 with self._sched_lock:
+                    # tuned knobs (and brownout recovery) must land on
+                    # idle ticks too — a quiet pipeline still converges
+                    self._apply_knobs()
                     for reason, _key, batch in self._coal.poll():
                         self._flush(batch, reason)
                     if not len(self._coal):
@@ -1238,7 +1288,7 @@ class SolvePipeline:
             # does NOT): while the dispatcher works, the delta fast path's
             # inline shortcut cannot acquire and routes through the queue
             with self._sched_lock:
-                self._apply_brownout()
+                self._apply_knobs()
                 # close the queue-wait phase on the request's trace:
                 # enqueue (RPC thread) -> pickup (this dispatcher)
                 trace = kwargs.get("trace") or NULL_TRACE
@@ -1300,7 +1350,8 @@ class SolverService:
                  registry: Optional[Registry] = None,
                  tracer: Optional[Tracer] = None,
                  max_slots: Optional[int] = None,
-                 max_wait_ms: Optional[float] = None) -> None:
+                 max_wait_ms: Optional[float] = None,
+                 knobs: Optional[Knobs] = None) -> None:
         self.registry = registry or default_registry
         self.scheduler = scheduler or BatchScheduler(registry=self.registry)
         # serving knobs for every pipeline this service constructs (None:
@@ -1338,6 +1389,21 @@ class SolverService:
         self.slo = SloEngine(self.registry, sampler=self.sampler,
                              clock=self.tracer.clock,
                              replica=self.tracer.replica)
+        # self-tuning (ISSUE 19, docs/TUNING.md): the live knob registry
+        # is always on (it changes nothing until a knob is set); the
+        # feedback controller arms only with KT_TUNE=1 AND a live
+        # sampler — it rides the sampler's tick like the occupancy
+        # accountant, so FakeClock harnesses drive it deterministically.
+        # An injected registry keeps a tuned bench/test service from
+        # leaking overrides into the process-global singleton.
+        self.knobs = knobs if knobs is not None else global_knobs()
+        tuning_zero_init(self.registry)
+        self.tuner: Optional[TuningController] = None
+        if tune_enabled() and self.sampler:
+            self.tuner = TuningController(
+                self.knobs, self.registry, sampler=self.sampler,
+                slo=self.slo, tracer=self.tracer)
+            self.sampler.add_hook(self.tuner.on_tick)
         if self.sampler:
             self.sampler.add_hook(self._occupancy.tick)
             self.sampler.start()
@@ -1367,7 +1433,8 @@ class SolverService:
             if pipe is None:
                 pipe = SolvePipeline(sched, registry=self.registry,
                                      max_slots=self.max_slots,
-                                     max_wait_ms=self.max_wait_ms)
+                                     max_wait_ms=self.max_wait_ms,
+                                     knobs=self.knobs)
                 self._pipelines[id(sched)] = pipe
             return pipe
 
@@ -1424,6 +1491,16 @@ class SolverService:
                 window_s=max(s for _, s in SLO_WINDOWS)),
         }
         return doc
+
+    def tunez(self) -> dict:
+        """The /tunez document provider (obs.export.serve(tunez=...)):
+        the live knob table — value, default, lattice, freeze/override
+        state — plus the controller's recent decision ring when the
+        feedback loop is armed (KT_TUNE=1)."""
+        if self.tuner is not None:
+            return self.tuner.tunez()
+        return {"enabled": False, "knobs": self.knobs.describe(),
+                "decisions": []}
 
     def close(self) -> None:
         # latch closed + snapshot under the lock (a late first RPC racing
@@ -1735,9 +1812,10 @@ def main(argv=None) -> int:
         # /fleetz fan-out (docs/OBSERVABILITY.md fleet tracing)
         _obs_server, obs_port = obs_serve(
             service.registry, flight, port=args.obs_port, host=obs_host,
-            extra=service.statusz_extra, sloz=service.sloz)
+            extra=service.statusz_extra, sloz=service.sloz,
+            tunez=service.tunez)
         print(f"observability on http://{obs_host}:{obs_port}/tracez "
-              f"(+/statusz /sloz /fleetz /metrics)")
+              f"(+/statusz /sloz /tunez /fleetz /metrics)")
     # graceful shutdown (ISSUE 12/13, docs/RESILIENCE.md): SIGTERM — the
     # kubelet's pod-termination signal, reinforced by deploy/solver.yaml's
     # preStop sleep — first enters the DRAIN handshake: new sessions are

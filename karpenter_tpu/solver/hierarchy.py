@@ -91,12 +91,16 @@ DEVICE_REF_MS = 24.0
 
 def hier_threshold() -> int:
     """Pod count at/above which the scheduler routes hierarchically
-    (``KT_HIER_THRESHOLD``, default 100k; 0 disables the hierarchical
-    path entirely)."""
+    (default 100k; 0 disables the hierarchical path entirely).  Read
+    through the knob registry (ISSUE 19): a tuned override wins, else
+    the registry falls back to ``KT_HIER_THRESHOLD``/the default at
+    call time — env workflows are untouched until something moves the
+    knob."""
+    from ..tuning.knobs import global_knobs
+
     try:
-        return int(os.environ.get("KT_HIER_THRESHOLD",
-                                  DEFAULT_HIER_THRESHOLD))
-    except ValueError:
+        return int(global_knobs().get("hier_threshold"))
+    except (TypeError, ValueError):
         return DEFAULT_HIER_THRESHOLD
 
 

@@ -123,9 +123,16 @@ def relax_delta_enabled() -> bool:
 
 
 def configured_iters() -> int:
+    """The live iteration budget, read through the knob registry
+    (ISSUE 19): a tuned override wins, else the registry falls back to
+    ``KT_RELAX_ITERS``/the default at call time — env workflows are
+    untouched until the controller actually moves the knob."""
+    from ..tuning.knobs import global_knobs
+
     try:
-        return int(os.environ.get("KT_RELAX_ITERS", str(DEFAULT_RELAX_ITERS)))
-    except ValueError:
+        # ktlint: allow[KT014] registry knob NAME, not a key tail
+        return int(global_knobs().get("relax_iters"))
+    except (TypeError, ValueError):
         return DEFAULT_RELAX_ITERS
 
 

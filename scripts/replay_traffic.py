@@ -52,11 +52,17 @@ def main(argv=None) -> int:
     ap.add_argument("--replay", metavar="PATH",
                     help="replay the capture at PATH")
     ap.add_argument("--shape", default="bursty",
-                    choices=["bursty", "diurnal", "uniform"])
+                    choices=["bursty", "diurnal", "uniform", "burst-train"])
     ap.add_argument("--n", type=int, default=200)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--rate", type=float, default=20.0,
                     help="mean request rate, 1/s (synthesize)")
+    ap.add_argument("--period", type=float, default=None,
+                    help="burst/diurnal cycle length, s (default: one "
+                         "cycle over the capture span)")
+    ap.add_argument("--amplitude", type=float, default=None,
+                    help="peak-rate multiplier for bursty / burst-train "
+                         "/ diurnal (default 8)")
     ap.add_argument("--sessions", type=int, default=4)
     ap.add_argument("--pods", type=int, default=40)
     ap.add_argument("--churn", type=int, default=4)
@@ -72,10 +78,13 @@ def main(argv=None) -> int:
         recs = replay.synthesize(
             n=args.n, shape=args.shape, seed=args.seed,
             mean_rate=args.rate, n_pods=args.pods, churn=args.churn,
-            sessions=args.sessions)
+            sessions=args.sessions, period=args.period,
+            amplitude=args.amplitude)
         replay.save_capture(args.synthesize, recs,
                             source=f"synthetic:{args.shape}",
-                            meta={"seed": args.seed, "rate": args.rate})
+                            meta={"seed": args.seed, "rate": args.rate,
+                                  "period": args.period,
+                                  "amplitude": args.amplitude})
         print(json.dumps({"written": args.synthesize, "records": len(recs),
                           "shape": args.shape}))
         return 0

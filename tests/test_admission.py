@@ -798,6 +798,61 @@ class TestBrownoutLadder:
         b = BrownoutController(step_s=0.0, registry=Registry())
         assert b.observe(100.0) == 0 and not b.enabled
 
+    def test_idle_decay_is_time_based_not_tick_counted(self):
+        """Regression (ISSUE 19 satellite): the queue-delay EWMA used to
+        decay a fixed alpha per idle TICK, so a stalled dispatcher (or a
+        FakeClock harness that never spins the 10Hz poll) pinned the
+        ladder at its last loaded rung after traffic stopped.  Decay is
+        now driven by ELAPSED clock time: one idle call after a long
+        quiet gap drains the ladder exactly as far as the old math would
+        have over the same wall time at the nominal cadence."""
+        clock = FakeClock()
+        b = BrownoutController(step_s=0.1, alpha=0.2, registry=Registry(),
+                               clock=clock)
+        b.observe(1.0)
+        assert b.level == 2 and b.ewma_s == pytest.approx(0.2)
+        # a zero-elapsed idle tick changes nothing
+        assert b.idle(clock.now()) == 2
+        assert b.ewma_s == pytest.approx(0.2)
+        # ten quiet seconds, ONE idle call: the old per-tick fold would
+        # have decayed a single alpha step (ewma 0.16, still level 2)
+        clock.advance(10.0)
+        assert b.idle(clock.now()) == 0
+        assert b.ewma_s < 1e-6
+
+    def test_idle_decay_is_cadence_independent(self):
+        """The same quiet interval drains the same amount whether the
+        dispatcher polled it as one sleep or a hundred 10ms ticks."""
+        sparse, dense = FakeClock(), FakeClock()
+        a = BrownoutController(step_s=0.1, alpha=0.2, registry=Registry(),
+                               clock=sparse)
+        c = BrownoutController(step_s=0.1, alpha=0.2, registry=Registry(),
+                               clock=dense)
+        a.observe(1.0)
+        c.observe(1.0)
+        sparse.advance(1.0)
+        a.idle(sparse.now())
+        for _ in range(100):
+            dense.advance(0.01)
+            c.idle(dense.now())
+        assert a.ewma_s == pytest.approx(c.ewma_s, rel=1e-6)
+        # ...and both match the old 10Hz per-tick fold over one second
+        assert a.ewma_s == pytest.approx(0.2 * (1.0 - 0.2) ** 10, rel=1e-6)
+
+    def test_retune_moves_thresholds_against_live_ewma(self):
+        """The tuning registry's brownout_ms application requantizes the
+        rung against the UNCHANGED EWMA (ISSUE 19)."""
+        b = self._ctl(alpha=1.0, step=0.1)
+        b.observe(0.15)
+        assert b.level == 1
+        b.retune(step_s=0.05)        # halve the ladder: 0.15 is rung 2
+        assert b.level == 2
+        b.retune(step_s=0.4)         # relax it: 0.15 < half of rung 1
+        assert b.level == 0
+        b.retune(slot_cap=4)
+        b.observe(0.8)               # back up the ladder (level 2+)
+        assert b.slot_cap(8) == 4
+
 
 class _BlockingScheduler:
     """Stub scheduler whose submits park on an event — the lever for

@@ -589,6 +589,68 @@ class TestHierarchicalGates:
         assert benchmod.check_budgets({"solve_p50_ms": 30.0}) == {}
 
 
+class TestTuningGates:
+    """ISSUE 19 budget gates (measure_tuning): the self-tuning replay
+    judgment — tuned throughput never below the static floor, the
+    protected critical class's p99 inside the slack, zero critical sheds
+    the static run did not pay, the controller's own decision loop under
+    the overhead budget, and clean replays."""
+
+    GOOD = {"tuning_throughput_ratio": 1.01,
+            "tuning_critical_p99_ratio": 0.97,
+            "tuning_new_critical_sheds": 0,
+            "tuning_overhead_pct": 0.2,
+            "tuning_steps": 48,
+            "tuning_replay_errors": 0}
+
+    def test_within_budgets_clean(self):
+        assert benchmod.check_budgets(dict(self.GOOD)) == {}
+
+    def test_throughput_below_floor_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, tuning_throughput_ratio=0.9))
+        assert any("static run's throughput" in f
+                   for f in out["budget_flags"])
+        # the floor itself (0.98) is inclusive-OK: never-worse within noise
+        assert benchmod.check_budgets(
+            dict(self.GOOD,
+                 tuning_throughput_ratio=benchmod.TUNING_THROUGHPUT_FLOOR)
+        ) == {}
+
+    def test_critical_p99_over_slack_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, tuning_critical_p99_ratio=1.31))
+        assert any("protected class" in f for f in out["budget_flags"])
+        # AT the 1.05x slack is inclusive-OK
+        assert benchmod.check_budgets(
+            dict(self.GOOD,
+                 tuning_critical_p99_ratio=benchmod.TUNING_CRITICAL_P99_SLACK)
+        ) == {}
+
+    def test_new_critical_sheds_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, tuning_new_critical_sheds=1))
+        assert any("guardrails are not holding" in f
+                   for f in out["budget_flags"])
+
+    def test_controller_overhead_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, tuning_overhead_pct=3.1))
+        assert any("feedback loop itself became load" in f
+                   for f in out["budget_flags"])
+
+    def test_replay_errors_flagged(self):
+        out = benchmod.check_budgets(
+            dict(self.GOOD, tuning_replay_errors=2))
+        assert any("errored during the self-tuning" in f
+                   for f in out["budget_flags"])
+
+    def test_missing_tuning_fields_not_flagged(self):
+        # records from rounds before the self-tuning bench carry none of
+        # the new fields; absence must never flag
+        assert benchmod.check_budgets({"value": 100.0}) == {}
+
+
 @pytest.mark.slow
 def test_500k_pod_solve_stretch():
     """ISSUE 6 stretch rung: the solve bench ceiling lifted from 50k

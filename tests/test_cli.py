@@ -187,6 +187,15 @@ def test_inventory_metrics_are_emitted(small_catalog):
                   or m.startswith("karpenter_slo_")
                   or m.startswith("karpenter_occupancy_")}
 
+    # the self-tuning family (ISSUE 19) is service-side for the same
+    # reason: SolverService wires the TuningController/knob gauges per
+    # replica; full-population zero-init is asserted by tests/
+    # test_tuning.py::test_zero_init_registers_full_population and the
+    # family is exercised end to end by the controller tests and
+    # bench.py measure_tuning
+    tuning_family = {m for m in INVENTORY
+                     if m.startswith("karpenter_tuning_")}
+
     # the replay family is DRIVER-side (obs/replay.Replayer): zero-inited
     # at its construction, asserted by tests/test_metrics_init.py::
     # TestFleetTracingSeries and exercised end to end by
@@ -197,7 +206,7 @@ def test_inventory_metrics_are_emitted(small_catalog):
 
     missing = (set(INVENTORY) - emitted - admission_family - delta_family
                - resilience_family - fleet_family - multihost_shim
-               - replay_family - slo_family
+               - replay_family - slo_family - tuning_family
                - {REMOTE_DEGRADED, REMOTE_FALLBACK_SOLVES})
     assert not missing, (
         f"documented metrics never emitted: {sorted(missing)} "

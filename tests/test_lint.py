@@ -2967,3 +2967,115 @@ class TestKT023InventoryDrift:
             registry.counter("karpenter_experimental_total")
         """
         assert rules_of(lint(src)) == []
+
+
+class TestKT024KnobEnvBypass:
+    SERVING = "karpenter_tpu/service/server.py"
+
+    def test_call_time_environ_get_fires(self):
+        src = """
+        import os
+
+        def _flush(self):
+            cap = int(os.environ.get("KT_MAX_SLOTS", "8"))
+            return cap
+        """
+        findings = lint(src, self.SERVING)
+        assert rules_of(findings) == ["KT024"]
+        assert "`KT_MAX_SLOTS`" in findings[0].message
+        assert "tuning registry" in findings[0].message
+
+    def test_subscript_and_getenv_fire(self):
+        src = """
+        import os
+
+        def route(self, st):
+            a = os.environ["KT_HIER_THRESHOLD"]
+            b = os.getenv("KT_DELTA_INLINE")
+            return a, b
+        """
+        assert rules_of(lint(src, "karpenter_tpu/solver/scheduler.py")) == [
+            "KT024", "KT024"]
+
+    def test_env_helper_with_knob_literal_fires(self):
+        src = """
+        from .policy import _env_float
+
+        def evaluate(self):
+            return _env_float("KT_BROWNOUT_MS", 2000.0)
+        """
+        assert rules_of(lint(
+            src, "karpenter_tpu/admission/brownout.py")) == ["KT024"]
+
+    def test_construction_scopes_are_exempt(self):
+        # env values ARE the lattice defaults at construction time: the
+        # module level, __init__, from_env, and main() CLI entry stay quiet
+        src = """
+        import os
+        from .policy import _env_float
+
+        DEFAULT = float(os.environ.get("KT_MAX_WAIT_MS", "0"))
+
+        class Pipeline:
+            def __init__(self):
+                self.wait = _env_float("KT_MAX_WAIT_MS", 0.0)
+
+        def main(argv=None):
+            return os.environ.get("KT_MAX_SLOTS", "8")
+        """
+        assert rules_of(lint(src, self.SERVING)) == []
+
+    def test_non_knob_env_and_non_serving_path_stay_quiet(self):
+        # only registry-owned envs in serving-path files are in scope
+        src = """
+        import os
+
+        def poll(self):
+            return os.environ.get("KT_SESSION_DIR", "")
+        """
+        assert rules_of(lint(src, self.SERVING)) == []
+        knob = """
+        import os
+
+        def poll(self):
+            return os.environ.get("KT_MAX_SLOTS", "8")
+        """
+        assert rules_of(lint(knob, "karpenter_tpu/obs/export.py")) == []
+
+    def test_tuning_package_is_exempt(self):
+        # the registry's own from-env fallback is the sanctioned read
+        src = """
+        import os
+
+        def refresh(self):
+            return os.environ.get("KT_MAX_SLOTS")
+        """
+        assert rules_of(lint(src, "karpenter_tpu/tuning/knobs.py")) == []
+
+    def test_dynamic_name_is_skipped_not_flagged(self):
+        src = """
+        import os
+
+        def read(self, name):
+            return os.environ.get(name)
+        """
+        assert rules_of(lint(src, self.SERVING)) == []
+
+    def test_suppression_with_reason(self):
+        src = """
+        import os
+
+        def legacy(self):
+            # ktlint: allow[KT024] pre-registry compat shim, ISSUE 20
+            return os.environ.get("KT_MAX_SLOTS", "8")
+        """
+        assert rules_of(lint(src, self.SERVING)) == []
+
+    def test_package_is_clean(self):
+        # the refactor's point: NO serving-path file reads a knob env at
+        # call time anymore — everything routes through the registry
+        from karpenter_tpu.analysis.rules import kt024
+
+        active, _supp, n_files = analyze_package(rules=[kt024])
+        assert n_files > 60
+        assert active == [], "\n".join(f.format() for f in active)

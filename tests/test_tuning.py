@@ -1,0 +1,490 @@
+"""Self-tuning serving (ISSUE 19): the live knob registry and the online
+feedback controller.
+
+Four surfaces:
+
+1. **Registry semantics** — typed knobs with bounded lattices; env values
+   stay the call-time defaults (existing KT_* workflows untouched);
+   ``set``/``update`` are lattice-validated and all-or-nothing; the relax
+   lattice mirrors the compile-rung ladder so tuning can never mint a new
+   compile signature.
+2. **Snapshot atomicity** — a tuner update racing ``snapshot()`` (and the
+   pipeline's per-iteration ``_apply_knobs``) is observed WHOLE: old
+   values or new values, never a mix.  ``make battletest`` re-runs this
+   under KT_SANITIZE=1 lock-discipline proxies.
+3. **Controller guardrails** — the burn-rate freeze (no move while any
+   class SLO verdict is warn/breach) and the frozen-baseline revert (a
+   step whose observation window regressed throughput or critical p99 is
+   always rolled back to the exact prior lattice value) are seeded
+   regression tests, not claims.
+4. **Surface** — ``karpenter_tuning_*`` metrics move per decision and the
+   /tunez document renders the knob table + decision ring.
+"""
+
+import threading
+
+import pytest
+
+from karpenter_tpu.metrics import (
+    Registry,
+    TUNING_KNOB_VALUE,
+    TUNING_STEP_DURATION,
+    TUNING_STEPS,
+)
+from karpenter_tpu.tuning.controller import (
+    COOLDOWN_STEPS,
+    TuningController,
+    tune_enabled,
+    tune_interval_s,
+    zero_init,
+)
+from karpenter_tpu.tuning.knobs import (
+    KNOB_ENVS,
+    KnobSnapshot,
+    Knobs,
+    RELAX_ITER_LATTICE,
+    SPECS,
+)
+
+
+def fresh_knobs(**kw):
+    kw.setdefault("frozen", frozenset())
+    return Knobs(**kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_knob_env(monkeypatch):
+    for env in KNOB_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.delenv("KT_TUNE_FREEZE", raising=False)
+
+
+class TestKnobRegistry:
+    def test_relax_lattice_mirrors_compile_rungs(self):
+        # knobs.py cannot import relax (jax); the mirror is pinned HERE
+        from karpenter_tpu.solver import relax
+
+        assert tuple(RELAX_ITER_LATTICE) == tuple(relax.RELAX_ITER_RUNGS)
+
+    def test_env_is_the_call_time_default(self, monkeypatch):
+        k = fresh_knobs()
+        assert k.get("max_slots") == 8
+        monkeypatch.setenv("KT_MAX_SLOTS", "16")
+        assert k.get("max_slots") == 16       # read at call time, not ctor
+        monkeypatch.setenv("KT_MAX_SLOTS", "not-a-number")
+        assert k.get("max_slots") == 8        # bad value -> built-in
+
+    def test_off_lattice_env_override_is_honored(self, monkeypatch):
+        # an operator's explicit KT_MAX_SLOTS=24 wins even off-lattice;
+        # only the CONTROLLER is lattice-bound
+        monkeypatch.setenv("KT_MAX_SLOTS", "24")
+        k = fresh_knobs()
+        assert k.get("max_slots") == 24
+        assert k.snapshot().max_slots == 24
+        assert not k.snapshot().is_overridden("max_slots")
+
+    def test_set_is_lattice_validated(self):
+        k = fresh_knobs()
+        assert k.set("max_slots", 16)
+        assert k.get("max_slots") == 16
+        assert not k.set("max_slots", 3)      # off-lattice
+        assert k.get("max_slots") == 16
+        assert not k.set("no_such_knob", 1)
+
+    def test_update_is_all_or_nothing(self):
+        k = fresh_knobs()
+        assert not k.update(max_wait_ms=5.0, max_slots=3)  # 3 off-lattice
+        assert k.get("max_wait_ms") == 0.0                 # neither landed
+        assert k.get("max_slots") == 8
+        assert k.update(max_wait_ms=5.0, max_slots=16)
+        assert (k.get("max_wait_ms"), k.get("max_slots")) == (5.0, 16)
+
+    def test_reset_restores_env_default(self, monkeypatch):
+        monkeypatch.setenv("KT_HIER_THRESHOLD", "1234")
+        k = fresh_knobs()
+        k.set("hier_threshold", 200_000)
+        assert k.get("hier_threshold") == 200_000
+        k.reset("hier_threshold")
+        assert k.get("hier_threshold") == 1234
+
+    def test_freeze_env_and_api(self, monkeypatch):
+        monkeypatch.setenv("KT_TUNE_FREEZE", "max_slots, brownout_ms")
+        k = Knobs()
+        assert k.frozen("max_slots") and k.frozen("brownout_ms")
+        assert not k.set("max_slots", 16)
+        # a frozen member rejects the WHOLE batch (all-or-nothing)
+        assert not k.update(max_wait_ms=5.0, max_slots=16)
+        assert k.get("max_wait_ms") == 0.0
+        k.thaw("max_slots")
+        assert k.set("max_slots", 16)
+        k.freeze("max_slots")
+        assert not k.set("max_slots", 8)
+
+    def test_lattice_stepping(self, monkeypatch):
+        k = fresh_knobs()
+        assert k.step("max_slots", +1) == 16
+        assert k.step("max_slots", -1) == 4
+        k.set("max_slots", 32)
+        assert k.step("max_slots", +1) is None     # lattice edge
+        # off-lattice env value steps onto the nearest admissible rung
+        k2 = fresh_knobs()
+        monkeypatch.setenv("KT_MAX_SLOTS", "24")
+        assert k2.step("max_slots", +1) == 32
+        assert k2.step("max_slots", -1) == 16
+        # bool knobs flip
+        assert k.step("inline_delta", +1) is False
+
+    def test_snapshot_is_immutable(self):
+        snap = fresh_knobs().snapshot()
+        with pytest.raises(AttributeError):
+            snap.max_slots = 99
+        with pytest.raises(TypeError):
+            snap.values["max_slots"] = 99
+        assert snap.get("max_slots") == 8 and snap.max_slots == 8
+        assert isinstance(snap, KnobSnapshot)
+
+    def test_describe_renders_every_spec(self):
+        k = fresh_knobs()
+        k.set("max_slots", 16)
+        k.freeze("brownout_ms")
+        doc = k.describe()
+        assert set(doc) == {s.name for s in SPECS}
+        assert doc["max_slots"]["value"] == 16
+        assert doc["max_slots"]["overridden"] is True
+        assert doc["brownout_ms"]["frozen"] is True
+        assert doc["max_wait_ms"]["env"] == "KT_MAX_WAIT_MS"
+        assert doc["relax_iters"]["lattice"] == list(RELAX_ITER_LATTICE)
+
+    def test_enable_knobs(self, monkeypatch):
+        assert not tune_enabled()
+        monkeypatch.setenv("KT_TUNE", "1")
+        assert tune_enabled()
+        monkeypatch.setenv("KT_TUNE_INTERVAL_S", "7.5")
+        assert tune_interval_s() == 7.5
+        monkeypatch.setenv("KT_TUNE_INTERVAL_S", "junk")
+        assert tune_interval_s() == 30.0
+
+
+class TestSnapshotAtomicity:
+    """The tear-freedom contract (ISSUE 19 satellite): a tuner update
+    racing a megabatch flush / brownout evaluation is observed whole.
+    ``make battletest`` re-runs these under KT_SANITIZE=1."""
+
+    PAIRS = [(0.0, 8), (1.0, 4), (5.0, 16), (10.0, 32), (20.0, 2)]
+
+    def test_snapshot_never_tears(self):
+        k = fresh_knobs()
+        valid = set(self.PAIRS)
+        stop = threading.Event()
+        torn = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                w, s = self.PAIRS[i % len(self.PAIRS)]
+                assert k.update(max_wait_ms=w, max_slots=s)
+                i += 1
+
+        def reader():
+            while not stop.is_set():
+                snap = k.snapshot()
+                pair = (snap.max_wait_ms, snap.max_slots)
+                if pair not in valid:
+                    torn.append(pair)
+
+        threads = [threading.Thread(target=writer)] + [
+            threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            import time
+            time.sleep(0.5)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(5.0)
+        assert torn == [], f"torn snapshots observed: {torn[:5]}"
+
+    def test_pipeline_apply_observes_whole_snapshots(self):
+        """The pipeline's per-iteration ``_apply_knobs`` (the point a
+        flush reads its wait/slots and the brownout ladder overlays)
+        lands paired tuner updates whole on the coalescer — while the
+        live dispatcher thread runs its own idle-tick applications and
+        brownout evaluations concurrently."""
+        from karpenter_tpu.admission import AdmissionControl
+        from karpenter_tpu.service.server import SolvePipeline
+
+        class StubScheduler:
+            backend = "oracle"
+
+        reg = Registry()
+        k = fresh_knobs()
+        pipe = SolvePipeline(StubScheduler(), registry=reg,
+                             admission=AdmissionControl(registry=reg),
+                             knobs=k, max_slots=8, max_wait_ms=0.0)
+        valid = {(w / 1000.0, s) for w, s in self.PAIRS}
+        stop = threading.Event()
+        torn = []
+        try:
+            def writer():
+                i = 0
+                while not stop.is_set():
+                    w, s = self.PAIRS[i % len(self.PAIRS)]
+                    assert k.update(max_wait_ms=w, max_slots=s)
+                    i += 1
+
+            def applier():
+                while not stop.is_set():
+                    with pipe._sched_lock:
+                        pipe._apply_knobs()
+                        pair = (pipe._coal.max_wait, pipe._coal.max_slots)
+                    if pair not in valid:
+                        torn.append(pair)
+
+            threads = [threading.Thread(target=writer),
+                       threading.Thread(target=applier),
+                       threading.Thread(target=applier)]
+            for t in threads:
+                t.start()
+            import time
+            time.sleep(0.5)
+            stop.set()
+            for t in threads:
+                t.join(5.0)
+        finally:
+            stop.set()
+            pipe.stop()
+        assert torn == [], f"torn applications observed: {torn[:5]}"
+
+
+class FakeSampler:
+    """Windowed-signal stub: per-class served rates + a critical p99."""
+
+    interval_s = 1.0
+
+    def __init__(self):
+        self.rates = {"critical": 10.0, "batch": 50.0, "best_effort": 5.0}
+        self.p99 = 0.05
+        self.hooks = []
+
+    def add_hook(self, hook):
+        self.hooks.append(hook)
+
+    def increase(self, name, labels=None, window_s=300.0):
+        rate = self.rates.get((labels or {}).get("class"))
+        return None if rate is None else rate * window_s
+
+    def quantile(self, name, q, labels=None, window_s=300.0):
+        return self.p99
+
+    def scale(self, factor):
+        self.rates = {c: r * factor for c, r in self.rates.items()}
+
+
+class FakeSlo:
+    def __init__(self, verdict="ok"):
+        self.verdict = verdict
+
+    def evaluate(self):
+        return {"classes": {"critical": {"verdict": self.verdict},
+                            "batch": {"verdict": "ok"}}}
+
+
+def make_controller(tuned=("max_slots",), slo=None, sampler=None,
+                    knobs=None, registry=None):
+    sampler = sampler or FakeSampler()
+    return TuningController(
+        knobs=knobs or fresh_knobs(), registry=registry or Registry(),
+        sampler=sampler, slo=slo or FakeSlo(), interval_s=10.0,
+        window_s=10.0, tuned=tuned), sampler
+
+
+class TestControllerGuardrails:
+    def test_probe_then_keep_on_flat_window(self):
+        ctl, _ = make_controller()
+        assert ctl.step(0.0) == "applied"
+        assert ctl.knobs.get("max_slots") == 16
+        assert ctl.step(10.0) == "kept"           # flat window: hold
+        assert ctl.knobs.get("max_slots") == 16
+        assert ctl.decisions[-1]["reason"] == "flat"
+
+    def test_regressed_throughput_always_reverts(self):
+        """THE guardrail: a step whose observation window regressed the
+        objective is rolled back to the exact prior lattice value."""
+        ctl, sampler = make_controller()
+        assert ctl.step(0.0) == "applied"
+        sampler.scale(0.5)                        # window regressed
+        assert ctl.step(10.0) == "reverted"
+        assert ctl.knobs.get("max_slots") == 8    # exact prior value
+        assert ctl.decisions[-1]["reason"] == "throughput"
+        assert not ctl.knobs.snapshot().is_overridden("max_slots") or \
+            ctl.knobs.get("max_slots") == 8
+
+    def test_critical_p99_regression_reverts(self):
+        # throughput held but critical p99 blew the 1.05x slack
+        ctl, sampler = make_controller()
+        assert ctl.step(0.0) == "applied"
+        sampler.p99 = 0.2
+        assert ctl.step(10.0) == "reverted"
+        assert ctl.knobs.get("max_slots") == 8
+        assert ctl.decisions[-1]["reason"] == "p99"
+
+    def test_burn_rate_freezes_proposals(self):
+        ctl, _ = make_controller(slo=FakeSlo("warn"))
+        assert ctl.step(0.0) == "frozen"
+        assert ctl.knobs.get("max_slots") == 8    # nothing moved
+        assert ctl.decisions[-1]["reason"] == "burn"
+
+    def test_burn_mid_probe_reverts_not_judges(self):
+        slo = FakeSlo("ok")
+        ctl, sampler = make_controller(slo=slo)
+        assert ctl.step(0.0) == "applied"
+        sampler.scale(2.0)            # window looks great, but...
+        slo.verdict = "breach"        # ...a class is burning: revert
+        assert ctl.step(10.0) == "reverted"
+        assert ctl.knobs.get("max_slots") == 8
+        assert ctl.decisions[-1]["reason"] == "burn"
+
+    def test_slo_evaluation_failure_freezes(self):
+        class BrokenSlo:
+            def evaluate(self):
+                raise RuntimeError("boom")
+
+        ctl, _ = make_controller(slo=BrokenSlo())
+        assert ctl.step(0.0) == "frozen"
+        assert ctl.knobs.get("max_slots") == 8
+
+    def test_no_windowed_data_never_moves(self):
+        sampler = FakeSampler()
+        sampler.rates = {}
+        ctl, _ = make_controller(sampler=sampler)
+        assert ctl.step(0.0) == "skipped"
+        assert ctl.decisions[-1]["reason"] == "no_data"
+
+    def test_no_data_mid_probe_reverts(self):
+        ctl, sampler = make_controller()
+        assert ctl.step(0.0) == "applied"
+        sampler.rates = {}
+        assert ctl.step(10.0) == "reverted"
+        assert ctl.knobs.get("max_slots") == 8
+        assert ctl.decisions[-1]["reason"] == "no_data"
+
+    def test_reverted_direction_cools_down(self):
+        ctl, sampler = make_controller()
+        ctl.step(0.0)                             # probe 8 -> 16
+        sampler.scale(0.5)
+        ctl.step(10.0)                            # reverted; (+1) cools
+        sampler.scale(2.0)                        # traffic back
+        # next proposal must try the OTHER direction, not re-probe up
+        assert ctl.step(20.0) == "applied"
+        assert ctl.knobs.get("max_slots") == 4
+        probe = ctl.tunez()["probe"]
+        assert probe["knob"] == "max_slots" and probe["to"] == 4
+
+    def test_improvement_gives_momentum(self):
+        ctl, sampler = make_controller()
+        assert ctl.step(0.0) == "applied"         # 8 -> 16
+        sampler.scale(1.2)                        # strict improvement
+        assert ctl.step(10.0) == "kept"
+        assert ctl.decisions[-1]["reason"] == "improved"
+        assert ctl.step(20.0) == "applied"        # same knob, same dir
+        assert ctl.knobs.get("max_slots") == 32
+
+    def test_frozen_knob_is_never_proposed(self):
+        k = fresh_knobs()
+        k.freeze("max_slots")
+        ctl, _ = make_controller(knobs=k)
+        assert ctl.step(0.0) == "skipped"
+        assert ctl.decisions[-1]["reason"] == "edge_or_cooldown"
+
+    def test_round_robin_covers_all_tuned_knobs(self):
+        ctl, sampler = make_controller(
+            tuned=("max_wait_ms", "max_slots", "brownout_ms",
+                   "relax_iters"))
+        touched = set()
+        t = 0.0
+        for _ in range(16):
+            ctl.step(t)
+            t += 10.0
+            probe = ctl.tunez()["probe"]
+            if probe:
+                touched.add(probe["knob"])
+        assert touched == {"max_wait_ms", "max_slots", "brownout_ms",
+                           "relax_iters"}
+
+
+class TestControllerSurface:
+    def test_on_tick_paces_to_interval(self):
+        ctl, _ = make_controller()
+        ctl.on_tick(0.0)              # first tick only stamps
+        assert len(ctl.decisions) == 0
+        ctl.on_tick(5.0)              # inside the interval: no step
+        assert len(ctl.decisions) == 0
+        ctl.on_tick(10.0)
+        assert len(ctl.decisions) == 1
+
+    def test_metrics_move_per_decision(self):
+        reg = Registry()
+        ctl, sampler = make_controller(registry=reg)
+        ctl.step(0.0)
+        steps = reg.counter(TUNING_STEPS)
+        assert steps.get({"knob": "max_slots", "outcome": "applied"}) == 1
+        gauge = reg.gauge(TUNING_KNOB_VALUE)
+        assert gauge.get({"knob": "max_slots"}) == 16.0
+        sampler.scale(0.5)
+        ctl.step(10.0)
+        assert steps.get({"knob": "max_slots", "outcome": "reverted"}) == 1
+        assert gauge.get({"knob": "max_slots"}) == 8.0
+        assert reg.histogram(TUNING_STEP_DURATION).count() == 2
+
+    def test_zero_init_registers_full_population(self):
+        reg = Registry()
+        zero_init(reg)
+        steps = reg.counter(TUNING_STEPS)
+        for s in SPECS:
+            for outcome in ("applied", "kept", "reverted", "frozen",
+                            "skipped"):
+                assert steps.has({"knob": s.name, "outcome": outcome})
+                assert steps.get({"knob": s.name, "outcome": outcome}) == 0
+        assert steps.has({"knob": "none", "outcome": "skipped"})
+        assert reg.gauge(TUNING_KNOB_VALUE).has({"knob": "max_slots"})
+
+    def test_tunez_document(self):
+        ctl, _ = make_controller()
+        ctl.step(0.0)
+        doc = ctl.tunez()
+        assert doc["enabled"] is True
+        assert doc["tuned"] == ["max_slots"]
+        assert doc["steps"] == 1
+        assert doc["probe"]["knob"] == "max_slots"
+        assert set(doc["knobs"]) == {s.name for s in SPECS}
+        assert doc["decisions"][-1]["outcome"] == "applied"
+        import json
+        json.dumps(doc)               # the /tunez view must serialize
+
+    def test_tune_step_traces_every_decision(self):
+        from karpenter_tpu.obs.trace import Tracer
+
+        tracer = Tracer(registry=Registry())
+        finished = []
+        tracer.add_sink(finished.append)
+        ctl, _ = make_controller()
+        ctl.tracer = tracer
+        ctl.step(0.0)
+        assert [t.name for t in finished] == ["tune_step"]
+        attrs = finished[0].root.attrs
+        assert attrs["knob"] == "max_slots"
+        assert attrs["outcome"] == "applied"
+
+    def test_cooldown_expires_after_steps(self):
+        ctl, sampler = make_controller()
+        ctl.step(0.0)
+        sampler.scale(0.5)
+        ctl.step(10.0)                # revert: (max_slots, +1) cools
+        assert ctl._cooldown
+        sampler.scale(2.0)
+        t = 20.0
+        for _ in range(COOLDOWN_STEPS + 1):
+            ctl.step(t)
+            t += 10.0
+        assert not ctl._cooldown
