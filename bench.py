@@ -412,6 +412,13 @@ WARMSTART_P50_BUDGET_MS = 1.0
 #: dispatch must beat the serial per-candidate loop by at least this factor
 SWEEP_SPEEDUP_MIN = 5.0
 
+#: gang gates (ISSUE 20): the all-or-nothing epilogue must never ship a
+#: partial gang under engineered infeasibility, the packing what-if must
+#: land a co-locatable gang in strictly fewer topology domains than naive
+#: per-pod placement, and a gang-FREE batch must not pay more than this
+#: for the armed machinery (the epilogue's has_gangs() early-out)
+GANG_LATENCY_RATIO_MAX = 1.10
+
 #: delta-serving gates (ISSUE 10): the end-to-end number users see — a
 #: steady-state churn RPC through the session-stateful SolveDelta protocol
 #: (encode perturbation -> gRPC loopback -> admission -> warm-start step ->
@@ -792,6 +799,34 @@ def check_budgets(rec):
             "wave (contract: every wave is ONE vmapped dispatch)")
     if rec.get("hier_error"):
         flags.append(f"hierarchical bench fell back: {rec['hier_error']}")
+    # gang gates (ISSUE 20): all-or-nothing proven under engineered
+    # infeasibility, packing beats naive per-pod spread, and gang-free
+    # batches don't pay for the armed machinery
+    gav = rec.get("gang_atomicity_violations")
+    if gav:
+        flags.append(
+            f"{gav:.0f} gang(s) shipped PARTIALLY placed under engineered "
+            "infeasibility — the all-or-nothing contract is broken")
+    if rec.get("gang_retracted_untyped"):
+        flags.append(
+            "retracted gang member(s) missing the typed GangUnplaced "
+            "reason — callers cannot distinguish gang retraction from "
+            "ordinary infeasibility")
+    gsn, gsp = rec.get("gang_spread_naive_zones"), rec.get(
+        "gang_spread_packed_zones")
+    if gsn is not None and gsp is not None and gsp >= gsn:
+        flags.append(
+            f"gang packing shipped {gsp:.0f} zone(s) vs naive per-pod "
+            f"{gsn:.0f} — the co-location what-if is not engaging")
+    if rec.get("gang_pack_whole") is False:
+        flags.append(
+            "the packed gang lost member(s) — packing must preserve "
+            "all-or-nothing")
+    glr = rec.get("gang_latency_ratio")
+    if glr is not None and glr > GANG_LATENCY_RATIO_MAX:
+        flags.append(
+            f"gang-free solve pays {glr:.2f}x with the gang machinery "
+            f"armed (budget {GANG_LATENCY_RATIO_MAX}x)")
     # self-tuning gates (ISSUE 19): the controller must pay for itself on
     # replayed production shapes — never-worse throughput, the protected
     # class held, and its own decision loop nearly free
@@ -2776,6 +2811,166 @@ def _tensors_identical(a, b) -> bool:
     return True
 
 
+def measure_gang():
+    """Gang gates (ISSUE 20, docs/GANGS.md): (a) zero atomicity violations
+    under engineered infeasibility — gangs doomed by an unsatisfiable
+    member or an incomplete roster must retract EVERY seat with the typed
+    reason; (b) on a co-locatable scenario (free existing capacity
+    scattered across zones) the packing what-if must ship the gang in
+    strictly fewer zones than naive per-pod placement; (c) a gang-free
+    batch with the machinery armed must stay within
+    GANG_LATENCY_RATIO_MAX of the KT_GANG=0 path (paired-median)."""
+    import dataclasses
+    import gc
+    import statistics
+
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.catalog import DEFAULT_ZONES, generate_catalog
+    from karpenter_tpu.models.instancetype import GIB
+    from karpenter_tpu.models.pod import PodSpec
+    from karpenter_tpu.models.provisioner import Provisioner
+    from karpenter_tpu.solver.scheduler import BatchScheduler
+    from karpenter_tpu.solver.types import SimNode
+
+    catalog = generate_catalog(full=False)
+    provs = [Provisioner(name="default").with_defaults()]
+
+    def member(gid, i, size, cpu=1.0, sel=None):
+        return PodSpec(
+            name=f"{gid}-m{i}", labels={"app": gid},
+            requests={"cpu": cpu, "memory": 0.5 * GIB},
+            node_selector=dict(sel or {}), owner_key=gid,
+            gang_id=gid, gang_size=size)
+
+    # (a) atomicity under engineered infeasibility: per variant, one
+    # feasible gang, one doomed by an unsatisfiable member pin, one
+    # submitted with an incomplete roster, plus singleton ballast
+    violations = untyped = retracted = placed = 0
+    for v in range(4):
+        pods = [member("bg-ok", i, 4) for i in range(4)]
+        doomed = [member("bg-pin", i, 4 + v) for i in range(4 + v)]
+        doomed[v % len(doomed)] = dataclasses.replace(
+            doomed[v % len(doomed)],
+            node_selector={L.ZONE: "zone-none"})
+        short = [member("bg-short", i, 8) for i in range(3 + v)]
+        singles = [PodSpec(name=f"bs{v}-{i}", labels={"app": "bs"},
+                           requests={"cpu": 0.5, "memory": 0.5 * GIB},
+                           owner_key="bs")
+                   for i in range(8)]
+        res = BatchScheduler(backend="tpu").solve(
+            pods + doomed + singles + short, provs, catalog)
+        for gang in (pods, doomed, short):
+            seated = [p for p in gang if p.name in res.assignments]
+            if seated and len(seated) != len(gang):
+                violations += 1
+            elif not seated:
+                retracted += 1
+                if not all(
+                        str(res.infeasible.get(p.name, "")).startswith(
+                            "GangUnplaced") for p in gang):
+                    untyped += 1
+            else:
+                placed += 1
+
+    # (b) co-locatable spread: 2 free CPUs on one existing node per zone,
+    # a 6x1cpu gang — naive per-pod placement (KT_GANG=0) fills the free
+    # capacity across all three zones; the epilogue's packing what-if
+    # should buy one cheap node and land the gang in ONE zone
+    def spread_cluster():
+        nodes = []
+        for zi, z in enumerate(DEFAULT_ZONES):
+            n = SimNode(
+                instance_type="m5.xlarge", provisioner="default",
+                zone=z, capacity_type="on-demand", price=0.192,
+                allocatable={L.RESOURCE_CPU: 4.0,
+                             L.RESOURCE_MEMORY: 14.8 * GIB,
+                             L.RESOURCE_PODS: 110.0},
+                existing=True, name=f"gsp{zi}")
+            n.stamp_labels()
+            n.pods.append(PodSpec(
+                name=f"gsp{zi}-fill", labels={"app": "fill"},
+                requests={"cpu": 2.0, "memory": 2.0 * GIB},
+                owner_key="fill"))
+            nodes.append(n)
+        return nodes
+
+    gang6 = [member("bg-pack", i, 6) for i in range(6)]
+
+    def zones_of(res, members):
+        by_node = {n.name: n.zone
+                   for n in list(res.existing_nodes) + list(res.nodes)}
+        return {by_node[res.assignments[p.name]] for p in members
+                if p.name in res.assignments}
+
+    os.environ["KT_GANG"] = "0"
+    try:
+        naive = BatchScheduler(backend="tpu").solve(
+            gang6, provs, catalog, existing_nodes=spread_cluster())
+    finally:
+        os.environ.pop("KT_GANG", None)
+    packed = BatchScheduler(backend="tpu").solve(
+        gang6, provs, catalog, existing_nodes=spread_cluster())
+    spread_naive = len(zones_of(naive, gang6))
+    spread_packed = len(zones_of(packed, gang6))
+    packed_whole = all(p.name in packed.assignments for p in gang6)
+
+    # (c) gang-free latency: the armed epilogue's has_gangs() early-out
+    # must make gang-free batches free — paired-median on/off ratio
+    free_pods = [PodSpec(name=f"gf-{d}-{i}", labels={"app": f"gfd{d}"},
+                         requests={"cpu": 0.25 * (1 + d % 3),
+                                   "memory": (0.5 + d % 4) * GIB},
+                         owner_key=f"gfd{d}")
+                 for d in range(8) for i in range(40)]
+    sched = BatchScheduler(backend="tpu")
+    sched.solve(free_pods, provs, catalog)  # warm
+
+    def _solve_wall():
+        # best-of-3: host scheduling jitter on a ~25 ms CPU solve dwarfs
+        # the early-out under test; the floor is the honest signal
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            sched.solve(free_pods, provs, catalog)
+            best = min(best, (time.perf_counter() - t0) * 1000.0)
+        return best
+
+    ratios = []
+    gc_was = gc.isenabled()
+    gc.disable()
+    try:
+        for k in range(9):
+            gc.collect()
+            if k % 2 == 0:
+                on_ms = _solve_wall()
+                os.environ["KT_GANG"] = "0"
+                try:
+                    off_ms = _solve_wall()
+                finally:
+                    os.environ.pop("KT_GANG", None)
+            else:
+                os.environ["KT_GANG"] = "0"
+                try:
+                    off_ms = _solve_wall()
+                finally:
+                    os.environ.pop("KT_GANG", None)
+                on_ms = _solve_wall()
+            ratios.append(on_ms / max(off_ms, 1e-9))
+    finally:
+        if gc_was:
+            gc.enable()
+
+    return {
+        "gang_atomicity_violations": violations,
+        "gang_retracted_untyped": untyped,
+        "gang_gangs_retracted": retracted,
+        "gang_gangs_placed": placed,
+        "gang_spread_naive_zones": spread_naive,
+        "gang_spread_packed_zones": spread_packed,
+        "gang_pack_whole": packed_whole,
+        "gang_latency_ratio": round(statistics.median(ratios), 4),
+    }
+
+
 def run_bench():
     from karpenter_tpu.models.tensorize import TensorizeCache, tensorize
     from karpenter_tpu.solver import reference
@@ -2831,6 +3026,7 @@ def run_bench():
     delta_serving = measure_delta_serving()
     cold_restart = measure_cold_restart()
     hierarchical = measure_hierarchical()
+    gang = measure_gang()
     restart_recovery = measure_restart_recovery()
     fleet_failover = measure_fleet_failover()
     multihost = measure_multihost_fence()
@@ -2882,6 +3078,7 @@ def run_bench():
         **delta_serving,
         **cold_restart,
         **hierarchical,
+        **gang,
         **restart_recovery,
         **fleet_failover,
         **multihost,

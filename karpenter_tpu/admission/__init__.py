@@ -20,6 +20,16 @@ guide).  Four mechanisms compose behind :class:`AdmissionControl`, which
 
 ``KT_ADMISSION=0`` disables the subsystem entirely: the pipeline keeps
 its PR-4 FIFO verbatim and behavior is byte-identical to pre-admission.
+
+Gang contract (ISSUE 20, docs/GANGS.md): a gang is ONE admission unit.
+The queue admits/sheds whole REQUESTS — never individual pods — so a
+request carrying a gang is judged whole by construction: a shed sheds
+every member together (the typed ``SolveShedError`` covers the gang),
+and no path here may admit or refuse a gang-tagged pod individually
+(ktlint KT025 flags per-member ``gang_id`` access in this package; the
+sanctioned entry points are ``karpenter_tpu.gang``'s helpers, e.g.
+``gang.admission_units`` for ticket accounting and
+``gang.validate_batch`` at the service door).
 """
 
 from __future__ import annotations

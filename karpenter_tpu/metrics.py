@@ -371,6 +371,18 @@ TUNING_STEPS = "karpenter_tuning_steps_total"
 TUNING_STEP_OUTCOMES = ("applied", "kept", "reverted", "frozen", "skipped")
 TUNING_KNOB_VALUE = "karpenter_tuning_knob_value"
 TUNING_STEP_DURATION = "karpenter_tuning_step_duration_seconds"
+# ---- gang scheduling (ISSUE 20: karpenter_tpu/gang/) --------------------
+GANG_GANGS = "karpenter_solver_gang_gangs_total"
+#: per-gang epilogue outcomes (KT003 zero-init source — gang.zero_init_
+#: gang_metrics, called from BatchScheduler construction): 'placed' (every
+#: member seated, scan placement kept), 'packed' (every member seated and
+#: the co-location repack adopted a strictly cheaper spread), 'retracted'
+#: (a member was infeasible — the WHOLE gang's seats were retracted and
+#: every member surfaced as GangUnplaced; never a partial placement)
+GANG_OUTCOMES = ("placed", "packed", "retracted")
+GANG_SPREAD_ZONES = "karpenter_solver_gang_spread_zones"
+GANG_SPREAD_CLASSES = "karpenter_solver_gang_spread_node_classes"
+GANG_DURATION = "karpenter_solver_gang_duration_seconds"
 # ---- /fleetz peer-fetch accounting (ISSUE 18 satellite) -----------------
 FLEET_PEER_FETCH = "karpenter_fleet_peer_fetch_total"
 #: per-peer /fleetz fan-out outcomes (KT003 zero-init source): 'ok'
@@ -921,6 +933,29 @@ INVENTORY = {
         "Per-peer /fleetz fan-out fetches by outcome ('ok' / 'timeout' "
         "/ 'error'); failed peers are marked stale in the merged view "
         "instead of degrading the whole aggregation."),
+    GANG_GANGS: (
+        "counter", ("outcome",),
+        "Gangs judged by the all-or-nothing epilogue (docs/GANGS.md), by "
+        "outcome: 'placed' (every member seated; scan placement kept), "
+        "'packed' (every member seated and the co-location repack adopted "
+        "a strictly cheaper node-cost + spread objective), 'retracted' (a "
+        "member was infeasible, so the whole gang's seats were retracted "
+        "and every member surfaced with the typed GangUnplaced reason — a "
+        "partial gang placement is impossible by construction)."),
+    GANG_SPREAD_ZONES: (
+        "histogram", (),
+        "Distinct zones each fully-placed gang's members landed on (1 = "
+        "perfectly co-located; the spread penalty the gang epilogue "
+        "minimizes weighs zones first, node classes second)."),
+    GANG_SPREAD_CLASSES: (
+        "histogram", (),
+        "Distinct node classes (instance types — the rack proxy) each "
+        "fully-placed gang's members landed on."),
+    GANG_DURATION: (
+        "histogram", (),
+        "Wall time of one gang epilogue pass (membership audit + any "
+        "retraction re-solve + co-location repack what-ifs), seconds; "
+        "gang-free batches skip the pass entirely."),
 }
 
 

@@ -146,6 +146,12 @@ class PodSpec:
     volume_zone_requirements: List[Requirement] = field(default_factory=list)
     do_not_evict: bool = False
     is_daemon: bool = False  # daemonset-owned: never blocks drain/emptiness
+    # gang scheduling (docs/GANGS.md): members of one gang share a gang_id
+    # and carry the gang's total size; ""/0 = ungrouped (old wire bytes
+    # decode to exactly this).  A gang either FULLY places or contributes
+    # zero nodes — enforced by karpenter_tpu/gang/ in the solve epilogue.
+    gang_id: str = ""
+    gang_size: int = 0
     uid: int = field(default_factory=lambda: next(_pod_counter))
 
     def __post_init__(self) -> None:
@@ -224,4 +230,10 @@ class PodSpec:
             self.priority,
             (tuple(self.volume_zone_requirements)
              if self.volume_zone_requirements else ()),
+            # gang identity splits dedup groups: two gangs with identical
+            # specs must stay separately retractable (all-or-nothing is
+            # judged per gang_id), and the relax/hierarchy rungs key gang
+            # coupling off the group
+            self.gang_id,
+            self.gang_size,
         )

@@ -137,6 +137,11 @@ class SolveTensors:
     #: constraint couples groups through the shared ct domains and limits),
     #: and the native tier declines them (native.has_topology)
     has_ct_spread: bool = False
+    # gang tag per group (ISSUE 20, docs/GANGS.md): ordinal into the batch's
+    # gang roster, -1 ungrouped.  Consumed host-side only (hierarchy's
+    # union-find joins equal tags so a gang is never split across blocks) —
+    # the device scan never sees it, so gang-free tensors stay byte-stable
+    g_gang: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.int32))
 
     @property
     def G(self) -> int:
@@ -298,6 +303,8 @@ def group_pods(pods: Sequence[PodSpec]) -> List[PodGroup]:
                     and p.required_affinity_terms == rep.required_affinity_terms
                     and p.preferred_affinity_terms == rep.preferred_affinity_terms
                     and p.volume_zone_requirements == rep.volume_zone_requirements
+                    and p.gang_id == rep.gang_id
+                    and p.gang_size == rep.gang_size
                 ):
                     grp.pods.append(p)
                     continue
@@ -792,6 +799,17 @@ def tensorize(
     key_check[zone_key] = False
     key_check[ct_key] = False
 
+    # ---- gang tags ------------------------------------------------------
+    # ordinal per distinct gang_id, first-seen order over the FFD-sorted
+    # groups; group_key includes gang_id, so a gang's members can span
+    # several groups (heterogeneous ranks) but a group never mixes gangs
+    g_gang = np.full(G, -1, dtype=np.int32)
+    gang_ord: Dict[str, int] = {}
+    for gi, g in enumerate(groups):
+        gid = g.pods[0].gang_id
+        if gid:
+            g_gang[gi] = gang_ord.setdefault(gid, len(gang_ord))
+
     return SolveTensors(
         vocab=vocab,
         groups=groups,
@@ -829,4 +847,5 @@ def tensorize(
         g_host_paff=g_host_paff,
         g_positive_affinity=g_unsupported,
         has_ct_spread=batch_needs_oracle(g.pods[0] for g in groups),
+        g_gang=g_gang,
     )

@@ -24,6 +24,7 @@ import grpc
 from collections import OrderedDict
 
 from .. import faults as faults_mod
+from .. import gang as gangmod
 from ..admission import SolveDeadlineError, SolveShedError, parse_class
 from ..metrics import (
     FLEET_ENDPOINTS,
@@ -700,6 +701,12 @@ class RemoteScheduler:
         # rung is a server-side refinement governed by the sidecar's own
         # KT_RELAX policy (the wire carries no per-request override), so
         # only the local-fallback solve below honors the caller's value
+        #
+        # gang audit client-side (ISSUE 20): a malformed gang would only
+        # bounce off the server's INVALID_ARGUMENT — raise the same typed
+        # error here, before paying the round trip (and identically on the
+        # degraded local path, which skips the server's door check)
+        gangmod.validate_batch(pods)
         trace = trace or NULL_TRACE
         if self._remote_ok():
             # fleet-wide tracing (ISSUE 15): the "remote" span's wire
@@ -1014,6 +1021,8 @@ class DeltaSession:
     ) -> SolveResult:
         """(Re-)establish the session: full solve, full cluster on the
         wire, ledger reset to the arguments."""
+        # same fail-fast gang audit as the server door (ISSUE 20)
+        gangmod.validate_batch(pods)
         self._pods = {p.name: p for p in pods}
         self._provisioners = list(provisioners)
         self._instance_types = list(instance_types)
@@ -1050,6 +1059,9 @@ class DeltaSession:
             raise DeltaSessionUnknown(
                 "DeltaSession.solve() must establish the session before "
                 "solve_delta()")
+        # an added gang is one perturbation — audit it before it enters
+        # the ledger, same typed error as the server door (ISSUE 20)
+        gangmod.validate_batch(added)
         # 1. fold the perturbation into the cluster ledger + pending set.
         # Removals BEFORE adds, matching the server's apply order
         # (warmstart unseats removals first, then places adds), so a

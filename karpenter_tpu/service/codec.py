@@ -48,6 +48,7 @@ def encode_pod(p: PodSpec) -> pb.Pod:
     out = pb.Pod(
         name=p.name, namespace=p.namespace, priority=p.priority,
         deletion_cost=p.deletion_cost, owner=p.owner_key,
+        gang_id=p.gang_id, gang_size=p.gang_size,
     )
     for k, v in p.labels.items():
         out.labels[k] = v
@@ -263,6 +264,9 @@ def decode_pod(p: pb.Pod) -> PodSpec:
         deletion_cost=p.deletion_cost or 1.0,
         owner_key=p.owner,
         volume_zone_requirements=[_dreq(r) for r in p.volume_zone_requirements],
+        # old wire bytes carry no gang tags and decode to ""/0 = ungrouped
+        gang_id=p.gang_id,
+        gang_size=p.gang_size,
     )
 
 

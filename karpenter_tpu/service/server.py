@@ -24,6 +24,7 @@ from typing import Optional
 import grpc
 
 from .. import faults as faults_mod
+from .. import gang as gangmod
 from ..admission import (
     AdmissionControl,
     SolveDeadlineError,
@@ -1104,6 +1105,12 @@ class SolvePipeline:
         # only has to carry these.
         watch = {p.name for p in pods}
         watch.update(info["removed"])
+        if gangmod.gang_enabled() and info["removed"]:
+            # a member removal retracts the WHOLE gang (ISSUE 20): the
+            # comembers' seats change too, so the delta reply must carry
+            # them — the scheduler's own expansion decides their fate
+            watch.update(gangmod.expand_gang_removals(
+                prev, info["removed"])[0])
         watch.update(prev.infeasible)
         meta = getattr(prev, "_warmstart_meta", None)
         if meta is not None:
@@ -1537,6 +1544,18 @@ class SolverService:
 
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
         kwargs = codec.decode_request(request)
+        # gang audit at the door (ISSUE 20, docs/GANGS.md): a malformed
+        # gang (members disagreeing on gang_size, oversubscribed roster)
+        # refuses WHOLE with INVALID_ARGUMENT before admission ever queues
+        # it — the gang is one ticket, so refusal is all-or-nothing too.
+        # A well-formed request stays one admission unit either way: a
+        # shed sheds the whole request, gangs included.
+        try:
+            gangmod.validate_batch(kwargs.get("pods", ()))
+        except gangmod.GangValidationError as err:
+            if context is None:
+                raise
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
         sess = codec.decode_delta_fields(request)
         sched = self._scheduler_for(request.backend)
         pclass = parse_class(getattr(request, "priority_class", ""))
@@ -1566,6 +1585,13 @@ class SolverService:
                 n_pods=len(kwargs.get("pods", ())), priority_class=pclass,
                 delta=bool(sess and sess["delta"]),
                 **({"session_id": sess["session_id"]} if sess else {}),
+                # gang-bearing batches record their admission-unit count
+                # (each gang = ONE ticket): n_pods vs gang_units is the
+                # trace-visible gang compression of the request
+                **({"gang_units": gangmod.admission_units(
+                        kwargs.get("pods", ()))}
+                   if gangmod.gang_enabled()
+                   and gangmod.has_gangs(kwargs.get("pods", ())) else {}),
             ) as trace:
                 kwargs["trace"] = trace
                 if self._pipelined:

@@ -39,6 +39,7 @@ from ..metrics import (
     CONSOLIDATION_SWEEPS,
     Registry,
 )
+from ..gang import nodes_carry_gangs
 from ..models import labels as L
 from ..obs.trace import NULL_TRACE
 from .types import SimNode, SolveResult, node_classes
@@ -560,6 +561,12 @@ def sweep_what_ifs(
                 # scheduler.solve([]) answer
                 results[k] = SolveResult(nodes=[], assignments={},
                                          infeasible={})
+                continue
+            if nodes_carry_gangs([all_nodes[i] for i in candidates[k]]):
+                # gang what-ifs re-seat the ENTIRE gang or the candidate
+                # fails (ISSUE 20): only the serial path's gang epilogue
+                # audits that (preseated-comember counting, typed
+                # retraction) — the vmapped slot answer has no epilogue
                 continue
             try:
                 hardened = [_harden_preferences(p) for p in pods]

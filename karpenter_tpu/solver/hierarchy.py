@@ -71,6 +71,7 @@ from ..metrics import (
     HIER_SOLVES,
     Registry,
 )
+from ..gang import gang_enabled
 from ..obs.trace import NULL_TRACE
 from .types import SimNode, SolveResult
 
@@ -182,6 +183,18 @@ def coupling_components(st) -> List[List[int]]:
                 continue
             for g in it:
                 uf.union(first, g)
+    # gang never-split (ISSUE 20): groups carrying the same gang tag join
+    # one component — the partition must hand an entire gang to one block,
+    # or the per-block solves could each place a legal-looking fragment
+    # the all-or-nothing epilogue would then have to retract whole
+    g_gang = np.asarray(getattr(st, "g_gang", np.zeros(0, dtype=np.int32)))
+    if g_gang.size and gang_enabled():
+        first_of: Dict[int, int] = {}
+        for gi in np.nonzero(g_gang >= 0)[0]:
+            tag = int(g_gang[gi])
+            anchor = first_of.setdefault(tag, int(gi))
+            if anchor != int(gi):
+                uf.union(anchor, int(gi))
     comps: Dict[int, List[int]] = {}
     for gi in range(G):
         comps.setdefault(uf.find(gi), []).append(gi)

@@ -82,6 +82,7 @@ from ..metrics import (
     Registry,
     registry as default_registry,
 )
+from ..gang import gang_fixed
 from ..models import labels as L
 from ..obs.trace import NULL_TRACE
 from .types import SimNode, SolveResult
@@ -390,6 +391,11 @@ def eligible_partition(st, result: SolveResult):
                 or st.g_host_paff[gi] >= 0 or bool(watched[gi])):
             continue
         if rep.volume_claims or rep.volume_zone_requirements or rep.is_daemon:
+            continue
+        if gang_fixed(rep):
+            # gang members are relax-INELIGIBLE (ISSUE 20): their scan
+            # seats are fixed boundary conditions the gang epilogue audits
+            # and packs — the rung must not move them out from under it
             continue
         if not bool(np.all(dom_ok[gi] | ~avail_dom)):
             continue  # zone/ct pinning: the node's domain choice couples

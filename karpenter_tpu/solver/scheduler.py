@@ -566,6 +566,10 @@ class BatchScheduler:
         from .hierarchy import zero_init_hier_metrics as _hier_zero_init
 
         _hier_zero_init(self.registry)
+        # gang outcome series exist before the first ganged batch (KT003)
+        from ..gang import zero_init_gang_metrics as _gang_zero_init
+
+        _gang_zero_init(self.registry)
         # hierarchical re-entrancy depth: repair solves issued from inside
         # solve_hierarchical must never route hierarchically themselves
         self._hier_depth = 0
@@ -706,12 +710,38 @@ class BatchScheduler:
                 relax=None if relax_delta_enabled() else False,
             )
 
-        return warmstart.delta_solve(
+        # gang composition (ISSUE 20, docs/GANGS.md): a member removal
+        # retracts the WHOLE gang — seated comembers join the removal set
+        # and surface as unplaced with the typed GangUnplaced reason
+        from .. import gang as gangmod
+
+        gang_retracted: Dict[str, str] = {}
+        if gangmod.gang_enabled() and removed:
+            removed, gang_retracted = gangmod.expand_gang_removals(
+                prev, removed)
+
+        out = warmstart.delta_solve(
             prev, added, removed, iced,
             solve_displaced=_solve, solve_full=_solve_full,
             max_delta_frac=max_delta_frac, registry=self.registry,
             unavailable=unavailable, force_full=force_full,
         )
+        # a gang add places atomically or falls back to the FULL solve:
+        # when the incremental tier left an added gang (wholly, post-
+        # epilogue) unplaced, re-solve everything from the stripped base —
+        # one more chance before the typed verdict stands.  The warm-start
+        # retention dict re-offers the failed members to the full solve.
+        if (gangmod.gang_enabled() and out.mode != "full" and added
+                and gangmod.delta_needs_full(out.result, added)):
+            out = warmstart.delta_solve(
+                out.result, (), (), (),
+                solve_displaced=_solve, solve_full=_solve_full,
+                max_delta_frac=max_delta_frac, registry=self.registry,
+                unavailable=unavailable, force_full=True,
+            )
+        for name, reason in gang_retracted.items():
+            out.result.infeasible.setdefault(name, reason)
+        return out
 
     #: capability probe for SolvePipeline._flush: this scheduler's
     #: submit_many accepts flush_reason= and owns the MEGABATCH_FLUSH
@@ -1024,6 +1054,36 @@ class BatchScheduler:
                     daemonsets, unavailable, allow_new_nodes,
                     max_new_nodes, relax, trace,
                 )
+
+                # gang all-or-nothing + co-location epilogue (ISSUE 20,
+                # karpenter_tpu/gang/): after the relax rung — gang groups
+                # are relax-INELIGIBLE (relax.eligible_partition), so their
+                # scan seats are fixed boundary conditions by the time the
+                # epilogue audits, retracts, and packs them
+                from .. import gang as gangmod
+
+                if gangmod.gang_enabled() and gangmod.has_gangs(pods):
+                    with trace.span("gang") as gang_span:
+                        result = gangmod.run_epilogue(
+                            result, pods,
+                            registry=self.registry,
+                            # a retraction that would disturb watched spread/
+                            # affinity accounting re-solves the keep-set from
+                            # the pristine pre-solve existing nodes
+                            resolve=lambda keep: self._solve_wave(
+                                keep, provisioners, instance_types,
+                                list(existing_nodes), daemonsets, unavailable,
+                                allow_new_nodes, max_new_nodes, trace=trace),
+                            provisioners=provisioners,
+                            instance_types=instance_types,
+                            daemonsets=daemonsets,
+                            unavailable=unavailable,
+                            allow_new_nodes=allow_new_nodes,
+                            max_new_nodes=max_new_nodes,
+                            in_band=self._reseat_in_band,
+                            trace=gang_span,
+                        )
+
                 trace.annotate(
                     served_cold=result.served_cold,
                     n_nodes=len(result.nodes),

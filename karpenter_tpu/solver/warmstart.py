@@ -60,6 +60,7 @@ from ..metrics import (
     Registry,
     registry as default_registry,
 )
+from ..gang import gang_fixed
 from ..models import labels as L
 from ..models.pod import PodSpec
 from .types import SimNode, SolveResult, node_classes
@@ -524,7 +525,12 @@ def delta_solve(
         own, foreign = _matched_terms(meta, p)
         if foreign:
             return _full()
-        if own or _has_constraints(p) or p.volume_claims or p.is_daemon:
+        # gang members never take the host fast path: only the scan
+        # subproblem runs the gang epilogue, and the host first-fit could
+        # otherwise seat an INCOMPLETE gang (short of its declared size)
+        # with no all-or-nothing audit (ISSUE 20, docs/GANGS.md)
+        if (own or _has_constraints(p) or p.volume_claims or p.is_daemon
+                or gang_fixed(p)):
             host_ok = False
 
     if host_ok:

@@ -9,6 +9,7 @@ judged on the whole distribution before touching the ceilings.
     python scripts/fuzz_sweep.py --delta-wire [n_seeds] [chain_len]
     python scripts/fuzz_sweep.py --relax [n_seeds]
     python scripts/fuzz_sweep.py --hier [n_seeds]
+    python scripts/fuzz_sweep.py --gang [n_seeds]
 
 ``--cached`` re-solves every scenario a second time through ONE scheduler
 instance, so the second pass runs the incremental tensorize cache
@@ -39,6 +40,18 @@ partition must never split a constraint-reachability component across
 blocks — asserted structurally on random adversarial scenarios under
 forced block pressure — and (c) on an overlapping scenario the repair
 pass must leave no pod unseated that flat seats.
+
+``--gang`` (ISSUE 20) fuzzes the all-or-nothing gang contract
+(karpenter_tpu/gang/, docs/GANGS.md): per seed, random scenarios whose
+deployments are randomly promoted to gangs (some deliberately doomed by
+an unsatisfiable member, some submitted with an incomplete roster) solve
+through the full scheduler and the sweep HARD-asserts (a) no gang is
+ever partially placed — every gang's members are all in ``assignments``
+or all in ``infeasible`` with the typed ``GangUnplaced`` reason, (b) the
+shipped solution passes the ground-truth validator, and (c) the
+gang-free singleton subset's per-pod cost stays within the plain fuzz
+ceiling of the reference oracle (the gang path must not tax ungrouped
+pods).
 
 ``--delta-wire`` (ISSUE 10) drives the same random churn chains through a
 REAL gRPC client/server pair — ``DeltaSession`` against an in-process
@@ -71,12 +84,13 @@ from karpenter_tpu.solver.scheduler import BatchScheduler
 
 argv = [a for a in sys.argv[1:]
         if a not in ("--cached", "--delta", "--delta-wire", "--relax",
-                     "--hier")]
+                     "--hier", "--gang")]
 cached = "--cached" in sys.argv[1:]
 delta = "--delta" in sys.argv[1:]
 delta_wire = "--delta-wire" in sys.argv[1:]
 relax_mode = "--relax" in sys.argv[1:]
 hier_mode = "--hier" in sys.argv[1:]
+gang_mode = "--gang" in sys.argv[1:]
 catalog = generate_catalog(full=False)
 
 
@@ -493,12 +507,126 @@ def run_hier_seeds(n_seeds: int) -> int:
     return failures
 
 
+def _gangify(seed: int, pods):
+    """Randomly promote whole deployments (owner_key groups) to gangs:
+    ~half the groups become gangs, one in four gangs is DOOMED by giving
+    a member an unsatisfiable zone pin, and one in five is submitted with
+    an incomplete roster (declared size > submitted members) — both must
+    retract whole.  Returns (pods, gangs: {gid: [names]}, doomed: {gid})."""
+    import dataclasses
+    import random
+
+    from karpenter_tpu.models import labels as L
+
+    rng = random.Random(88_000 + seed)
+    groups = {}
+    for p in pods:
+        groups.setdefault(p.owner_key or p.name, []).append(p)
+    out, gangs, doomed = [], {}, set()
+    for gi, (owner, members) in enumerate(sorted(groups.items())):
+        if len(members) < 2 or rng.random() < 0.5:
+            out.extend(members)
+            continue
+        gid = f"fzg{seed}-{gi}"
+        size = len(members)
+        kind = rng.random()
+        if kind < 0.20:
+            # incomplete roster: declare more ranks than the batch carries
+            size = len(members) + rng.randint(1, 3)
+            doomed.add(gid)
+        marked = [dataclasses.replace(p, gang_id=gid, gang_size=size)
+                  for p in members]
+        if 0.20 <= kind < 0.40:
+            # unsatisfiable member: a zone no catalog offering serves
+            j = rng.randrange(len(marked))
+            marked[j] = dataclasses.replace(
+                marked[j],
+                node_selector={**marked[j].node_selector,
+                               L.ZONE: "zone-none"})
+            doomed.add(gid)
+        gangs[gid] = [p.name for p in marked]
+        out.extend(marked)
+    return out, gangs, doomed
+
+
+def run_gang_seeds(n_seeds: int) -> int:
+    """All-or-nothing gang fuzz (ISSUE 20); returns the number of failing
+    seeds.  Per seed: no partial gang, typed retraction reasons,
+    ground-truth validity, singleton-subset cost ceiling vs the gang-free
+    oracle."""
+    from test_fuzz_parity import FUZZ_PARITY
+
+    failures = 0
+    placed_total = retracted_total = 0
+    for seed in range(n_seeds):
+        problems = []
+        base, provs, unavailable = random_scenario(seed, catalog)
+        pods, gangs, doomed = _gangify(seed, base)
+        sched = BatchScheduler(backend="tpu")
+        res = sched.solve(pods, provs, catalog, unavailable=unavailable)
+        # (a) the contract: every gang fully places or fully retracts
+        for gid, names in gangs.items():
+            placed = [n for n in names if n in res.assignments]
+            if placed and len(placed) != len(names):
+                problems.append(
+                    f"gang {gid} PARTIAL: {len(placed)}/{len(names)} placed")
+                continue
+            if not placed:
+                retracted_total += 1
+                untyped = [n for n in names if n not in res.infeasible]
+                if untyped:
+                    problems.append(
+                        f"gang {gid} retracted but {untyped[:3]} carry no "
+                        "infeasible reason")
+                elif not any(
+                        str(res.infeasible[n]).startswith("GangUnplaced")
+                        for n in names):
+                    problems.append(
+                        f"gang {gid} retracted without a typed "
+                        f"GangUnplaced reason: {res.infeasible[names[0]]}")
+            else:
+                placed_total += 1
+                if gid in doomed:
+                    problems.append(
+                        f"gang {gid} placed despite an engineered dooming")
+        # (b) ground-truth validity of whatever shipped
+        errs = validate_solution(pods, provs, res, catalog)
+        if errs:
+            problems.append(f"validator: {errs[:2]}")
+        # (c) the gang path must not tax ungrouped pods: solve the
+        # singleton subset alone (gang machinery armed, zero gangs) and
+        # hold the plain fuzz ceiling vs the gang-free reference oracle
+        singles = [p for p in pods if not p.gang_id]
+        if singles:
+            oracle = reference.solve(singles, provs, catalog,
+                                     unavailable=unavailable)
+            tpu = BatchScheduler(backend="tpu").solve(
+                singles, provs, catalog, unavailable=unavailable)
+            if (oracle.new_node_cost > 0 and tpu.n_scheduled
+                    and oracle.n_scheduled):
+                r = (tpu.new_node_cost / tpu.n_scheduled) / (
+                    oracle.new_node_cost / oracle.n_scheduled)
+                if r > FUZZ_PARITY + 1e-9:
+                    problems.append(f"singleton cost ratio {r:.4f}")
+        tag = "OK " if not problems else "FAIL"
+        print(f"gang seed {seed}: {tag} gangs={len(gangs)} "
+              f"doomed={len(doomed)}"
+              + (f" {problems}" if problems else ""))
+        failures += bool(problems)
+    print(f"gang sweep: {placed_total} placed, {retracted_total} retracted "
+          f"over {n_seeds} seeds")
+    return failures
+
+
 if relax_mode:
     n_seeds = int(argv[0]) if len(argv) > 0 else 25
     sys.exit(1 if run_relax_seeds(n_seeds) else 0)
 if hier_mode:
     n_seeds = int(argv[0]) if len(argv) > 0 else 12
     sys.exit(1 if run_hier_seeds(n_seeds) else 0)
+if gang_mode:
+    n_seeds = int(argv[0]) if len(argv) > 0 else 20
+    sys.exit(1 if run_gang_seeds(n_seeds) else 0)
 if delta_wire:
     n_seeds = int(argv[0]) if len(argv) > 0 else 10
     chain_len = int(argv[1]) if len(argv) > 1 else 4

@@ -73,6 +73,14 @@ NEW_FIELDS = {
         # chain-identity nonce echo (ISSUE 17, see SolveRequest 21)
         (10, "session_nonce", F.TYPE_STRING, F.LABEL_OPTIONAL),
     ],
+    # gang scheduling (ISSUE 20, docs/GANGS.md): members of one gang share
+    # a gang_id and declare the gang's total size.  Old bytes decode to
+    # ""/0 = ungrouped; old decoders skip the tags — a mixed-version fleet
+    # simply schedules gang pods individually (pre-gang semantics).
+    "Pod": [
+        (14, "gang_id", F.TYPE_STRING, F.LABEL_OPTIONAL),
+        (15, "gang_size", F.TYPE_INT32, F.LABEL_OPTIONAL),
+    ],
 }
 
 

@@ -1,0 +1,457 @@
+"""Gang scheduling (ISSUE 20, docs/GANGS.md): all-or-nothing pod groups.
+
+The contract under test: a gang either FULLY places or every member
+returns unplaced with the typed ``GangUnplaced`` reason — never a
+partial placement.  Enforced on the full-solve path (the epilogue
+audit), on delta perturbations over real gRPC (atomic add or whole
+fallback; one member's removal retracts every comember), through the
+hierarchy partition (a gang is never split across blocks), through
+consolidation what-ifs (whole-gang reseat or rejection), and OFF via
+the ``KT_GANG=0`` kill switch (gang-free batches byte-identical, tagged
+batches back to per-pod behavior).
+"""
+
+import dataclasses
+
+import pytest
+
+from karpenter_tpu import gang
+from karpenter_tpu.metrics import (
+    GANG_DURATION,
+    GANG_GANGS,
+    GANG_OUTCOMES,
+    Registry,
+)
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.instancetype import GIB
+from karpenter_tpu.models.pod import PodSpec
+from karpenter_tpu.models.provisioner import Provisioner
+from karpenter_tpu.solver.scheduler import BatchScheduler
+from karpenter_tpu.solver.types import SimNode
+
+
+def member(gid, i, size, cpu=0.5, sel=None, labels=None):
+    return PodSpec(
+        name=f"{gid}-m{i}", labels=dict(labels or {"app": gid}),
+        requests={"cpu": cpu, "memory": 0.5 * GIB},
+        node_selector=dict(sel or {}), owner_key=gid,
+        gang_id=gid, gang_size=size)
+
+
+def singles(tag, n, cpu=0.5):
+    return [PodSpec(name=f"{tag}-{i}", labels={"app": tag},
+                    requests={"cpu": cpu, "memory": 0.5 * GIB},
+                    owner_key=tag)
+            for i in range(n)]
+
+
+def gang_outcome(res, members):
+    placed = [p for p in members if p.name in res.assignments]
+    if len(placed) == len(members):
+        return "placed"
+    assert not placed, (
+        f"PARTIAL gang: {len(placed)}/{len(members)} seated — "
+        "the all-or-nothing contract is broken")
+    return "retracted"
+
+
+class TestAtomicity:
+    """All-or-nothing on the full-solve path, under injected member
+    infeasibility — the tentpole's core claim."""
+
+    def test_feasible_gang_places_whole(self, small_catalog):
+        provs = [Provisioner(name="default").with_defaults()]
+        pods = [member("ga", i, 4) for i in range(4)] + singles("s", 6)
+        res = BatchScheduler(backend="oracle").solve(
+            pods, provs, small_catalog)
+        assert gang_outcome(res, pods[:4]) == "placed"
+        assert all(f"s-{i}" in res.assignments for i in range(6))
+
+    def test_unsatisfiable_member_retracts_every_seat(self, small_catalog):
+        """One member pinned to a zone no offering serves: its comembers
+        are individually feasible, and every one of them must still come
+        back out — typed."""
+        provs = [Provisioner(name="default").with_defaults()]
+        doomed = [member("gx", i, 5) for i in range(5)]
+        doomed[2] = dataclasses.replace(
+            doomed[2], node_selector={L.ZONE: "zone-none"})
+        pods = doomed + singles("s", 6)
+        res = BatchScheduler(backend="oracle").solve(
+            pods, provs, small_catalog)
+        assert gang_outcome(res, doomed) == "retracted"
+        for p in doomed:
+            assert str(res.infeasible[p.name]).startswith("GangUnplaced"), \
+                res.infeasible[p.name]
+        # the retraction is surgical: singleton bystanders keep their seats
+        assert all(f"s-{i}" in res.assignments for i in range(6))
+
+    def test_incomplete_roster_waits_whole(self, small_catalog):
+        """gang_size declares 8 ranks; only 3 arrived.  Individually
+        feasible, collectively not yet a gang — zero seats."""
+        provs = [Provisioner(name="default").with_defaults()]
+        early = [member("gw", i, 8) for i in range(3)]
+        res = BatchScheduler(backend="oracle").solve(
+            early + singles("s", 4), provs, small_catalog)
+        assert gang_outcome(res, early) == "retracted"
+        assert "could seat only" in str(res.infeasible["gw-m0"])
+
+    def test_preseated_comembers_complete_the_roster(self, small_catalog):
+        """2 of 4 ranks already run on an existing node; the batch brings
+        the other 2.  The audit counts the seated comembers — the gang
+        places."""
+        provs = [Provisioner(name="default").with_defaults()]
+        node = SimNode(
+            instance_type="m5.xlarge", provisioner="default",
+            zone="zone-1a", capacity_type="on-demand", price=0.192,
+            allocatable={L.RESOURCE_CPU: 4.0,
+                         L.RESOURCE_MEMORY: 14.8 * GIB,
+                         L.RESOURCE_PODS: 110.0},
+            existing=True, name="gex0")
+        node.stamp_labels()
+        for i in (0, 1):
+            node.pods.append(member("gp", i, 4))
+        late = [member("gp", i, 4) for i in (2, 3)]
+        res = BatchScheduler(backend="oracle").solve(
+            late, provs, small_catalog, existing_nodes=[node])
+        assert gang_outcome(res, late) == "placed"
+
+    def test_preseated_majority_never_masks_an_unplaced_member(
+            self, small_catalog):
+        """3 of 4 ranks preseated, the 4th arrives unsatisfiable: the
+        preseated count exceeds nothing — ANY unplaced batch member dooms
+        the gang."""
+        provs = [Provisioner(name="default").with_defaults()]
+        node = SimNode(
+            instance_type="m5.2xlarge", provisioner="default",
+            zone="zone-1a", capacity_type="on-demand", price=0.384,
+            allocatable={L.RESOURCE_CPU: 8.0,
+                         L.RESOURCE_MEMORY: 29.6 * GIB,
+                         L.RESOURCE_PODS: 110.0},
+            existing=True, name="gex1")
+        node.stamp_labels()
+        for i in (0, 1, 2):
+            node.pods.append(member("gm", i, 4))
+        last = dataclasses.replace(
+            member("gm", 3, 4), node_selector={L.ZONE: "zone-none"})
+        res = BatchScheduler(backend="oracle").solve(
+            [last], provs, small_catalog, existing_nodes=[node])
+        assert last.name not in res.assignments
+        assert str(res.infeasible[last.name]).startswith("GangUnplaced")
+
+
+class TestKillSwitch:
+    def test_gang_free_batches_are_byte_identical(self, small_catalog,
+                                                  monkeypatch):
+        provs = [Provisioner(name="default").with_defaults()]
+        pods = singles("kf", 20) + singles("kg", 10, cpu=1.0)
+        on = BatchScheduler(backend="oracle").solve(
+            pods, provs, small_catalog)
+        monkeypatch.setenv("KT_GANG", "0")
+        off = BatchScheduler(backend="oracle").solve(
+            pods, provs, small_catalog)
+
+        def canon(res):
+            # node NAMES come from the process-global SimNode counter —
+            # compare placements name-independently
+            by_node = {n.name: (n.instance_type, n.zone, n.capacity_type,
+                                tuple(sorted(p.name for p in n.pods)))
+                       for n in res.nodes}
+            return {pn: by_node.get(nn)
+                    for pn, nn in res.assignments.items()}
+
+        assert canon(on) == canon(off)
+        assert on.infeasible == off.infeasible
+
+    def test_kill_switch_restores_per_pod_behavior(self, small_catalog,
+                                                   monkeypatch):
+        """KT_GANG=0: the doomed gang's feasible members seat per-pod —
+        the pre-gang partial placement, byte-for-byte the old contract."""
+        monkeypatch.setenv("KT_GANG", "0")
+        provs = [Provisioner(name="default").with_defaults()]
+        doomed = [member("gz", i, 4) for i in range(4)]
+        doomed[0] = dataclasses.replace(
+            doomed[0], node_selector={L.ZONE: "zone-none"})
+        res = BatchScheduler(backend="oracle").solve(
+            doomed, provs, small_catalog)
+        assert all(p.name in res.assignments for p in doomed[1:])
+        assert "gz-m0" in res.infeasible
+        assert not str(res.infeasible["gz-m0"]).startswith("GangUnplaced")
+
+
+class TestValidation:
+    def test_disagreeing_sizes_refused(self):
+        bad = [member("gv", 0, 4), dataclasses.replace(
+            member("gv", 1, 4), gang_size=5)]
+        with pytest.raises(gang.GangValidationError):
+            gang.validate_batch(bad)
+
+    def test_oversubscribed_roster_refused(self):
+        bad = [member("gv", i, 2) for i in range(3)]
+        with pytest.raises(gang.GangValidationError):
+            gang.validate_batch(bad)
+
+    def test_nonpositive_size_refused(self):
+        with pytest.raises(gang.GangValidationError):
+            gang.validate_batch([dataclasses.replace(
+                member("gv", 0, 1), gang_size=-2)])
+
+    def test_admission_units_count_each_gang_once(self):
+        pods = (singles("u", 4) + [member("ga", i, 3) for i in range(3)]
+                + [member("gb", i, 2) for i in range(2)])
+        assert gang.admission_units(pods) == 4 + 1 + 1
+
+
+class TestMetricsZeroInit:
+    def test_outcome_series_born_at_zero(self):
+        reg = Registry()
+        BatchScheduler(backend="auto", registry=reg)
+        c = reg.counter(GANG_GANGS)
+        for outcome in GANG_OUTCOMES:
+            assert c.has({"outcome": outcome}), \
+                f"{GANG_GANGS}{{outcome={outcome}}} missing at construction"
+            assert c.get({"outcome": outcome}) == 0.0
+
+    def test_reconstruction_does_not_clobber(self, small_catalog):
+        reg = Registry()
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        provs = [Provisioner(name="default").with_defaults()]
+        doomed = [member("gc", i, 9) for i in range(2)]
+        sched.solve(doomed, provs, small_catalog)
+        before = reg.counter(GANG_GANGS).get({"outcome": "retracted"})
+        assert before >= 1.0
+        BatchScheduler(backend="oracle", registry=reg)
+        assert reg.counter(GANG_GANGS).get(
+            {"outcome": "retracted"}) == before
+
+    def test_retraction_observes_duration(self, small_catalog):
+        reg = Registry()
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        provs = [Provisioner(name="default").with_defaults()]
+        sched.solve([member("gd", i, 9) for i in range(2)],
+                    provs, small_catalog)
+        assert sum(reg.histogram(GANG_DURATION).totals.values()) >= 1
+
+
+class TestHierarchyNeverSplit:
+    def test_gang_members_share_one_coupling_component(self, small_catalog):
+        """Two member shapes of one gang tensorize as two groups with no
+        shared constraint surface — the gang tag alone must union them so
+        the partition can never split the gang across blocks."""
+        import numpy as np
+
+        from karpenter_tpu.models.tensorize import tensorize
+        from karpenter_tpu.solver import hierarchy as H
+
+        provs = [Provisioner(name="default").with_defaults()]
+        a = [member("gh", i, 6, cpu=0.5, labels={"app": "gh-a"})
+             for i in range(3)]
+        b = [dataclasses.replace(
+                member("gh", i + 3, 6, cpu=1.5, labels={"app": "gh-b"}))
+             for i in range(3)]
+        loose = singles("hs", 4)
+        st = tensorize(a + b + loose, provs, small_catalog)
+        g_gang = np.asarray(st.g_gang)
+        tagged = [gi for gi in range(len(st.groups)) if g_gang[gi] >= 0]
+        assert len(tagged) >= 2, "expected the gang to span >=2 groups"
+        comps = H.coupling_components(st)
+        owner = {gi: ci for ci, comp in enumerate(comps) for gi in comp}
+        assert len({owner[gi] for gi in tagged}) == 1, \
+            "gang groups split across coupling components"
+
+    def test_kill_switch_drops_the_coupling(self, small_catalog,
+                                            monkeypatch):
+        import numpy as np
+
+        from karpenter_tpu.models.tensorize import tensorize
+        from karpenter_tpu.solver import hierarchy as H
+
+        monkeypatch.setenv("KT_GANG", "0")
+        provs = [Provisioner(name="default").with_defaults()]
+        a = [member("gh", i, 6, cpu=0.5, labels={"app": "gh-a"})
+             for i in range(3)]
+        b = [member("gh", i + 3, 6, cpu=1.5, labels={"app": "gh-b"})
+             for i in range(3)]
+        st = tensorize(a + b, provs, small_catalog)
+        g_gang = np.asarray(st.g_gang)
+        tagged = [gi for gi in range(len(st.groups)) if g_gang[gi] >= 0]
+        comps = H.coupling_components(st)
+        owner = {gi: ci for ci, comp in enumerate(comps) for gi in comp}
+        assert len({owner[gi] for gi in tagged}) == 2
+
+
+class TestConsolidationWholeGang:
+    def _cluster(self):
+        nodes = []
+        for i in range(3):
+            n = SimNode(
+                instance_type="m5.xlarge", provisioner="default",
+                zone="zone-1a", capacity_type="on-demand", price=0.192,
+                allocatable={L.RESOURCE_CPU: 4.0,
+                             L.RESOURCE_MEMORY: 14.8 * GIB,
+                             L.RESOURCE_PODS: 110.0},
+                existing=True, name=f"cw{i}")
+            n.stamp_labels()
+            nodes.append(n)
+        # node 0 carries the whole gang; 1-2 carry singletons
+        for i in range(3):
+            nodes[0].pods.append(member("gc", i, 3))
+        for i in (1, 2):
+            for j in range(2):
+                nodes[i].pods.append(PodSpec(
+                    name=f"cw{i}-p{j}", labels={"app": "cs"},
+                    requests={"cpu": 0.5, "memory": 0.5 * GIB},
+                    owner_key="cs"))
+        return nodes
+
+    def test_gang_what_if_reseats_whole_or_fails(self, small_catalog):
+        from karpenter_tpu.solver.consolidation import sweep_what_ifs
+
+        nodes = self._cluster()
+        reg = Registry()
+        sched = BatchScheduler(backend="oracle", registry=reg)
+        provs = [Provisioner(name="default").with_defaults()]
+        out = sweep_what_ifs(
+            sched, nodes, [[0], [1]], provisioners=provs,
+            instance_types=small_catalog, registry=reg)
+        gang_res = out.results[0]
+        assert not isinstance(gang_res, Exception)
+        names = {f"gc-m{i}" for i in range(3)}
+        seated = names & set(gang_res.assignments)
+        assert seated in (names, set()), \
+            f"consolidation what-if split the gang: {seated}"
+        if not seated:
+            assert all(str(gang_res.infeasible[n]).startswith("GangUnplaced")
+                       for n in names)
+
+    def test_parity_with_direct_solve(self, small_catalog):
+        """The sweep's gang-candidate answer equals the serial what-if the
+        deprovisioner would have computed itself — same seated set."""
+        from karpenter_tpu.solver.consolidation import sweep_what_ifs
+
+        nodes = self._cluster()
+        provs = [Provisioner(name="default").with_defaults()]
+        sched = BatchScheduler(backend="oracle")
+        out = sweep_what_ifs(
+            sched, nodes, [[0]], provisioners=provs,
+            instance_types=small_catalog)
+        direct = BatchScheduler(backend="oracle").solve(
+            [dataclasses.replace(p) for p in self._cluster()[0].pods],
+            provs, small_catalog,
+            existing_nodes=[n for n in self._cluster() if n.name != "cw0"],
+            allow_new_nodes=True, max_new_nodes=1)
+        assert set(out.results[0].assignments) == set(direct.assignments)
+
+
+class TestDeltaOverWire:
+    """Gang perturbations over real gRPC: atomic add, whole retraction on
+    a member removal, typed surfaces on the client's merged view."""
+
+    @pytest.fixture()
+    def server(self):
+        from karpenter_tpu.service.server import SolverService, make_server
+
+        reg = Registry()
+        service = SolverService(
+            BatchScheduler(backend="oracle", registry=reg), registry=reg)
+        srv, port = make_server(service, port=0)
+        yield service, port
+        srv.stop(grace=None)
+        service.close()
+
+    def test_gang_add_places_atomically(self, server, small_catalog):
+        from karpenter_tpu.service.client import DeltaSession
+
+        _service, port = server
+        provs = [Provisioner(name="default").with_defaults()]
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        sess.solve(singles("b", 12), provs, small_catalog)
+        add = [member("gw", i, 3) for i in range(3)]
+        res = sess.solve_delta(added=add)
+        assert all(p.name in res.assignments for p in add)
+        sess.close()
+
+    def test_doomed_gang_add_retracts_whole_over_the_wire(
+            self, server, small_catalog):
+        from karpenter_tpu.service.client import DeltaSession
+
+        _service, port = server
+        provs = [Provisioner(name="default").with_defaults()]
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        base = singles("b", 12)
+        sess.solve(base, provs, small_catalog)
+        add = [member("gd", i, 4) for i in range(4)]
+        add[1] = dataclasses.replace(
+            add[1], node_selector={L.ZONE: "zone-none"})
+        res = sess.solve_delta(added=add)
+        assert not any(p.name in res.assignments for p in add)
+        for p in add:
+            assert str(res.infeasible[p.name]).startswith("GangUnplaced")
+        # bystanders from the base batch keep their seats
+        assert all(p.name in res.assignments for p in base)
+        sess.close()
+
+    def test_member_removal_retracts_every_comember(self, server,
+                                                    small_catalog):
+        from karpenter_tpu.service.client import DeltaSession
+
+        _service, port = server
+        provs = [Provisioner(name="default").with_defaults()]
+        sess = DeltaSession(f"127.0.0.1:{port}")
+        g = [member("gr", i, 3) for i in range(3)]
+        sess.solve(singles("b", 10) + g, provs, small_catalog)
+        res = sess.solve_delta(removed=["gr-m0"])
+        assert not any(p.name in res.assignments for p in g)
+        for name in ("gr-m1", "gr-m2"):
+            assert str(res.infeasible[name]).startswith("GangUnplaced"), \
+                res.infeasible.get(name)
+        assert all(f"b-{i}" in res.assignments for i in range(10))
+        sess.close()
+
+    def test_malformed_gang_refused_at_the_door(self, server,
+                                                small_catalog):
+        """The facade validates client-side; a raw request (an old or
+        foreign client) must still be refused AT the server door with
+        INVALID_ARGUMENT — all-or-nothing applies to refusal too."""
+        import grpc
+
+        from karpenter_tpu.service import codec
+        from karpenter_tpu.service.client import SolverClient
+
+        _service, port = server
+        provs = [Provisioner(name="default").with_defaults()]
+        bad = [member("gb", 0, 4), dataclasses.replace(
+            member("gb", 1, 4), gang_size=6)]
+        client = SolverClient(f"127.0.0.1:{port}")
+        with pytest.raises(grpc.RpcError) as exc:
+            client.solve_raw(codec.encode_request(bad, provs, small_catalog))
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        client.close()
+
+    def test_facade_refuses_before_dialing(self):
+        from karpenter_tpu.service.client import DeltaSession
+
+        sess = DeltaSession("127.0.0.1:1")  # nothing listens: must not dial
+        bad = [member("gb", i, 2) for i in range(3)]
+        with pytest.raises(gang.GangValidationError):
+            sess.solve(bad, [Provisioner(name="default").with_defaults()],
+                       [])
+
+
+class TestWireCompat:
+    def test_old_bytes_decode_ungrouped(self):
+        """A pre-gang encoder leaves fields 14/15 unset; the decoder must
+        yield ''/0 — ungrouped — and the batch must validate clean."""
+        from karpenter_tpu.service import codec
+
+        p = PodSpec(name="old", requests={"cpu": 0.5})
+        wire = codec.encode_pod(p)
+        back = codec.decode_pod(wire)
+        assert back.gang_id == "" and back.gang_size == 0
+        gang.validate_batch([back])
+
+    def test_gang_fields_roundtrip(self):
+        from karpenter_tpu.service import codec
+
+        p = member("grt", 0, 7)
+        back = codec.decode_pod(codec.encode_pod(p))
+        assert back.gang_id == "grt" and back.gang_size == 7

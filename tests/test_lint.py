@@ -2457,7 +2457,10 @@ class TestWholeProgramGates:
             cache=SummaryCache(path=cache_file))
         cold = time.perf_counter() - t0
         assert active == []
-        assert cold < 5.0, f"cold whole-package lint took {cold:.2f}s"
+        # 6.5s, not 5.0: same full-suite headroom as the warm gate below —
+        # isolated cold runs sit near 2.7s, but background XLA compile
+        # threads from neighboring tests can double the wall
+        assert cold < 6.5, f"cold whole-package lint took {cold:.2f}s"
         files = collect_package_files()
         warm_cache = SummaryCache(path=cache_file)
         t1 = time.perf_counter()
@@ -3077,5 +3080,82 @@ class TestKT024KnobEnvBypass:
         from karpenter_tpu.analysis.rules import kt024
 
         active, _supp, n_files = analyze_package(rules=[kt024])
+        assert n_files > 60
+        assert active == [], "\n".join(f.format() for f in active)
+
+
+class TestKT025GangIdentityAccess:
+    ADMISSION = "karpenter_tpu/admission/queue.py"
+    SOLVER = "karpenter_tpu/solver/warmstart.py"
+
+    def test_gang_id_read_in_admission_fires(self):
+        src = """
+        def enqueue(self, pod):
+            if pod.gang_id:
+                self.groups[pod.gang_id].append(pod)
+        """
+        findings = lint(src, self.ADMISSION)
+        assert rules_of(findings) == ["KT025", "KT025"]
+        assert "`.gang_id`" in findings[0].message
+        assert "one unit" in findings[0].message
+
+    def test_gang_size_read_in_solver_fires(self):
+        src = """
+        def host_path(self, pods):
+            return [p for p in pods if p.gang_size == 0]
+        """
+        assert rules_of(lint(src, self.SOLVER)) == ["KT025"]
+
+    def test_write_fires_too(self):
+        # a solver path has no business minting membership either
+        src = """
+        def adopt(self, pod):
+            pod.gang_id = ""
+        """
+        assert rules_of(lint(src, self.SOLVER)) == ["KT025"]
+
+    def test_sanctioned_helpers_stay_quiet(self):
+        # the gang package's entry points are calls, not field reads
+        src = """
+        from ..gang import gang_fixed, gang_of, admission_units
+
+        def classify(self, pods):
+            units = admission_units(pods)
+            return [p for p in pods if not gang_fixed(p)], gang_of(pods[0])
+        """
+        assert rules_of(lint(src, self.SOLVER)) == []
+
+    def test_outside_scoped_packages_stays_quiet(self):
+        # models/pod.py declares the fields, codec moves them on/off the
+        # wire, and the gang package owns the semantics — all out of scope
+        src = """
+        def encode(self, p):
+            return (p.gang_id, p.gang_size)
+        """
+        assert rules_of(lint(src, "karpenter_tpu/service/codec.py")) == []
+        assert rules_of(lint(src, "karpenter_tpu/gang/__init__.py")) == []
+        assert rules_of(lint(src, "karpenter_tpu/models/pod.py")) == []
+
+    def test_unrelated_attribute_stays_quiet(self):
+        src = """
+        def seat(self, pod):
+            return pod.name, pod.priority
+        """
+        assert rules_of(lint(src, self.SOLVER)) == []
+
+    def test_suppression_with_reason(self):
+        src = """
+        def audit(self, pod):
+            # ktlint: allow[KT025] diagnostics-only dump, ISSUE 20
+            return pod.gang_id
+        """
+        assert rules_of(lint(src, self.SOLVER)) == []
+
+    def test_package_is_clean(self):
+        # the contract's point: admission/ and solver/ route every gang
+        # decision through karpenter_tpu.gang — zero raw field reads
+        from karpenter_tpu.analysis.rules import kt025
+
+        active, _supp, n_files = analyze_package(rules=[kt025])
         assert n_files > 60
         assert active == [], "\n".join(f.format() for f in active)

@@ -626,3 +626,40 @@ class TestSloSeries:
                                  {"outcome": outcome})
         assert ('karpenter_fleet_peer_fetch_total'
                 '{outcome="timeout"} 0') in reg.expose()
+
+
+class TestGangSeries:
+    """The gang epilogue's outcome family (ISSUE 20): every statically-
+    enumerable ``outcome`` label on the gangs counter is born at zero from
+    scheduler construction, so the FIRST retraction is rate()-visible."""
+
+    def test_gang_outcomes_born_at_zero(self):
+        from karpenter_tpu.metrics import GANG_GANGS, GANG_OUTCOMES
+
+        reg = Registry()
+        BatchScheduler(backend="auto", registry=reg)
+        c = reg.counter(GANG_GANGS)
+        for outcome in GANG_OUTCOMES:
+            assert series_exists(c, {"outcome": outcome}), \
+                f"{GANG_GANGS}{{outcome={outcome}}} missing at construction"
+            assert c.get({"outcome": outcome}) == 0.0
+
+    def test_gang_zeros_survive_into_exposition(self):
+        from karpenter_tpu.metrics import GANG_OUTCOMES
+
+        reg = Registry()
+        BatchScheduler(backend="auto", registry=reg)
+        text = reg.expose()
+        for outcome in GANG_OUTCOMES:
+            assert (f'karpenter_solver_gang_gangs_total'
+                    f'{{outcome="{outcome}"}} 0') in text
+
+    def test_gang_reconstruction_does_not_clobber(self):
+        from karpenter_tpu.metrics import GANG_GANGS
+
+        reg = Registry()
+        BatchScheduler(backend="auto", registry=reg)
+        reg.counter(GANG_GANGS).inc({"outcome": "retracted"})
+        BatchScheduler(backend="oracle", registry=reg)
+        assert reg.counter(GANG_GANGS).get(
+            {"outcome": "retracted"}) == 1.0
